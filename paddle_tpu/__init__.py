@@ -1,0 +1,80 @@
+"""paddle_tpu: a TPU-native deep learning framework with PaddlePaddle's user
+surface (reference: tianyan01/Paddle at /root/reference), built on jax/XLA.
+
+Dygraph Tensors are mutable handles over jax.Array with a tape-based autograd;
+static graph / to_static is jax.jit capture; distributed training is
+jax.sharding Meshes + XLA collectives instead of NCCL ProcessGroups.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Parameter, Place,
+    TPUPlace, Tensor, XPUPlace, device_count, enable_grad, get_default_dtype,
+    get_device, grad, is_compiled_with_cuda, is_compiled_with_tpu, no_grad,
+    seed, set_default_dtype, set_device, set_grad_enabled, to_tensor,
+)
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64, int8,
+    int16, int32, int64, uint8,
+)
+from .framework import random as _framework_random  # noqa: F401
+from .framework.random import get_rng_state, set_rng_state  # noqa: F401
+
+# dtype aliases paddle exposes at top level
+bool = bool_  # noqa: A001
+
+# ops — install Tensor methods first, then re-export every op at top level
+from . import ops  # noqa: E402
+ops.install_tensor_methods()
+from .ops import *  # noqa: F401,F403,E402
+from .ops import rank, shape, is_floating_point, is_complex  # noqa: F401,E402
+
+from . import amp  # noqa: F401,E402
+from . import flags as _flags_mod  # noqa: E402
+from .flags import get_flags, set_flags  # noqa: F401,E402
+
+# disable_static/enable_static are paddle's dygraph/static switches; dygraph
+# is the default and static graph is jit capture, so these are light toggles.
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def is_grad_enabled():
+    from .framework import autograd as _ag
+    return _ag.tape_enabled()
+
+
+def disable_signal_handler():
+    pass
+
+
+def save(obj, path, protocol=4, **configs):
+    from .framework.io import save as _save
+    return _save(obj, path, protocol=protocol, **configs)
+
+
+def load(path, **configs):
+    from .framework.io import load as _load
+    return _load(path, **configs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)

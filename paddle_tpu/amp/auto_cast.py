@@ -1,0 +1,134 @@
+"""AMP autocast.
+
+Mirrors ``paddle.amp.auto_cast`` O1/O2 (ref: /root/reference/python/paddle/amp/
+auto_cast.py:67,275 and the per-op autocast hook eager_amp_auto_cast.h). On TPU
+the natural amp dtype is bfloat16 (MXU-native); fp16 is also supported.
+
+O1: inputs of white-list ops are cast to the amp dtype, black-list ops to
+float32, everything else runs in the incoming dtype.
+O2: all float inputs are cast to the amp dtype except black-list ops.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..framework.dtype import convert_dtype, is_floating
+
+# ref: python/paddle/amp/auto_cast.py WHITE_LIST / BLACK_LIST
+WHITE_LIST = {
+    "conv2d", "conv1d", "conv3d", "conv2d_transpose", "matmul", "matmul_v2",
+    "mul", "bmm", "einsum", "linear", "fc", "attention", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "square", "reciprocal", "rsqrt", "pow",
+    "softmax_with_cross_entropy", "cross_entropy", "c_softmax_with_cross_entropy",
+    "mean", "sum", "cumsum", "softmax", "log_softmax", "layer_norm", "norm",
+    "batch_norm", "group_norm", "instance_norm", "reduce_sum", "reduce_mean",
+    "sigmoid_cross_entropy_with_logits", "cos_sim", "erf", "expm1", "tan",
+    "sin", "cos", "linspace",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None       # np dtype type, e.g. jnp.bfloat16
+        self.level = "O1"
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def amp_global_state():
+    return _state
+
+
+class auto_cast:
+    """with paddle.amp.auto_cast(enable=True, level='O1', dtype='bfloat16'):"""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "O1", "O2", "OD"):
+            raise ValueError(f"unsupported amp level {level}")
+        self._enable = enable and level != "O0"
+        self._level = level
+        self._dtype = convert_dtype(dtype)
+        self._white = set(WHITE_LIST)
+        self._black = set(BLACK_LIST)
+        if custom_white_list:
+            self._white |= set(custom_white_list)
+            self._black -= set(custom_white_list)
+        if custom_black_list:
+            self._black |= set(custom_black_list)
+            self._white -= set(custom_black_list)
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level,
+                       _state.white, _state.black)
+        _state.enabled = self._enable
+        _state.dtype = self._dtype
+        _state.level = self._level
+        _state.white = self._white
+        _state.black = self._black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.white, _state.black) = self._saved
+        return False
+
+
+amp_guard = auto_cast  # legacy alias (python/paddle/fluid/dygraph/amp)
+
+
+def _cast_tensor(t, dtype):
+    from ..framework.tensor import Tensor
+    if not isinstance(t, Tensor):
+        return t
+    if not is_floating(t.dtype) or t.dtype == np.dtype(dtype):
+        return t
+    return t.astype(dtype)
+
+
+def maybe_cast_inputs(op_name, tensor_args):
+    """Called from framework.op.apply for every op application."""
+    if not _state.enabled or op_name is None or op_name == "cast":
+        return tensor_args
+    if _state.level in ("O1", "OD"):
+        if op_name in _state.white:
+            return [_cast_tensor(t, _state.dtype) for t in tensor_args]
+        if op_name in _state.black:
+            import jax.numpy as jnp
+            return [_cast_tensor(t, jnp.float32) for t in tensor_args]
+        return tensor_args
+    # O2: everything to amp dtype except black list
+    if op_name in _state.black:
+        import jax.numpy as jnp
+        return [_cast_tensor(t, jnp.float32) for t in tensor_args]
+    return [_cast_tensor(t, _state.dtype) for t in tensor_args]
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the amp dtype
+    (ref: python/paddle/amp/auto_cast.py convert_to_fp16)."""
+    d = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if is_floating(p.dtype):
+                    p._data = p._data.astype(d)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,65 @@
+"""Global flag registry (ref: /root/reference/paddle/phi/core/flags.cc — 89
+PHI_DEFINE_EXPORTED_* flags; python surface paddle.get_flags/set_flags in
+python/paddle/__init__.py:38-39). Flags are also readable from FLAGS_* env."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _define(name, default, doc=""):
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+
+
+# the subset of reference flags that are meaningful on a TPU runtime
+_define("FLAGS_check_nan_inf", False,
+        "scan op outputs for nan/inf (ref: fluid/framework/operator.cc:2010)")
+_define("FLAGS_cudnn_deterministic", False)
+_define("FLAGS_benchmark", False)
+_define("FLAGS_eager_delete_tensor_gb", 0.0)
+_define("FLAGS_use_autotune", False)
+_define("FLAGS_conv_workspace_size_limit", 512)
+_define("FLAGS_allocator_strategy", "auto_growth")
+_define("FLAGS_fraction_of_gpu_memory_to_use", 0.92)
+_define("FLAGS_tpu_matmul_precision", "default",
+        "jax matmul precision: default|high|highest")
+_define("FLAGS_log_level", 0)
+_define("FLAGS_paddle_num_threads", 1)
+_define("FLAGS_enable_pallas_kernels", True,
+        "use pallas fused kernels (attention/layernorm/adamw) when available")
+_define("FLAGS_embedding_deterministic", False)
+_define("FLAGS_low_precision_op_list", 0)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        if f not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        out[f] = _REGISTRY[f]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag {k}")
+        _REGISTRY[k] = v
+
+
+def get_flag(name, default=None):
+    return _REGISTRY.get(name, default)

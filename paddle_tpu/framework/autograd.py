@@ -1,0 +1,242 @@
+"""Define-by-run autograd tape.
+
+The reference implements eager autograd as a C++ GradNode DAG walked by
+``egr::RunBackward`` (ref: /root/reference/paddle/fluid/eager/backward.cc:104,
+grad_node_info.h). Here each differentiable op records a node holding the
+``jax.vjp`` closure of its pure-jax impl; ``backward`` walks the tape in
+reverse execution order (a valid topological order) accumulating cotangents.
+
+A tensor id's cotangent is popped when its producing node is processed —
+all consumers appear later in forward order, hence earlier in the reverse
+walk, so the popped value is fully accumulated. Popping also makes in-place
+ops (same Tensor object re-produced) resolve to the correct version.
+
+Because nodes/closures are pure Python over jax values, the same machinery
+traces under ``jax.jit`` — a whole dygraph train step (forward, backward,
+optimizer update) can be captured by ``to_static`` into one XLA program.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+_float0 = jax.dtypes.float0
+
+
+class Node:
+    __slots__ = ("vjp_fn", "inputs", "output_ids", "output_metas")
+
+    def __init__(self, vjp_fn, inputs, output_ids, output_metas):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs            # list[Tensor] aligned with vjp arg order
+        self.output_ids = output_ids    # list[int] id() of output Tensors
+        self.output_metas = output_metas  # list[(shape, dtype)]
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.enabled = True
+        self.produced: set = set()       # ids of tensors produced by a node
+        self.retain: Dict[int, Any] = {}  # id -> Tensor retaining grad
+
+
+_tape = _TapeState()
+
+
+def tape_enabled() -> bool:
+    return _tape.enabled
+
+
+class no_grad:
+    """Context manager & decorator, mirrors paddle.no_grad."""
+
+    def __enter__(self):
+        self._prev = _tape.enabled
+        _tape.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tape.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _tape.enabled
+        _tape.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tape.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with enable_grad():
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __init__(self):
+            self._prev = _tape.enabled
+            _tape.enabled = bool(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _tape.enabled = self._prev
+            return False
+
+    return _Ctx()
+
+
+def record(vjp_fn, inputs, outputs):
+    """Append a node for an op application. `outputs` are Tensor objects."""
+    metas = [(tuple(o.shape), o.dtype) for o in outputs]
+    node = Node(vjp_fn, list(inputs), [id(o) for o in outputs], metas)
+    _tape.nodes.append(node)
+    for o in outputs:
+        _tape.produced.add(id(o))
+    return node
+
+
+def mark_retain(t):
+    _tape.retain[id(t)] = t
+
+
+def is_leaf(t) -> bool:
+    return id(t) not in _tape.produced
+
+
+def clear_tape():
+    _tape.nodes.clear()
+    _tape.produced.clear()
+    _tape.retain.clear()
+
+
+def _accumulate(grads: Dict[int, Any], key: int, value):
+    if value is None or (hasattr(value, "dtype") and value.dtype == _float0):
+        return
+    if key in grads:
+        grads[key] = grads[key] + value
+    else:
+        grads[key] = value
+
+
+def _run_backward(seed_tensors, seed_grads, retain_graph=False,
+                  wanted_ids=None, accumulate_into_leaf_grad=True):
+    grads: Dict[int, Any] = {}   # live cotangents, popped at producer
+    saved: Dict[int, Any] = {}   # final cotangents for ids we care about
+    care = set(wanted_ids or ())
+    care |= {id(t) for t in seed_tensors}
+    care |= set(_tape.retain)
+
+    for t, g in zip(seed_tensors, seed_grads):
+        _accumulate(grads, id(t), g)
+
+    leaf_hits: Dict[int, Any] = {}
+    for node in reversed(_tape.nodes):
+        if not any(oid in grads for oid in node.output_ids):
+            continue
+        cots = []
+        for oid, (shape, dtype) in zip(node.output_ids, node.output_metas):
+            g = grads.pop(oid, None)
+            if g is not None and oid in care:
+                saved[oid] = g
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            cots.append(g)
+        cot = tuple(cots) if len(cots) > 1 else cots[0]
+        in_grads = node.vjp_fn(cot)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or t.stop_gradient:
+                continue
+            _accumulate(grads, id(t), g)
+            if id(t) not in _tape.produced:
+                leaf_hits[id(t)] = t
+
+    final = dict(grads)
+    final.update(saved)
+
+    if accumulate_into_leaf_grad:
+        for tid, t in leaf_hits.items():
+            t._accumulate_grad(final[tid])
+        for t in seed_tensors:
+            if id(t) not in leaf_hits and id(t) in final and \
+                    not t.stop_gradient and is_leaf(t):
+                t._accumulate_grad(final[id(t)])
+        for tid, t in _tape.retain.items():
+            if tid in final and tid not in leaf_hits and \
+                    id(t) not in {id(s) for s in seed_tensors}:
+                t._accumulate_grad(final[tid])
+
+    if not retain_graph:
+        clear_tape()
+    return final
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward() entry. Seeds with ones."""
+    if tensor.stop_gradient:
+        raise RuntimeError(
+            "Tensor.backward() on a tensor with stop_gradient=True")
+    if grad_tensor is None:
+        g = jnp.ones(tensor.shape, tensor.dtype)
+    else:
+        g = grad_tensor.data if hasattr(grad_tensor, "data") else jnp.asarray(grad_tensor)
+    _run_backward([tensor], [g], retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Functional paddle.grad — returns grads of `outputs` wrt `inputs`
+    without writing .grad (ref: python/paddle/autograd/__init__.py)."""
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        seeds = [jnp.ones(o.shape, o.dtype) for o in outputs]
+    else:
+        grad_outputs = list(grad_outputs) if isinstance(grad_outputs, (list, tuple)) \
+            else [grad_outputs]
+        seeds = [jnp.ones(o.shape, o.dtype) if g is None else g.data
+                 for o, g in zip(outputs, grad_outputs)]
+    if retain_graph is None:
+        retain_graph = create_graph
+    final = _run_backward(outputs, seeds, retain_graph=retain_graph,
+                          wanted_ids=[id(t) for t in inputs],
+                          accumulate_into_leaf_grad=False)
+    from .tensor import Tensor
+    results = []
+    for t in inputs:
+        g = final.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors receives no gradient "
+                    "(pass allow_unused=True to return None instead)")
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=not create_graph))
+    return results

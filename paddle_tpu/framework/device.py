@@ -1,0 +1,131 @@
+"""Device / Place management.
+
+Mirrors ``paddle.set_device`` / ``paddle.get_device`` and the Place hierarchy
+(ref: /root/reference/paddle/phi/common/place.h, python/paddle/device/__init__.py).
+On TPU the native placement unit is a jax.Device; Places are thin wrappers so
+paddle-style code (``paddle.CUDAPlace(0)`` etc.) keeps working, with 'tpu' as
+the first-class device kind.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Base place. Holds a device kind + index resolved against jax.devices()."""
+
+    kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self._device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self.kind == other.kind and \
+            self._device_id == other._device_id
+
+    def __hash__(self):
+        return hash((self.kind, self._device_id))
+
+    def jax_device(self):
+        backend = {"tpu": "tpu", "gpu": "gpu", "cpu": "cpu"}.get(self.kind)
+        devs = jax.devices() if backend is None else _devices_for(backend)
+        return devs[self._device_id % len(devs)]
+
+
+def _devices_for(backend):
+    try:
+        return jax.devices(backend)
+    except RuntimeError:
+        return jax.devices()
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(Place):
+    # Accepted for API parity; resolves to whatever accelerator jax has.
+    kind = "gpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(Place):
+    kind = "xpu"
+
+
+class CustomPlace(Place):
+    def __init__(self, dev_type, device_id=0):
+        super().__init__(device_id)
+        self.kind = dev_type
+
+
+_CURRENT_DEVICE = None  # lazily resolved
+
+
+def _default_device_str():
+    plat = jax.default_backend()
+    if plat in ("tpu", "axon"):
+        return "tpu:0"
+    return f"{plat}:0"
+
+
+def set_device(device):
+    """paddle.set_device('tpu') / 'tpu:0' / 'cpu' / 'gpu:1'."""
+    global _CURRENT_DEVICE
+    if isinstance(device, Place):
+        _CURRENT_DEVICE = f"{device.kind}:{device.get_device_id()}"
+        return device
+    device = str(device)
+    if ":" not in device:
+        device = device + ":0"
+    kind, idx = device.split(":")
+    if kind in ("gpu", "cuda", "tpu", "xpu", "npu"):
+        # All accelerator names alias the real accelerator backend on this host.
+        _CURRENT_DEVICE = f"{kind}:{idx}"
+        place = TPUPlace(int(idx)) if kind == "tpu" else CUDAPlace(int(idx))
+    elif kind == "cpu":
+        _CURRENT_DEVICE = "cpu:0"
+        place = CPUPlace()
+    else:
+        _CURRENT_DEVICE = device
+        place = CustomPlace(kind, int(idx))
+    return place
+
+
+def get_device() -> str:
+    global _CURRENT_DEVICE
+    if _CURRENT_DEVICE is None:
+        _CURRENT_DEVICE = _default_device_str()
+    return _CURRENT_DEVICE
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,73 @@
+"""Dtype system.
+
+Paddle exposes dtypes as ``paddle.float32`` etc. and accepts strings everywhere
+(ref: /root/reference/paddle/phi/common/data_type.h). Here dtypes are jax/numpy
+dtypes directly; this module provides the canonicalization helpers and the
+default-dtype state (ref: python/paddle/framework/framework.py set_default_dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public dtype singletons (mirror paddle.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_ALIASES = {
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64, "double": jnp.float64,
+    "int8": jnp.int8, "int16": jnp.int16, "int32": jnp.int32,
+    "int64": jnp.int64, "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64, "complex128": jnp.complex128,
+}
+
+_DEFAULT_DTYPE = jnp.float32
+
+
+def set_default_dtype(d):
+    global _DEFAULT_DTYPE
+    _DEFAULT_DTYPE = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE
+
+
+def convert_dtype(dtype):
+    """Canonicalize a user-provided dtype (str / np / jnp) to a numpy dtype type."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_ALIASES:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return _STR_ALIASES[dtype]
+    # jnp.float32 etc. are already fine; np.dtype objects -> .type
+    if isinstance(dtype, np.dtype):
+        return dtype.type
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if np.dtype(dtype).name != "bool" else "bool"
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), np.integer)

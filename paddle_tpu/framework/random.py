@@ -1,0 +1,62 @@
+"""Global RNG state.
+
+Paddle has a global generator seeded by ``paddle.seed`` plus per-device
+generators (ref: /root/reference/paddle/fluid/framework/generator.cc). On TPU
+randomness is functional (jax.random keys), so the global state holds a key and
+splits it per draw. For jit-captured programs (to_static / fleet train steps)
+a *traced* key can be injected with ``key_scope`` so each compiled step gets
+fresh randomness instead of baking the trace-time key in as a constant.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.injected = None  # traced key during jit capture
+        self.injected_count = 0
+
+
+_state = _RNGState()
+
+
+def seed(value: int):
+    """paddle.seed — reseed the global generator."""
+    _state.key = jax.random.PRNGKey(int(value))
+    return _state
+
+
+def get_rng_state():
+    return _state.key
+
+
+def set_rng_state(key):
+    _state.key = key
+
+
+def next_key():
+    """Draw a fresh PRNG key. Inside a key_scope, folds a counter into the
+    injected (possibly traced) key so randomness is per-step under jit."""
+    if _state.injected is not None:
+        k = jax.random.fold_in(_state.injected, _state.injected_count)
+        _state.injected_count += 1
+        return k
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Route next_key() draws through `key` (typically a traced array)."""
+    prev, prev_count = _state.injected, _state.injected_count
+    _state.injected, _state.injected_count = key, 0
+    try:
+        yield
+    finally:
+        _state.injected, _state.injected_count = prev, prev_count

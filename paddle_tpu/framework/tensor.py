@@ -1,0 +1,317 @@
+"""paddle-style Tensor: a mutable handle over an immutable jax.Array.
+
+Mirrors the user surface of the reference's eager Tensor
+(ref: /root/reference/paddle/fluid/pybind/eager_method.cc — numpy()/astype()/
+backward()/grad/stop_gradient/...). Mutation (optimizer updates, set_value,
+in-place ops) rebinds ``_data``; autograd versioning is handled by the tape.
+
+Most math/manipulation methods are monkey-patched from paddle_tpu.ops at
+package import (mirroring python/paddle monkey_patch_tensor) — see
+paddle_tpu/__init__.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype, is_floating
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "name", "persistable",
+                 "trainable", "_hooks", "is_distributed", "_dist_attr",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        dtype = convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != np.dtype(dtype):
+            data = data.astype(dtype)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = False
+        self.trainable = True
+        self._hooks = []
+        self.is_distributed = False
+        self._dist_attr = None
+
+    # -- core properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def place(self):
+        from .device import get_device
+        return get_device()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    @property
+    def is_leaf(self):
+        return autograd.is_leaf(self)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        autograd.mark_retain(self)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    def _accumulate_grad(self, g):
+        for h in self._hooks:
+            out = h(Tensor(g))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else out
+        if self._grad is None:
+            self._grad = Tensor(g)
+        else:
+            self._grad._data = self._grad._data + g
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..framework.op import apply
+        return apply(lambda x: x + 0, (self,))
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self.dtype).itemsize
+
+    # -- mutation ----------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self.dtype)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # -- placement (no-ops on a single-process TPU runtime) ----------------
+    def cuda(self, *a, **kw):
+        return self
+
+    def cpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu",) or hasattr(a, "kind"):
+                continue
+            try:
+                d = convert_dtype(a)
+            except (ValueError, TypeError):
+                continue
+            if d is not None:
+                return self.astype(d)
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    @staticmethod
+    def _unwrap_index(idx):
+        if isinstance(idx, Tensor):
+            return idx._data
+        if isinstance(idx, tuple):
+            return tuple(Tensor._unwrap_index(i) for i in idx)
+        if isinstance(idx, list):
+            return jnp.asarray(idx) if len(idx) and not isinstance(idx[0], slice) else idx
+        return idx
+
+    def __getitem__(self, idx):
+        from .op import apply
+        idx = Tensor._unwrap_index(idx)
+        return apply(lambda x: x[idx], (self,))
+
+    def __setitem__(self, idx, value):
+        from .op import apply_inplace
+        idx = Tensor._unwrap_index(idx)
+        if isinstance(value, Tensor):
+            apply_inplace(self, lambda x, v: x.at[idx].set(v.astype(x.dtype)),
+                          (self, value))
+        else:
+            apply_inplace(self, lambda x: x.at[idx].set(value), (self,))
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        try:
+            data_str = repr(np.asarray(self._data))
+        except Exception:
+            data_str = f"<traced {self._data}>"
+        return (f"Tensor(shape={self.shape}, dtype={np.dtype(self.dtype).name}, "
+                f"stop_gradient={self.stop_gradient},\n{data_str})")
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    # arithmetic dunders are installed by ops._install_tensor_methods()
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/fluid/framework.py Parameter).
+    stop_gradient defaults to False and persistable True."""
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "need_clip", "is_dist_param")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_dist_param = False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (ref: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    if dtype is None and not hasattr(data, "dtype"):
+        # python scalars/lists follow paddle: ints->int64, floats->default
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            dtype = get_default_dtype()
+    return Tensor(jnp.asarray(data, dtype=convert_dtype(dtype)),
+                  stop_gradient=stop_gradient)

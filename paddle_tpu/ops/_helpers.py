@@ -1,0 +1,41 @@
+"""Shared helpers for op definitions."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import apply, apply_inplace, unwrap, wrap
+from ..framework.tensor import Tensor
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+__all__ = ["apply", "apply_inplace", "unwrap", "wrap", "Tensor", "jnp", "np",
+           "convert_dtype", "get_default_dtype", "op", "nodiff_op",
+           "normalize_axis", "scalar_or_unwrap"]
+
+
+def op(name, impl, *tensors, **kwargs):
+    """Apply a differentiable op."""
+    return apply(impl, tensors, kwargs, op_name=name)
+
+
+def nodiff_op(name, impl, *tensors, **kwargs):
+    return apply(impl, tensors, kwargs, differentiable=False, op_name=name)
+
+
+def normalize_axis(axis):
+    """paddle axes may be Tensors/ints/lists; canonicalize to python ints."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy()
+    if isinstance(axis, (list, tuple, np.ndarray)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def scalar_or_unwrap(x):
+    """Scalars stay python scalars (keeps weak typing); Tensors unwrap lazily
+    via apply; numpy arrays pass through."""
+    if isinstance(x, Tensor):
+        return x
+    return x

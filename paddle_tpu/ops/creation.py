@@ -1,0 +1,279 @@
+"""Tensor creation ops (ref: /root/reference/python/paddle/tensor/creation.py
+and random.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (Tensor, apply, convert_dtype, get_default_dtype, op,
+                       unwrap, wrap)
+from ..framework import random as _random
+from ..framework.tensor import to_tensor  # re-export
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "meshgrid", "tril", "triu", "tril_indices",
+    "triu_indices", "rand", "randn", "randint", "randint_like", "randperm",
+    "uniform", "normal", "standard_normal", "bernoulli", "multinomial",
+    "poisson", "assign", "clone", "one_hot", "complex", "numel", "diag_embed",
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s) if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    return d if d is not None else (default or get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else None
+    return wrap(jnp.full(_shape(shape), fill_value, convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return wrap(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return wrap(jnp.ones_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return wrap(jnp.full_like(unwrap(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    end = unwrap(end) if isinstance(end, Tensor) else end
+    step = unwrap(step) if isinstance(step, Tensor) else step
+    if dtype is None:
+        vals = [v for v in (start, end, step) if v is not None]
+        dtype = jnp.float32 if any(
+            isinstance(v, float) or (hasattr(v, "dtype") and np.issubdtype(np.asarray(v).dtype, np.floating))
+            for v in vals) else jnp.int64
+    return wrap(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    stop = unwrap(stop) if isinstance(stop, Tensor) else stop
+    num = int(unwrap(num)) if isinstance(num, Tensor) else int(num)
+    return wrap(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(num), base=base,
+                             dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(int(num_rows),
+                        int(num_columns) if num_columns is not None else None,
+                        dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(a):
+        if a.ndim == 1:
+            d = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(d.shape[0], dtype=bool)
+                mask = jnp.roll(mask, offset, axis=1) if offset else mask
+                d = jnp.where(mask, d, padding_value)
+            return d
+        return jnp.diagonal(a, offset=offset)
+    return op("diag", impl, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def impl(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return op("diag_embed", impl, x)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    arrays = [unwrap(a) for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [wrap(o) for o in outs]
+
+
+def tril(x, diagonal=0, name=None):
+    return op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+# -- random ----------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return wrap(jax.random.uniform(_random.next_key(), _shape(shape),
+                                   dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return wrap(jax.random.normal(_random.next_key(), _shape(shape),
+                                  dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(_random.next_key(), _shape(shape), low, high,
+                                   dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype) or unwrap(x).dtype
+    return wrap(jax.random.randint(_random.next_key(), unwrap(x).shape, low,
+                                   high, dtype=dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return wrap(jax.random.permutation(_random.next_key(), int(n)).astype(
+        convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                   minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        return wrap(m + s * jax.random.normal(_random.next_key(), sh))
+    sh = _shape(shape) if shape is not None else ()
+    return wrap(mean + std * jax.random.normal(_random.next_key(), sh,
+                                               dtype=get_default_dtype()))
+
+
+def bernoulli(x, name=None):
+    return wrap(jax.random.bernoulli(_random.next_key(),
+                                     unwrap(x)).astype(unwrap(x).dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = unwrap(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_random.next_key(), logits,
+                                     shape=a.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_random.next_key(), a.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    return wrap(jax.random.poisson(_random.next_key(),
+                                   unwrap(x)).astype(unwrap(x).dtype))
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    x._data = jax.random.uniform(_random.next_key(), tuple(x.shape),
+                                 dtype=x.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(_random.next_key(),
+                                             tuple(x.shape), dtype=x.dtype)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(_random.next_key(), tuple(x.shape), dtype=x.dtype)
+    x._data = -jnp.log(1 - u) / lam
+    return x
+
+
+# -- misc ------------------------------------------------------------------
+
+def assign(x, output=None):
+    data = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return wrap(data)
+    output._data = data.astype(output.dtype) if hasattr(output, "_data") else data
+    return output
+
+
+def clone(x, name=None):
+    return op("clone", lambda a: a + 0, x)
+
+
+def one_hot(x, num_classes, name=None):
+    return wrap(jax.nn.one_hot(unwrap(x), num_classes, dtype=get_default_dtype()))
+
+
+def complex(real, imag, name=None):
+    return op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)), dtype=jnp.int64))

@@ -1,0 +1,289 @@
+"""Linear algebra ops (ref: /root/reference/python/paddle/tensor/linalg.py).
+Matmuls are the MXU hot path — kept as single jnp calls so XLA tiles them."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (Tensor, nodiff_op, normalize_axis, op, unwrap, wrap)
+
+__all__ = [
+    "matmul", "bmm", "mv", "norm", "dist", "cond", "cholesky",
+    "cholesky_solve", "qr", "svd", "svdvals", "eig", "eigh", "eigvals",
+    "eigvalsh", "inv", "pinv", "det", "slogdet", "matrix_power",
+    "matrix_rank", "solve", "triangular_solve", "lstsq", "lu", "lu_unpack",
+    "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
+    "matrix_transpose", "householder_product", "pca_lowrank", "cdist",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return a @ b
+    return op("matmul", impl, x, y)
+
+
+def bmm(x, y, name=None):
+    return op("bmm", lambda a, b: jnp.einsum("bij,bjk->bik", a, b), x, y)
+
+
+def mv(x, vec, name=None):
+    return op("mv", lambda a, v: a @ v, x, vec)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    def impl(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            red_ax = ax
+            return jnp.max(jnp.abs(a), axis=red_ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        if ax is None:
+            a = a.reshape(-1)
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return op("p_norm", impl, x)
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return op("dist", impl, x, y)
+
+
+def cond(x, p=None, name=None):
+    return op("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return op("cholesky", impl, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return op("cholesky_solve", impl, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    def impl(a):
+        return tuple(jnp.linalg.qr(a, mode=mode))
+    q, r = op("qr", impl, x)
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    def impl(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return op("svd", impl, x)
+
+
+def svdvals(x, name=None):
+    return op("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def eig(x, name=None):
+    def impl(a):
+        return tuple(np_eig(a))
+    a = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(a)
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(v))
+
+
+def np_eig(a):
+    w, v = np.linalg.eig(np.asarray(a))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    def impl(a):
+        return tuple(jnp.linalg.eigh(a, UPLO=UPLO))
+    return op("eigh", impl, x)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(unwrap(x))
+    return wrap(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def inv(x, name=None):
+    return op("inverse", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op("pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond,
+                                                hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return op("determinant", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def impl(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return op("slogdet", impl, x)
+
+
+def matrix_power(x, n, name=None):
+    return op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nodiff_op("matrix_rank",
+                     lambda a: jnp.linalg.matrix_rank(a, tol=tol).astype(jnp.int64), x)
+
+
+def solve(x, y, name=None):
+    return op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return op("triangular_solve", impl, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+    a, b = unwrap(x), unwrap(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return (wrap(sol), wrap(res), wrap(rank.astype(jnp.int64)), wrap(sv))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    a = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(a)
+    if get_infos:
+        return wrap(lu_), wrap(piv.astype(jnp.int32) + 1), \
+            wrap(jnp.zeros((), jnp.int32))
+    return wrap(lu_), wrap(piv.astype(jnp.int32) + 1)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    a = unwrap(lu_data)
+    piv = np.asarray(unwrap(lu_pivots)) - 1
+    m = a.shape[-2]
+    perm = np.arange(m)
+    for i, p in enumerate(piv):
+        perm[i], perm[p] = perm[p], perm[i]
+    P = jnp.eye(m)[perm].T
+    L = jnp.tril(a, -1) + jnp.eye(*a.shape[-2:])
+    U = jnp.triu(a)
+    return wrap(P), wrap(L), wrap(U)
+
+
+def multi_dot(x, name=None):
+    from ._helpers import apply
+    return apply(lambda *xs: jnp.linalg.multi_dot(list(xs)), tuple(x),
+                 op_name="multi_dot")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = unwrap(input)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(a, bins=bins, range=rng)
+    return wrap(hist.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return wrap(jnp.asarray(hist)), [wrap(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    n = int(np.maximum(np.asarray(a).max(initial=-1) + 1, minlength))
+    out = jnp.bincount(a, weights=w, minlength=n, length=n)
+    return wrap(out if w is not None else out.astype(jnp.int64))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return op("cov", lambda a: jnp.cov(a, rowvar=rowvar,
+                                       ddof=1 if ddof else 0,
+                                       fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def matrix_transpose(x, name=None):
+    return op("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(t.shape[-1]):
+            v = jnp.zeros(a.shape[:-1], a.dtype).at[..., i].set(1.0)
+            v = v.at[..., i + 1:].set(a[..., i + 1:, i])
+            ti = t[..., i:i + 1]
+            q = q - ti[..., None] * (q @ v[..., None]) @ v[..., None, :]
+        return q[..., :n]
+    return op("householder_product", impl, x, tau)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = unwrap(x)
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return wrap(u[..., :q]), wrap(s[..., :q]), \
+        wrap(jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def impl(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0))
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return op("cdist", impl, x, y)

@@ -1,0 +1,126 @@
+"""Comparison / logical / bitwise ops (ref: /root/reference/python/paddle/
+tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, nodiff_op, unwrap, wrap
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "is_empty", "isclose", "allclose", "equal_all", "all", "any",
+    "is_tensor", "isreal", "iscomplex", "isposinf", "isneginf",
+]
+
+
+def equal(x, y, name=None):
+    return nodiff_op("equal", lambda a, b: a == b, x, y)
+
+
+def not_equal(x, y, name=None):
+    return nodiff_op("not_equal", lambda a, b: a != b, x, y)
+
+
+def less_than(x, y, name=None):
+    return nodiff_op("less_than", lambda a, b: a < b, x, y)
+
+
+def less_equal(x, y, name=None):
+    return nodiff_op("less_equal", lambda a, b: a <= b, x, y)
+
+
+def greater_than(x, y, name=None):
+    return nodiff_op("greater_than", lambda a, b: a > b, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return nodiff_op("greater_equal", lambda a, b: a >= b, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return nodiff_op("logical_and", jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return nodiff_op("logical_or", jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return nodiff_op("logical_xor", jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return nodiff_op("logical_not", jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return nodiff_op("bitwise_and", jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return nodiff_op("bitwise_or", jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return nodiff_op("bitwise_xor", jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return nodiff_op("bitwise_not", jnp.bitwise_not, x)
+
+
+def is_empty(x, name=None):
+    return wrap(jnp.asarray(unwrap(x).size == 0))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nodiff_op("isclose",
+                     lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nodiff_op("allclose",
+                     lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                               equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return nodiff_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    from ._helpers import normalize_axis
+    ax = normalize_axis(axis)
+    return nodiff_op("reduce_all",
+                     lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    from ._helpers import normalize_axis
+    ax = normalize_axis(axis)
+    return nodiff_op("reduce_any",
+                     lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isreal(x, name=None):
+    return nodiff_op("isreal", jnp.isreal, x)
+
+
+def iscomplex(x):
+    return np.issubdtype(np.dtype(unwrap(x).dtype), np.complexfloating)
+
+
+def isposinf(x, name=None):
+    return nodiff_op("isposinf", jnp.isposinf, x)
+
+
+def isneginf(x, name=None):
+    return nodiff_op("isneginf", jnp.isneginf, x)

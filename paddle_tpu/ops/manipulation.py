@@ -1,0 +1,661 @@
+"""Shape / layout manipulation ops (ref: /root/reference/python/paddle/tensor/
+manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (Tensor, apply, apply_inplace, convert_dtype,
+                       nodiff_op, normalize_axis, op, unwrap, wrap)
+
+__all__ = [
+    "cast", "reshape", "reshape_", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk",
+    "vsplit", "hsplit", "dsplit", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "transpose", "moveaxis", "flip",
+    "roll", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_add", "index_put",
+    "put_along_axis", "take_along_axis", "slice", "strided_slice", "pad",
+    "repeat_interleave", "unbind", "unique", "unique_consecutive",
+    "masked_select", "masked_fill", "where", "nonzero", "unstack",
+    "tensordot", "einsum", "as_complex", "as_real", "view", "view_as",
+    "unflatten", "atleast_1d", "atleast_2d", "atleast_3d", "row_stack",
+    "column_stack", "hstack", "vstack", "dstack", "t", "shard_index",
+    "crop", "unfold", "diagonal", "diagonal_scatter", "fill_diagonal_",
+    "flatten_", "as_strided", "select_scatter", "slice_scatter",
+]
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return op("cast", lambda a: a.astype(d), x)
+
+
+def _resolve_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(unwrap(s)) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    sh = _resolve_shape(shape)
+    # paddle: 0 means "copy this dim from input"
+    def impl(a):
+        resolved = tuple(a.shape[i] if d == 0 else d for i, d in enumerate(sh))
+        return a.reshape(resolved)
+    return op("reshape", impl, x)
+
+
+def reshape_(x, shape, name=None):
+    sh = _resolve_shape(shape)
+    def impl(a):
+        resolved = tuple(a.shape[i] if d == 0 else d for i, d in enumerate(sh))
+        return a.reshape(resolved)
+    return apply_inplace(x, impl, (x,))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(new_shape)
+    return op("flatten", impl, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(a):
+        nd = a.ndim
+        s = start_axis % nd
+        e = stop_axis % nd
+        return a.reshape(a.shape[:s] + (-1,) + a.shape[e + 1:])
+    return apply_inplace(x, impl, (x,))
+
+
+def squeeze(x, axis=None, name=None):
+    ax = normalize_axis(axis)
+    def impl(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = (ax,) if isinstance(ax, int) else ax
+        axes = tuple(a_ % a.ndim for a_ in axes if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return op("squeeze", impl, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data = out._data
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    ax = normalize_axis(axis)
+    axes = (ax,) if isinstance(ax, int) else tuple(ax)
+    def impl(a):
+        out = a
+        for a_ in sorted(a2 % (out.ndim + 1) for a2 in axes):
+            out = jnp.expand_dims(out, a_)
+        return out
+    return op("unsqueeze", impl, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data = out._data
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = tuple(x)
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=ax), tensors,
+                 op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = tuple(x)
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), tensors, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    a = unwrap(x)
+    dim = a.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+                 for s in num_or_sections]
+        total_known = int(np.sum([s for s in sizes if s != -1]))
+        sizes = [dim - total_known if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+    def impl(arr):
+        return tuple(jax.lax.slice_in_dim(arr, int(offsets[i]),
+                                          int(offsets[i + 1]), axis=ax)
+                     for i in range(len(sizes)))
+    return list(apply(impl, (x,), op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, 0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, 1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, 2)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _resolve_shape(repeat_times)
+    return op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    sh = _resolve_shape(shape)
+    def impl(a):
+        # paddle: -1 keeps the original dim
+        nd = len(sh)
+        aligned = (1,) * (nd - a.ndim) + a.shape
+        resolved = tuple(aligned[i] if d == -1 else d for i, d in enumerate(sh))
+        return jnp.broadcast_to(a.reshape(aligned), resolved)
+    return op("expand", impl, x)
+
+
+def expand_as(x, y, name=None):
+    target = tuple(unwrap(y).shape)
+    def impl(a):
+        aligned = (1,) * (len(target) - a.ndim) + a.shape
+        return jnp.broadcast_to(a.reshape(aligned), target)
+    return op("expand_as", impl, x)
+
+
+def broadcast_to(x, shape, name=None):
+    sh = _resolve_shape(shape)
+    return op("broadcast_to", lambda a: jnp.broadcast_to(a, sh), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [unwrap(t) for t in inputs]
+    sh = jnp.broadcast_shapes(*[a.shape for a in arrays])
+    return [op("broadcast_to", lambda a: jnp.broadcast_to(a, sh), t)
+            for t in inputs]
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def t(x, name=None):
+    def impl(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return op("t", impl, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def flip(x, axis, name=None):
+    ax = normalize_axis(axis)
+    return op("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    ax = normalize_axis(axis)
+    sh = normalize_axis(shifts)
+    def impl(a):
+        if ax is None:
+            return jnp.roll(a.reshape(-1), sh).reshape(a.shape)
+        return jnp.roll(a, sh, axis=ax)
+    return op("roll", impl, x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    def impl(a, idx):
+        idx = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(a, idx, axis=ax)
+    return op("gather", impl, x, index)
+
+
+def gather_nd(x, index, name=None):
+    def impl(a, idx):
+        # idx [..., k] indexes the first k dims of a
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k == a.ndim else \
+            a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return op("gather_nd", impl, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return op("scatter", impl, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data = out._data
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    sh = _resolve_shape(shape)
+    def impl(idx, upd):
+        out = jnp.zeros(sh, upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return op("scatter_nd", impl, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return op("scatter_nd_add", impl, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    def impl(a, idx):
+        return jnp.take(a, idx, axis=axis)
+    return op("index_select", impl, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def impl(a, idx, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+    return op("index_add", impl, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(unwrap(i) for i in indices)
+    def impl(a, v):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+    return op("index_put", impl, x, value)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def impl(a, idx, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, v, axis=axis) if hasattr(jnp, "put_along_axis") \
+                else _put_along(a, idx, v, axis, "set")
+        if reduce in ("add", "sum"):
+            return _put_along(a, idx, v, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return _put_along(a, idx, v, axis, "multiply")
+        raise ValueError(reduce)
+    return op("put_along_axis", impl, x, indices, values)
+
+
+def _put_along(a, idx, v, axis, mode):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    grids[axis] = idx
+    ref = a.at[tuple(grids)]
+    return getattr(ref, mode)(v)
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    def impl(a, idx):
+        if broadcast:
+            target = list(idx.shape)
+            for i in range(a.ndim):
+                if i != axis % a.ndim:
+                    target[i] = a.shape[i]
+            idx = jnp.broadcast_to(idx, tuple(target))
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return op("take_along_axis", impl, x, indices)
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes = [int(a) for a in axes]
+    starts = [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(unwrap(e)) if isinstance(e, Tensor) else int(e) for e in ends]
+    def impl(a):
+        return a[tuple(_mk_slices(a, axes, starts, ends))]
+    return op("slice", impl, x)
+
+
+def _mk_slices(a, axes, starts, ends):
+    import builtins
+    sls = [builtins.slice(None)] * a.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sls[ax] = builtins.slice(s, e)
+    return sls
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    def impl(a):
+        sls = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sls[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(sls)]
+    return op("strided_slice", impl, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    def impl(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # paddle order: per-dim low/high starting from dim 0
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to trailing spatial dims, NCHW/NHWC aware
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C") and data_format.startswith("N"):  # NHWC/NLC/NDHWC
+                dims = builtins_range(1, 1 + n_spatial)
+            else:  # NCHW-style: spatial dims are last
+                dims = builtins_range(nd - n_spatial, nd)
+            # paddle pads last-dim-first within the spec? it pads in order
+            # [d0_l, d0_r, d1_l, d1_r ...] over the chosen dims
+            for j, d in enumerate(dims):
+                widths[d] = (pad[2 * j], pad[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return op("pad", impl, x)
+
+
+def builtins_range(*args):
+    return list(range(*args))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        r = repeats.numpy()
+        def impl(a):
+            return jnp.repeat(a, jnp.asarray(r), axis=axis,
+                              total_repeat_length=int(r.sum()))
+        return op("repeat_interleave", impl, x)
+    return op("repeat_interleave",
+              lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def unbind(x, axis=0, name=None):
+    n = unwrap(x).shape[axis]
+    def impl(a):
+        return tuple(jnp.take(a, i, axis=axis) for i in range(n))
+    return list(apply(impl, (x,), op_name="unbind"))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return wrap(jnp.asarray(res))
+    outs = [wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    keep = np.ones(a.shape[ax], dtype=bool)
+    if a.shape[ax] > 1:
+        moved = np.moveaxis(a, ax, 0)
+        eq = (moved[1:] == moved[:-1]).reshape(a.shape[ax] - 1, -1).all(axis=1)
+        keep[1:] = ~eq
+    out = np.compress(keep, a, axis=ax)
+    rets = [wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(wrap(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[ax]))
+        rets.append(wrap(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def masked_select(x, mask, name=None):
+    a, m = unwrap(x), unwrap(mask)
+    m = jnp.broadcast_to(m, a.shape)
+    return wrap(a.reshape(-1)[jnp.flatnonzero(m.reshape(-1))])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = unwrap(value) if isinstance(value, Tensor) else value
+    return op("masked_fill", lambda a, m: jnp.where(m, v, a), x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return op("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.numpy().tolist()
+    return op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *xs: jnp.einsum(equation, *xs), operands,
+                 op_name="einsum")
+
+
+def as_complex(x, name=None):
+    return op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return op("as_real", lambda a: jnp.stack([a.real, a.imag], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = convert_dtype(shape_or_dtype)
+    return op("view_dtype", lambda a: a.view(d), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unflatten(x, axis, shape, name=None):
+    sh = _resolve_shape(shape)
+    def impl(a):
+        ax = axis % a.ndim
+        resolved = tuple(sh)
+        return a.reshape(a.shape[:ax] + resolved + a.shape[ax + 1:])
+    return op("unflatten", impl, x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [op("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [op("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [op("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hstack(x, name=None):
+    return apply(lambda *xs: jnp.hstack(xs), tuple(x), op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *xs: jnp.vstack(xs), tuple(x), op_name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *xs: jnp.dstack(xs), tuple(x), op_name="dstack")
+
+
+row_stack = vstack
+column_stack = hstack
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def impl(idx):
+        lower = shard_id * size
+        in_shard = (idx >= lower) & (idx < lower + size)
+        return jnp.where(in_shard, idx - lower, ignore_value)
+    return nodiff_op("shard_index", impl, input)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+    sh = _resolve_shape(shape)
+    off = [0] * len(sh) if offsets is None else \
+        [int(unwrap(o)) if isinstance(o, Tensor) else int(o) for o in offsets]
+    def impl(a):
+        sls = tuple(builtins.slice(o, o + (a.shape[i] if s == -1 else s))
+                    for i, (o, s) in enumerate(zip(off, sh)))
+        return a[sls]
+    return op("crop", impl, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: paddle.nn.functional.unfold). x: [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+    def impl(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return op("unfold", impl, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op("diagonal",
+              lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def impl(a, b):
+        moved = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        n = builtins_min(moved.shape[-2], moved.shape[-1])
+        i = jnp.arange(n - builtins_abs(offset))
+        r = i + builtins_max(-offset, 0)
+        c = i + builtins_max(offset, 0)
+        moved = moved.at[..., r, c].set(jnp.moveaxis(b, -1, -1))
+        return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+    return op("diagonal_scatter", impl, x, y)
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def builtins_abs(a):
+    return a if a >= 0 else -a
+
+
+def fill_diagonal_(x, value, offset=0, wrap_=False, name=None):
+    def impl(a):
+        n = builtins_min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - builtins_abs(offset))
+        r = i + builtins_max(-offset, 0)
+        c = i + builtins_max(offset, 0)
+        return a.at[..., r, c].set(value)
+    return apply_inplace(x, impl, (x,))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def impl(a):
+        flat = a.reshape(-1)
+        idx = jnp.full(tuple(shape), offset)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = jnp.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[idx]
+    return op("as_strided", impl, x)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    import builtins
+    def impl(a, v):
+        sls = [builtins.slice(None)] * a.ndim
+        sls[axis] = index
+        return a.at[tuple(sls)].set(v)
+    return op("select_scatter", impl, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    import builtins
+    def impl(a, v):
+        sls = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sls[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a.at[tuple(sls)].set(v)
+    return op("slice_scatter", impl, x, value)

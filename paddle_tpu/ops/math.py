@@ -1,0 +1,710 @@
+"""Elementwise math + reductions (ref: /root/reference/python/paddle/tensor/
+math.py, stat.py). Semantics follow paddle: `axis=None` reduces all dims,
+`keepdim` keyword, int/float promotion per jnp defaults."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (Tensor, apply, apply_inplace, convert_dtype,
+                       get_default_dtype, nodiff_op, normalize_axis, op,
+                       unwrap, wrap)
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "floor_mod", "pow", "scale", "abs", "ceil", "floor", "round",
+    "trunc", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "sign", "sgn", "sin", "cos", "tan", "asin",
+    "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh",
+    "atanh", "erf", "erfinv", "sigmoid", "maximum", "minimum", "fmax", "fmin",
+    "clip", "lerp", "addmm", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "prod", "sum", "mean", "max", "min", "amax", "amin",
+    "logsumexp", "nanmean", "nansum", "std", "var", "median", "nanmedian",
+    "kron", "outer", "inner", "dot", "cross", "isfinite", "isinf", "isnan",
+    "nan_to_num", "angle", "conj", "real", "imag", "deg2rad", "rad2deg",
+    "gcd", "lcm", "diff", "frac", "heaviside", "hypot", "logaddexp", "neg",
+    "stanh", "add_n", "count_nonzero", "increment", "multiply_", "add_",
+    "subtract_", "divide_", "clip_", "scale_", "exp_", "sqrt_", "rsqrt_",
+    "reciprocal_", "round_", "ceil_", "floor_", "tanh_", "sigmoid_",
+    "quantile", "trapezoid", "cumulative_trapezoid", "rot90", "logit",
+    "log_normalize", "renorm", "inverse", "digamma", "lgamma", "polygamma",
+    "nextafter", "ldexp", "copysign", "signbit", "i0", "sinc", "take",
+    "broadcast_shape", "mm", "vander", "led_to_default",
+]
+
+_dd = get_default_dtype
+
+
+def _binop(name, fn, x, y):
+    return op(name, fn, x, y)
+
+
+def add(x, y, name=None):
+    return _binop("elementwise_add", lambda a, b: a + b, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binop("elementwise_sub", lambda a, b: a - b, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binop("elementwise_mul", lambda a, b: a * b, x, y)
+
+
+def divide(x, y, name=None):
+    return _binop("elementwise_div", lambda a, b: jnp.true_divide(a, b), x, y)
+
+
+def floor_divide(x, y, name=None):
+    return nodiff_op("floor_divide", lambda a, b: jnp.floor_divide(a, b), x, y)
+
+
+def mod(x, y, name=None):
+    return _binop("elementwise_mod", lambda a, b: jnp.mod(a, b), x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return _binop("pow", lambda a, b: jnp.power(a, b), x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def impl(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out.astype(a.dtype)
+    s = unwrap(scale) if isinstance(scale, Tensor) else scale
+    return op("scale", impl, x, s, bias)
+
+
+def abs(x, name=None):
+    return op("abs", jnp.abs, x)
+
+
+def ceil(x, name=None):
+    return op("ceil", jnp.ceil, x)
+
+
+def floor(x, name=None):
+    return op("floor", jnp.floor, x)
+
+
+def round(x, name=None):
+    return op("round", jnp.round, x)
+
+
+def trunc(x, name=None):
+    return op("trunc", jnp.trunc, x)
+
+
+def exp(x, name=None):
+    return op("exp", jnp.exp, x)
+
+
+def expm1(x, name=None):
+    return op("expm1", jnp.expm1, x)
+
+
+def log(x, name=None):
+    return op("log", jnp.log, x)
+
+
+def log2(x, name=None):
+    return op("log2", jnp.log2, x)
+
+
+def log10(x, name=None):
+    return op("log10", jnp.log10, x)
+
+
+def log1p(x, name=None):
+    return op("log1p", jnp.log1p, x)
+
+
+def sqrt(x, name=None):
+    return op("sqrt", jnp.sqrt, x)
+
+
+def rsqrt(x, name=None):
+    return op("rsqrt", jax.lax.rsqrt, x)
+
+
+def square(x, name=None):
+    return op("square", jnp.square, x)
+
+
+def reciprocal(x, name=None):
+    return op("reciprocal", lambda a: 1.0 / a, x)
+
+
+def sign(x, name=None):
+    return op("sign", jnp.sign, x)
+
+
+sgn = sign
+
+
+def sin(x, name=None):
+    return op("sin", jnp.sin, x)
+
+
+def cos(x, name=None):
+    return op("cos", jnp.cos, x)
+
+
+def tan(x, name=None):
+    return op("tan", jnp.tan, x)
+
+
+def asin(x, name=None):
+    return op("asin", jnp.arcsin, x)
+
+
+def acos(x, name=None):
+    return op("acos", jnp.arccos, x)
+
+
+def atan(x, name=None):
+    return op("atan", jnp.arctan, x)
+
+
+def atan2(x, y, name=None):
+    return op("atan2", jnp.arctan2, x, y)
+
+
+def sinh(x, name=None):
+    return op("sinh", jnp.sinh, x)
+
+
+def cosh(x, name=None):
+    return op("cosh", jnp.cosh, x)
+
+
+def tanh(x, name=None):
+    return op("tanh", jnp.tanh, x)
+
+
+def asinh(x, name=None):
+    return op("asinh", jnp.arcsinh, x)
+
+
+def acosh(x, name=None):
+    return op("acosh", jnp.arccosh, x)
+
+
+def atanh(x, name=None):
+    return op("atanh", jnp.arctanh, x)
+
+
+def erf(x, name=None):
+    return op("erf", jax.scipy.special.erf, x)
+
+
+def erfinv(x, name=None):
+    return op("erfinv", jax.scipy.special.erfinv, x)
+
+
+def sigmoid(x, name=None):
+    return op("sigmoid", jax.nn.sigmoid, x)
+
+
+def logit(x, eps=None, name=None):
+    def impl(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1 - eps)
+        return jnp.log(a / (1 - a))
+    return op("logit", impl, x)
+
+
+def maximum(x, y, name=None):
+    return _binop("elementwise_max", jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _binop("elementwise_min", jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return _binop("fmax", jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return _binop("fmin", jnp.fmin, x, y)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = unwrap(min) if isinstance(min, Tensor) else min
+    mx = unwrap(max) if isinstance(max, Tensor) else max
+    return op("clip", lambda a: jnp.clip(a, mn, mx), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return op("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    def impl(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=d)
+        return jnp.cumsum(a, axis=normalize_axis(axis), dtype=d)
+    return op("cumsum", impl, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return op("cumprod", lambda a: jnp.cumprod(a, axis=normalize_axis(dim),
+                                               dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        ax = normalize_axis(axis)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax]).reshape(
+            [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        inds = jax.lax.associative_scan(jnp.maximum,
+                                        jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    return op("cummax", impl, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        ax = normalize_axis(axis)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.associative_scan(jnp.minimum, a, axis=ax)
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax]).reshape(
+            [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        inds = jax.lax.associative_scan(jnp.maximum,
+                                        jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    return op("cummin", impl, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def impl(a):
+        ax = normalize_axis(axis)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return _logcumsumexp_stable(a, ax)
+    return op("logcumsumexp", impl, x)
+
+
+def _logcumsumexp_stable(a, ax):
+    def combine(x, y):
+        xm, xs = x
+        ym, ys = y
+        m = jnp.maximum(xm, ym)
+        return m, xs * jnp.exp(xm - m) + ys * jnp.exp(ym - m)
+    m, s = jax.lax.associative_scan(combine, (a, jnp.ones_like(a)), axis=ax)
+    return m + jnp.log(s)
+
+
+def _reduce(name, fn, x, axis, keepdim, **kw):
+    ax = normalize_axis(axis)
+    return op(name, lambda a: fn(a, axis=ax, keepdims=keepdim, **kw), x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+    def impl(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return op("reduce_sum", impl, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_mean", jnp.mean, x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+    def impl(a):
+        out = jnp.prod(a, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return op("reduce_prod", impl, x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_max", jnp.max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_min", jnp.min, x, axis, keepdim)
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return op("logsumexp",
+              lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce("nanmean", jnp.nanmean, x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+    def impl(a):
+        out = jnp.nansum(a, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return op("nansum", impl, x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return op("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                       keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return op("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                       keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return op("nanmedian", lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = normalize_axis(axis)
+    qq = unwrap(q) if isinstance(q, Tensor) else q
+    return op("quantile", lambda a: jnp.quantile(
+        a, jnp.asarray(qq), axis=ax, keepdims=keepdim, method=interpolation), x)
+
+
+def kron(x, y, name=None):
+    return op("kron", jnp.kron, x, y)
+
+
+def outer(x, y, name=None):
+    return op("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y, name=None):
+    return op("inner", jnp.inner, x, y)
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.einsum("bi,bi->b", a, b)
+    return op("dot", impl, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def impl(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return op("cross", impl, x, y)
+
+
+def mm(x, y, name=None):
+    return op("matmul", lambda a, b: a @ b, x, y)
+
+
+def isfinite(x, name=None):
+    return nodiff_op("isfinite", jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return nodiff_op("isinf", jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return nodiff_op("isnan", jnp.isnan, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return op("nan_to_num",
+              lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def angle(x, name=None):
+    return op("angle", jnp.angle, x)
+
+
+def conj(x, name=None):
+    return op("conj", jnp.conj, x)
+
+
+def real(x, name=None):
+    return op("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return op("imag", jnp.imag, x)
+
+
+def deg2rad(x, name=None):
+    return op("deg2rad", jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return op("rad2deg", jnp.rad2deg, x)
+
+
+def gcd(x, y, name=None):
+    return nodiff_op("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return nodiff_op("lcm", jnp.lcm, x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if isinstance(prepend, Tensor) else prepend
+    app = unwrap(append) if isinstance(append, Tensor) else append
+    return op("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                         append=app), x)
+
+
+def frac(x, name=None):
+    return op("frac", lambda a: a - jnp.trunc(a), x)
+
+
+def heaviside(x, y, name=None):
+    return op("heaviside", jnp.heaviside, x, y)
+
+
+def hypot(x, y, name=None):
+    return op("hypot", jnp.hypot, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return op("logaddexp", jnp.logaddexp, x, y)
+
+
+def neg(x, name=None):
+    return op("neg", jnp.negative, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    def impl(*xs):
+        out = xs[0]
+        for a in xs[1:]:
+            out = out + a
+        return out
+    return apply(impl, tuple(inputs), op_name="add_n")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return nodiff_op("count_nonzero",
+                     lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64), x)
+
+
+def increment(x, value=1.0, name=None):
+    return apply_inplace(x, lambda a: a + value, (x,))
+
+
+def digamma(x, name=None):
+    return op("digamma", jax.scipy.special.digamma, x)
+
+
+def lgamma(x, name=None):
+    return op("lgamma", jax.scipy.special.gammaln, x)
+
+
+def polygamma(x, n, name=None):
+    return op("polygamma", lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+def nextafter(x, y, name=None):
+    return nodiff_op("nextafter", jnp.nextafter, x, y)
+
+
+def ldexp(x, y, name=None):
+    return op("ldexp", lambda a, b: a * jnp.exp2(b.astype(jnp.float32)), x, y)
+
+
+def copysign(x, y, name=None):
+    return op("copysign", jnp.copysign, x, y)
+
+
+def signbit(x, name=None):
+    return nodiff_op("signbit", jnp.signbit, x)
+
+
+def i0(x, name=None):
+    return op("i0", jnp.i0, x)
+
+
+def sinc(x, name=None):
+    return op("sinc", jnp.sinc, x)
+
+
+def take(x, index, mode="raise", name=None):
+    def impl(a, idx):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            idx = jnp.mod(idx, flat.shape[0])
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        return flat[idx]
+    return op("take", impl, x, index)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return op("trapezoid", lambda a, b: jax.scipy.integrate.trapezoid(
+            a, x=b, axis=axis), y, x)
+    return op("trapezoid", lambda a: jax.scipy.integrate.trapezoid(
+        a, dx=dx if dx is not None else 1.0, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def impl(a, *rest):
+        b = rest[0] if rest else None
+        d = jnp.diff(b, axis=axis) if b is not None else (dx or 1.0)
+        sl1 = [slice(None)] * a.ndim
+        sl2 = [slice(None)] * a.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (a[tuple(sl1)] + a[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is not None:
+        return op("cumulative_trapezoid", impl, y, x)
+    return op("cumulative_trapezoid", impl, y)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def impl(a):
+        dims = [i for i in range(a.ndim) if i != axis % a.ndim]
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return op("renorm", impl, x)
+
+
+def inverse(x, name=None):
+    return op("inverse", jnp.linalg.inv, x)
+
+
+def log_normalize(x, axis=-1):
+    return op("log_normalize",
+              lambda a: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return op("vander", lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def led_to_default(x):  # internal helper, not public paddle API
+    return x
+
+
+# -- in-place variants -----------------------------------------------------
+
+def add_(x, y, name=None):
+    return apply_inplace(x, lambda a, b: a + b, (x, y))
+
+
+def subtract_(x, y, name=None):
+    return apply_inplace(x, lambda a, b: a - b, (x, y))
+
+
+def multiply_(x, y, name=None):
+    return apply_inplace(x, lambda a, b: a * b, (x, y))
+
+
+def divide_(x, y, name=None):
+    return apply_inplace(x, lambda a, b: jnp.true_divide(a, b), (x, y))
+
+
+def clip_(x, min=None, max=None, name=None):
+    mn = unwrap(min) if isinstance(min, Tensor) else min
+    mx = unwrap(max) if isinstance(max, Tensor) else max
+    return apply_inplace(x, lambda a: jnp.clip(a, mn, mx), (x,))
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return apply_inplace(
+        x, lambda a: (a * scale + bias if bias_after_scale else (a + bias) * scale).astype(a.dtype),
+        (x,))
+
+
+def exp_(x, name=None):
+    return apply_inplace(x, jnp.exp, (x,))
+
+
+def sqrt_(x, name=None):
+    return apply_inplace(x, jnp.sqrt, (x,))
+
+
+def rsqrt_(x, name=None):
+    return apply_inplace(x, jax.lax.rsqrt, (x,))
+
+
+def reciprocal_(x, name=None):
+    return apply_inplace(x, lambda a: 1.0 / a, (x,))
+
+
+def round_(x, name=None):
+    return apply_inplace(x, jnp.round, (x,))
+
+
+def ceil_(x, name=None):
+    return apply_inplace(x, jnp.ceil, (x,))
+
+
+def floor_(x, name=None):
+    return apply_inplace(x, jnp.floor, (x,))
+
+
+def tanh_(x, name=None):
+    return apply_inplace(x, jnp.tanh, (x,))
+
+
+def sigmoid_(x, name=None):
+    return apply_inplace(x, jax.nn.sigmoid, (x,))

@@ -1,0 +1,123 @@
+"""Search / sort ops (ref: /root/reference/python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (Tensor, apply, convert_dtype, nodiff_op,
+                       normalize_axis, op, unwrap, wrap)
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "kthvalue",
+    "mode", "index_sample", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+    def impl(a):
+        if ax is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        out = jnp.argmax(a, axis=ax)
+        return jnp.expand_dims(out, ax).astype(d) if keepdim else out.astype(d)
+    return nodiff_op("argmax", impl, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+    def impl(a):
+        if ax is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        out = jnp.argmin(a, axis=ax)
+        return jnp.expand_dims(out, ax).astype(d) if keepdim else out.astype(d)
+    return nodiff_op("argmin", impl, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def impl(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return nodiff_op("argsort", impl, x)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def impl(a):
+        return jnp.sort(a, axis=axis, stable=stable, descending=descending)
+    return op("sort", impl, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+    def impl(a):
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    vals, idx = apply(impl, (x,), op_name="top_k")
+    return vals, idx
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def impl(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return nodiff_op("searchsorted", impl, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        vals = jnp.sort(moved, axis=-1)[..., k - 1]
+        idx = jnp.argsort(moved, axis=-1, stable=True)[..., k - 1]
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply(impl, (x,), op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(idxs))
+
+
+def index_sample(x, index, name=None):
+    def impl(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+    return op("index_sample", impl, x, index)

@@ -1,0 +1,25 @@
+"""Test config: run on an 8-device virtual CPU mesh so sharding/collective
+paths are exercised without TPU pods (mirrors how the reference tests
+multi-node via multi-process on one host, SURVEY.md §4)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    yield

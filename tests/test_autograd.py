@@ -1,0 +1,124 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _param(arr):
+    t = paddle.to_tensor(np.asarray(arr, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_simple_backward():
+    x = _param([2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_broadcast():
+    w = _param(np.ones((3, 2)))
+    x = paddle.to_tensor(np.array([[1.0, 2.0, 3.0]], np.float32))
+    y = paddle.matmul(x, w)          # [1,2]
+    loss = (y * y).mean()
+    loss.backward()
+    assert w.grad.shape == [3, 2]
+    # analytic: y = [6,6]; dloss/dy = y/1... mean over 2 elements -> y
+    expected = np.outer([1, 2, 3], [6.0, 6.0])
+    np.testing.assert_allclose(w.grad.numpy(), expected, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = _param([1.0])
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad():
+    x = _param([1.0])
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_stop_gradient_cut():
+    x = _param([2.0])
+    y = x * 3
+    z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_functional_grad():
+    x = _param([2.0])
+    y = x ** 3
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+
+
+def test_multi_output_op_backward():
+    x = _param([[3.0, 1.0, 2.0]])
+    vals, idx = paddle.topk(x, 2, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_inplace_versioning():
+    x = _param([1.0, 2.0])
+    y = x * 2          # uses v0 of y's input x
+    y.add_(paddle.to_tensor([1.0, 1.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_setitem_grad():
+    x = _param([1.0, 2.0, 3.0])
+    y = x * 1
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+
+def test_getitem_grad():
+    x = _param([[1.0, 2.0], [3.0, 4.0]])
+    y = x[0] * 2
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2.0, 2.0], [0.0, 0.0]])
+
+
+def test_retain_graph():
+    x = _param([2.0])
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_backward_with_grad_tensor():
+    x = _param([1.0, 1.0])
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 6.0])
+
+
+def test_clear_grad():
+    x = _param([1.0])
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_hook():
+    x = _param([1.0])
+    x.register_hook(lambda g: g * 10)
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_second_use_same_tensor():
+    x = _param([3.0])
+    y = x * x + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])

@@ -1,0 +1,136 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_int_default_dtype():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype in (np.int32, np.int64)
+
+
+def test_arith_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((1.0 - a).numpy(), [0, -1])
+
+
+def test_matmul():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    c = a @ b
+    assert c.shape == [2, 4]
+    np.testing.assert_allclose(c.numpy(), np.full((2, 4), 3.0))
+
+
+def test_methods_installed():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(a.sum().numpy(), 10.0)
+    np.testing.assert_allclose(a.mean(axis=0).numpy(), [2, 3])
+    np.testing.assert_allclose(a.reshape([4]).numpy(), [1, 2, 3, 4])
+    np.testing.assert_allclose(a.t().numpy(), [[1, 3], [2, 4]])
+    assert a.astype("int32").dtype == np.int32
+
+
+def test_getitem_setitem():
+    a = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(a[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[:, 1].numpy(), [1, 5, 9])
+    a[0, 0] = 100.0
+    assert a.numpy()[0, 0] == 100.0
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(a[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_inplace_ops():
+    a = paddle.to_tensor([1.0, 2.0])
+    a.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(a.numpy(), [2, 3])
+    a.scale_(2.0)
+    np.testing.assert_allclose(a.numpy(), [4, 6])
+
+
+def test_cast_clone_detach():
+    a = paddle.to_tensor([1.5, 2.5])
+    assert a.clone().shape == [2]
+    d = a.detach()
+    assert d.stop_gradient
+    a.set_value(np.array([9.0, 9.0], np.float32))
+    np.testing.assert_allclose(a.numpy(), [9, 9])
+    # detach shares nothing after set_value rebind (jax arrays immutable)
+    np.testing.assert_allclose(d.numpy(), [1.5, 2.5])
+
+
+def test_shape_utils():
+    a = paddle.zeros([2, 3, 4])
+    assert paddle.shape(a).numpy().tolist() == [2, 3, 4]
+    assert a.numel() == 24
+    assert a.ndim == 3
+
+
+def test_creation_ops():
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy()[1, 1] == 1.0
+    t = paddle.rand([4, 4])
+    assert t.shape == [4, 4]
+    r = paddle.randperm(10).numpy()
+    assert sorted(r.tolist()) == list(range(10))
+
+
+def test_concat_split_stack():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    parts = paddle.split(c, [1, 3], axis=0)
+    assert parts[1].shape == [3, 3]
+
+
+def test_comparisons_and_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    assert (a < b).numpy().tolist() == [True, False, False]
+    assert paddle.logical_and(a > 1, a < 3).numpy().tolist() == [False, True, False]
+    assert bool(paddle.allclose(a, a))
+
+
+def test_search_ops():
+    a = paddle.to_tensor([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    assert paddle.argmax(a, axis=1).numpy().tolist() == [0, 1]
+    vals, idx = paddle.topk(a, 2, axis=1)
+    assert vals.numpy()[0].tolist() == [3.0, 2.0]
+    s = paddle.sort(a, axis=1)
+    assert s.numpy()[0].tolist() == [1.0, 2.0, 3.0]
+
+
+def test_linalg():
+    a = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    inv = paddle.inverse(a)
+    np.testing.assert_allclose(inv.numpy(), np.eye(3) / 2, atol=1e-6)
+    n = paddle.norm(paddle.to_tensor([3.0, 4.0]))
+    np.testing.assert_allclose(n.numpy(), 5.0, rtol=1e-6)
+
+
+def test_einsum():
+    a = paddle.rand([2, 3])
+    b = paddle.rand([3, 4])
+    c = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
