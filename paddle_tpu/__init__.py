@@ -36,8 +36,28 @@ from . import amp  # noqa: F401,E402
 from . import flags as _flags_mod  # noqa: E402
 from .flags import get_flags, set_flags  # noqa: F401,E402
 
+from . import nn  # noqa: F401,E402  (also installs paddle.ParamAttr)
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from .regularizer import L1Decay, L2Decay  # noqa: F401,E402
+from .nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401,E402
+                      ClipGradByValue)
+# paddle.nn re-exports the clip classes too
+nn.ClipGradByGlobalNorm = ClipGradByGlobalNorm
+nn.ClipGradByNorm = ClipGradByNorm
+nn.ClipGradByValue = ClipGradByValue
+nn.clip_grad_norm_ = __import__("paddle_tpu.nn.clip", fromlist=["x"]).clip_grad_norm_
+nn.clip_grad_value_ = __import__("paddle_tpu.nn.clip", fromlist=["x"]).clip_grad_value_
+nn.initializer.set_global_initializer  # noqa: B018
+
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from .framework import autograd as _autograd_mod  # noqa: E402
+from . import autograd  # noqa: F401,E402
+
 # disable_static/enable_static are paddle's dygraph/static switches; dygraph
-# is the default and static graph is jit capture, so these are light toggles.
+# is the default and static graph is symbolic capture (framework/symbolic.py).
 _static_mode = [False]
 
 

@@ -1,0 +1,64 @@
+"""paddle.autograd surface (ref: /root/reference/python/paddle/autograd/)."""
+from __future__ import annotations
+
+from .framework.autograd import backward, grad, no_grad, set_grad_enabled  # noqa: F401
+from .framework.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.container = None
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (ref: python/paddle/autograd/py_layer.py).
+
+    Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads).
+    Registered on the tape as one node whose vjp calls user backward."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .framework import autograd as ag
+        from .framework.op import unwrap
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        needs_grad = ag.tape_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        if needs_grad:
+            for t in outs:
+                t.stop_gradient = False
+
+            def vjp_fn(cots):
+                cot_list = list(cots) if isinstance(cots, (tuple, list)) \
+                    else [cots]
+                grads = cls.backward(ctx, *[Tensor(c) for c in cot_list])
+                grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+                return tuple(unwrap(g) if g is not None else None
+                             for g in grads)
+
+            ag.record(vjp_fn, tensor_args, outs)
+        return out
+
+
+class EagerPyLayer(PyLayer):
+    pass

@@ -1,0 +1,64 @@
+"""Distributed environment state.
+
+On TPU there is one controller process per host and the device mesh carries
+parallelism (vs. the reference's one-process-per-GPU PADDLE_TRAINER_* env,
+ref: /root/reference/python/paddle/distributed/launch/controllers/
+collective.py:97-125). Rank/world_size here describe the *logical* position
+used by samplers and fleet topology; they are derived from the active
+HybridCommunicateGroup when fleet is initialized, else from jax process
+env."""
+from __future__ import annotations
+
+import os
+
+_state = {
+    "initialized": False,
+    "hcg": None,
+}
+
+
+def set_hcg(hcg):
+    _state["hcg"] = hcg
+
+
+def get_hcg():
+    return _state["hcg"]
+
+
+def mark_initialized():
+    _state["initialized"] = True
+
+
+def is_initialized():
+    return _state["initialized"]
+
+
+def get_rank():
+    import jax
+    if _state["hcg"] is not None:
+        return _state["hcg"].get_global_rank()
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size():
+    import jax
+    if _state["hcg"] is not None:
+        return _state["hcg"].get_world_size()
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    return int(env) if env else jax.process_count()
+
+
+def get_data_world_size():
+    """Size of the data-parallel axis (sharding × dp under hybrid)."""
+    if _state["hcg"] is not None:
+        return (_state["hcg"].get_data_parallel_world_size()
+                * _state["hcg"].get_sharding_parallel_world_size())
+    return get_world_size()
+
+
+def get_data_rank():
+    if _state["hcg"] is not None:
+        return (_state["hcg"].get_data_parallel_rank()
+                * _state["hcg"].get_sharding_parallel_world_size()
+                + _state["hcg"].get_sharding_parallel_rank())
+    return get_rank()

@@ -27,13 +27,15 @@ _float0 = jax.dtypes.float0
 
 
 class Node:
-    __slots__ = ("vjp_fn", "inputs", "output_ids", "output_metas")
+    __slots__ = ("vjp_fn", "inputs", "output_ids", "output_metas", "multi")
 
-    def __init__(self, vjp_fn, inputs, output_ids, output_metas):
+    def __init__(self, vjp_fn, inputs, output_ids, output_metas, multi=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs            # list[Tensor] aligned with vjp arg order
         self.output_ids = output_ids    # list[int] id() of output Tensors
         self.output_metas = output_metas  # list[(shape, dtype)]
+        # whether the impl returned a tuple (vjp cotangent must match)
+        self.multi = len(output_ids) > 1 if multi is None else multi
 
 
 class _TapeState(threading.local):
@@ -111,10 +113,10 @@ def set_grad_enabled(mode: bool):
     return _Ctx()
 
 
-def record(vjp_fn, inputs, outputs):
+def record(vjp_fn, inputs, outputs, multi=None):
     """Append a node for an op application. `outputs` are Tensor objects."""
     metas = [(tuple(o.shape), o.dtype) for o in outputs]
-    node = Node(vjp_fn, list(inputs), [id(o) for o in outputs], metas)
+    node = Node(vjp_fn, list(inputs), [id(o) for o in outputs], metas, multi)
     _tape.nodes.append(node)
     for o in outputs:
         _tape.produced.add(id(o))
@@ -156,6 +158,9 @@ def _run_backward(seed_tensors, seed_grads, retain_graph=False,
         _accumulate(grads, id(t), g)
 
     leaf_hits: Dict[int, Any] = {}
+    prev_enabled = _tape.enabled
+    _tape.enabled = False  # ops run inside vjp_fns (e.g. PyLayer.backward)
+    # must not append to the tape being walked
     for node in reversed(_tape.nodes):
         if not any(oid in grads for oid in node.output_ids):
             continue
@@ -167,7 +172,7 @@ def _run_backward(seed_tensors, seed_grads, retain_graph=False,
             if g is None:
                 g = jnp.zeros(shape, dtype)
             cots.append(g)
-        cot = tuple(cots) if len(cots) > 1 else cots[0]
+        cot = tuple(cots) if node.multi else cots[0]
         in_grads = node.vjp_fn(cot)
         for t, g in zip(node.inputs, in_grads):
             if t is None or t.stop_gradient:
@@ -176,6 +181,7 @@ def _run_backward(seed_tensors, seed_grads, retain_graph=False,
             if id(t) not in _tape.produced:
                 leaf_hits[id(t)] = t
 
+    _tape.enabled = prev_enabled
     final = dict(grads)
     final.update(saved)
 

@@ -1,0 +1,56 @@
+"""paddle.save / paddle.load (ref: /root/reference/python/paddle/framework/
+io.py:278-328 — pickled state dicts with Tensor reducers). Tensors are
+serialized as numpy arrays; nested dicts/lists round-trip."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__paddle_tensor__": True, "data": obj.numpy(),
+                "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient",
+                                                          True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(str(path), "rb") as f:
+        raw = pickle.load(f)
+    return _from_saveable(raw, return_numpy=configs.get("return_numpy",
+                                                        False))

@@ -41,6 +41,11 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
 
     kwargs = kwargs or {}
     tensor_args = maybe_cast_inputs(op_name, tensor_args)
+
+    from .symbolic import SymbolicTensor, build_node
+    if any(isinstance(a, SymbolicTensor) for a in tensor_args):
+        return build_node(impl, tensor_args, kwargs)
+
     arrays = tuple(unwrap(a) for a in tensor_args)
     input_tensors = [a if isinstance(a, Tensor) else None for a in tensor_args]
     needs_grad = (
@@ -58,7 +63,7 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
     outs = list(out) if multi else [out]
     out_tensors = [wrap(o, stop_gradient=not needs_grad) for o in outs]
     if needs_grad:
-        autograd.record(vjp_fn, input_tensors, out_tensors)
+        autograd.record(vjp_fn, input_tensors, out_tensors, multi=multi)
     return tuple(out_tensors) if multi else out_tensors[0]
 
 
@@ -70,8 +75,19 @@ def apply_inplace(target, impl: Callable, tensor_args: Sequence[Any],
     walk resolves versions by reverse execution order (see autograd).
     """
     from .tensor import Tensor
+    from .symbolic import SymbolicTensor, build_node
 
     kwargs = kwargs or {}
+    if any(isinstance(a, SymbolicTensor) for a in tensor_args):
+        out = build_node(impl, tensor_args, kwargs)
+        if isinstance(target, SymbolicTensor):
+            target._node = out._node
+            target._out_idx = out._out_idx
+            target._aval = out._aval
+            return target
+        raise RuntimeError("in-place op on a concrete Tensor with symbolic "
+                           "inputs is not supported in static mode")
+
     arrays = tuple(unwrap(a) for a in tensor_args)
     input_tensors = [a if isinstance(a, Tensor) else None for a in tensor_args]
     needs_grad = (

@@ -1,0 +1,192 @@
+"""Symbolic tensors for static-graph mode.
+
+The reference's static graph is a ProgramDesc protobuf executed by
+InterpreterCore (ref: /root/reference/paddle/fluid/framework/new_executor/
+interpretercore.cc:656 Convert, :878 RunOperator). Here the "program" is a
+DAG of pure-jax impl closures built by the same op layer (framework.op.apply
+branches when an input is symbolic); the Executor compiles the whole DAG —
+including optimizer updates — into one XLA program, which is the
+InterpreterCore+fusion-pass pipeline collapsed into XLA.
+
+Shape/dtype inference (the reference's InferMeta, paddle/phi/infermeta/) is
+jax.eval_shape over the impl.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .tensor import Tensor
+
+
+class SymNode:
+    __slots__ = ("impl", "kwargs", "args", "n_outs", "id")
+    _counter = [0]
+
+    def __init__(self, impl, kwargs, args, n_outs):
+        self.impl = impl
+        self.kwargs = kwargs
+        self.args = args          # list of SymbolicTensor | Tensor | raw
+        self.n_outs = n_outs
+        SymNode._counter[0] += 1
+        self.id = SymNode._counter[0]
+
+
+class SymbolicTensor(Tensor):
+    """A graph variable: no concrete data until Executor.run."""
+
+    __slots__ = ("_node", "_out_idx", "_aval", "_feed_name")
+
+    def __init__(self, aval, node=None, out_idx=0, feed_name=None, name=None):
+        # bypass Tensor.__init__ array conversion
+        object.__setattr__(self, "_data", None)
+        self.stop_gradient = True
+        self._grad = None
+        self.name = name or (feed_name or f"sym_{id(self)}")
+        self.persistable = False
+        self.trainable = True
+        self._hooks = []
+        self.is_distributed = False
+        self._dist_attr = None
+        self._node = node
+        self._out_idx = out_idx
+        self._aval = aval
+        self._feed_name = feed_name
+
+    @property
+    def shape(self):
+        return list(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"SymbolicTensor '{self.name}' has no data before Executor.run")
+
+    def __repr__(self):
+        return (f"SymbolicTensor(name={self.name}, shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name})")
+
+
+def is_symbolic(x):
+    return isinstance(x, SymbolicTensor)
+
+
+def build_node(impl: Callable, tensor_args, kwargs) -> Any:
+    """Called from framework.op.apply when any input is symbolic."""
+    avals = []
+    for a in tensor_args:
+        if isinstance(a, SymbolicTensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype))
+        elif isinstance(a, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype))
+        else:
+            avals.append(a)
+    out_aval = jax.eval_shape(lambda *xs: impl(*xs, **kwargs), *avals)
+    multi = isinstance(out_aval, (tuple, list))
+    outs_avals = list(out_aval) if multi else [out_aval]
+    node = SymNode(impl, kwargs, list(tensor_args), len(outs_avals))
+    outs = [SymbolicTensor(av, node, i) for i, av in enumerate(outs_avals)]
+    prog = current_program()
+    if prog is not None:
+        prog._nodes.append(node)
+    return tuple(outs) if multi else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# program context
+# ---------------------------------------------------------------------------
+
+class Program:
+    """Static-graph program (ref: python/paddle/fluid/framework.py Program).
+    Holds feed vars, recorded nodes, state updates (e.g. BN running stats)
+    and attached optimizer ops."""
+
+    def __init__(self):
+        self._feeds: Dict[str, SymbolicTensor] = {}
+        self._nodes: List[SymNode] = []
+        self._state_updates: List[Tuple[Tensor, SymbolicTensor]] = []
+        self._optimize_ops: List[Tuple[Any, SymbolicTensor]] = []
+        self.random_seed = None
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p._feeds = dict(self._feeds)
+        p._nodes = list(self._nodes)
+        p._state_updates = list(self._state_updates)
+        if not for_test:
+            p._optimize_ops = list(self._optimize_ops)
+        return p
+
+    def global_block(self):
+        return self
+
+    # Block-protocol shims
+    @property
+    def ops(self):
+        return self._nodes
+
+    def all_parameters(self):
+        seen, out = {}, []
+        for node in self._nodes:
+            for a in node.args:
+                from .tensor import Parameter
+                if isinstance(a, Parameter) and id(a) not in seen:
+                    seen[id(a)] = True
+                    out.append(a)
+        return out
+
+
+_default_main = Program()
+_default_startup = Program()
+_program_stack: List[Program] = []
+
+
+def current_program() -> Optional[Program]:
+    if _program_stack:
+        return _program_stack[-1]
+    import paddle_tpu
+    return _default_main if not paddle_tpu.in_dynamic_mode() else None
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+def reset_default_programs():
+    global _default_main, _default_startup
+    _default_main = Program()
+    _default_startup = Program()
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _program_stack.append(self.main)
+        return self
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def record_state_update(target: Tensor, sym_value: SymbolicTensor):
+    prog = current_program()
+    if prog is not None:
+        prog._state_updates.append((target, sym_value))

@@ -1,0 +1,247 @@
+"""paddle.jit: to_static / save / load.
+
+The reference translates dygraph Python to a static ProgramDesc via AST
+rewriting and runs it with PartialProgramLayer inside dygraph
+(ref: /root/reference/python/paddle/jit/api.py:232,
+dy2static/program_translator.py:304, partial_program.py:150).
+
+TPU-native design: `to_static` captures the layer/function as ONE jitted
+pure-jax function with parameters and buffers as inputs. The capture is
+registered on the autograd tape as a single op, so dygraph
+``loss.backward()`` differentiates straight through the compiled program
+(vjp-of-jit == compiled backward) — the PartialProgramLayer semantics with
+XLA doing the program construction instead of AST transforms.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import autograd, random as _random
+from ..framework.op import apply, unwrap
+from ..framework.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+from ..static.input_spec import InputSpec
+
+__all__ = ["to_static", "not_to_static", "save", "load", "ignore_module",
+           "enable_to_static", "TranslatedLayer", "StaticFunction"]
+
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag: bool):
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
+
+def ignore_module(modules):
+    pass
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    fn._not_to_static = True
+    return fn
+
+
+def _tree_flatten_tensors(obj):
+    """Flatten nested (list/tuple/dict) structures of Tensors."""
+    leaves: List[Any] = []
+
+    def walk(o):
+        if isinstance(o, Tensor):
+            leaves.append(o)
+            return ("T", len(leaves) - 1)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [walk(v) for v in o])
+        if isinstance(o, dict):
+            return ("dict", {k: walk(v) for k, v in o.items()})
+        return ("L", o)
+
+    treedef = walk(obj)
+    return leaves, treedef
+
+
+def _tree_unflatten(treedef, leaves):
+    kind = treedef[0]
+    if kind == "T":
+        return leaves[treedef[1]]
+    if kind in ("list", "tuple"):
+        seq = [_tree_unflatten(t, leaves) for t in treedef[1]]
+        return seq if kind == "list" else tuple(seq)
+    if kind == "dict":
+        return {k: _tree_unflatten(t, leaves) for k, t in treedef[1].items()}
+    return treedef[1]
+
+
+class StaticFunction:
+    """Compiled-callable cache keyed by input signature (the analog of the
+    reference's _ExecutorCache / ProgramCache)."""
+
+    def __init__(self, function, input_spec=None, layer=None, **kwargs):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__"))
+
+    @property
+    def forward_function(self):
+        return self._function
+
+    def _collect_state(self):
+        if self._layer is None:
+            return [], [], [], []
+        params, pnames = [], []
+        for n, p in self._layer.named_parameters():
+            params.append(p)
+            pnames.append(n)
+        buffers, bnames = [], []
+        for n, b in self._layer.named_buffers():
+            if b is not None:
+                buffers.append(b)
+                bnames.append(n)
+        return params, pnames, buffers, bnames
+
+    def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            if self._layer is not None:
+                return self._function(self._layer, *args, **kwargs)
+            return self._function(*args, **kwargs)
+
+        params, _, buffers, _ = self._collect_state()
+        arg_leaves, arg_tree = _tree_flatten_tensors((args, kwargs))
+        sig = (
+            tuple((tuple(t.shape), str(t.dtype)) for t in arg_leaves),
+            repr(arg_tree),
+            self._layer.training if self._layer is not None else None,
+            autograd.tape_enabled(),
+        )
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build(arg_tree, len(arg_leaves), len(params),
+                                len(buffers))
+            self._cache[sig] = entry
+        impl, n_out_buffers_box, out_tree_box = entry
+
+        key = _random.next_key()
+        tensor_args = tuple(arg_leaves) + tuple(params) + tuple(buffers) \
+            + (key,)
+        flat_out = apply(impl, tensor_args, op_name="jit_program")
+        if not isinstance(flat_out, tuple):
+            flat_out = (flat_out,)
+        n_buf = n_out_buffers_box[0]
+        out_leaves = flat_out[:len(flat_out) - n_buf]
+        new_buf = flat_out[len(flat_out) - n_buf:]
+        for b, nb in zip(buffers, new_buf):
+            b._data = nb.data
+        return _tree_unflatten(out_tree_box[0], list(out_leaves))
+
+    def _build(self, arg_tree, n_args, n_params, n_buffers):
+        out_tree_box = [None]
+        n_out_buffers_box = [n_buffers]
+        fn = self._function
+        layer = self._layer
+        collect = self._collect_state
+
+        @jax.jit
+        def impl(*arrays):
+            arg_arrays = arrays[:n_args]
+            param_arrays = arrays[n_args:n_args + n_params]
+            buffer_arrays = arrays[n_args + n_params:
+                                   n_args + n_params + n_buffers]
+            key = arrays[-1]
+            params, _, buffers, _ = collect()
+            saved_p = [p._data for p in params]
+            saved_b = [b._data for b in buffers]
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            for b, a in zip(buffers, buffer_arrays):
+                b._data = a
+            try:
+                wrapped = [Tensor(a, stop_gradient=True) for a in arg_arrays]
+                call_args, call_kwargs = _tree_unflatten(
+                    arg_tree, wrapped)
+                with autograd.no_grad(), _random.key_scope(key):
+                    if layer is not None:
+                        out = fn(layer, *call_args, **call_kwargs)
+                    else:
+                        out = fn(*call_args, **call_kwargs)
+                out_leaves, out_tree = _tree_flatten_tensors(out)
+                out_tree_box[0] = out_tree
+                new_buffer_arrays = [b._data for b in buffers]
+            finally:
+                for p, a in zip(params, saved_p):
+                    p._data = a
+                for b, a in zip(buffers, saved_b):
+                    b._data = a
+            return tuple(unwrap(t) for t in out_leaves) \
+                + tuple(new_buffer_arrays)
+
+        return impl, n_out_buffers_box, out_tree_box
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static (ref: python/paddle/jit/api.py:232)."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(type(obj).forward, input_spec, layer=obj)
+            obj.forward = sf
+            obj._static_function = sf
+            return obj
+        # plain function or unbound Layer.forward
+        return StaticFunction(obj, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (ref: python/paddle/jit/translated_layer.py:1303)."""
+
+    def __init__(self, inner_layer, input_spec=None):
+        super().__init__()
+        self._inner = inner_layer
+        self._input_spec = input_spec
+
+    def forward(self, *args, **kwargs):
+        return self._inner(*args, **kwargs)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists the layer (pickled class + state dict) plus
+    input specs. The TPU runtime re-jits at load; XLA compilation cache makes
+    this cheap vs. shipping a serialized program."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    state = {k: v.numpy() for k, v in layer.state_dict().items()}
+    payload = {
+        "layer": layer,
+        "state": state,
+        "input_spec": input_spec,
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    layer = payload["layer"]
+    sd = {k: Tensor(v) for k, v in payload["state"].items()}
+    layer.set_state_dict(sd)
+    layer.eval()
+    return TranslatedLayer(layer, payload.get("input_spec"))

@@ -1,0 +1,51 @@
+"""paddle.nn surface (ref: /root/reference/python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer.container import (LayerDict, LayerList, ParameterList,  # noqa: F401
+                              Sequential)
+from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity,  # noqa: F401
+                           Dropout, Dropout2D, Dropout3D, Embedding, Flatten,
+                           Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
+                           PairwiseDistance, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
+from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,  # noqa: F401
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
+                               ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
+                               Softplus, Softshrink, Softsign, Swish, Tanh,
+                               Tanhshrink, ThresholdedReLU)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,  # noqa: F401
+                         Conv3D, Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,  # noqa: F401
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         RMSNorm, SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,  # noqa: F401
+                         CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
+                         HingeEmbeddingLoss, KLDivLoss, L1Loss,
+                         MarginRankingLoss, MSELoss,
+                         MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss,
+                         SigmoidFocalLoss, SmoothL1Loss, SoftMarginLoss,
+                         TripletMarginLoss)
+from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+
+
+def _install_top_level():
+    """Expose paddle.ParamAttr / paddle.nn at the package root."""
+    import paddle_tpu
+    paddle_tpu.ParamAttr = ParamAttr
+    paddle_tpu.nn = __import__("paddle_tpu.nn", fromlist=["nn"])
+
+
+_install_top_level()

@@ -1,0 +1,213 @@
+"""Activation functionals (ref: /root/reference/python/paddle/nn/functional/
+activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.op import apply, apply_inplace, unwrap
+from ...framework.tensor import Tensor
+from ...ops._helpers import op, normalize_axis
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu",
+    "hardshrink", "hardsigmoid", "hardswish", "hardtanh", "leaky_relu",
+    "log_sigmoid", "log_softmax", "maxout", "mish", "prelu", "rrelu",
+    "sigmoid", "silu", "softmax", "softmax_", "softplus", "softshrink",
+    "softsign", "swish", "tanh", "tanh_", "tanhshrink", "thresholded_relu",
+    "glu", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return op("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return apply_inplace(x, jax.nn.relu, (x,))
+
+
+def relu6(x, name=None):
+    return op("relu6", jax.nn.relu6, x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return apply_inplace(x, lambda a: jax.nn.elu(a, alpha), (x,))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op("hardshrink",
+              lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op("hardsigmoid",
+              lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return op("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def log_sigmoid(x, name=None):
+    return op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    ax = normalize_axis(axis)
+    def impl(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=ax)
+    return op("log_softmax", impl, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return op("maxout", impl, x)
+
+
+def mish(x, name=None):
+    return op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return op("prelu", impl, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    from ...framework import random as _random
+    if training:
+        def impl(a):
+            r = jax.random.uniform(_random.next_key(), a.shape, a.dtype,
+                                   lower, upper)
+            return jnp.where(a >= 0, a, r * a)
+        return op("rrelu", impl, x)
+    mid = (lower + upper) / 2.0
+    return op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def sigmoid(x, name=None):
+    return op("sigmoid", jax.nn.sigmoid, x)
+
+
+def silu(x, name=None):
+    return op("silu", jax.nn.silu, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    ax = normalize_axis(axis)
+    def impl(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=ax)
+    return op("softmax", impl, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    ax = normalize_axis(axis)
+    return apply_inplace(x, lambda a: jax.nn.softmax(a, axis=ax), (x,))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def impl(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a,
+                         jnp.logaddexp(scaled, 0.0) / beta)
+    return op("softplus", impl, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op("softshrink",
+              lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0), x)
+
+
+def softsign(x, name=None):
+    return op("softsign", jax.nn.soft_sign, x)
+
+
+def swish(x, name=None):
+    return op("swish", jax.nn.silu, x)
+
+
+def tanh(x, name=None):
+    return op("tanh", jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    return apply_inplace(x, jnp.tanh, (x,))
+
+
+def tanhshrink(x, name=None):
+    return op("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return op("thresholded_relu",
+              lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def glu(x, axis=-1, name=None):
+    def impl(a):
+        lhs, rhs = jnp.split(a, 2, axis=axis)
+        return lhs * jax.nn.sigmoid(rhs)
+    return op("glu", impl, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _random
+    def impl(a):
+        g = jax.random.gumbel(_random.next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis) \
+                if hasattr(jnp, "put_along_axis") else \
+                onehot.at[_along(idx, y, axis)].set(1.0)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return op("gumbel_softmax", impl, x)
+
+
+def _along(idx, y, axis):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    grids[axis] = idx
+    return tuple(grids)

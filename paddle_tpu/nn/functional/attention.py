@@ -1,0 +1,116 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` mirrors the flash-attention entry the
+reference binds (ref: /root/reference/paddle/phi/kernels/gpu/
+flash_attn_kernel.cu, python/paddle/nn/functional/flash_attention.py).
+On TPU the fast path is the Pallas flash kernel in
+paddle_tpu/ops/pallas/flash_attention.py, selected when shapes/dtypes
+qualify and FLAGS_enable_pallas_kernels is on; otherwise a jnp fallback that
+XLA fuses well."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.op import apply, unwrap
+from ...framework.tensor import Tensor
+from ...flags import get_flag
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdpa_reference"]
+
+
+def _sdpa_jnp(q, k, v, mask, dropout_p, causal, scale):
+    # q,k,v: [B, L, H, D] (paddle flash-attn layout)
+    qh = jnp.moveaxis(q, 1, 2)  # [B,H,L,D]
+    kh = jnp.moveaxis(k, 1, 2)
+    vh = jnp.moveaxis(v, 1, 2)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        scores = jnp.where(cm, scores, -1e30 if scores.dtype == jnp.float32
+                           else -3e4)
+    if mask is not None:
+        scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.moveaxis(out, 2, 1)  # back to [B,L,H,D]
+
+
+def sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                   scale=None):
+    """Pure-jnp reference used by tests to validate the pallas kernel."""
+    args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
+    def impl(qa, ka, va, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_jnp(qa, ka, va, m, dropout_p, is_causal, scale)
+    return apply(impl, args, op_name="flash_attention")
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention.
+    Layout [batch, seqlen, num_heads, head_dim] as the reference's
+    flash-attention API."""
+    use_pallas = (
+        get_flag("FLAGS_enable_pallas_kernels", True)
+        and attn_mask is None
+        and dropout_p == 0.0
+        and query.shape[-1] >= 64
+        and query.shape[-1] % 64 == 0
+        and query.shape[1] % 128 == 0
+        and key.shape[1] % 128 == 0
+        and _on_tpu()
+    )
+    if use_pallas:
+        from ...ops.pallas.flash_attention import flash_attention_blhd
+        def impl(qa, ka, va):
+            return flash_attention_blhd(qa, ka, va, causal=is_causal)
+        return apply(impl, (query, key, value), op_name="flash_attention")
+    return sdpa_reference(query, key, value, attn_mask, dropout_p, is_causal)
+
+
+def _on_tpu():
+    import jax
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """ref: python/paddle/nn/functional/flash_attention.py — returns
+    (out, softmax) tuple like the reference."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, **kw):
+    """Varlen API: fall back to dense per-sequence attention."""
+    q, k, v = unwrap(query), unwrap(key), unwrap(value)
+    cu_q = unwrap(cu_seqlens_q)
+    cu_k = unwrap(cu_seqlens_k)
+    import numpy as np
+    cu_q = np.asarray(cu_q)
+    cu_k = np.asarray(cu_k)
+    outs = []
+    for i in range(len(cu_q) - 1):
+        qs = query[int(cu_q[i]):int(cu_q[i + 1])]
+        ks = key[int(cu_k[i]):int(cu_k[i + 1])]
+        vs = value[int(cu_k[i]):int(cu_k[i + 1])]
+        from ...ops.manipulation import unsqueeze, squeeze
+        o = sdpa_reference(unsqueeze(qs, 0), unsqueeze(ks, 0),
+                           unsqueeze(vs, 0), None, dropout, causal, scale)
+        outs.append(squeeze(o, 0))
+    from ...ops.manipulation import concat
+    return concat(outs, 0), None

@@ -1,0 +1,172 @@
+"""Convolutions via lax.conv_general_dilated — the MXU conv path
+(ref: /root/reference/python/paddle/nn/functional/conv.py; kernels
+paddle/phi/kernels/gpudnn/conv_kernel.cu). Weight layout matches paddle:
+[out_c, in_c/groups, *kernel]."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import apply
+from ...framework.tensor import Tensor
+from ...ops._helpers import op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n, data_format):
+    """paddle padding: int | [int]*n | [[lo,hi]]*n | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-dim spec incl. batch/channel — strip those
+        spatial = [p for p in padding if list(p) != [0, 0]] or padding[-n:]
+        if len(spatial) != n:
+            spatial = padding[-n:]
+        return [tuple(p) for p in spatial]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dn(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    strides = _tuple(stride, n)
+    dils = _tuple(dilation, n)
+    pads = _padding(padding, n, data_format)
+    dn = _dn(n, channel_last)
+
+    def impl(a, w, *rest):
+        # paddle weight [O, I/g, *k]; lax wants per dn spec
+        if channel_last:
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))  # [*k, I/g, O]
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pads,
+            rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=a.dtype)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(bshape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(impl, args, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    strides = _tuple(stride, n)
+    dils = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for conv_transpose")
+    pads = _padding(padding, n, data_format)
+    dn = _dn(n, channel_last)
+
+    def impl(a, w, *rest):
+        # paddle transpose-conv weight layout: [in_c, out_c/g, *k]
+        k = w.shape[2:]
+        # gradient-of-conv formulation: lhs_dilation = stride
+        tpads = []
+        for i in range(n):
+            lo, hi = pads[i]
+            eff_k = (k[i] - 1) * dils[i] + 1
+            tpads.append((eff_k - 1 - lo, eff_k - 1 - hi + opad[i]))
+        # w: [I, O/g, *k] -> flip spatial, swap to [O, I/g-style]
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ii, og = wt.shape[0], wt.shape[1]
+            wt = wt.reshape((groups, ii // groups, og) + k)
+            wt = jnp.swapaxes(wt, 1, 2)          # [g, O/g, I/g, *k]
+            wt = wt.reshape((og * groups, ii // groups) + k)
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)
+        if channel_last:
+            wt = jnp.moveaxis(wt, (0, 1), (-1, -2))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=tpads,
+            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=a.dtype)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(bshape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    out = apply(impl, args, op_name=f"conv{n}d_transpose")
+    if output_size is not None:
+        target = _tuple(output_size, n)
+        sl = [slice(None)] * out.ndim
+        off = 1 if not channel_last else 1
+        for i in range(n):
+            d = (2 + i) if not channel_last else (1 + i)
+            sl[d] = slice(0, target[i])
+        out = out[tuple(sl)]
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

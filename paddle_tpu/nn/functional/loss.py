@@ -1,0 +1,369 @@
+"""Loss functionals (ref: /root/reference/python/paddle/nn/functional/loss.py).
+cross_entropy matches paddle semantics: soft/hard labels, ignore_index,
+label smoothing via label_smooth, reductions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import apply
+from ...framework.tensor import Tensor
+from ...ops._helpers import op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
+    "log_loss", "sigmoid_focal_loss", "triplet_margin_loss",
+    "soft_margin_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+    "multi_label_soft_margin_loss", "npair_loss", "ctc_loss", "dice_loss",
+    "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def impl(logits, lbl, *rest):
+        w = rest[0] if rest else None
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        n_cls = logits.shape[ax]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[ax] == n_cls
+                          and jnp.issubdtype(lbl.dtype, jnp.floating)):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_cls
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if w is not None:
+                wc = jnp.sum(soft * w.reshape(
+                    [-1 if i == ax else 1 for i in range(logits.ndim)]), axis=ax)
+                loss = loss * wc
+            return _reduce(loss, reduction)
+        hard = lbl
+        if hard.ndim == logits.ndim:
+            hard = jnp.squeeze(hard, axis=ax)
+        hard = hard.astype(jnp.int32)
+        valid = hard != ignore_index
+        safe = jnp.where(valid, hard, 0)
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(safe, n_cls, axis=ax, dtype=logp.dtype)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / n_cls
+            picked = -jnp.sum(soft * logp, axis=ax)
+        else:
+            picked = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, ax), axis=ax).squeeze(ax)
+        picked = jnp.where(valid, picked, 0.0)
+        if w is not None:
+            wsel = jnp.where(valid, jnp.take(w, safe), 0.0)
+            picked = picked * wsel
+            if reduction == "mean":
+                return jnp.sum(picked) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+            return jnp.sum(picked) / denom
+        return _reduce(picked, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(impl, args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    # paddle keeps a trailing singleton dim on the loss
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return op("mse_loss",
+              lambda a, b: _reduce((a - b) ** 2, reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op("l1_loss",
+              lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def impl(logp, lbl, *rest):
+        w = rest[0] if rest else None
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1),
+                                      axis=1).squeeze(1)
+        picked = jnp.where(valid, picked, 0.0)
+        if w is not None:
+            wsel = jnp.where(valid, jnp.take(w, safe), 0.0)
+            picked = picked * wsel
+            if reduction == "mean":
+                return jnp.sum(picked) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(
+                jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(picked, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(impl, args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def impl(p, l, *rest):
+        eps = 1e-12
+        out = -(l * jnp.log(jnp.maximum(p, eps))
+                + (1 - l) * jnp.log(jnp.maximum(1 - p, eps)))
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(impl, args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def impl(z, l, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            out = -(pw * l * log_sig + (1 - l) * log_sig_neg)
+        else:
+            out = -(l * log_sig + (1 - l) * log_sig_neg)
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+    args = (logit, label) + tuple(t for t in (weight, pos_weight)
+                                  if t is not None)
+    return apply(impl, args, op_name="sigmoid_cross_entropy_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def impl(logp, tgt):
+        out = tgt * (jnp.log(jnp.maximum(tgt, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce(out, reduction)
+    return apply(impl, (input, label), op_name="kldiv_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(out, reduction)
+    return op("smooth_l1_loss", impl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def impl(a, b, l):
+        out = jnp.maximum(-l * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply(impl, (input, other, label), op_name="margin_ranking_loss")
+
+
+def square_error_cost(input, label):
+    return op("square_error_cost", lambda a, b: (a - b) ** 2, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def impl(p, l):
+        return -(l * jnp.log(p + epsilon)
+                 + (1 - l) * jnp.log(1 - p + epsilon))
+    return op("log_loss", impl, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def impl(z, l, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        pt = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        out = a_t * ((1 - pt) ** gamma) * ce
+        if rest:
+            out = out / rest[0]
+        return _reduce(out, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply(impl, args, op_name="sigmoid_focal_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def impl(a, pos, neg):
+        def dst(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_pos = dst(a, pos)
+        d_neg = dst(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dst(pos, neg))
+        return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+    return apply(impl, (input, positive, negative),
+                 op_name="triplet_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def impl(a, l):
+        return _reduce(jnp.log1p(jnp.exp(-l * a)), reduction)
+    return op("soft_margin_loss", impl, input, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def impl(a, l):
+        out = jnp.where(l == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(out, reduction)
+    return op("hinge_embedding_loss", impl, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def impl(a, b, l):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(l == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+    return apply(impl, (input1, input2, label),
+                 op_name="cosine_embedding_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def impl(z, l, *rest):
+        out = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        out = out.mean(-1)
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(impl, args, op_name="multi_label_soft_margin_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def impl(a, p, l):
+        sim = a @ p.T
+        l = l.reshape(-1)
+        target = (l[:, None] == l[None, :]).astype(sim.dtype)
+        target = target / target.sum(-1, keepdims=True)
+        ce = -jnp.sum(target * jax.nn.log_softmax(sim, -1), -1).mean()
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * a.shape[0])
+        return ce + reg
+    return apply(impl, (anchor, positive, labels), op_name="npair_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def impl(p, l):
+        l_oh = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * l_oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(l_oh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(impl, (input, label), op_name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def impl(a, l):
+        if log_input:
+            out = jnp.exp(a) - l * a
+        else:
+            out = a - l * jnp.log(a + epsilon)
+        if full:
+            stirling = l * jnp.log(jnp.maximum(l, 1.0)) - l \
+                + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(l, 1.0))
+            out = out + jnp.where(l > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return op("poisson_nll_loss", impl, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def impl(mu, l, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (l - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(out, reduction)
+    return apply(impl, (input, label, variance), op_name="gaussian_nll_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion in log space (ref:
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h). log_probs: [T,B,C]."""
+    def impl(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        Lext = 2 * lbl_len.astype(jnp.int32) + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1).squeeze(1)
+        alpha0 = alpha0.at[:, 1].set(jnp.where(Lext > 1, first_lbl, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            m_safe = jnp.maximum(m, neg_inf)
+            tot = m_safe + jnp.log(
+                jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe)
+                + jnp.exp(a_shift2 - m_safe) + 1e-38)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return tot + emit, None
+
+        def scan_body(alpha, t):
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze once past input length
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        idx_last = Lext - 1
+        idx_prev = jnp.maximum(Lext - 2, 0)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1).squeeze(1)
+        a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1).squeeze(1)
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-38)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+    return apply(impl, (log_probs, labels, input_lengths, label_lengths),
+                 op_name="ctc_loss")

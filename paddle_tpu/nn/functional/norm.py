@@ -1,0 +1,218 @@
+"""Normalization functionals (ref: /root/reference/python/paddle/nn/
+functional/norm.py; fused GPU kernels in paddle/phi/kernels/fusion/gpu/ —
+here XLA fuses the elementwise chain natively, pallas variant in
+paddle_tpu/ops/pallas)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.op import apply
+from ...framework.tensor import Tensor
+from ...ops._helpers import op, normalize_axis
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm",
+           "group_norm", "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return op("normalize", impl, x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(normalized_shape)
+
+    def impl(a, *rest):
+        axes = tuple(range(a.ndim - n_norm, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(normalized_shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(normalized_shape)
+        return out.astype(a.dtype)
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(impl, args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (no reference equivalent op; used by the Llama family)."""
+    def impl(a, *rest):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        out = a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)
+        if rest:
+            out = out * rest[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply(impl, args, op_name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def stats_shape(a):
+        s = [1] * a.ndim
+        s[ch_axis] = a.shape[ch_axis]
+        return s
+
+    from ...framework.symbolic import SymbolicTensor, record_state_update
+    if use_batch_stats and isinstance(x, SymbolicTensor):
+        # static mode: batch stats + running-stat updates are graph nodes;
+        # Executor writes the new running stats back after each run
+        def impl_sym(a, m, v, *rest):
+            ch = ch_axis % a.ndim
+            axes = tuple(i for i in range(a.ndim) if i != ch)
+            bm = jnp.mean(a.astype(jnp.float32), axis=axes)
+            bv = jnp.var(a.astype(jnp.float32), axis=axes)
+            n = 1
+            for i in axes:
+                n *= a.shape[i]
+            unbiased = bv * (n / max(n - 1, 1))
+            new_m = momentum * m + (1 - momentum) * bm.astype(m.dtype)
+            new_v = momentum * v + (1 - momentum) * unbiased.astype(v.dtype)
+            shape = [1] * a.ndim
+            shape[ch] = a.shape[ch]
+            out = (a.astype(jnp.float32) - bm.reshape(shape)) * \
+                jax.lax.rsqrt(bv.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].astype(jnp.float32).reshape(shape); i += 1
+            if bias is not None:
+                out = out + rest[i].astype(jnp.float32).reshape(shape)
+            return out.astype(a.dtype), new_m, new_v
+        args = (x, running_mean, running_var) + tuple(
+            t for t in (weight, bias) if t is not None)
+        out, new_m, new_v = apply(impl_sym, args, op_name="batch_norm")
+        if running_mean is not None:
+            record_state_update(running_mean, new_m)
+        if running_var is not None:
+            record_state_update(running_var, new_v)
+        return out
+
+    if use_batch_stats:
+        # compute batch stats eagerly so running stats update in-place
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(a.ndim) if i != ch_axis % a.ndim)
+        bm = jnp.mean(a.astype(jnp.float32), axis=axes)
+        bv = jnp.var(a.astype(jnp.float32), axis=axes)
+        if running_mean is not None:
+            running_mean._data = (momentum * running_mean.data
+                                  + (1 - momentum) * bm.astype(running_mean.dtype))
+        if running_var is not None:
+            import numpy as _np
+            n = int(_np.prod([a.shape[i] for i in axes]))
+            unbiased = bv * (n / max(n - 1, 1))
+            running_var._data = (momentum * running_var.data
+                                 + (1 - momentum) * unbiased.astype(running_var.dtype))
+        mean_arr, var_arr = bm, bv
+        def impl(a_, *rest):
+            axes_ = tuple(i for i in range(a_.ndim) if i != ch_axis % a_.ndim)
+            m = jnp.mean(a_.astype(jnp.float32), axis=axes_, keepdims=True)
+            v = jnp.var(a_.astype(jnp.float32), axis=axes_, keepdims=True)
+            out = (a_.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].astype(jnp.float32).reshape(stats_shape(a_)); i += 1
+            if bias is not None:
+                out = out + rest[i].astype(jnp.float32).reshape(stats_shape(a_))
+            return out.astype(a_.dtype)
+        args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+        return apply(impl, args, op_name="batch_norm")
+
+    def impl(a, m, v, *rest):
+        m = m.astype(jnp.float32).reshape(stats_shape(a))
+        v = v.astype(jnp.float32).reshape(stats_shape(a))
+        out = (a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(stats_shape(a)); i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(stats_shape(a))
+        return out.astype(a.dtype)
+    args = (x, running_mean, running_var) + tuple(
+        t for t in (weight, bias) if t is not None)
+    return apply(impl, args, op_name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def impl(a, *rest):
+        ch = ch_axis % a.ndim
+        axes = tuple(i for i in range(a.ndim) if i not in (0, ch))
+        m = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[ch] = a.shape[ch]
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape); i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(impl, args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def impl(a, *rest):
+        if channel_last:
+            a_nchw = jnp.moveaxis(a, -1, 1)
+        else:
+            a_nchw = a
+        n, c = a_nchw.shape[0], a_nchw.shape[1]
+        spatial = a_nchw.shape[2:]
+        g = a_nchw.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (g.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+        out = out.reshape(a_nchw.shape)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape); i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        out = out.astype(a.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(impl, args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
+        windows = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                             for i in range(size)], axis=-1)
+        div = jnp.moveaxis(windows.sum(-1), -1, ch_axis)
+        return a / jnp.power(k + alpha * div, beta)
+    return op("local_response_norm", impl, x)

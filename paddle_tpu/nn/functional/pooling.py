@@ -1,0 +1,209 @@
+"""Pooling functionals via lax.reduce_window (ref: /root/reference/python/
+paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._helpers import op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False,
+          is_avg=False, name="pool"):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        p = _tuple(padding, n) if not (isinstance(padding, (list, tuple))
+                                       and isinstance(padding[0], (list, tuple))) \
+            else None
+        if p is not None:
+            pads = [(pi, pi) for pi in p]
+        else:
+            pads = [tuple(pp) for pp in padding]
+
+    def impl(a):
+        if channel_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            wpads = [(0, 0)] + (list(pads) if not isinstance(pads, str) else pads) + [(0, 0)] \
+                if not isinstance(pads, str) else pads
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+            wpads = [(0, 0), (0, 0)] + list(pads) if not isinstance(pads, str) else pads
+        if isinstance(wpads, list) and ceil_mode:
+            # widen high-side pads so the last partial window is included
+            sp_off = 1 if channel_last else 2
+            wpads = list(wpads)
+            for i in range(n):
+                d = sp_off + i
+                lo, hi = wpads[d]
+                size = a.shape[d] + lo + hi
+                rem = (size - k[i]) % s[i]
+                if rem != 0:
+                    wpads[d] = (lo, hi + (s[i] - rem))
+        out = jax.lax.reduce_window(a, init, reducer, dims, strides, wpads)
+        if is_avg:
+            if (not isinstance(wpads, str)) and any(p != (0, 0) for p in wpads) \
+                    and exclusive and not count_include_pad:
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                               strides, wpads)
+                out = out / counts
+            else:
+                out = out / float(np.prod(k))
+        return out
+    return op(name, impl, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf,
+                 df, ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
+                data_format, ceil_mode, name="max_pool2d")
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                 data_format, ceil_mode, name="max_pool3d")
+
+
+def _max_pool_indices(x, kernel, stride, padding, data_format):
+    from ...framework.op import unwrap, wrap
+    a = unwrap(x)
+    k = _tuple(kernel, 2)
+    s = _tuple(stride if stride is not None else kernel, 2)
+    p = _tuple(padding, 2)
+    n, c, h, w = a.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, a.shape)
+    # select index of max via reduce_window on (value, index) pairs
+    def sel(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    vals, idxs = jax.lax.reduce_window(
+        (a, flat_idx), (-jnp.inf, -1.0), sel,
+        (1, 1) + k, (1, 1) + s, pads)
+    return wrap(idxs.astype(jnp.int64))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0, df,
+                 ceil_mode, exclusive=exclusive, is_avg=True,
+                 name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                data_format, ceil_mode, exclusive=exclusive, is_avg=True,
+                name="avg_pool2d")
+    if divisor_override:
+        k = _tuple(kernel_size, 2)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 data_format, ceil_mode, exclusive=exclusive, is_avg=True,
+                 name="avg_pool3d")
+
+
+def _adaptive_pool(x, output_size, n, mode, data_format, name):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    osize = _tuple(output_size, n)
+
+    def impl(a):
+        sp_off = 1 if channel_last else 2
+        out = a
+        for i in range(n):
+            d = sp_off + i
+            in_n = out.shape[d]
+            out_n = osize[i] if osize[i] is not None else in_n
+            if in_n == out_n:
+                continue
+            if in_n % out_n == 0:
+                k = in_n // out_n
+                moved = jnp.moveaxis(out, d, -1)
+                new_shape = moved.shape[:-1] + (out_n, k)
+                red = moved.reshape(new_shape)
+                red = jnp.mean(red, -1) if mode == "avg" else jnp.max(red, -1)
+                out = jnp.moveaxis(red, -1, d)
+            else:
+                # variable window per output position (paddle formula)
+                starts = (np.arange(out_n) * in_n) // out_n
+                ends = ((np.arange(out_n) + 1) * in_n + out_n - 1) // out_n
+                moved = jnp.moveaxis(out, d, 0)
+                pieces = []
+                for s0, e0 in zip(starts, ends):
+                    seg = moved[int(s0):int(e0)]
+                    pieces.append(seg.mean(0) if mode == "avg" else seg.max(0))
+                out = jnp.moveaxis(jnp.stack(pieces, 0), 0, d)
+        return out
+    return op(name, impl, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCW",
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format,
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format,
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCW",
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW",
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW",
+                          "adaptive_max_pool3d")

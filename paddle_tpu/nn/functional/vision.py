@@ -1,0 +1,156 @@
+"""Vision functionals (ref: /root/reference/python/paddle/nn/functional/
+vision.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op
+from ...framework.op import apply
+
+__all__ = ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+           "grid_sample", "affine_grid", "temporal_shift"]
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return op("pixel_shuffle", impl, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return op("pixel_unshuffle", impl, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.swapaxes(3, 4).reshape(n, h, w, c)
+    return op("channel_shuffle", impl, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: [N,C,H,W], grid: [N,Ho,Wo,2] in [-1,1] (xy order)."""
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            # [N,Ho,Wo] gathers per batch
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                vals = jnp.where(inb[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+            return jnp.moveaxis(out, -1, 1)
+
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == "reflection":
+            def reflect(v, n_):
+                if align_corners:
+                    span = n_ - 1
+                    v = jnp.abs(jnp.mod(v + span, 2 * span) - span) if span > 0 \
+                        else jnp.zeros_like(v)
+                else:
+                    span = n_
+                    v = jnp.mod(v + 0.5 + 2 * span, 2 * span)
+                    v = jnp.abs(v - span) - 0.5
+                    v = jnp.clip(v, 0, n_ - 1)
+                return v
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        v00 = sample(x0, y0)
+        v01 = sample(x1, y0)
+        v10 = sample(x0, y1)
+        v11 = sample(x1, y1)
+        out = (v00 * ((1 - wx) * (1 - wy))[..., None]
+               + v01 * (wx * (1 - wy))[..., None]
+               + v10 * ((1 - wx) * wy)[..., None]
+               + v11 * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+    return apply(impl, (x, grid), op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if hasattr(out_shape, "numpy"):
+        out_shape = out_shape.numpy().tolist()
+    out_shape = [int(s) for s in out_shape]
+    def impl(th):
+        n, _, h, w = out_shape
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+    return op("affine_grid", impl, theta)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def impl(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                                 a[:, :-1, fold:2 * fold]], 1)
+        rest = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return op("temporal_shift", impl, x)

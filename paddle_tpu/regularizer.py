@@ -1,0 +1,13 @@
+"""Regularizers (ref: /root/reference/python/paddle/regularizer.py)."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff

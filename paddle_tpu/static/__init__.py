@@ -1,0 +1,180 @@
+"""paddle.static surface (ref: /root/reference/python/paddle/static/).
+
+Static mode = build a symbolic DAG with the same paddle.nn layers, run it
+through Executor (one jitted XLA program). `paddle.enable_static()` switches
+op applications into graph building."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.symbolic import (Program, SymbolicTensor,  # noqa: F401
+                                  default_main_program,
+                                  default_startup_program, program_guard,
+                                  reset_default_programs)
+from ..framework.tensor import Tensor
+from ..framework.dtype import convert_dtype, get_default_dtype
+from .executor import Executor  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401
+
+import jax
+
+__all__ = ["data", "InputSpec", "Program", "Executor",
+           "default_main_program", "default_startup_program",
+           "program_guard", "name_scope", "save_inference_model",
+           "load_inference_model", "scope_guard", "global_scope", "cpu_places",
+           "cuda_places", "tpu_places", "nn", "gradients", "append_backward"]
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """ref: python/paddle/static/input.py data()."""
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    shape = tuple(int(s) if s not in (None, -1) else -1 for s in shape)
+    # -1 dims get a placeholder batch of 1 for aval purposes; Executor re-jits
+    # per concrete feed shape anyway.
+    aval_shape = tuple(1 if s == -1 else s for s in shape)
+    aval = jax.ShapeDtypeStruct(aval_shape, dtype)
+    var = SymbolicTensor(aval, feed_name=name, name=name)
+    prog = default_main_program()
+    prog._feeds[name] = var
+    return var
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _Scope:
+    def var(self, name):
+        return None
+
+    def find_var(self, name):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def cpu_places(device_count=None):
+    from ..framework.device import CPUPlace
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.device import CUDAPlace
+    return [CUDAPlace(0)]
+
+
+def tpu_places(device_ids=None):
+    from ..framework.device import TPUPlace
+    import jax as _jax
+    n = len(_jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients: use optimizer.minimize, which differentiates the "
+        "program during Executor compilation")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    # backward is appended implicitly by Executor when an optimizer is
+    # attached via minimize(); return empty params_grads for API parity.
+    return []
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Persist the (feeds, fetches, program, params) needed for inference
+    (ref: python/paddle/static/io.py)."""
+    import pickle
+    program = program or default_main_program()
+    nodes, leaf_tensors, feeds = __import__(
+        "paddle_tpu.static.executor", fromlist=["x"])._collect_graph(
+        [f for f in fetch_vars])
+    payload = {
+        "program": program,
+        "feed_names": [f.name for f in feed_vars],
+        "fetch_vars": fetch_vars,
+        "leaf_values": {id(t): t.numpy() for t in leaf_tensors.values()},
+    }
+    import os
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    import pickle
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    program = payload["program"]
+    return [program, payload["feed_names"], payload["fetch_vars"]]
+
+
+class nn:
+    """Minimal paddle.static.nn facade — modern static code uses paddle.nn
+    layers directly; these exist for legacy-style scripts."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = _nn.Linear(in_dim, size)
+        from ..ops.manipulation import reshape
+        flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim]) \
+            if len(x.shape) > num_flatten_dims + 1 else x
+        out = layer(flat)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, **kwargs):
+        from .. import nn as _nn
+        ch = input.shape[1]
+        return _nn.BatchNorm(ch)(input)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               activation=None, **kwargs):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        layer = _nn.Conv2D(input.shape[1], num_filters, filter_size, stride,
+                           padding)
+        out = layer(input)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+
+def amp_guard(*a, **kw):
+    from ..amp import auto_cast
+    return auto_cast(*a, **kw)

@@ -1,0 +1,201 @@
+"""Static-graph Executor.
+
+ref: /root/reference/python/paddle/fluid/executor.py:1275 Executor.run →
+_ExecutorCache (:722,889,634) → StandaloneExecutor/InterpreterCore. Here the
+cached artifact is a jitted function evaluating the whole program DAG —
+forward, optimizer update (grads via jax.grad over parameter leaves), and
+state updates — in one XLA program, with donated buffers for params/states.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.symbolic import (Program, SymbolicTensor,
+                                  default_main_program,
+                                  default_startup_program)
+from ..framework.tensor import Parameter, Tensor
+
+
+def _collect_graph(targets: List[SymbolicTensor]):
+    """Topological node order + leaf tensors reachable from targets."""
+    nodes = []
+    seen_nodes = set()
+    leaf_tensors: Dict[int, Tensor] = {}
+    feeds: Dict[str, SymbolicTensor] = {}
+
+    def visit_sym(s: SymbolicTensor):
+        if s._node is None:
+            if s._feed_name is not None:
+                feeds[s._feed_name] = s
+            return
+        visit_node(s._node)
+
+    def visit_node(n):
+        if n.id in seen_nodes:
+            return
+        seen_nodes.add(n.id)
+        for a in n.args:
+            if isinstance(a, SymbolicTensor):
+                visit_sym(a)
+            elif isinstance(a, Tensor):
+                leaf_tensors[id(a)] = a
+        nodes.append(n)
+
+    for t in targets:
+        visit_sym(t)
+    return nodes, leaf_tensors, feeds
+
+
+def _eval_graph(nodes, targets, env):
+    """env: {('feed', name): arr, ('t', id): arr}. Returns list of arrays."""
+    values: Dict[Tuple[int, int], Any] = {}
+
+    def lookup(a):
+        if isinstance(a, SymbolicTensor):
+            if a._node is None:
+                return env[("feed", a._feed_name)]
+            return values[(a._node.id, a._out_idx)]
+        if isinstance(a, Tensor):
+            return env[("t", id(a))]
+        return a
+
+    for n in nodes:
+        args = [lookup(a) for a in n.args]
+        out = n.impl(*args, **n.kwargs)
+        if n.n_outs == 1 and not isinstance(out, (tuple, list)):
+            values[(n.id, 0)] = out
+        else:
+            for i, o in enumerate(out):
+                values[(n.id, i)] = o
+    return [lookup(t) for t in targets]
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        feed = feed or {}
+        if program is None:
+            program = default_main_program()
+        if program is default_startup_program() or (
+                isinstance(program, Program) and not program._nodes
+                and not fetch_list):
+            return []
+        fetch_list = list(fetch_list or [])
+        fetch_syms = [f for f in fetch_list]
+
+        # all graph targets: fetches + state updates + optimizer losses
+        state_targets = [s for _, s in program._state_updates]
+        opt_losses = [l for _, l in program._optimize_ops]
+        all_targets = [t for t in fetch_syms
+                       if isinstance(t, SymbolicTensor)] + state_targets \
+            + opt_losses
+        nodes, leaf_tensors, feeds_map = _collect_graph(all_targets)
+
+        leaf_ids = sorted(leaf_tensors.keys())
+        leaf_objs = [leaf_tensors[i] for i in leaf_ids]
+        trainable = [t for t in leaf_objs
+                     if isinstance(t, Parameter) and not t.stop_gradient]
+
+        # optimizer states (created eagerly, passed as runtime inputs)
+        opt_blobs = []
+        for opt, loss_sym in program._optimize_ops:
+            params = trainable
+            states = [opt._get_state(p) for p in params]
+            masters = [opt._master_weights.get(p.name) for p in params]
+            metas = tuple(
+                (float(p.optimize_attr.get("learning_rate", 1.0)),
+                 opt._wd_for_param(p), m is not None)
+                for p, m in zip(params, masters))
+            opt_blobs.append((opt, loss_sym, params, states, metas))
+
+        sig = (id(program), len(program._nodes),
+               tuple(sorted(feeds_map.keys())),
+               tuple((tuple(np.asarray(v).shape)) for v in feed.values()),
+               tuple(id(t) if isinstance(t, SymbolicTensor) else None
+                     for t in fetch_syms),
+               tuple(id(o) for o, _ in program._optimize_ops))
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._compile(program, nodes, leaf_ids, leaf_objs,
+                               fetch_syms, state_targets, opt_blobs)
+            self._cache[sig] = fn
+
+        feed_arrays = {k: jnp.asarray(np.asarray(
+            v.numpy() if hasattr(v, "numpy") else v))
+            for k, v in feed.items()}
+        leaf_arrays = [t.data for t in leaf_objs]
+        opt_state_arrays = [
+            ([opt._get_state(p) for p in params], jnp.asarray(
+                opt.get_lr(), jnp.float32), jnp.asarray(
+                opt._step_count + 1, jnp.float32))
+            for opt, _, params, _, _ in opt_blobs]
+
+        fetches, state_arrays, new_leafs, new_opt_states = fn(
+            feed_arrays, leaf_arrays, opt_state_arrays)
+
+        # write back state updates and optimizer results
+        for (target, _), arr in zip(program._state_updates, state_arrays):
+            target._data = arr
+        for t, arr in zip(leaf_objs, new_leafs):
+            if arr is not None:
+                t._data = arr
+        for (opt, _, params, _, _), sts in zip(opt_blobs, new_opt_states):
+            opt._step_count += 1
+            for p, st in zip(params, sts):
+                opt._accumulators[p.name] = st
+
+        outs = []
+        for f, arr in zip(fetch_syms, fetches):
+            outs.append(np.asarray(arr) if return_numpy else Tensor(arr))
+        return outs
+
+    def _compile(self, program, nodes, leaf_ids, leaf_objs, fetch_syms,
+                 state_targets, opt_blobs):
+        n_leaf = len(leaf_objs)
+        trainable_idx = [i for i, t in enumerate(leaf_objs)
+                         if isinstance(t, Parameter) and not t.stop_gradient]
+
+        def run_fn(feed_arrays, leaf_arrays, opt_state_arrays):
+            env = {("feed", k): v for k, v in feed_arrays.items()}
+            for tid, arr, obj in zip(leaf_ids, leaf_arrays, leaf_objs):
+                env[("t", id(obj))] = arr
+
+            sym_fetches = [t for t in fetch_syms
+                           if isinstance(t, SymbolicTensor)]
+            fetch_vals = _eval_graph(nodes, sym_fetches + state_targets, env)
+            fetches = fetch_vals[:len(sym_fetches)]
+            state_arrays = fetch_vals[len(sym_fetches):]
+
+            new_leafs = [None] * n_leaf
+            new_opt_states = []
+            for (opt, loss_sym, params, _, metas), (states, lr, step) in zip(
+                    opt_blobs, opt_state_arrays):
+                pidx = trainable_idx
+
+                def loss_of(p_arrs):
+                    env2 = dict(env)
+                    for i, arr in zip(pidx, p_arrs):
+                        env2[("t", id(leaf_objs[i]))] = arr
+                    return _eval_graph(nodes, [loss_sym], env2)[0]
+
+                p_arrs = [env[("t", id(leaf_objs[i]))] for i in pidx]
+                grads = jax.grad(loss_of)(p_arrs)
+                fused = opt._make_fused(list(metas))
+                new_ps, new_sts = fused(p_arrs, grads, states, lr, step)
+                for i, np_ in zip(pidx, new_ps):
+                    new_leafs[i] = np_
+                new_opt_states.append(new_sts)
+            return fetches, state_arrays, new_leafs, new_opt_states
+
+        return jax.jit(run_fn)
+
+    def close(self):
+        pass

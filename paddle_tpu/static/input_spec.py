@@ -1,0 +1,32 @@
+"""InputSpec (ref: /root/reference/python/paddle/static/input.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype) or get_default_dtype()
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name}, name={self.name})")

@@ -1,0 +1,177 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_to_static_matches_dygraph():
+    paddle.seed(1)
+    net = SmallNet()
+    x = paddle.rand([3, 4])
+    eager = net(x).numpy()
+    snet = paddle.jit.to_static(net)
+    out = snet(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+    # second call hits the compiled cache
+    out2 = snet(x)
+    np.testing.assert_allclose(out2.numpy(), eager, rtol=1e-5)
+
+
+def test_to_static_backward_flows():
+    net = SmallNet()
+    paddle.jit.to_static(net)
+    x = paddle.rand([3, 4])
+    loss = net(x).sum()
+    loss.backward()
+    for p in net.parameters():
+        assert p.grad is not None
+        assert not np.allclose(p.grad.numpy(), 0.0)
+
+
+def test_to_static_training_converges():
+    paddle.seed(0)
+    net = SmallNet()
+    paddle.jit.to_static(net)
+    opt = paddle.optimizer.Adam(0.05, parameters=net.parameters())
+    x = paddle.rand([16, 4])
+    y = paddle.rand([16, 2])
+    losses = []
+    for _ in range(30):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * 2 + b
+
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([0.5, 0.5])
+    np.testing.assert_allclose(f(x, y).numpy(), [2.5, 4.5])
+
+
+def test_to_static_updates_buffers():
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(x)
+
+    net = BNNet()
+    paddle.jit.to_static(net)
+    x = paddle.rand([8, 4]) + 3.0
+    net(x)
+    assert not np.allclose(net.bn._mean.numpy(), 0.0)
+
+
+def test_jit_save_load(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = paddle.rand([2, 4])
+    ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path)
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5)
+
+
+def test_static_program_forward():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [-1, 4], "float32")
+            net = SmallNet()
+            out = net(x)
+            assert out.shape[-1] == 2
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.rand(3, 4).astype(np.float32)
+        (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        expected = np.maximum(
+            xv @ net.fc1.weight.numpy() + net.fc1.bias.numpy(), 0) @ \
+            net.fc2.weight.numpy() + net.fc2.bias.numpy()
+        np.testing.assert_allclose(res, expected, rtol=1e-4)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_training_with_minimize():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [8, 4], "float32")
+            y = paddle.static.data("y", [8, 2], "float32")
+            net = SmallNet()
+            pred = net(x)
+            loss = F.mse_loss(pred, y)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.rand(8, 4).astype(np.float32)
+        yv = np.random.rand(8, 2).astype(np.float32)
+        losses = []
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+    finally:
+        paddle.disable_static()
+
+
+def test_static_batchnorm_updates_stats():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main, paddle.static.Program()):
+            x = paddle.static.data("x", [8, 4], "float32")
+            bn = nn.BatchNorm1D(4)
+            out = bn(x)
+        exe = paddle.static.Executor()
+        xv = np.random.rand(8, 4).astype(np.float32) + 5
+        exe.run(main, feed={"x": xv}, fetch_list=[out])
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+    finally:
+        paddle.disable_static()
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0])
+    x.stop_gradient = False
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])

@@ -1,0 +1,229 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    l = nn.Linear(4, 3)
+    assert l.weight.shape == [4, 3]
+    x = paddle.rand([2, 4])
+    y = l(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ l.weight.numpy() + l.bias.numpy(), rtol=1e-5)
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    assert len(sd) == 4
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(),
+                               net.fc1.weight.numpy())
+
+
+def test_sequential_and_layerlist():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = m(paddle.rand([3, 4]))
+    assert y.shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+
+
+def test_conv2d_matches_numpy():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.rand([1, 2, 5, 5])
+    y = conv(x)
+    assert y.shape == [1, 3, 5, 5]
+    # compare against a naive conv at one output position
+    xa = x.numpy()
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    patch = xa[0, :, 0:3, 0:3]
+    expected = (w[1] * patch).sum() + b[1]
+    np.testing.assert_allclose(y.numpy()[0, 1, 1, 1], expected, rtol=1e-4)
+
+
+def test_conv2d_transpose_shape():
+    deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    y = deconv(paddle.rand([1, 4, 5, 5]))
+    assert y.shape == [1, 2, 10, 10]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.rand([4, 3, 2, 2])
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.rand([2, 5, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-2)
+
+
+def test_groupnorm_instance_norm():
+    gn = nn.GroupNorm(2, 4)
+    y = gn(paddle.rand([2, 4, 3, 3]))
+    assert y.shape == [2, 4, 3, 3]
+    inorm = nn.InstanceNorm2D(4)
+    y = inorm(paddle.rand([2, 4, 3, 3]))
+    assert y.shape == [2, 4, 3, 3]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(idx)
+    assert y.shape == [2, 2, 4]
+    np.testing.assert_allclose(y.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    y = d(x)
+    kept = (y.numpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, np.float32()).reshape(1, 1, 4, 4)
+                         if False else
+                         np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y = nn.AdaptiveAvgPool2D(1)(x)
+    np.testing.assert_allclose(y.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), [-0.1, 0, 2],
+                               rtol=1e-6)
+    np.testing.assert_allclose(F.softmax(x).numpy().sum(), 1.0, rtol=1e-6)
+    assert F.gelu(x).shape == [3]
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 2, 1, 4]))
+    loss = F.cross_entropy(logits, labels)
+    la = logits.numpy()
+    expected = -np.take_along_axis(
+        la - np.log(np.exp(la).sum(-1, keepdims=True)),
+        labels.numpy().reshape(-1, 1), 1).mean()
+    np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, -100, 1, -100]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    la = logits.numpy()
+    logp = la - np.log(np.exp(la).sum(-1, keepdims=True))
+    expected = -(logp[0, 0] + logp[2, 1]) / 2
+    np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-4)
+
+
+def test_losses_shapes():
+    a = paddle.rand([3, 4])
+    b = paddle.rand([3, 4])
+    assert F.mse_loss(a, b).ndim == 0
+    assert F.l1_loss(a, b, "none").shape == [3, 4]
+    assert nn.KLDivLoss()(F.log_softmax(a), F.softmax(b)).ndim == 0
+    assert F.smooth_l1_loss(a, b).ndim == 0
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.rand([2, 5, 4])
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 8]
+
+
+def test_lstm_cell():
+    cell = nn.LSTMCell(4, 8)
+    h, (hn, cn) = cell(paddle.rand([2, 4]))
+    assert h.shape == [2, 8]
+    assert cn.shape == [2, 8]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.rand([2, 6, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    y = enc(paddle.rand([2, 6, 16]))
+    assert y.shape == [2, 6, 16]
+    # layers must not share parameters
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = paddle.rand([2, 5, 16])
+    tgt = paddle.rand([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_interpolate():
+    x = paddle.rand([1, 2, 4, 4])
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 2, 8, 8]
+    y = F.interpolate(x, size=[6, 6], mode="bilinear")
+    assert y.shape == [1, 2, 6, 6]
+
+
+def test_grad_flows_through_layers():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    x = paddle.rand([3, 4])
+    loss = net(x).sum()
+    loss.backward()
+    for p in net.parameters():
+        assert p.grad is not None, p.name
+        assert p.grad.shape == p.shape

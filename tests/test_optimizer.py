@@ -1,0 +1,166 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_param():
+    p = paddle.framework.Parameter(np.array([5.0, -3.0], np.float32),
+                                   name="p0")
+    return p
+
+
+def test_sgd_step():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    (p * p).sum().backward()
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [5 - 0.1 * 10, -3 + 0.1 * 6],
+                               rtol=1e-6)
+
+
+def test_momentum_velocity():
+    p = _quadratic_param()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    for _ in range(3):
+        (p * p).sum().backward()
+        o.step()
+        o.clear_grad()
+    assert abs(p.numpy()[0]) < 5.0
+
+
+@pytest.mark.parametrize("cls", [opt.Adam, opt.AdamW, opt.RMSProp,
+                                 opt.Adagrad, opt.Adadelta, opt.Adamax,
+                                 opt.Lamb])
+def test_optimizers_converge(cls):
+    p = _quadratic_param()
+    start = float((p * p).sum().numpy())
+    kwargs = {"learning_rate": 0.5, "parameters": [p]}
+    o = cls(**kwargs)
+    for _ in range(60):
+        loss = (p * p).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    final = float((p * p).sum().numpy())
+    if cls is opt.Adadelta:  # tiny effective steps early on; just require descent
+        assert final < start * 0.99, (start, final)
+    else:
+        assert np.abs(p.numpy()).max() < 1.0, p.numpy()
+
+
+def test_adam_matches_reference_formula():
+    p = paddle.framework.Parameter(np.array([1.0], np.float32), name="pa")
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    (p * 3.0).sum().backward()
+    o.step()
+    # m=0.1*3, v=0.001*9, corrected: step = lr*sqrt(1-b2)/(1-b1)
+    m = 0.1 * 3
+    v = 0.001 * 9
+    expected = 1.0 - 0.1 * (np.sqrt(1 - 0.999) / (1 - 0.9)) * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [expected], rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    paddle.to_tensor([0.0]).sum()
+    (p.sum() * 0).backward()  # zero grads
+    o.step()
+    # grad = 0 + wd*p -> p_new = p - lr*wd*p
+    np.testing.assert_allclose(p.numpy(), [5 * 0.95, -3 * 0.95], rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.framework.Parameter(np.array([2.0], np.float32), name="pw")
+    o = opt.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    (p * 0.0).sum().backward()
+    o.step()
+    # zero grad: only decay applies: p - lr*wd*p
+    np.testing.assert_allclose(p.numpy(), [2.0 * (1 - 0.01)], rtol=1e-5)
+
+
+def test_lr_scheduler_with_optimizer():
+    p = _quadratic_param()
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.01)
+
+
+def test_lr_schedules():
+    s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert s() == pytest.approx(1.0)
+    s.step(10)
+    assert s() == pytest.approx(0.0, abs=1e-6)
+
+    w = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    w.step(5)
+    assert w() == pytest.approx(0.05)
+
+    n = opt.lr.NoamDecay(d_model=512, warmup_steps=100)
+    assert n() > 0
+
+    pw = opt.lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1])
+    pw.step(3)
+    assert pw() == pytest.approx(0.5)
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.framework.Parameter(np.array([3.0], np.float32), name="c1")
+    p2 = paddle.framework.Parameter(np.array([4.0], np.float32), name="c2")
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+    (p1 * 3.0 + p2 * 4.0).backward()
+    # grads (3, 4) -> global norm 5 -> scaled by 1/5
+    o.step()
+    np.testing.assert_allclose(p1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _quadratic_param()
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    (p * p).sum().backward()
+    o.step()
+    sd = o.state_dict()
+    p2 = _quadratic_param()
+    o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    np.testing.assert_allclose(
+        o2._accumulators["p0"]["moment1"],
+        o._accumulators["p0"]["moment1"])
+
+
+def test_minimize_api():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    o.minimize(loss)
+    assert p.grad is not None
+
+
+def test_training_convergence_mlp():
+    paddle.seed(42)
+    net = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 1))
+    o = opt.Adam(learning_rate=0.01, parameters=net.parameters())
+    x = np.random.randn(64, 2).astype(np.float32)
+    y = (x[:, :1] * 2 + x[:, 1:] * -1 + 0.5).astype(np.float32)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    first = None
+    for i in range(100):
+        pred = net(xt)
+        loss = F.mse_loss(pred, yt)
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    final = float(loss.numpy())
+    assert final < first * 0.1, (first, final)
