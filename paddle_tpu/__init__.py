@@ -53,6 +53,9 @@ nn.initializer.set_global_initializer  # noqa: B018
 
 from . import jit  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from . import parallel  # noqa: F401,E402
 from .framework import autograd as _autograd_mod  # noqa: E402
 from . import autograd  # noqa: F401,E402
 

@@ -1,0 +1,38 @@
+"""paddle.distributed (ref: /root/reference/python/paddle/distributed/
+__init__.py). NCCL ProcessGroups → jax Mesh axes; collectives → XLA
+collectives over ICI/DCN (SURVEY.md §5)."""
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .communication import (Group, P2POp, ReduceOp, all_gather,  # noqa: F401
+                            all_gather_object, all_reduce, all_to_all,
+                            alltoall, alltoall_single, barrier,
+                            batch_isend_irecv, broadcast, get_world_group,
+                            irecv, isend, new_group, recv, reduce,
+                            reduce_scatter, scatter, send, stream, wait)
+from .parallel import (DataParallel, get_rank, get_world_size,  # noqa: F401
+                       init_parallel_env)
+from . import sharding  # noqa: F401
+
+
+def is_initialized():
+    return env.is_initialized()
+
+
+def is_available():
+    return True
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """ref: python/paddle/distributed/spawn.py:426. In the single-controller
+    TPU runtime parallelism lives in the mesh, not processes: run func once;
+    it sees all devices."""
+    func(*args)
+    return None

@@ -1,0 +1,21 @@
+from .group import Group, ReduceOp, get_world_group, new_group  # noqa: F401
+from .ops import (P2POp, all_gather, all_gather_object, all_reduce,  # noqa: F401
+                  all_to_all, alltoall, alltoall_single, barrier,
+                  batch_isend_irecv, broadcast, irecv, isend, recv, reduce,
+                  reduce_scatter, scatter, send, wait)
+
+# stream variants (ref: python/paddle/distributed/communication/stream/) —
+# XLA issues collectives asynchronously already; sync_op is accepted and
+# completion is exposed via wait().
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(alltoall)
+    alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

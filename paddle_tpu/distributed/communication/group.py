@@ -1,0 +1,92 @@
+"""Communication groups.
+
+A reference ProcessGroup is a NCCL communicator over a rank list
+(ref: /root/reference/paddle/fluid/distributed/collective/process_group.h:53).
+Here a Group names a mesh axis (or an ad-hoc 1-D mesh over chosen devices);
+collectives over the group are XLA collectives over that axis."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from ...parallel import mesh as mesh_mod
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int = 0, axis: Optional[str] = None,
+                 name: Optional[str] = None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis = axis          # mesh axis name when axis-aligned
+        self._name = name or f"group_{gid}"
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def rank(self):
+        from .. import env
+        return self.get_group_rank(env.get_rank())
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis}, ranks={self.ranks})"
+
+
+_groups = {}
+_group_counter = [0]
+_world_group: Optional[Group] = None
+
+
+def _new_group_id():
+    _group_counter[0] += 1
+    return _group_counter[0]
+
+
+def get_world_group() -> Group:
+    global _world_group
+    if _world_group is None:
+        n = len(jax.devices())
+        _world_group = Group(list(range(n)), 0, axis=None, name="world")
+    return _world_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None) -> Group:
+    if ranks is None:
+        return get_world_group()
+    g = Group(list(ranks), _new_group_id(), axis=axis)
+    _groups[g.id] = g
+    return g
+
+
+def axis_group(axis: str, ranks: List[int]) -> Group:
+    g = Group(ranks, _new_group_id(), axis=axis, name=f"{axis}_group")
+    _groups[g.id] = g
+    return g
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    return group if group is not None else get_world_group()

@@ -1,0 +1,18 @@
+"""paddle.distributed.fleet (ref: /root/reference/python/paddle/distributed/
+fleet/__init__.py)."""
+from . import meta_parallel  # noqa: F401
+from .fleet import (HybridParallelOptimizer, PaddleCloudRoleMaker,  # noqa: F401
+                    UserDefinedRoleMaker, barrier_worker, distributed_model,
+                    distributed_optimizer, init, is_first_worker,
+                    is_initialized, worker_index, worker_num)
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       get_hybrid_communicate_group)
+from .meta_parallel.sharding import (group_sharded_parallel,  # noqa: F401
+                                     save_group_sharded_model)
+
+# submodule aliases matching the reference layout
+from . import fleet as _fleet_mod  # noqa: F401
+from .layers import mpu  # noqa: F401
+
+utils = None

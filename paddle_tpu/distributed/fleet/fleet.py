@@ -1,0 +1,166 @@
+"""fleet facade (ref: /root/reference/python/paddle/distributed/fleet/
+fleet.py — init:168, _init_hybrid_parallel_env:385, distributed_model via
+fleet/model.py:30, distributed_optimizer via
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:238)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env as dist_env
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+
+
+_fleet = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init (ref: fleet/fleet.py:168). Builds the 4-D (plus sep)
+    topology and the global device mesh."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    names = ["data", "pipe", "sharding", "sep", "model"]
+    import jax
+    n_dev = len(jax.devices())
+    degrees = [hc["dp_degree"], hc["pp_degree"], hc["sharding_degree"],
+               hc.get("sep_degree", 1), hc["mp_degree"]]
+    specified = 1
+    for d in degrees:
+        specified *= max(d, 1)
+    if hc["dp_degree"] <= 0:
+        degrees[0] = max(n_dev // (specified // max(hc["dp_degree"], 1)), 1) \
+            if specified else n_dev
+        # recompute: dp fills the remainder
+        rest = degrees[1] * degrees[2] * degrees[3] * degrees[4]
+        degrees[0] = max(n_dev // rest, 1)
+
+    topo = CommunicateTopology(names, degrees)
+    hcg = HybridCommunicateGroup(topo, global_rank=dist_env.get_rank()
+                                 if dist_env.get_rank() < topo.world_size
+                                 else 0)
+    set_hybrid_communicate_group(hcg)
+    _fleet.initialized = True
+    _fleet.strategy = strategy
+    _fleet.hcg = hcg
+    dist_env.mark_initialized()
+
+    # model-parallel RNG streams (ref: mpu/random.py)
+    from .layers.mpu import random as mpu_random
+    seed = strategy.tensor_parallel_configs.get("tensor_init_seed", -1)
+    mpu_random.model_parallel_random_seed(
+        None if seed in (-1, None) else seed)
+    return None
+
+
+def is_initialized():
+    return _fleet.initialized
+
+
+def get_hybrid_communicate_group_():
+    return _fleet.hcg
+
+
+def worker_index():
+    return dist_env.get_rank()
+
+
+def worker_num():
+    return dist_env.get_world_size()
+
+
+def is_first_worker():
+    return dist_env.get_rank() == 0
+
+
+def barrier_worker():
+    pass
+
+
+def distributed_model(model):
+    """ref: fleet/model.py:30 — wrap per topology."""
+    hcg = _fleet.hcg or get_hybrid_communicate_group()
+    from .meta_parallel.meta_parallel_base import (ShardingParallel,
+                                                   TensorParallel)
+    from .meta_parallel.pipeline_parallel import (
+        PipelineParallel, PipelineParallelWithInterleave)
+    from .meta_parallel.pp_layers import PipelineLayer
+
+    strategy = _fleet.strategy
+    mode = hcg.get_parallel_mode()
+    if mode == "pipeline_parallel" or isinstance(model, PipelineLayer):
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, strategy)
+        return PipelineParallel(_WrapAsPipeline(model), hcg, strategy)
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+class _WrapAsPipeline:
+    def __init__(self, model):
+        self._model = model
+
+    def __call__(self, *a, **kw):
+        return self._model(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_model"], item)
+
+
+class HybridParallelOptimizer:
+    """ref: hybrid_parallel_optimizer.py:238 — wraps the user optimizer; in
+    the reference it fuses DP-group allreduce of grads and widens grad-clip
+    to all axes. Under GSPMD gradients are global values already, and
+    ClipGradByGlobalNorm sees full tensors, so the wrapper is thin; sharding
+    stage-1 state placement is applied when enabled."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if strategy is not None and strategy.hybrid_configs[
+                "sharding_degree"] > 1:
+            from .meta_parallel.sharding import DygraphShardingOptimizer
+            DygraphShardingOptimizer(optimizer, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, _fleet.hcg,
+                                   strategy or _fleet.strategy)
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._kwargs = kwargs
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    pass

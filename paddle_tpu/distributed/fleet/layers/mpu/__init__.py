@@ -1,0 +1,4 @@
+from . import mp_layers, mp_ops, random  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401

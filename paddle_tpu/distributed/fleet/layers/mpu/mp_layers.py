@@ -1,0 +1,164 @@
+"""Tensor-parallel layers (ref: /root/reference/python/paddle/distributed/
+fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding:35,
+ColumnParallelLinear:173, RowParallelLinear:343, ParallelCrossEntropy:524).
+
+TPU-native design (GSPMD global view): each layer holds the FULL logical
+weight placed on the global mesh with a NamedSharding over the 'mp' axis;
+forward is the plain math plus sharding constraints, and XLA's SPMD
+partitioner inserts the identity/allreduce/allgather collectives the
+reference implements by hand in mp_ops.py + c_* CUDA ops. Per-rank local
+shapes are available via .local_shape for checkpoint interop.
+"""
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from .....framework.tensor import Parameter
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from .....parallel import mesh as mesh_mod
+from ...topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_size():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    return mesh_mod.mesh_axis_size("mp")
+
+
+def _place(param: Parameter, *spec):
+    param._data = mesh_mod.shard_tensor_data(param._data,
+                                             PartitionSpec(*spec))
+    param._dist_attr = PartitionSpec(*spec)
+    param.is_distributed = True
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'
+    (ref: mp_layers.py:35; C++ op c_embedding_op.cc)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.world_size = _mp_size()
+        assert num_embeddings % self.world_size == 0, \
+            "vocab size must divide mp degree"
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _place(self.weight, "mp", None)
+
+    @property
+    def local_shape(self):
+        return [self._num_embeddings // self.world_size, self._embedding_dim]
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        from .....framework.op import apply
+        return apply(lambda a: mesh_mod.constraint(a), (out,),
+                     op_name="c_identity")
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over 'mp' (ref: mp_layers.py:173).
+    gather_output=False leaves activations sharded for a following
+    RowParallelLinear (Megatron pairing)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mp_size()
+        assert out_features % self.world_size == 0
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _place(self.weight, None, "mp")
+        self.bias = None
+        if has_bias or has_bias is None:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            _place(self.bias, "mp")
+
+    @property
+    def local_shape(self):
+        return [self._in_features, self._out_features // self.world_size]
+
+    def forward(self, x):
+        from .....framework.op import apply
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return apply(lambda a: mesh_mod.constraint(a), (out,),
+                         op_name="c_concat")
+        nd = out.ndim
+        spec = [None] * (nd - 1) + ["mp"]
+        return apply(lambda a: mesh_mod.constraint(a, *spec), (out,),
+                     op_name="c_identity")
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over 'mp'; output needs an allreduce
+    which GSPMD inserts from the contraction over a sharded dim
+    (ref: mp_layers.py:343)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mp_size()
+        assert in_features % self.world_size == 0
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _place(self.weight, "mp", None)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            _place(self.bias)
+
+    @property
+    def local_shape(self):
+        return [self._in_features // self.world_size, self._out_features]
+
+    def forward(self, x):
+        from .....framework.op import apply
+        if self.input_is_parallel:
+            nd = x.ndim
+            spec = [None] * (nd - 1) + ["mp"]
+            x = apply(lambda a: mesh_mod.constraint(a, *spec), (x,),
+                      op_name="c_identity")
+        out = F.linear(x, self.weight, self.bias)
+        return apply(lambda a: mesh_mod.constraint(a), (out,),
+                     op_name="mp_allreduce_sum")
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over vocab-sharded logits (ref: mp_layers.py:524; CUDA
+    kernel c_softmax_with_cross_entropy_op.cu). The log-sum-exp reduction
+    over the sharded vocab dim becomes an XLA allreduce under GSPMD."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        from .....ops.manipulation import unsqueeze
+        return unsqueeze(loss, -1)

@@ -1,0 +1,96 @@
+"""TP communication primitives (ref: /root/reference/python/paddle/
+distributed/fleet/layers/mpu/mp_ops.py — _c_identity:26, _c_concat:90,
+_c_split:152, _mp_allreduce:218, _c_lookup_table:297,
+_c_softmax_with_cross_entropy:374, _parallel_linear:512, split:664).
+
+Under GSPMD these are sharding-constraint annotations (forward no-op /
+backward allreduce pairs fall out of the partitioner); the functions keep
+the reference signatures so fleet code ports unchanged."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .....framework.op import apply, unwrap, wrap
+from .....framework.tensor import Tensor
+from .....nn import functional as F
+from .....parallel import mesh as mesh_mod
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """fwd identity / bwd allreduce — GSPMD derives this from replicated
+    output of an mp-sharded consumer."""
+    return apply(lambda a: a, (tensor,), op_name="c_identity")
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """fwd allreduce / bwd identity: constrain to replicated."""
+    return apply(lambda a: mesh_mod.constraint(a), (tensor,),
+                 op_name="mp_allreduce_sum")
+
+
+def _c_concat(tensor, group=None):
+    """gather mp-sharded last dim -> replicated full tensor."""
+    return apply(lambda a: mesh_mod.constraint(a), (tensor,),
+                 op_name="c_concat")
+
+
+def _c_split(tensor, group=None):
+    """split last dim over mp: constrain last dim sharded."""
+    nd = tensor.ndim
+    spec = [None] * (nd - 1) + ["mp"]
+    return apply(lambda a: mesh_mod.constraint(a, *spec), (tensor,),
+                 op_name="c_split")
+
+
+def _c_lookup_table(table, index, start_index=0, name=None):
+    return F.embedding(index, table)
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False,
+                                  ignore_index=-100):
+    loss = F.cross_entropy(logits, label, reduction="none",
+                           ignore_index=ignore_index)
+    from .....ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, -1)
+    if return_softmax:
+        return loss, F.softmax(logits)
+    return loss
+
+
+def _parallel_linear(x, num_rows, num_cols, axis, param_attr, bias_attr,
+                     gather_out, inner_rank, nranks, split_tensor, name,
+                     group=None):
+    from .mp_layers import ColumnParallelLinear, RowParallelLinear
+    if axis == 0:
+        layer = RowParallelLinear(num_rows, num_cols, param_attr,
+                                  bias_attr is not False,
+                                  input_is_parallel=split_tensor)
+    else:
+        layer = ColumnParallelLinear(num_rows, num_cols, param_attr,
+                                     bias_attr is not False,
+                                     gather_output=gather_out)
+    return layer(x)
+
+
+def _parallel_embedding(x, per_part_embeddings, origin_size, param_attr,
+                        inner_rank, num_partitions, name, group=None):
+    from .mp_layers import VocabParallelEmbedding
+    layer = VocabParallelEmbedding(origin_size[0], origin_size[1], param_attr)
+    return layer(x)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Auto-split API (ref: mp_ops.py:664)."""
+    if operation == "linear":
+        return _parallel_linear(x, size[0], size[1], axis, weight_attr,
+                                bias_attr, gather_out, 0, num_partitions,
+                                axis == 0, name)
+    if operation == "embedding":
+        return _parallel_embedding(x, size[0] // num_partitions, size,
+                                   weight_attr, 0, num_partitions, name)
+    raise ValueError(f"unsupported operation {operation}")

@@ -1,0 +1,73 @@
+"""RNG state tracker for hybrid parallel (ref: /root/reference/python/paddle/
+distributed/fleet/layers/mpu/random.py — RNGStatesTracker with
+local_seed/global_seed). In the GSPMD global view dropout masks are global
+arrays, so 'local' vs 'global' seeds reduce to distinct named key streams."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = _random.get_rng_state()
+        _random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = _random.get_rng_state()
+            _random.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    from ...topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    _random.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0

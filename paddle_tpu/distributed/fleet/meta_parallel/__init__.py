@@ -1,0 +1,12 @@
+from ..layers.mpu.mp_layers import (ColumnParallelLinear,  # noqa: F401
+                                    ParallelCrossEntropy, RowParallelLinear,
+                                    VocabParallelEmbedding)
+from ..layers.mpu.random import get_rng_state_tracker  # noqa: F401
+from .meta_parallel_base import (MetaParallelBase, ShardingParallel,  # noqa: F401
+                                 TensorParallel)
+from .pipeline_parallel import (PipelineParallel,  # noqa: F401
+                                PipelineParallelWithInterleave)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,  # noqa: F401
+                       GroupShardedStage2, GroupShardedStage3,
+                       group_sharded_parallel, save_group_sharded_model)

@@ -1,0 +1,53 @@
+"""MetaParallelBase + TP/Sharding wrappers (ref: /root/reference/python/
+paddle/distributed/fleet/meta_parallel/meta_parallel_base.py,
+tensor_parallel.py:27, sharding_parallel.py:22)."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers_holder = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *args, **kwargs):
+        return self._layers_holder(*args, **kwargs)
+
+    # delegate layer protocol to the wrapped model
+    def parameters(self, include_sublayers=True):
+        return self._layers_holder.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers_holder.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers_holder.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers_holder.set_state_dict(*a, **kw)
+
+    def train(self):
+        self._layers_holder.train()
+        return self
+
+    def eval(self):
+        self._layers_holder.eval()
+        return self
+
+
+class TensorParallel(MetaParallelBase):
+    """In the GSPMD world, parameter placement was done by the mpu layers at
+    construction; initial-state broadcast (hybrid_parallel_util.py:199) is
+    unnecessary because a global array IS one logical value."""
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass

@@ -1,0 +1,163 @@
+"""PipelineLayer (ref: /root/reference/python/paddle/distributed/fleet/
+meta_parallel/parallel_layers/pp_layers.py — LayerDesc:56,
+SharedLayerDesc:76, PipelineLayer:240 with seg_method partitioning).
+
+Single-controller twist: every stage's layers exist in this process; the
+stage partition drives (a) the per-stage execution used by
+PipelineParallel's microbatch schedule and (b) the stacked-stage SPMD
+pipeline (parallel/pipeline.py) when stages are uniform."""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+from ..topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layers (e.g. embedding shared with the LM head,
+    ref: pp_layers.py:76). In single-controller SPMD the same Parameter
+    object is simply reused — no broadcast needed."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+        self._recompute_interval = recompute_interval
+        self._descs = list(layers)
+
+        # build all layers (shared descs reuse one instance per key)
+        shared_instances = {}
+        built: List[Any] = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in shared_instances:
+                    shared_instances[d.layer_name] = (d.build_layer(), d)
+                built.append(shared_instances[d.layer_name])
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer) or callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self._built = built
+        self.shared_layers = {k: v[0] for k, v in shared_instances.items()}
+
+        # register as sublayers for parameters()
+        self.run_function = LayerList(
+            [l if isinstance(l, Layer) else _FnLayer(l)
+             for l in self._unwrap_built()])
+
+        # stage partition
+        self.segment_parts = self._segment(seg_method)
+
+    def _unwrap_built(self):
+        out = []
+        for b in self._built:
+            if isinstance(b, tuple):  # shared
+                out.append(b[0])
+            else:
+                out.append(b)
+        return out
+
+    def _segment(self, seg_method):
+        n = len(self._built)
+        if seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":")[1]
+            block_idx = [i for i, l in enumerate(self._unwrap_built())
+                         if type(l).__name__ == cls_name]
+            # layers before first block go to stage 0, after last to last
+            per = len(block_idx) // self._num_stages
+            rem = len(block_idx) % self._num_stages
+            parts = [0]
+            cursor = 0
+            for s in range(self._num_stages):
+                take = per + (1 if s < rem else 0)
+                cursor += take
+                end = block_idx[cursor - 1] + 1 if cursor > 0 else 0
+                parts.append(n if s == self._num_stages - 1 else end)
+            return parts
+        # uniform
+        per = n // self._num_stages
+        rem = n % self._num_stages
+        parts = [0]
+        for s in range(self._num_stages):
+            parts.append(parts[-1] + per + (1 if s < rem else 0))
+        return parts
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self._unwrap_built()[lo:hi]
+
+    def forward_stage(self, x, stage_id):
+        from ....parallel import mesh as mesh_mod
+        for item, layer in zip(self._built[self.segment_parts[stage_id]:
+                                           self.segment_parts[stage_id + 1]],
+                               self.stage_layers(stage_id)):
+            if isinstance(item, tuple):  # shared layer with custom forward
+                inst, desc = item
+                if desc.forward_func is not None:
+                    x = desc.forward_func(inst, x)
+                    continue
+            x = layer(x) if not isinstance(layer, _FnLayer) else layer(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

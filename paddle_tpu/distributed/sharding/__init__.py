@@ -1,0 +1,4 @@
+"""paddle.distributed.sharding (ref: /root/reference/python/paddle/
+distributed/sharding/group_sharded.py)."""
+from ..fleet.meta_parallel.sharding import (group_sharded_parallel,  # noqa: F401
+                                            save_group_sharded_model)

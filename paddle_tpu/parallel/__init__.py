@@ -1,0 +1,6 @@
+"""TPU-native parallel substrate: the global mesh, SPMD pipeline schedule,
+and ring attention. This is the layer paddle.distributed / fleet are built
+on — pure jax, usable directly for custom parallelism."""
+from . import mesh  # noqa: F401
+from .mesh import (build_mesh, constraint, get_mesh, mesh_axis_size,  # noqa: F401
+                   named_sharding, set_mesh, shard_tensor_data)

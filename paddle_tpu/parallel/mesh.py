@@ -1,0 +1,107 @@
+"""Global device mesh management.
+
+The reference builds one NCCL communicator per topology axis slice
+(ref: /root/reference/python/paddle/distributed/fleet/base/topology.py:140-156
+HybridCommunicateGroup). The TPU-native equivalent is ONE
+jax.sharding.Mesh whose named axes are the parallelism axes; every
+"communication group" is a mesh axis name, and collectives are XLA ops that
+ride ICI/DCN (SURVEY.md §5 'Distributed communication backend').
+
+Axis names: 'dp' (data), 'pp' (pipeline), 'sharding' (ZeRO), 'mp'
+(tensor/model), 'sep' (sequence/context parallel — absent in the reference,
+first-class here).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh: Optional[Mesh] = None
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None) -> Mesh:
+    """Create and install the global mesh. Innermost axis ('mp') maps to the
+    fastest ICI links, mirroring the reference's topology order
+    [data, pipe, sharding, model] (topology.py:54) with 'model' innermost."""
+    global _global_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh needs {total} devices, only {len(devices)} available")
+    if total < len(devices) and dp == -1:
+        sizes["dp"] = len(devices) // (pp * sharding * sep * mp)
+        total = len(devices)
+    arr = np.array(devices[:total]).reshape(
+        [sizes[a] for a in AXIS_ORDER])
+    _global_mesh = Mesh(arr, AXIS_ORDER)
+    return _global_mesh
+
+
+def get_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        # default: pure data parallel over all local devices
+        build_mesh(dp=len(jax.devices()))
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def mesh_axis_size(axis: str) -> int:
+    m = get_mesh()
+    return m.shape[axis] if axis in m.shape else 1
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def shard_tensor_data(data, spec: PartitionSpec):
+    """Place a jax array on the global mesh with the given PartitionSpec."""
+    return jax.device_put(data, NamedSharding(get_mesh(), spec))
+
+
+def constraint(x, *spec):
+    """with_sharding_constraint that is a no-op outside jit."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(get_mesh(), PartitionSpec(*spec)))
+    except Exception:
+        return x
+
+
+def current_axis_names():
+    """Axis names bound inside the current shard_map/xmap trace, if any."""
+    try:
+        from jax._src.core import get_axis_env  # jax>=0.5 internal
+        return set(get_axis_env().axis_sizes.keys())
+    except Exception:
+        try:
+            import jax.core as jc
+            frame = jc.thread_local_state.trace_state.axis_env  # older jax
+            return {f.name for f in frame}
+        except Exception:
+            return set()
+
+
+def inside_spmd_region(axis: str) -> bool:
+    try:
+        import jax
+        jax.lax.axis_index(axis)  # raises if axis not bound
+        return True
+    except Exception:
+        return False
