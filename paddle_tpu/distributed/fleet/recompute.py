@@ -1,0 +1,80 @@
+"""Activation recomputation (ref: /root/reference/python/paddle/distributed/
+fleet/recompute/recompute.py — RecomputeFunction:69, recompute():332,
+recompute_sequential:456).
+
+TPU-native: jax.checkpoint (rematerialization) on the captured pure
+function — XLA re-emits the forward in the backward pass; no RNG state
+save/restore dance is needed because dropout keys are explicit inputs."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ....framework import autograd, random as _random
+from ....framework.op import apply, unwrap
+from ....framework.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    layer = function if isinstance(function, Layer) else None
+    params = list(layer.parameters()) if layer is not None else []
+    n_args_total = len(args)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    n_t = len(tensor_idx)
+    key = _random.next_key()
+
+    def pure(*arrays):
+        arg_arrays = arrays[:n_t]
+        param_arrays = arrays[n_t:n_t + len(params)]
+        saved = [p._data for p in params]
+        for p, a in zip(params, param_arrays):
+            p._data = a
+        try:
+            call_args = list(args)
+            for i, a in zip(tensor_idx, arg_arrays):
+                call_args[i] = Tensor(a, stop_gradient=True)
+            with autograd.no_grad(), _random.key_scope(key):
+                out = function(*call_args, **kwargs)
+        finally:
+            for p, a in zip(params, saved):
+                p._data = a
+        if isinstance(out, (tuple, list)):
+            return tuple(unwrap(t) for t in out)
+        return unwrap(out)
+
+    impl = jax.checkpoint(pure)
+    tensor_args = tuple(args[i] for i in tensor_idx) + tuple(params)
+    return apply(impl, tensor_args, op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """ref: recompute.py:456 — chunk a Sequential into recompute segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if isinstance(functions, Layer):
+        functions = list(functions.children())
+    n = len(functions)
+    per = (n + segments - 1) // segments
+    out = args[0] if len(args) == 1 else args
+
+    class _Seg(Layer):
+        def __init__(self, layers):
+            super().__init__()
+            from ....nn.layer.container import LayerList
+            self.seg = LayerList(layers)
+
+        def forward(self, x):
+            for l in self.seg:
+                x = l(x)
+            return x
+
+    for s in range(0, n, per):
+        seg = _Seg(functions[s:s + per])
+        out = recompute(seg, out, **kwargs)
+    return out
+
+
+class LegacyRecomputeFunction:
+    pass

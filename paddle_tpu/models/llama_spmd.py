@@ -1,0 +1,313 @@
+"""Flagship SPMD Llama trainer — the pod-scale performance path.
+
+The reference trains this model class through Fleet hybrid parallel:
+per-rank processes, NCCL groups per axis, 1F1B p2p, ZeRO state partitioning
+(SURVEY.md §2.4). Here the whole hybrid step is ONE jitted program over the
+global mesh:
+
+- dp:        batch dim sharded over 'dp'
+- mp (TP):   Megatron column/row sharding on qkv/o and gate/up/down + vocab
+             — GSPMD inserts the allreduces
+- pp:        decoder stack split into stages, stacked on a 'pp'-sharded
+             leading dim, scheduled by the shard_map ppermute pipeline
+             (parallel/pipeline.py); backward = AD through the schedule
+- sep (SP):  activations sharded over sequence between blocks; k/v gathered
+             only inside attention (ring attention kernel: ops/pallas)
+- ZeRO:      AdamW moments + fp32 master weights sharded over 'sharding'
+- bf16 compute, fp32 master accumulate; per-block jax.checkpoint (remat)
+
+The dygraph/user-facing Llama lives in models/llama.py; this trainer is the
+analog of the reference's fused static path (fused_multi_transformer +
+distributed_strategy), built TPU-first.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_mod
+from ..parallel.pipeline import spmd_pipeline
+from .llama import LlamaConfig
+
+
+def _place(a, *spec):
+    return mesh_mod.shard_tensor_data(a, P(*spec))
+
+
+def _zero_spec(shape, base_spec, axis="sharding"):
+    """Add 'sharding' to the first free, divisible dim of base_spec."""
+    n = mesh_mod.mesh_axis_size(axis)
+    spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    if n <= 1:
+        return P(*spec)
+    for i, (dim, s) in enumerate(zip(shape, spec)):
+        if s is None and dim % n == 0 and dim >= n:
+            spec[i] = axis
+            break
+    return P(*spec)
+
+
+class LlamaSpmdTrainer:
+    def __init__(self, config: LlamaConfig, lr=3e-4, weight_decay=0.1,
+                 beta1=0.9, beta2=0.95, eps=1e-8, remat=True,
+                 n_micro=None, seed=0, compute_dtype=jnp.bfloat16,
+                 from_state_dict=None):
+        self.config = config
+        self.lr = lr
+        self.wd = weight_decay
+        self.b1, self.b2, self.eps = beta1, beta2, eps
+        self.remat = remat
+        self.compute_dtype = compute_dtype
+        mesh = mesh_mod.get_mesh()
+        self.pp = mesh.shape.get("pp", 1)
+        self.n_micro = n_micro or max(2 * self.pp, 1)
+        L = config.num_hidden_layers
+        assert L % self.pp == 0, "layers must divide pp degree"
+        self.layers_per_stage = L // self.pp
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self._stepno = 0
+        self.params = self._init_params(seed)
+        self.opt_state = self._init_opt_state()
+        self._step_fn = None
+
+    # -- parameters ---------------------------------------------------------
+    def _param_specs(self):
+        c = self.config
+        H = c.hidden_size
+        KV = c.num_key_value_heads * self.head_dim
+        F = c.intermediate_size
+        # block leaves all carry leading dims [pp, layers_per_stage, ...]
+        blk = {
+            "wq": ((H, H), (None, "mp")),
+            "wk": ((H, KV), (None, "mp")),
+            "wv": ((H, KV), (None, "mp")),
+            "wo": ((H, H), ("mp", None)),
+            "wg": ((H, F), (None, "mp")),
+            "wu": ((H, F), (None, "mp")),
+            "wd": ((F, H), ("mp", None)),
+            "ln1": ((H,), (None,)),
+            "ln2": ((H,), (None,)),
+        }
+        return blk
+
+    def _init_params(self, seed):
+        c = self.config
+        key = jax.random.PRNGKey(seed)
+        dt = self.compute_dtype
+        H, V = c.hidden_size, c.vocab_size
+        keys = jax.random.split(key, 4 + len(self._param_specs()))
+        std = 0.02
+
+        def init(k, shape, spec, scale=std, ones=False):
+            if ones:
+                # add 0 to escape jnp's constant cache: donated buffers must
+                # be unique
+                a = jnp.ones(shape, dt) + jnp.zeros((), dt)
+            else:
+                a = (scale * jax.random.normal(k, shape)).astype(dt)
+            return _place(a, *spec)
+
+        params = {
+            "embed": init(keys[0], (V, H), ("mp", None)),
+            "norm": init(keys[1], (H,), (None,), ones=True),
+            "head": init(keys[2], (H, V), (None, "mp")),
+        }
+        blocks = {}
+        blk_specs = self._param_specs()
+        for i, (name, (shape, spec)) in enumerate(blk_specs.items()):
+            full_shape = (self.pp, self.layers_per_stage) + shape
+            full_spec = ("pp", None) + spec
+            ones = name.startswith("ln")
+            blocks[name] = init(keys[3 + i], full_shape, full_spec,
+                                scale=std, ones=ones)
+        params["blocks"] = blocks
+        return params
+
+    def _init_opt_state(self):
+        def init_state(a):
+            shape = a.shape
+            base = a.sharding.spec if isinstance(a.sharding,
+                                                 NamedSharding) else ()
+            spec = _zero_spec(shape, tuple(base))
+            def zeros():
+                # fresh buffer per accumulator (escape the constant cache)
+                return jnp.zeros(shape, jnp.float32) + jnp.zeros(
+                    (), jnp.float32)
+            return {
+                "m": mesh_mod.shard_tensor_data(zeros(), spec),
+                "v": mesh_mod.shard_tensor_data(zeros(), spec),
+                "master": mesh_mod.shard_tensor_data(
+                    a.astype(jnp.float32) + jnp.zeros((), jnp.float32),
+                    spec),
+            }
+        return jax.tree_util.tree_map(init_state, self.params,
+                                      is_leaf=lambda x: hasattr(x, "shape"))
+
+    # -- model math ---------------------------------------------------------
+    def _rope(self, T, offset=0):
+        d = self.head_dim
+        inv = 1.0 / (self.config.rope_theta **
+                     (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        t = jnp.arange(offset, offset + T, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        return jnp.cos(emb), jnp.sin(emb)
+
+    def _block(self, bp, x):
+        """One decoder block. x: [B, T, H] (dp on B, sep on T)."""
+        c = self.config
+        nh = c.num_attention_heads
+        nkv = c.num_key_value_heads
+        hd = self.head_dim
+        dt = x.dtype
+        B, T, H = x.shape
+
+        def rms(h, w):
+            h32 = h.astype(jnp.float32)
+            out = h32 * jax.lax.rsqrt(
+                jnp.mean(h32 * h32, axis=-1, keepdims=True)
+                + c.rms_norm_eps)
+            return (out * w.astype(jnp.float32)).astype(dt)
+
+        h = rms(x, bp["ln1"])
+        q = (h @ bp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ bp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ bp["wv"]).reshape(B, T, nkv, hd)
+        cos, sin = self._rope(T)
+        cos = cos[None, :, None, :].astype(dt)
+        sin = sin[None, :, None, :].astype(dt)
+
+        def rot(u):
+            u1, u2 = jnp.split(u, 2, axis=-1)
+            return jnp.concatenate([-u2, u1], axis=-1)
+
+        q = q * cos + rot(q) * sin
+        k = k * cos + rot(k) * sin
+        if nkv != nh:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        # sequence parallel: q stays sep-sharded; k/v gathered across 'sep'
+        k = mesh_mod.constraint(k, "dp", None, "mp", None)
+        v = mesh_mod.constraint(v, "dp", None, "mp", None)
+        q = mesh_mod.constraint(q, "dp", "sep", "mp", None)
+
+        scale = 1.0 / math.sqrt(hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(B, T, nh * hd)
+        x = x + attn @ bp["wo"]
+
+        h = rms(x, bp["ln2"])
+        gate = jax.nn.silu(h @ bp["wg"])
+        up = h @ bp["wu"]
+        x = x + (gate * up) @ bp["wd"]
+        return mesh_mod.constraint(x, "dp", "sep", None)
+
+    def _stage_fn(self, stage_params, x):
+        """Run this stage's layers_per_stage blocks (scan + remat)."""
+        block = self._block
+        if self.remat:
+            block = jax.checkpoint(block)
+
+        def body(carry, bp):
+            return block(bp, carry), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    def forward(self, params, ids):
+        """ids: [B, T] -> logits [B, T, V]."""
+        x = jnp.take(params["embed"], ids, axis=0).astype(self.compute_dtype)
+        x = mesh_mod.constraint(x, "dp", "sep", None)
+        if self.pp > 1:
+            B = x.shape[0]
+            assert B % self.n_micro == 0, "batch must divide n_micro"
+            mb = B // self.n_micro
+            x_micro = x.reshape((self.n_micro, mb) + x.shape[1:])
+            out = spmd_pipeline(self._stage_fn, params["blocks"], x_micro)
+            x = out.reshape((B,) + out.shape[2:])
+        else:
+            stage = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+            x = self._stage_fn(stage, x)
+        x32 = x.astype(jnp.float32)
+        x32 = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, -1, keepdims=True) + self.config.rms_norm_eps)
+        x = (x32 * params["norm"].astype(jnp.float32)).astype(
+            self.compute_dtype)
+        logits = x @ params["head"]
+        return mesh_mod.constraint(logits, "dp", "sep", "mp")
+
+    def loss_fn(self, params, ids, labels):
+        logits = self.forward(params, ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = labels[:, 1:]
+        picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+        return -picked.mean()
+
+    # -- optimizer ----------------------------------------------------------
+    def _adamw(self, p, g, st, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self.b1 * st["m"] + (1 - self.b1) * g32
+        v = self.b2 * st["v"] + (1 - self.b2) * g32 * g32
+        mh = m / (1 - self.b1 ** step)
+        vh = v / (1 - self.b2 ** step)
+        upd = mh / (jnp.sqrt(vh) + self.eps) + self.wd * st["master"]
+        master = st["master"] - lr * upd
+        return master.astype(p.dtype), {"m": m, "v": v, "master": master}
+
+    def _make_step(self):
+        def step(params, opt_state, ids, labels, lr, stepno):
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, ids,
+                                                           labels)
+            leaves_p, tree = jax.tree_util.tree_flatten(params)
+            leaves_g = jax.tree_util.tree_leaves(grads)
+            leaves_s = tree.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for p, g, st in zip(leaves_p, leaves_g, leaves_s):
+                np_, ns = self._adamw(p, g, st, lr, stepno)
+                new_p.append(np_)
+                new_s.append(ns)
+            return (loss, jax.tree_util.tree_unflatten(tree, new_p),
+                    jax.tree_util.tree_unflatten(tree, new_s))
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_step(self, ids, labels=None):
+        if labels is None:
+            labels = ids
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        self._stepno += 1
+        ids = _place(jnp.asarray(ids), "dp", None)
+        labels = _place(jnp.asarray(labels), "dp", None)
+        loss, self.params, self.opt_state = self._step_fn(
+            self.params, self.opt_state, ids, labels,
+            jnp.asarray(self.lr, jnp.float32),
+            jnp.asarray(self._stepno, jnp.float32))
+        return loss
+
+    # -- analytics ----------------------------------------------------------
+    def flops_per_token(self):
+        """Approximate training FLOPs/token (6 * params-in-matmuls, plus
+        attention quadratic term)."""
+        c = self.config
+        H, F, V = c.hidden_size, c.intermediate_size, c.vocab_size
+        KV = c.num_key_value_heads * self.head_dim
+        per_layer = 2 * H * H + 2 * H * KV + 3 * H * F
+        matmul_params = c.num_hidden_layers * per_layer + 2 * V * H
+        return 6 * matmul_params
+
+    def param_count(self):
+        return sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(self.params))

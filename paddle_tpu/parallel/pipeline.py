@@ -1,0 +1,96 @@
+"""SPMD pipeline parallelism over the 'pp' mesh axis.
+
+The reference schedules 1F1B by exchanging activations over NCCL p2p between
+per-stage processes (ref: /root/reference/python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py:174, pp_utils/p2p_communication.py:329).
+On TPU the whole schedule is compiled: stage weights are stacked on a
+leading dim sharded over 'pp', and a shard_map (manual on 'pp' only — other
+axes stay under GSPMD) runs the classic scan-with-ppermute pipeline: at
+step t each stage processes one micro-batch and ppermutes its activation to
+the next stage. Forward+backward through this region is differentiable
+(ppermute's transpose is the reverse shift), so 1F1B falls out of
+reverse-mode AD over the loop — the same dataflow, scheduled by XLA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
+                  axis: str = "pp", extra_spec=None):
+    """Run `stage_fn(params_slice, x_mb) -> y_mb` as a pipeline.
+
+    stage_params: pytree whose leaves have leading dim n_stages (sharded
+    over `axis`). x_micro: [n_micro, mb, ...] array of micro-batched inputs
+    (replicated over `axis`). Returns [n_micro, mb, ...] outputs (replicated
+    over `axis`). Activations must have the same shape/dtype across stages.
+    """
+    mesh = mesh_mod.get_mesh()
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        def apply_one(x):
+            p = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+            return stage_fn(p, x)
+        return jax.lax.map(apply_one, x_micro)
+
+    n_micro = x_micro.shape[0]
+    T = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_stage(params_local, x):
+        # params_local leaves: [1, ...] (this stage's slice)
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        mb_shape = x.shape[1:]
+        state = jnp.zeros(mb_shape, x.dtype)
+        outputs = jnp.zeros((n_micro,) + mb_shape, x.dtype)
+
+        def body(carry, t):
+            state, outputs = carry
+            x_t = jax.lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            inp = jnp.where(stage == 0, x_t, state)
+            y = stage_fn(params_local, inp)
+            idx = t - (n_stages - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(idx, 0, n_micro - 1), axis=0)
+            take = jnp.logical_and(stage == n_stages - 1, idx >= 0)
+            outputs = jnp.where(take, upd, outputs)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(body, (state, outputs),
+                                           jnp.arange(T))
+        # broadcast the last stage's outputs to every pp rank
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, 0.0), axis)
+        return outputs
+
+    sm = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return sm(stage_params, x_micro)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees along a new leading dim and place it
+    sharded over 'pp'."""
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+    def place(a):
+        spec = [None] * a.ndim
+        spec[0] = "pp"
+        return mesh_mod.shard_tensor_data(a, P(*spec))
+    return jax.tree_util.tree_map(place, stacked)
