@@ -11,10 +11,10 @@ from typing import Any
 
 import jax
 
-from ....framework import autograd, random as _random
-from ....framework.op import apply, unwrap
-from ....framework.tensor import Tensor
-from ....nn.layer.layers import Layer
+from ...framework import autograd, random as _random
+from ...framework.op import apply, unwrap
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
 
 
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
@@ -62,7 +62,7 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     class _Seg(Layer):
         def __init__(self, layers):
             super().__init__()
-            from ....nn.layer.container import LayerList
+            from ...nn.layer.container import LayerList
             self.seg = LayerList(layers)
 
         def forward(self, x):

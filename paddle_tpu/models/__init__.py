@@ -1,0 +1,5 @@
+"""Model zoo: the benchmark families from BASELINE.md."""
+from .llama import LlamaConfig, LlamaForCausalLM  # noqa: F401
+from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .unet import UNetConfig, UNetModel  # noqa: F401

@@ -1,0 +1,165 @@
+"""BERT (config #2: static-graph pure-DP benchmark; ref model family from
+PaddleNLP running on the reference runtime). Built from paddle_tpu.nn
+TransformerEncoder so the encoder math exercises the framework's own
+attention path."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab=1000, hidden=64, layers=2, heads=4, inter=128, seq=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers, num_attention_heads=heads,
+                          intermediate_size=inter,
+                          max_position_embeddings=seq)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import paddle_tpu as paddle
+        seq = input_ids.shape[1]
+        pos = paddle.arange(seq, dtype="int64")
+        from ..ops.manipulation import unsqueeze
+        pos = unsqueeze(pos, 0)
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation="gelu",
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            from ..ops.manipulation import unsqueeze, cast
+            from ..ops.math import scale
+            # [B, L] 1/0 -> additive [B, 1, 1, L]
+            m = unsqueeze(cast(attention_mask, "float32"), [1, 2])
+            attention_mask = scale(m - 1.0, 1e4)
+        x = self.encoder(x, attention_mask)
+        pooled = self.pooler(x)
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class BertLMHead(nn.Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.activation = nn.GELU()
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        if embedding_weights is not None:
+            # tied: decoder weight is the transpose of the embedding
+            self._tied = embedding_weights
+        else:
+            self._tied = None
+
+    def forward(self, x):
+        x = self.layer_norm(self.activation(self.transform(x)))
+        if self._tied is not None:
+            from ..ops.linalg import matmul
+            return matmul(x, self._tied, transpose_y=True) + self.decoder.bias
+        return self.decoder(x)
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMHead(config,
+                              self.bert.embeddings.word_embeddings.weight)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        pred = self.cls(seq_out)
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is not None:
+            from ..ops.manipulation import reshape
+            mlm_loss = F.cross_entropy(
+                reshape(pred, [-1, pred.shape[-1]]),
+                reshape(masked_lm_labels, [-1]), ignore_index=-100)
+            loss = mlm_loss
+            if next_sentence_label is not None:
+                loss = loss + F.cross_entropy(
+                    nsp_logits, next_sentence_label)
+            return loss, pred
+        return pred, nsp_logits

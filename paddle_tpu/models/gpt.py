@@ -1,0 +1,157 @@
+"""GPT-2/3 family (config #4: 13B-class with recompute + AMP O2; the
+reference's auto_parallel tests are built on this model,
+ref: /root/reference/test/auto_parallel/auto_parallel_gpt_model.py)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    recompute: bool = False
+
+    @staticmethod
+    def gpt3_13b():
+        return GPTConfig(hidden_size=5120, num_hidden_layers=40,
+                         num_attention_heads=40, intermediate_size=20480,
+                         max_position_embeddings=2048)
+
+    @staticmethod
+    def tiny(vocab=512, hidden=64, layers=2, heads=4, inter=128, seq=64):
+        return GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         intermediate_size=inter,
+                         max_position_embeddings=seq)
+
+
+def _mp_active():
+    from ..distributed.fleet.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        H = config.hidden_size
+        self.ln_1 = nn.LayerNorm(H, config.layer_norm_eps)
+        self.ln_2 = nn.LayerNorm(H, config.layer_norm_eps)
+        if _mp_active():
+            from ..distributed.fleet.meta_parallel import (
+                ColumnParallelLinear, RowParallelLinear)
+            self.qkv = ColumnParallelLinear(H, 3 * H, gather_output=False)
+            self.proj = RowParallelLinear(H, H, input_is_parallel=True)
+            self.fc_in = ColumnParallelLinear(H, config.intermediate_size,
+                                              gather_output=False)
+            self.fc_out = RowParallelLinear(config.intermediate_size, H,
+                                            input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(H, 3 * H)
+            self.proj = nn.Linear(H, H)
+            self.fc_in = nn.Linear(H, config.intermediate_size)
+            self.fc_out = nn.Linear(config.intermediate_size, H)
+        self.n_head = config.num_attention_heads
+        self.head_dim = H // config.num_attention_heads
+        self.attn_drop = nn.Dropout(config.attention_probs_dropout_prob)
+        self.resid_drop = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        from ..ops.manipulation import concat, reshape, split
+        b, l = x.shape[0], x.shape[1]
+        h = self.ln_1(x)
+        qkv = self.qkv(h)
+        q, k, v = split(qkv, 3, axis=-1)
+        q = reshape(q, [b, l, self.n_head, self.head_dim])
+        k = reshape(k, [b, l, self.n_head, self.head_dim])
+        v = reshape(v, [b, l, self.n_head, self.head_dim])
+        new_cache = None
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        attn = F.scaled_dot_product_attention(
+            q, k, v, is_causal=l > 1,
+            dropout_p=self.attn_drop.p if self.training else 0.0)
+        attn = reshape(attn, [b, l, self.n_head * self.head_dim])
+        x = x + self.resid_drop(self.proj(attn))
+        h = self.ln_2(x)
+        h = self.fc_out(F.gelu(self.fc_in(h), approximate=True))
+        x = x + self.resid_drop(h)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if _mp_active():
+            from ..distributed.fleet.meta_parallel import (
+                VocabParallelEmbedding)
+            self.wte = VocabParallelEmbedding(config.vocab_size,
+                                              config.hidden_size)
+        else:
+            self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids, caches=None, pos_offset=0):
+        import paddle_tpu as paddle
+        from ..ops.manipulation import unsqueeze
+        l = input_ids.shape[1]
+        pos = unsqueeze(paddle.arange(pos_offset, pos_offset + l,
+                                      dtype="int64"), 0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
+            elif self.config.recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(block, x)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        from ..ops.linalg import matmul
+        logits = matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            from ..ops.manipulation import reshape
+            loss = F.cross_entropy(
+                reshape(logits[:, :-1], [-1, self.config.vocab_size]),
+                reshape(labels[:, 1:], [-1]))
+            return loss, logits
+        return logits

@@ -1,0 +1,206 @@
+"""Diffusion UNet (config #5: Stable-Diffusion-style conv/groupnorm path —
+the reference serves this through PaddleMIX on the phi conv/group_norm
+kernels, ref: /root/reference/paddle/phi/kernels/gpu/group_norm_kernel.cu).
+A compact SD-style UNet: timestep embedding, ResBlocks with GroupNorm+SiLU,
+self-attention at low resolutions, skip connections."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    base_channels: int = 128
+    channel_mult: tuple = (1, 2, 4)
+    num_res_blocks: int = 2
+    attention_resolutions: tuple = (2, 4)
+    num_heads: int = 4
+    groups: int = 32
+
+    @staticmethod
+    def tiny():
+        return UNetConfig(in_channels=3, out_channels=3, base_channels=32,
+                          channel_mult=(1, 2), num_res_blocks=1,
+                          attention_resolutions=(2,), num_heads=2, groups=8)
+
+
+def timestep_embedding(t, dim, max_period=10000):
+    import paddle_tpu as paddle
+    from ..ops.manipulation import concat, cast
+    from ..ops.math import cos, exp, sin
+    from ..ops.manipulation import unsqueeze
+    half = dim // 2
+    freqs = paddle.to_tensor(
+        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
+               / half))
+    args = unsqueeze(cast(t, "float32"), -1) * unsqueeze(freqs, 0)
+    return concat([cos(args), sin(args)], axis=-1)
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, time_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_ch), in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb = nn.Linear(time_ch, out_ch)
+        self.norm2 = nn.GroupNorm(min(groups, out_ch), out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.skip = nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch else None
+
+    def forward(self, x, temb):
+        from ..ops.manipulation import unsqueeze
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + unsqueeze(self.time_emb(F.silu(temb)), [2, 3])
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = self.skip(x) if self.skip is not None else x
+        return h + skip
+
+
+class AttnBlock(nn.Layer):
+    def __init__(self, channels, num_heads, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(min(groups, channels), channels)
+        self.qkv = nn.Conv2D(channels, channels * 3, 1)
+        self.proj = nn.Conv2D(channels, channels, 1)
+        self.num_heads = num_heads
+        self.channels = channels
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape, split, transpose
+        b, c, h, w = x.shape
+        qkv = self.qkv(self.norm(x))
+        q, k, v = split(qkv, 3, axis=1)
+        hd = c // self.num_heads
+
+        def to_blhd(t):
+            t = reshape(t, [b, self.num_heads, hd, h * w])
+            return transpose(t, [0, 3, 1, 2])  # [B, L, H, D]
+        out = F.scaled_dot_product_attention(to_blhd(q), to_blhd(k),
+                                             to_blhd(v))
+        out = transpose(out, [0, 2, 3, 1])  # [B, H, D, L]
+        out = reshape(out, [b, c, h, w])
+        return x + self.proj(out)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x, temb=None):
+        return self.op(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x, temb=None):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNetModel(nn.Layer):
+    def __init__(self, config: UNetConfig = None):
+        super().__init__()
+        config = config or UNetConfig()
+        self.config = config
+        ch = config.base_channels
+        time_ch = ch * 4
+        self.time_mlp1 = nn.Linear(ch, time_ch)
+        self.time_mlp2 = nn.Linear(time_ch, time_ch)
+        self.conv_in = nn.Conv2D(config.in_channels, ch, 3, padding=1)
+
+        self.down_blocks = nn.LayerList()
+        self.down_attns = nn.LayerList()
+        self.downsamples = nn.LayerList()
+        chans = [ch]
+        cur = ch
+        for level, mult in enumerate(config.channel_mult):
+            out_ch = ch * mult
+            for _ in range(config.num_res_blocks):
+                self.down_blocks.append(ResBlock(cur, out_ch, time_ch,
+                                                 config.groups))
+                use_attn = (2 ** level) in config.attention_resolutions
+                self.down_attns.append(
+                    AttnBlock(out_ch, config.num_heads, config.groups)
+                    if use_attn else nn.Identity())
+                cur = out_ch
+                chans.append(cur)
+            if level < len(config.channel_mult) - 1:
+                self.downsamples.append(Downsample(cur))
+                chans.append(cur)
+            else:
+                self.downsamples.append(nn.Identity())
+
+        self.mid_block1 = ResBlock(cur, cur, time_ch, config.groups)
+        self.mid_attn = AttnBlock(cur, config.num_heads, config.groups)
+        self.mid_block2 = ResBlock(cur, cur, time_ch, config.groups)
+
+        self.up_blocks = nn.LayerList()
+        self.up_attns = nn.LayerList()
+        self.upsamples = nn.LayerList()
+        for level, mult in reversed(list(enumerate(config.channel_mult))):
+            out_ch = ch * mult
+            for _ in range(config.num_res_blocks + 1):
+                skip_ch = chans.pop()
+                self.up_blocks.append(ResBlock(cur + skip_ch, out_ch,
+                                               time_ch, config.groups))
+                use_attn = (2 ** level) in config.attention_resolutions
+                self.up_attns.append(
+                    AttnBlock(out_ch, config.num_heads, config.groups)
+                    if use_attn else nn.Identity())
+                cur = out_ch
+            if level > 0:
+                self.upsamples.append(Upsample(cur))
+            else:
+                self.upsamples.append(nn.Identity())
+
+        self.norm_out = nn.GroupNorm(min(config.groups, cur), cur)
+        self.conv_out = nn.Conv2D(cur, config.out_channels, 3, padding=1)
+
+    def forward(self, x, timesteps):
+        from ..ops.manipulation import concat
+        temb = timestep_embedding(timesteps, self.config.base_channels)
+        temb = self.time_mlp2(F.silu(self.time_mlp1(temb)))
+
+        h = self.conv_in(x)
+        skips = [h]
+        bi = 0
+        n_levels = len(self.config.channel_mult)
+        for level in range(n_levels):
+            for _ in range(self.config.num_res_blocks):
+                h = self.down_blocks[bi](h, temb)
+                h = self.down_attns[bi](h)
+                skips.append(h)
+                bi += 1
+            if level < n_levels - 1:
+                h = self.downsamples[level](h)
+                skips.append(h)
+
+        h = self.mid_block1(h, temb)
+        h = self.mid_attn(h)
+        h = self.mid_block2(h, temb)
+
+        bi = 0
+        for idx, level in enumerate(reversed(range(n_levels))):
+            for _ in range(self.config.num_res_blocks + 1):
+                h = concat([h, skips.pop()], axis=1)
+                h = self.up_blocks[bi](h, temb)
+                h = self.up_attns[bi](h)
+                bi += 1
+            if level > 0:
+                h = self.upsamples[idx](h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))

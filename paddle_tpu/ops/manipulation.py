@@ -98,8 +98,11 @@ def unsqueeze(x, axis, name=None):
     ax = normalize_axis(axis)
     axes = (ax,) if isinstance(ax, int) else tuple(ax)
     def impl(a):
+        # paddle semantics: each axis indexes a position in the OUTPUT rank
+        out_rank = a.ndim + len(axes)
+        resolved = sorted(a_ % out_rank for a_ in axes)
         out = a
-        for a_ in sorted(a2 % (out.ndim + 1) for a2 in axes):
+        for a_ in resolved:
             out = jnp.expand_dims(out, a_)
         return out
     return op("unsqueeze", impl, x)

@@ -1,0 +1,2 @@
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152, wide_resnet50_2, wide_resnet101_2)

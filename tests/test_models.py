@@ -1,0 +1,109 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_resnet18_forward_backward():
+    from paddle_tpu.vision.models import resnet18
+    net = resnet18(num_classes=10)
+    x = paddle.rand([2, 3, 32, 32])
+    y = net(x)
+    assert y.shape == [2, 10]
+    labels = paddle.to_tensor(np.array([1, 2]))
+    loss = F.cross_entropy(y, labels)
+    loss.backward()
+    assert net.conv1.weight.grad is not None
+
+
+def test_resnet50_shapes():
+    from paddle_tpu.vision.models import resnet50
+    net = resnet50(num_classes=10)
+    net.eval()
+    y = net(paddle.rand([1, 3, 64, 64]))
+    assert y.shape == [1, 10]
+
+
+def test_llama_tiny_train_and_generate():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2, inter=64, seq=32)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 64, (2, 16)))
+    loss, logits = model(ids, labels=ids)
+    assert logits.shape == [2, 16, 64]
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    l0 = float(loss)
+    for _ in range(5):
+        loss, _ = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0
+    out = model.generate(ids[:, :4], max_new_tokens=3)
+    assert out.shape == [2, 7]
+
+
+def test_gpt_tiny():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+    loss, logits = model(ids, labels=ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss.backward()
+    assert model.gpt.wte.weight.grad is not None
+
+
+def test_gpt_recompute_matches():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    model = GPTForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 8)))
+    loss1, _ = model(ids, labels=ids)
+    loss1.backward()
+    g1 = model.gpt.wte.weight.grad.numpy().copy()
+    model.gpt.wte.weight.clear_grad()
+    for p in model.parameters():
+        p.clear_grad()
+
+    cfg.recompute = True
+    loss2, _ = model(ids, labels=ids)
+    loss2.backward()
+    g2 = model.gpt.wte.weight.grad.numpy()
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-6)
+
+
+def test_bert_tiny():
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification
+    cfg = BertConfig.tiny()
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    model.eval()
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+    mask = paddle.ones([2, 16], dtype="int64")
+    logits = model(ids, attention_mask=mask)
+    assert logits.shape == [2, 3]
+    labels = paddle.to_tensor(np.array([0, 2]))
+    loss, _ = model(ids, attention_mask=mask, labels=labels)
+    loss.backward()
+    assert model.classifier.weight.grad is not None
+
+
+def test_unet_tiny():
+    from paddle_tpu.models import UNetConfig, UNetModel
+    cfg = UNetConfig.tiny()
+    model = UNetModel(cfg)
+    x = paddle.rand([2, 3, 16, 16])
+    t = paddle.to_tensor(np.array([1, 10]))
+    y = model(x, t)
+    assert y.shape == [2, 3, 16, 16]
+    loss = (y * y).mean()
+    loss.backward()
+    assert model.conv_in.weight.grad is not None
