@@ -56,6 +56,13 @@ from . import static  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import parallel  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 from .framework import autograd as _autograd_mod  # noqa: E402
 from . import autograd  # noqa: F401,E402
 

@@ -15,6 +15,11 @@ import jax.numpy as jnp
 
 from . import autograd
 
+try:  # per-op host profiling hook (the reference's platform::RecordEvent)
+    from ..profiler import _host as _prof_host
+except Exception:  # pragma: no cover
+    _prof_host = None
+
 
 def unwrap(x):
     from .tensor import Tensor
@@ -54,7 +59,16 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
         and any(t is not None and not t.stop_gradient for t in input_tensors)
     )
 
-    if needs_grad:
+    if _prof_host is not None and _prof_host.enabled:
+        import time as _time
+        _t0 = _time.perf_counter_ns()
+        if needs_grad:
+            out, vjp_fn = jax.vjp(lambda *xs: impl(*xs, **kwargs), *arrays)
+        else:
+            out = impl(*arrays, **kwargs)
+        _prof_host.events.append((op_name or getattr(impl, "__name__", "op"),
+                                  _t0, _time.perf_counter_ns()))
+    elif needs_grad:
         out, vjp_fn = jax.vjp(lambda *xs: impl(*xs, **kwargs), *arrays)
     else:
         out = impl(*arrays, **kwargs)

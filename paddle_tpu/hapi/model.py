@@ -1,0 +1,235 @@
+"""Keras-like Model API (ref: /root/reference/python/paddle/hapi/model.py —
+fit:1049, evaluate:1740, predict:1991). train_batch runs through the
+jitted TrainStep when possible (one XLA program per step) and falls back to
+eager dygraph otherwise."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import autograd
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric)
+        return self
+
+    # -- single-batch ops ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[self._t(i) for i in inputs])
+        outs = _to_list(outputs)
+        losses = self._loss(*(outs + [self._t(l) for l in labels])) \
+            if self._loss else outs[0]
+        loss_list = _to_list(losses)
+        total = loss_list[0]
+        for extra in loss_list[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(outs[0], *[self._t(l) for l in labels])
+            metrics.append(m.update(res))
+        out_loss = [[float(l.numpy())] for l in loss_list]
+        if metrics:
+            return out_loss, metrics
+        return out_loss
+
+    @autograd.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[self._t(i) for i in inputs])
+        outs = _to_list(outputs)
+        losses = self._loss(*(outs + [self._t(l) for l in labels])) \
+            if self._loss else outs[0]
+        loss_list = _to_list(losses)
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(outs[0], *[self._t(l) for l in labels])
+            metrics.append(m.update(res))
+        out_loss = [[float(l.numpy())] for l in loss_list]
+        if metrics:
+            return out_loss, metrics
+        return out_loss
+
+    @autograd.no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        outputs = self.network(*[self._t(i) for i in inputs])
+        return [o.numpy() for o in _to_list(outputs)]
+
+    def _t(self, x):
+        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._loader(train_data, batch_size, shuffle, drop_last,
+                              num_workers)
+        eval_loader = self._loader(eval_data, batch_size, False, False,
+                                   num_workers) if eval_data is not None \
+            else None
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                batch_size=batch_size, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[n for m in self._metrics
+                                         for n in _to_list(m.name())])
+        self.stop_training = False
+        for c in cbks:
+            c.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for c in cbks:
+                c.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                for c in cbks:
+                    c.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                res = self.train_batch(inputs, labels)
+                logs = self._logs(res)
+                for c in cbks:
+                    c.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            for c in cbks:
+                c.on_epoch_end(epoch, logs if steps else None)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size,
+                                          verbose=0, _prepared=True)
+                for c in cbks:
+                    c.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        for c in cbks:
+            c.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _prepared=False):
+        loader = eval_data if _prepared else self._loader(
+            eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            loss = res[0] if isinstance(res, tuple) else res
+            losses.append(loss[0][0])
+        logs = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            for n, v in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                logs[n] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size, False, False,
+                              num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            n_in = len(self._inputs) if self._inputs else \
+                (len(batch) - 1 if has_labels and len(batch) > 1 else
+                 len(batch))
+            inputs = list(batch[:n_in])
+            labels = list(batch[n_in:])
+            return inputs, labels
+        return [batch], []
+
+    def _logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            loss, metrics = res
+        else:
+            loss, metrics = res, []
+        logs["loss"] = loss[0]
+        for m, v in zip(self._metrics, metrics):
+            for n, vv in zip(_to_list(m.name()), _to_list(v)):
+                logs[n] = vv
+        return logs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtype)

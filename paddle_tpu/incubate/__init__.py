@@ -1,0 +1,31 @@
+"""paddle.incubate (ref: /root/reference/python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+
+
+class distributed:
+    class models:
+        from . import moe  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..nn.functional import softmax
+    from ..ops.creation import tril, ones
+    from ..framework.op import apply
+    import jax.numpy as jnp
+
+    def impl(a):
+        import jax
+        T = a.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+    return apply(impl, (x,), op_name="softmax_mask_fuse_upper_triangle")
+
+
+def segment_sum(data, segment_ids, name=None):
+    from ..framework.op import apply
+    import jax
+
+    return apply(lambda d, s: jax.ops.segment_sum(d, s), (data, segment_ids),
+                 op_name="segment_sum")

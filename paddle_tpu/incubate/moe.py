@@ -1,0 +1,248 @@
+"""Mixture-of-Experts (ref: /root/reference/python/paddle/incubate/
+distributed/models/moe/moe_layer.py:261 MoELayer, gate/*.py,
+utils.py:32-85 all-to-all dispatch; CUDA capacity ops
+paddle/fluid/operators/number_count_op.cu, limit_by_capacity_op.cu;
+cutlass grouped-GEMM expert kernel paddle/phi/kernels/fusion/cutlass/
+moe_kernel.cu).
+
+TPU-native design (GShard dense dispatch): the gate produces a dispatch
+mask [tokens, E, C] and combine weights; expert inputs/outputs move via
+einsum with expert-stacked weights [E, ...] sharded over the expert axis —
+under GSPMD the dispatch einsum lowers to the all-to-all the reference
+issues manually, and the per-expert FFN is one batched (grouped) GEMM on
+the MXU."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..framework.op import apply
+from ..framework.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..parallel import mesh as mesh_mod
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "number_count", "limit_by_capacity", "prune_gate_by_capacity",
+           "assign_pos"]
+
+
+# -- capacity utilities (ref: fluid/operators/number_count_op.cu etc.) ------
+
+def number_count(numbers, upper_range):
+    def impl(a):
+        return jnp.bincount(a.reshape(-1), length=upper_range).astype(
+            jnp.int64)
+    return apply(impl, (numbers,), differentiable=False,
+                 op_name="number_count")
+
+
+def limit_by_capacity(expert_count, capacity, n_worker):
+    def impl(ec, cap):
+        return jnp.minimum(ec, cap)
+    return apply(impl, (expert_count, capacity), differentiable=False,
+                 op_name="limit_by_capacity")
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    def impl(gi, ec):
+        # mark tokens overflowing an expert's capacity with -1
+        one_hot = jax.nn.one_hot(gi, n_expert, dtype=jnp.int32)
+        pos_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot
+        pos = jnp.max(pos_in_expert, axis=-1)
+        cap = jnp.take(ec, gi)
+        return jnp.where(pos <= cap, gi, -1)
+    return apply(impl, (gate_idx, expert_count), differentiable=False,
+                 op_name="prune_gate_by_capacity")
+
+
+def assign_pos(x, cum_count):
+    def impl(gi, cc):
+        order = jnp.argsort(gi, stable=True)
+        return order.astype(jnp.int64)
+    return apply(impl, (x, cum_count), differentiable=False,
+                 op_name="assign_pos")
+
+
+# -- gates ------------------------------------------------------------------
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model, num_expert, topk=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.topk = topk
+        self.gate = nn.Linear(d_model, num_expert)
+        self.loss = None
+
+
+class NaiveGate(BaseGate):
+    """top-k softmax gate, no aux loss (ref: gate/naive_gate.py)."""
+
+    def forward(self, x):
+        logits = self.gate(x)
+        return logits, None
+
+
+class GShardGate(BaseGate):
+    """top-2 gate with load-balancing aux loss (ref: gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, topk=2, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert, topk)
+        self.capacity_factor = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+
+        def aux(lg):
+            probs = jax.nn.softmax(lg, -1)
+            top1 = jnp.argmax(lg, -1)
+            me = probs.mean(0)
+            ce = jax.nn.one_hot(top1, lg.shape[-1]).mean(0)
+            return jnp.sum(me * ce) * lg.shape[-1]
+        loss = apply(aux, (logits,), op_name="gshard_aux_loss")
+        self.loss = loss
+        return logits, loss
+
+
+class SwitchGate(BaseGate):
+    """top-1 switch gate (ref: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, topk=1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert, 1)
+
+    def forward(self, x):
+        logits = self.gate(x)
+
+        def aux(lg):
+            probs = jax.nn.softmax(lg, -1)
+            top1 = jnp.argmax(lg, -1)
+            density = jax.nn.one_hot(top1, lg.shape[-1]).mean(0)
+            density_proxy = probs.mean(0)
+            return jnp.sum(density * density_proxy) * lg.shape[-1]
+        loss = apply(aux, (logits,), op_name="switch_aux_loss")
+        self.loss = loss
+        return logits, loss
+
+
+# -- MoE layer --------------------------------------------------------------
+
+class ExpertFFN(nn.Layer):
+    def __init__(self, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, d_hidden)
+        self.fc2 = nn.Linear(d_hidden, d_model)
+        self.act = getattr(F, activation)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class MoELayer(nn.Layer):
+    """ref: moe_layer.py:261. experts: list of Layers (used for per-expert
+    weights; stacked for the grouped GEMM) or an int expert count with
+    d_hidden."""
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, num_expert=None,
+                 d_hidden=None, top_k=2, capacity_factor=1.25,
+                 ep_axis="dp", **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, int):
+            num_expert = experts
+            experts = None
+        if experts is None:
+            assert num_expert is not None and d_hidden is not None
+            experts = [ExpertFFN(d_model, d_hidden)
+                       for _ in range(num_expert)]
+        self.experts = nn.LayerList(experts)
+        self.num_expert = len(self.experts)
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis if mesh_mod.mesh_axis_size(ep_axis) > 1 \
+            else None
+        if gate is None or (isinstance(gate, dict) and
+                            gate.get("type", "gshard") == "gshard"):
+            self.gate = GShardGate(d_model, self.num_expert, top_k)
+        elif isinstance(gate, dict) and gate.get("type") == "switch":
+            self.gate = SwitchGate(d_model, self.num_expert)
+        elif isinstance(gate, dict) and gate.get("type") == "naive":
+            self.gate = NaiveGate(d_model, self.num_expert, top_k)
+        else:
+            self.gate = gate
+        self.top_k = self.gate.topk
+
+    def _stacked_expert_params(self):
+        w1 = [e.fc1.weight for e in self.experts]
+        b1 = [e.fc1.bias for e in self.experts]
+        w2 = [e.fc2.weight for e in self.experts]
+        b2 = [e.fc2.bias for e in self.experts]
+        return w1, b1, w2, b2
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        x2 = reshape(x, [-1, d])
+        logits, aux_loss = self.gate(x2)
+        self.l_aux = aux_loss
+
+        n_tokens = x2.shape[0]
+        E = self.num_expert
+        k = self.top_k
+        cap = max(int(self.capacity_factor * n_tokens * k / E), k)
+        ep = self.ep_axis
+
+        w1s, b1s, w2s, b2s = self._stacked_expert_params()
+        args = (x2, logits) + tuple(w1s) + tuple(b1s) + tuple(w2s) \
+            + tuple(b2s)
+
+        def impl(xa, lg, *flat):
+            w1 = jnp.stack(flat[:E])
+            b1 = jnp.stack(flat[E:2 * E])
+            w2 = jnp.stack(flat[2 * E:3 * E])
+            b2 = jnp.stack(flat[3 * E:4 * E])
+            if ep is not None:
+                w1 = mesh_mod.constraint(w1, ep)
+                w2 = mesh_mod.constraint(w2, ep)
+
+            probs = jax.nn.softmax(lg, axis=-1)
+            topv, topi = jax.lax.top_k(probs, k)
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+
+            # dispatch/combine tensors (GShard): [N, E, C]
+            combine = jnp.zeros((xa.shape[0], E, cap), xa.dtype)
+            for slot in range(k):
+                idx = topi[:, slot]
+                onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+                pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+                pos = jnp.sum(pos, -1)
+                keep = pos < cap
+                val = jnp.where(keep, topv[:, slot], 0.0)
+                combine = combine + (
+                    jax.nn.one_hot(idx, E, dtype=xa.dtype)[:, :, None]
+                    * jax.nn.one_hot(jnp.where(keep, pos, 0), cap,
+                                     dtype=xa.dtype)[:, None, :]
+                    * val[:, None, None])
+            dispatch = (combine > 0).astype(xa.dtype)
+
+            # all-to-all dispatch as einsum (GSPMD lowers to a2a when sharded)
+            exp_in = jnp.einsum("nec,nd->ecd", dispatch, xa)
+            if ep is not None:
+                exp_in = mesh_mod.constraint(exp_in, ep)
+            h = jnp.einsum("ecd,edf->ecf", exp_in, w1) + b1[:, None, :]
+            h = jax.nn.gelu(h)
+            exp_out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+            if ep is not None:
+                exp_out = mesh_mod.constraint(exp_out, ep)
+            return jnp.einsum("nec,ecd->nd", combine, exp_out)
+
+        out = apply(impl, args, op_name="moe")
+        return reshape(out, orig_shape)

@@ -1,0 +1,14 @@
+"""paddle.incubate.nn — fused transformer layers (ref: /root/reference/
+python/paddle/incubate/nn/layer/fused_transformer.py; CUDA kernels
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu,
+fused_attention_op.cu, fused_feedforward_op.cu).
+
+On TPU "fused" means: written as one jnp chain so XLA fuses the elementwise
+work into the GEMMs, with the flash-attention pallas kernel on the score
+path. The classes keep the reference's weight-list API."""
+from .fused_transformer import (FusedFeedForward, FusedMultiHeadAttention,  # noqa: F401
+                                FusedMultiTransformer,
+                                FusedTransformerEncoderLayer)
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]

@@ -1,0 +1,3 @@
+"""paddle.vision (ref: /root/reference/python/paddle/vision/)."""
+from . import datasets, models, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403

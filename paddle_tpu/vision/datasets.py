@@ -1,0 +1,124 @@
+"""paddle.vision.datasets (ref: /root/reference/python/paddle/vision/
+datasets/). This runtime is zero-egress: datasets load from a local
+`data_file` when given; `FakeData`/`mode='fake'` generates deterministic
+synthetic samples so training pipelines (e.g. the ResNet/CIFAR benchmark
+config) run hermetically."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+
+
+class FakeData(Dataset):
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.rng = np.random.RandomState(seed)
+        self.data = self.rng.randint(
+            0, 256, (num_samples,) + self.image_shape).astype(np.uint8)
+        self.labels = self.rng.randint(0, num_classes, num_samples)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Cifar10(Dataset):
+    """Loads the standard cifar-10-python.tar.gz if `data_file` points to it;
+    otherwise falls back to deterministic synthetic data (mode='fake')."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self.data, self.labels = self._load(data_file, mode)
+        else:
+            fake = FakeData(2000 if mode == "train" else 400,
+                            (3, 32, 32), 10, seed=0 if mode == "train" else 1)
+            self.data, self.labels = fake.data, fake.labels
+
+    def _load(self, path, mode):
+        datas, labels = [], []
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames()
+                     if ("data_batch" in n if mode == "train"
+                         else "test_batch" in n)]
+            for n in sorted(names):
+                d = pickle.load(tf.extractfile(n), encoding="bytes")
+                datas.append(d[b"data"].reshape(-1, 3, 32, 32))
+                labels.extend(d[b"labels"])
+        return np.concatenate(datas).astype(np.uint8), np.asarray(labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def _load(self, path, mode):
+        with tarfile.open(path) as tf:
+            name = "cifar-100-python/train" if mode == "train" else \
+                "cifar-100-python/test"
+            d = pickle.load(tf.extractfile(name), encoding="bytes")
+            return (d[b"data"].reshape(-1, 3, 32, 32).astype(np.uint8),
+                    np.asarray(d[b"fine_labels"]))
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            import gzip
+            with gzip.open(image_path) as f:
+                f.read(16)
+                buf = f.read()
+                self.data = np.frombuffer(buf, np.uint8).reshape(-1, 28, 28)
+            with gzip.open(label_path) as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            fake = FakeData(2000 if mode == "train" else 400, (1, 28, 28),
+                            10, seed=2 if mode == "train" else 3)
+            self.data = fake.data[:, 0]
+            self.labels = fake.labels
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,253 @@
+"""paddle.vision.transforms (ref: /root/reference/python/paddle/vision/
+transforms/transforms.py) — numpy/HWC-based, composable."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "ContrastTransform",
+           "RandomResizedCrop", "Pad", "to_tensor", "normalize", "resize",
+           "hflip", "vflip", "crop", "center_crop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _to_hwc_array(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+def to_tensor(pic, data_format="CHW"):
+    a = _to_hwc_array(pic).astype(np.float32)
+    if a.dtype == np.uint8 or a.max() > 1.5:
+        a = a / 255.0
+    if data_format == "CHW":
+        a = a.transpose(2, 0, 1)
+    return a
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (a - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (a - mean) / std
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = _to_hwc_array(img)
+    if isinstance(size, int):
+        h, w = a.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    out_h, out_w = size
+    ys = (np.arange(out_h) + 0.5) * a.shape[0] / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * a.shape[1] / out_w - 0.5
+    if interpolation == "nearest":
+        yi = np.clip(np.round(ys).astype(int), 0, a.shape[0] - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, a.shape[1] - 1)
+        return a[yi][:, xi]
+    y0 = np.clip(np.floor(ys).astype(int), 0, a.shape[0] - 1)
+    y1 = np.clip(y0 + 1, 0, a.shape[0] - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, a.shape[1] - 1)
+    x1 = np.clip(x0 + 1, 0, a.shape[1] - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    af = a.astype(np.float32)
+    out = (af[y0][:, x0] * (1 - wy) * (1 - wx)
+           + af[y0][:, x1] * (1 - wy) * wx
+           + af[y1][:, x0] * wy * (1 - wx)
+           + af[y1][:, x1] * wy * wx)
+    return out.astype(a.dtype)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    a = _to_hwc_array(img)
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    a = _to_hwc_array(img)
+    h, w = a.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(a, top, left, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        a = _to_hwc_array(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, int) else self.padding
+            if isinstance(p, int):
+                a = np.pad(a, ((p, p), (p, p), (0, 0)))
+        h, w = a.shape[:2]
+        th, tw = self.size
+        top = pyrandom.randint(0, max(h - th, 0))
+        left = pyrandom.randint(0, max(w - tw, 0))
+        return a[top:top + th, left:left + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        a = _to_hwc_array(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = pyrandom.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                top = pyrandom.randint(0, h - ch)
+                left = pyrandom.randint(0, w - cw)
+                return resize(a[top:top + ch, left:left + cw], self.size,
+                              self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size,
+                      self.interpolation)
+
+
+def hflip(img):
+    return _to_hwc_array(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_hwc_array(img)[::-1]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _to_hwc_array(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _to_hwc_array(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return _to_hwc_array(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        a = _to_hwc_array(img)
+        p = self.padding
+        if isinstance(p, int):
+            widths = ((p, p), (p, p), (0, 0))
+        elif len(p) == 2:
+            widths = ((p[1], p[1]), (p[0], p[0]), (0, 0))
+        else:
+            widths = ((p[1], p[3]), (p[0], p[2]), (0, 0))
+        return np.pad(a, widths, constant_values=self.fill)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        a = _to_hwc_array(img).astype(np.float32)
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        return np.clip(a * f, 0, 255 if a.max() > 1.5 else 1.0)
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        a = _to_hwc_array(img).astype(np.float32)
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        mean = a.mean()
+        return np.clip((a - mean) * f + mean,
+                       0, 255 if a.max() > 1.5 else 1.0)

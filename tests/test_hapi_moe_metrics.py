@@ -1,0 +1,115 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_hapi_model_fit():
+    from paddle_tpu.vision.datasets import FakeData
+    from paddle_tpu.metric import Accuracy
+    paddle.seed(1)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 8 * 8, 32), nn.ReLU(),
+                        nn.Linear(32, 10))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    data = FakeData(64, (3, 8, 8), 10)
+    model.fit(data, batch_size=16, epochs=1, verbose=0)
+    logs = model.evaluate(data, batch_size=16, verbose=0)
+    assert "loss" in logs and "acc" in logs
+
+
+def test_hapi_save_load(tmp_path):
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.MSELoss())
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    w0 = net.weight.numpy().copy()
+    net.weight.set_value(np.zeros_like(w0))
+    model.load(p)
+    np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_accuracy_metric():
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = paddle.to_tensor(np.array([[1], [1]]))
+    corr = m.compute(pred, label)
+    acc = m.update(corr)
+    assert acc == pytest.approx(0.5)
+
+
+def test_moe_layer_forward_backward():
+    from paddle_tpu.incubate.moe import MoELayer
+    paddle.seed(0)
+    moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, top_k=2)
+    x = paddle.rand([8, 16])
+    x.stop_gradient = False
+    y = moe(x)
+    assert y.shape == [8, 16]
+    y.sum().backward()
+    assert moe.experts[0].fc1.weight.grad is not None
+    assert moe.gate.gate.weight.grad is not None
+    # aux loss exists and is scalar
+    assert moe.l_aux is not None and moe.l_aux.ndim == 0
+
+
+def test_moe_switch_gate():
+    from paddle_tpu.incubate.moe import MoELayer
+    moe = MoELayer(d_model=8, num_expert=2, d_hidden=16,
+                   gate={"type": "switch"})
+    y = moe(paddle.rand([4, 8]))
+    assert y.shape == [4, 8]
+
+
+def test_fused_multi_transformer_decode():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    paddle.seed(0)
+    fmt = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    fmt.eval()
+    x = paddle.rand([2, 4, 32])
+    out = fmt(x)
+    assert out.shape == [2, 4, 32]
+    caches = fmt.gen_cache(2, max_len=16)
+    step_in = paddle.rand([2, 1, 32])
+    out, caches = fmt(step_in, caches=caches, time_step=0)
+    assert out.shape == [2, 1, 32]
+    out, caches = fmt(paddle.rand([2, 1, 32]), caches=caches, time_step=1)
+    assert out.shape == [2, 1, 32]
+
+
+def test_profiler_records_ops():
+    import paddle_tpu.profiler as profiler
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    x = paddle.rand([4, 4])
+    (x @ x).sum()
+    prof.step()
+    prof.stop()
+    s = prof.summary()
+    assert "matmul" in s
+
+
+def test_vision_transforms():
+    from paddle_tpu.vision import transforms as T
+    img = np.random.randint(0, 256, (32, 32, 3), np.uint8)
+    pipe = T.Compose([T.Resize(16), T.RandomHorizontalFlip(1.0),
+                      T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = pipe(img)
+    assert out.shape == (3, 16, 16)
+    assert out.dtype == np.float32
+
+
+def test_fake_cifar_loader():
+    from paddle_tpu.vision.datasets import Cifar10
+    from paddle_tpu.io import DataLoader
+    ds = Cifar10(mode="test")
+    loader = DataLoader(ds, batch_size=8)
+    imgs, labels = next(iter(loader))
+    assert imgs.shape == [8, 3, 32, 32]
+    assert labels.shape == [8]
