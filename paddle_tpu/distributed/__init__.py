@@ -12,6 +12,11 @@ from .communication import (Group, P2POp, ReduceOp, all_gather,  # noqa: F401
 from .parallel import (DataParallel, get_rank, get_world_size,  # noqa: F401
                        init_parallel_env)
 from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (ProcessMesh, Replicate, Shard, dtensor_from_fn,  # noqa: F401
+                            reshard, shard_tensor)
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def is_initialized():

@@ -1,0 +1,270 @@
+"""Semi-auto parallel (ref: /root/reference/python/paddle/distributed/
+auto_parallel/ + C++ core paddle/fluid/distributed/auto_parallel/
+dist_attr.h:51 TensorDistAttr{process_mesh, dims_mapping}).
+
+The reference's pipeline — Completion propagates dims_mapping over ops
+(completion.py), Partitioner rewrites per-rank programs, Resharder inserts
+comm ops (reshard.py) — IS GSPMD (see PAPERS.md): here DistAttr maps to a
+jax NamedSharding, propagation/partitioning/resharding are done by XLA's
+SPMD partitioner, and `reshard` is a device_put/with_sharding_constraint."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.tensor import Tensor
+from ...parallel import mesh as mesh_mod
+
+__all__ = ["ProcessMesh", "TensorDistAttr", "shard_tensor", "dtensor_from_fn",
+           "reshard", "shard_op", "Engine", "Strategy", "get_mesh",
+           "Shard", "Replicate", "Partial"]
+
+
+class Shard:
+    """Placement: shard tensor dim `dim` over the mesh axis it is paired
+    with (ref: new-style placements in later paddle; equivalent to
+    dims_mapping entries)."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+
+class ProcessMesh:
+    """ref: auto_parallel/process_mesh.py. Wraps a jax Mesh over the chosen
+    device ids."""
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self.dim_names = list(dim_names)
+        devices = np.asarray(jax.devices())
+        dev_grid = devices[np.asarray(self.process_ids) % len(devices)]
+        self._jax_mesh = Mesh(dev_grid.reshape(arr.shape),
+                              tuple(self.dim_names))
+
+    @property
+    def mesh(self):
+        return np.asarray(self.process_ids).reshape(self.shape)
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self.process_ids == other.process_ids and \
+            self.shape == other.shape
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+class TensorDistAttr:
+    """ref: dist_attr.h:51 — {process_mesh, dims_mapping}; dims_mapping[i]
+    is the mesh dim tensor-dim i is sharded over (-1 = replicated)."""
+
+    def __init__(self, process_mesh=None, dims_mapping=None):
+        self.process_mesh = process_mesh
+        self.dims_mapping = dims_mapping or []
+
+    def to_partition_spec(self) -> PartitionSpec:
+        names = []
+        for m in self.dims_mapping:
+            if m is None or m == -1:
+                names.append(None)
+            else:
+                names.append(self.process_mesh.dim_names[m])
+        return PartitionSpec(*names)
+
+    def __repr__(self):
+        return (f"TensorDistAttr(mesh={self.process_mesh}, "
+                f"dims_mapping={self.dims_mapping})")
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements) -> PartitionSpec:
+    ndim = max((p.dim for p in placements if isinstance(p, Shard)),
+               default=-1) + 1
+    spec = {}
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            spec[p.dim] = mesh.dim_names[axis_idx]
+    max_dim = max(spec.keys(), default=-1)
+    return PartitionSpec(*[spec.get(i) for i in range(max_dim + 1)])
+
+
+def shard_tensor(x, process_mesh=None, placements=None, dims_mapping=None,
+                 dist_attr=None, stop_gradient=None):
+    """Place a Tensor on a mesh (ref: auto_parallel/api shard_tensor)."""
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    if dist_attr is not None:
+        process_mesh = dist_attr.process_mesh
+        spec = dist_attr.to_partition_spec()
+    elif placements is not None:
+        spec = _placements_to_spec(process_mesh, placements)
+    elif dims_mapping is not None:
+        spec = TensorDistAttr(process_mesh, dims_mapping).to_partition_spec()
+    else:
+        spec = PartitionSpec()
+    jmesh = process_mesh.jax_mesh if process_mesh is not None \
+        else mesh_mod.get_mesh()
+    x._data = jax.device_put(x.data, NamedSharding(jmesh, spec))
+    x._dist_attr = TensorDistAttr(process_mesh, dims_mapping)
+    x.is_distributed = True
+    return x
+
+
+def dtensor_from_fn(fn, process_mesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, process_mesh, placements)
+
+
+def reshard(x, process_mesh=None, placements=None, dist_attr=None):
+    """Move a tensor to a new sharding — the reference inserts comm ops via
+    Resharder (reshard.py, 3k LoC); here it is one resharding device_put
+    (XLA generates the collective)."""
+    return shard_tensor(x, process_mesh, placements, dist_attr=dist_attr)
+
+
+def shard_op(op_fn, process_mesh=None, in_shardings=None, out_shardings=None):
+    def wrapper(*args, **kwargs):
+        return op_fn(*args, **kwargs)
+    return wrapper
+
+
+def get_mesh():
+    return mesh_mod.get_mesh()
+
+
+class Strategy:
+    """ref: auto_parallel/strategy.py."""
+
+    def __init__(self, config=None):
+        from ..fleet.strategy import _Config
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = _Config(enable=False, checkpoints=None)
+        self.sharding = _Config(enable=False, stage=1, degree=1)
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = _Config(enable=False, fused_passes_list=[])
+
+
+class Engine:
+    """ref: auto_parallel/engine.py:55 — fit/evaluate/predict over an
+    annotated model. _build/_plan/_parallel (engine.py:563,722,750) collapse
+    into: trace once under jit with parameter NamedShardings; XLA completes
+    and partitions."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.strategy = strategy or Strategy()
+        self._train_step = None
+
+    def _loss_fn(self, layer, *batch):
+        *inputs, label = batch if len(batch) > 1 else (batch[0], None)
+        out = layer(*inputs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        if self.loss is not None and label is not None:
+            return self.loss(out, label)
+        return out
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_sample_split=None,
+            valid_freq=1, valid_steps=None, collate_fn=None, callbacks=None,
+            verbose=2, num_workers=0):
+        from ...io import DataLoader
+        from ...parallel.train_step import TrainStep
+        if self.strategy.recompute["enable"]:
+            if hasattr(self.model, "config"):
+                self.model.config.recompute = True
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=True)
+        step_fn = TrainStep(self.model, self.optimizer,
+                            loss_fn=self._loss_fn)
+        self._train_step = step_fn
+        history = {"loss": []}
+        it = 0
+        for epoch in range(epochs):
+            for batch in loader:
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = step_fn(*batch)
+                history["loss"].append(float(loss.numpy()))
+                it += 1
+                if verbose and it % log_freq == 0:
+                    print(f"[auto_parallel] epoch {epoch} step {it} "
+                          f"loss {history['loss'][-1]:.4f}")
+                if steps_per_epoch and it >= steps_per_epoch:
+                    break
+        step_fn.sync_to_layer()
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=2, num_workers=0):
+        from ...io import DataLoader
+        from ...framework.autograd import no_grad
+        loader = valid_data if isinstance(valid_data, DataLoader) else \
+            DataLoader(valid_data, batch_size=batch_size)
+        losses = []
+        with no_grad():
+            for batch in loader:
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self._loss_fn(self.model, *batch)
+                losses.append(float(loss.numpy()))
+        return {"loss": float(np.mean(losses)) if losses else 0.0}
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=2,
+                num_workers=0):
+        from ...io import DataLoader
+        from ...framework.autograd import no_grad
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        with no_grad():
+            for batch in loader:
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                out = self.model(*batch)
+                outs.append(out.numpy() if isinstance(out, Tensor)
+                            else out[0].numpy())
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+        save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None:
+            save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework.io import load
+        import os
+        self.model.set_state_dict(load(path + ".pdparams"))
+        if load_optimizer and self.optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self.optimizer.set_state_dict(load(path + ".pdopt"))

@@ -1,0 +1,97 @@
+"""Elastic training manager (ref: /root/reference/python/paddle/distributed/
+fleet/elastic/manager.py:124 ElasticManager — etcd membership watch +
+relaunch; collective.py:61).
+
+On TPU pods, membership is the pod slice itself: failures surface as
+jax.distributed heartbeat loss and the platform restarts the slice. This
+manager provides the reference's API over a file/TCP-store heartbeat so
+single/multi-host CPU+TPU runs can detect scale events and trigger a
+relaunch callback; checkpoint/resume supplies the state continuity."""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, etcd_client=None, heartbeat_dir=None,
+                 np=None, host=None, interval=3):
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.dir = heartbeat_dir or os.environ.get(
+            "PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic")
+        self.interval = interval
+        self.enable = self.np > 1 or os.environ.get(
+            "PADDLE_ELASTIC_ENABLE") == "1"
+        self._stop = threading.Event()
+        self._thread = None
+        self.on_scale: Optional[Callable] = None
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+
+    def _beat_path(self, rank):
+        return os.path.join(self.dir, f"rank_{rank}.beat")
+
+    def start(self):
+        if not self.enable:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with open(self._beat_path(self.rank), "w") as f:
+                json.dump({"ts": time.time(), "host": self.host}, f)
+            self._stop.wait(self.interval)
+
+    def watch(self):
+        """Return current membership status (the reference polls etcd)."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        now = time.time()
+        alive = 0
+        for r in range(self.np):
+            p = self._beat_path(r)
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        beat = json.load(f)
+                    if now - beat["ts"] < 6 * self.interval:
+                        alive += 1
+                except (json.JSONDecodeError, OSError):
+                    pass
+        if alive < self.np:
+            if self.on_scale:
+                self.on_scale(alive, self.np)
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1)
+        try:
+            os.remove(self._beat_path(self.rank))
+        except OSError:
+            pass
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+
+
+def scale_np(np_new):
+    """ref: distributed/elastic.py:21-43 — request a new world size."""
+    os.environ["PADDLE_ELASTIC_NP"] = str(np_new)
+    return np_new

@@ -1,0 +1,69 @@
+"""Launcher CLI: python -m paddle_tpu.distributed.launch [...] train.py
+
+ref: /root/reference/python/paddle/distributed/launch/main.py +
+controllers/collective.py:37,97-125 (build_pod computes PADDLE_TRAINER_*
+env and spawns one worker per device; master KV rendezvous in
+controllers/master.py).
+
+TPU single-controller model: ONE process per HOST drives all local chips
+through the mesh, so --devices selects chips, --nnodes/--master configure
+jax.distributed for multi-host pods, and per-device worker processes are
+unnecessary. The PADDLE_TRAINER_* env is still exported for scripts that
+read it."""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="master endpoint ip:port for multi-host rendezvous")
+    p.add_argument("--nnodes", default="1")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--devices", "--gpus", "--xpus", default=None,
+                   help="chip ids to use, e.g. 0,1,2,3")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--server_num", default=None)
+    p.add_argument("--trainer_num", default=None)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch():
+    args = _parse()
+    nnodes = int(str(args.nnodes).split(":")[0])
+
+    if args.devices:
+        ids = args.devices.split(",")
+        os.environ["TPU_VISIBLE_DEVICES"] = args.devices
+        os.environ["CUDA_VISIBLE_DEVICES"] = args.devices
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(args.rank))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nnodes))
+    if args.master:
+        os.environ["PADDLE_MASTER"] = args.master
+        host, port = args.master.split(":")
+        os.environ.setdefault("MASTER_ADDR", host)
+        os.environ.setdefault("MASTER_PORT", port)
+
+    if args.master and nnodes > 1:
+        import jax
+        jax.distributed.initialize(args.master, num_processes=nnodes,
+                                   process_id=args.rank)
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,142 @@
+"""paddle.inference (ref: /root/reference/paddle/fluid/inference/api/
+analysis_predictor.cc — AnalysisPredictor::Run:1071, ZeroCopyRun:2044;
+python surface python/paddle/inference/).
+
+The reference's deployment pipeline (analysis passes → IR fusions → TRT
+subgraphs → NaiveExecutor) maps to: load the saved program, jit it once,
+run — XLA is the analysis+fusion pipeline. The Config/Predictor/handle API
+is preserved."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 4
+
+
+class Config:
+    def __init__(self, prog_file=None, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_tpu = True
+        self._precision = PrecisionType.Float32
+        self._memory_pool_mb = 0
+
+    def set_prog_file(self, path):
+        self.prog_file = path
+
+    def set_params_file(self, path):
+        self.params_file = path
+
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._precision = precision
+
+    def enable_tpu(self, precision=PrecisionType.Bfloat16):
+        self._use_tpu = True
+        self._precision = precision
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        # TensorRT is CUDA-only; XLA applies its own fusion. Accepted no-op.
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _Handle:
+    def __init__(self, name, predictor, is_input):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data):
+        self._pred._inputs[self.name] = np.asarray(data)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return self._pred._outputs[self.name]
+
+    def share_external_data(self, data):
+        self.copy_from_cpu(np.asarray(data))
+
+
+class Predictor:
+    """Runs a paddle_tpu.jit-saved model (ref AnalysisPredictor)."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+        path = config.prog_file
+        if path and path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        self._layer = jit.load(path)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._input_names = ["input_" + str(i) for i in range(8)]
+        self._output_names: List[str] = []
+        self._precision = config._precision
+
+    def get_input_names(self):
+        return self._input_names
+
+    def get_input_handle(self, name):
+        return _Handle(name, self, True)
+
+    def get_output_names(self):
+        return self._output_names
+
+    def get_output_handle(self, name):
+        return _Handle(name, self, False)
+
+    def run(self, inputs: Optional[List] = None):
+        if inputs is not None:
+            args = [Tensor(np.asarray(
+                a.numpy() if hasattr(a, "numpy") else a)) for a in inputs]
+        else:
+            args = [Tensor(self._inputs[n]) for n in self._input_names
+                    if n in self._inputs]
+        from ..framework.autograd import no_grad
+        with no_grad():
+            out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {n: o.numpy() for n, o in zip(self._output_names,
+                                                      outs)}
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+    def zero_copy_run(self):
+        return self.run()
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,76 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+
+
+def test_process_mesh_and_shard_tensor():
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+    assert mesh.shape == [2, 4]
+    t = paddle.rand([8, 16])
+    dist.shard_tensor(t, mesh, [dist.Shard(0), dist.Shard(1)])
+    from jax.sharding import NamedSharding
+    assert isinstance(t.data.sharding, NamedSharding)
+    np.testing.assert_allclose(t.numpy().shape, (8, 16))
+
+
+def test_dist_attr_to_spec():
+    from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                      TensorDistAttr)
+    mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+    attr = TensorDistAttr(mesh, [-1, 1])
+    spec = attr.to_partition_spec()
+    assert spec == __import__("jax").sharding.PartitionSpec(None, "mp")
+
+
+def test_reshard():
+    mesh = dist.ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+    t = paddle.rand([8, 4])
+    dist.shard_tensor(t, mesh, [dist.Shard(0)])
+    before = t.numpy().copy()
+    dist.reshard(t, mesh, [dist.Replicate()])
+    np.testing.assert_allclose(t.numpy(), before)
+
+
+def test_engine_fit():
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2))
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    engine = Engine(net, loss=nn.MSELoss(), optimizer=opt)
+    x = paddle.rand([32, 4])
+    y = paddle.rand([32, 2])
+    ds = TensorDataset([x, y])
+    hist = engine.fit(ds, batch_size=8, epochs=3, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = engine.evaluate(ds, batch_size=8)
+    assert "loss" in logs
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    net = nn.Linear(4, 4)
+    path = str(tmp_path / "ck")
+    ckpt.save_state_dict(net.state_dict(), path)
+    w0 = net.weight.numpy().copy()
+    net.weight.set_value(np.zeros_like(w0))
+    ckpt.load_state_dict(path, target_state_dict=net.state_dict())
+    np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_inference_predictor(tmp_path):
+    import paddle_tpu.inference as infer
+    net = nn.Linear(4, 2)
+    net.eval()
+    x = paddle.rand([2, 4])
+    ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path)
+    cfg = infer.Config(path + ".pdmodel")
+    pred = infer.create_predictor(cfg)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
