@@ -40,6 +40,8 @@ _define("FLAGS_paddle_num_threads", 1)
 _define("FLAGS_enable_pallas_kernels", True,
         "use pallas fused kernels (attention/layernorm/adamw) when available")
 _define("FLAGS_embedding_deterministic", False)
+_define("FLAGS_tpu_flash_impl", "jax",
+        "flash attention kernel: jax (tuned pallas) | native (this repo)")
 _define("FLAGS_low_precision_op_list", 0)
 
 

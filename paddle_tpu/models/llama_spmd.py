@@ -40,6 +40,13 @@ def _place(a, *spec):
     return mesh_mod.shard_tensor_data(a, P(*spec))
 
 
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def _zero_spec(shape, base_spec, axis="sharding"):
     """Add 'sharding' to the first free, divisible dim of base_spec."""
     n = mesh_mod.mesh_axis_size(axis)
@@ -200,12 +207,19 @@ class LlamaSpmdTrainer:
         q = mesh_mod.constraint(q, "dp", "sep", "mp", None)
 
         scale = 1.0 / math.sqrt(hd)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        use_flash = (_on_tpu() and hd % 64 == 0 and T % 128 == 0
+                     and mesh_mod.mesh_axis_size("sep") == 1)
+        if use_flash:
+            from ..ops.pallas.flash_attention import flash_attention_blhd
+            attn = flash_attention_blhd(q, k, v, causal=True,
+                                        sm_scale=scale)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         attn = attn.reshape(B, T, nh * hd)
         x = x + attn @ bp["wo"]
 

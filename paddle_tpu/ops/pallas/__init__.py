@@ -1,0 +1,2 @@
+"""Pallas TPU kernels: the fused-op layer (the reference's CUDA
+fused/cutlass kernels, SURVEY.md §2.1 phi/kernels/fusion)."""
