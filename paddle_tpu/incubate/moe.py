@@ -139,6 +139,7 @@ class ExpertFFN(nn.Layer):
         super().__init__()
         self.fc1 = nn.Linear(d_model, d_hidden)
         self.fc2 = nn.Linear(d_hidden, d_model)
+        self.act_name = activation
         self.act = getattr(F, activation)
 
     def forward(self, x):
@@ -186,6 +187,52 @@ class MoELayer(nn.Layer):
         b2 = [e.fc2.bias for e in self.experts]
         return w1, b1, w2, b2
 
+    @staticmethod
+    def _gshard_routing(lg, k, E, cap):
+        """Per-slot routing: yields (expert one-hot [N,E] int32, capacity
+        position [N], kept-weight [N]) per top-k slot.
+
+        Capacity positions of slot s are offset by the cumulative per-expert
+        token counts of slots < s (canonical GShard/lingvo dense dispatch),
+        so a token routed to expert e via slot 1 never reuses a position
+        already taken by a slot-0 token of the same expert.
+        """
+        probs = jax.nn.softmax(lg, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)
+        topv = topv / jnp.sum(topv, -1, keepdims=True)
+
+        offset = jnp.zeros((E,), jnp.int32)
+        for slot in range(k):
+            idx = topi[:, slot]
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+            pos = jnp.sum(((jnp.cumsum(onehot, axis=0) - 1)
+                           + offset[None, :]) * onehot, -1)
+            keep = pos < cap
+            val = jnp.where(keep, topv[:, slot], 0.0)
+            yield onehot, pos, keep, val
+            offset = offset + jnp.sum(onehot, axis=0)
+
+    @staticmethod
+    def _gshard_combine(lg, k, E, cap, dtype):
+        """Dense GShard combine tensor [N, E, C]."""
+        combine = jnp.zeros((lg.shape[0], E, cap), dtype)
+        for onehot, pos, keep, val in MoELayer._gshard_routing(lg, k, E, cap):
+            combine = combine + (
+                onehot.astype(dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.where(keep, pos, 0), cap,
+                                 dtype=dtype)[:, None, :]
+                * val[:, None, None])
+        return combine
+
+    @staticmethod
+    def _gshard_weights(lg, k, E, cap):
+        """Per-(token, expert) combine weight [N, E] — the capacity-respecting
+        mixture weights without materializing the O(N*E*C) combine tensor."""
+        w = jnp.zeros((lg.shape[0], E), lg.dtype)
+        for onehot, pos, keep, val in MoELayer._gshard_routing(lg, k, E, cap):
+            w = w + onehot.astype(lg.dtype) * val[:, None]
+        return w
+
     def forward(self, x):
         from ..ops.manipulation import reshape
         orig_shape = x.shape
@@ -199,7 +246,33 @@ class MoELayer(nn.Layer):
         k = self.top_k
         cap = max(int(self.capacity_factor * n_tokens * k / E), k)
         ep = self.ep_axis
+        gshard_combine = self._gshard_combine
 
+        fused = all(type(e) is ExpertFFN for e in self.experts)
+        if fused:
+            acts = {e.act_name for e in self.experts}
+            fused = len(acts) == 1 and hasattr(jax.nn, next(iter(acts)))
+        if not fused:
+            # Generic experts (custom Layers / heterogeneous activations):
+            # run every expert module on all tokens and mix with the
+            # capacity-respecting combine weights. Correct but O(E*N).
+            def combine_w(lg):
+                return self._gshard_weights(lg, k, E, cap)
+            w = apply(combine_w, (logits,), op_name="moe_combine")
+            out = None
+            for e_idx, expert in enumerate(self.experts):
+                y = expert(x2)
+                contrib = y * w[:, e_idx:e_idx + 1]
+                out = contrib if out is None else out + contrib
+            return reshape(out, orig_shape)
+
+        act_name = self.experts[0].act_name
+        if act_name == "gelu":
+            # F.gelu defaults to exact erf; jax.nn.gelu to tanh-approximate
+            def act_fn(h):
+                return jax.nn.gelu(h, approximate=False)
+        else:
+            act_fn = getattr(jax.nn, act_name)
         w1s, b1s, w2s, b2s = self._stacked_expert_params()
         args = (x2, logits) + tuple(w1s) + tuple(b1s) + tuple(w2s) \
             + tuple(b2s)
@@ -213,24 +286,7 @@ class MoELayer(nn.Layer):
                 w1 = mesh_mod.constraint(w1, ep)
                 w2 = mesh_mod.constraint(w2, ep)
 
-            probs = jax.nn.softmax(lg, axis=-1)
-            topv, topi = jax.lax.top_k(probs, k)
-            topv = topv / jnp.sum(topv, -1, keepdims=True)
-
-            # dispatch/combine tensors (GShard): [N, E, C]
-            combine = jnp.zeros((xa.shape[0], E, cap), xa.dtype)
-            for slot in range(k):
-                idx = topi[:, slot]
-                onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
-                pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot
-                pos = jnp.sum(pos, -1)
-                keep = pos < cap
-                val = jnp.where(keep, topv[:, slot], 0.0)
-                combine = combine + (
-                    jax.nn.one_hot(idx, E, dtype=xa.dtype)[:, :, None]
-                    * jax.nn.one_hot(jnp.where(keep, pos, 0), cap,
-                                     dtype=xa.dtype)[:, None, :]
-                    * val[:, None, None])
+            combine = gshard_combine(lg, k, E, cap, xa.dtype)
             dispatch = (combine > 0).astype(xa.dtype)
 
             # all-to-all dispatch as einsum (GSPMD lowers to a2a when sharded)
@@ -238,7 +294,7 @@ class MoELayer(nn.Layer):
             if ep is not None:
                 exp_in = mesh_mod.constraint(exp_in, ep)
             h = jnp.einsum("ecd,edf->ecf", exp_in, w1) + b1[:, None, :]
-            h = jax.nn.gelu(h)
+            h = act_fn(h)
             exp_out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
             if ep is not None:
                 exp_out = mesh_mod.constraint(exp_out, ep)

@@ -34,10 +34,12 @@ NEG_INF = -1e30
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
                       acc_scratch, *, kv_steps, sm_scale, causal,
-                      block_q, block_k):
+                      block_q, block_k, t_k, causal_offset, mask_tail):
     """Grid: (batch*heads, q_blocks, kv_blocks). Online softmax: running max
     (m), normalizer (l) and fp32 accumulator live in VMEM scratch across the
-    kv_block grid dimension."""
+    kv_block grid dimension. `t_k` is the un-padded KV length (tail KV blocks
+    beyond it are masked out); causal masking offsets the row index by
+    t_k - t_q so cross-length attention matches the dense reference."""
     kv_i = pl.program_id(2)
 
     @pl.when(kv_i == 0)
@@ -53,18 +55,32 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
                             preferred_element_type=jnp.float32)
     s = s * sm_scale                   # [block_q, block_k]
 
+    pad_valid = None
+    if mask_tail:
+        col = kv_i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        pad_valid = col < t_k
+        s = jnp.where(pad_valid, s, NEG_INF)
     if causal:
         q_i = pl.program_id(1)
         row = q_i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         col = kv_i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(row >= col, s, NEG_INF)
+        # causal-masked entries get NEG_INF but are NOT force-zeroed below:
+        # a fully-masked row then degrades to uniform attention, matching
+        # the dense reference (softmax of an all-NEG_INF row) and hence the
+        # AD backward of the custom_vjp.
+        s = jnp.where(row + causal_offset >= col, s, NEG_INF)
 
     m_prev = m_scratch[...]            # [block_q, 1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     p = jnp.exp(s - m_new)
+    if pad_valid is not None:
+        # padding columns must contribute exactly 0 even for rows whose
+        # running max is still NEG_INF (exp(NEG_INF - NEG_INF) == 1)
+        p = jnp.where(pad_valid, p, 0.0)
     alpha = jnp.exp(m_prev - m_new)
     l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
     acc = acc_scratch[...] * alpha + jax.lax.dot(
@@ -82,19 +98,29 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
 
 def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
                       block_k=128, interpret=False):
-    """q,k,v: [BH, T, D] -> o [BH, T, D]."""
+    """q,k,v: [BH, T, D] -> o [BH, T, D]. Handles sequence lengths that are
+    not multiples of the block size by padding + in-kernel masking."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_k)
-    grid = (bh, t_q // block_q, t_k // block_k)
+    # block sublane dims must stay tile-aligned for Mosaic (16 covers bf16)
+    block_q = min(block_q, -(-t_q // 16) * 16)
+    block_k = min(block_k, -(-t_k // 16) * 16)
+    t_q_pad = -(-t_q // block_q) * block_q
+    t_k_pad = -(-t_k // block_k) * block_k
+    if t_q_pad != t_q:
+        q = jnp.pad(q, ((0, 0), (0, t_q_pad - t_q), (0, 0)))
+    if t_k_pad != t_k:
+        k = jnp.pad(k, ((0, 0), (0, t_k_pad - t_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, t_k_pad - t_k), (0, 0)))
+    grid = (bh, t_q_pad // block_q, t_k_pad // block_k)
 
     kernel = functools.partial(
         _flash_fwd_kernel, kv_steps=grid[2], sm_scale=sm_scale,
-        causal=causal, block_q=block_q, block_k=block_k)
+        causal=causal, block_q=block_q, block_k=block_k, t_k=t_k,
+        causal_offset=t_k - t_q, mask_tail=(t_k_pad != t_k))
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -103,7 +129,7 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -115,6 +141,7 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
             if (pltpu is not None and not interpret
                 and hasattr(pltpu, "CompilerParams")) else None),
     )(q, k, v)
+    return out[:, :t_q] if t_q_pad != t_q else out
 
 
 def _mha_jnp(q, k, v, causal, sm_scale):
@@ -129,11 +156,16 @@ def _mha_jnp(q, k, v, causal, sm_scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+# tests set this to run the pallas kernel in interpret mode on CPU
+_FORCE_INTERPRET = False
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _native_flash_bhtd(q, k, v, causal, sm_scale):
     b, h, t, d = q.shape
     o = _flash_fwd_pallas(q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
-                          v.reshape(b * h, -1, d), causal, sm_scale)
+                          v.reshape(b * h, -1, d), causal, sm_scale,
+                          interpret=_FORCE_INTERPRET)
     return o.reshape(b, h, t, d)
 
 
@@ -169,6 +201,19 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as jax_flash)
             out = jax_flash(qh, kh, vh, causal=causal, sm_scale=sm_scale)
-        except Exception:
+        except Exception as e:
+            global _warned_fallback
+            if not _warned_fallback:
+                import warnings
+                warnings.warn(
+                    "jax tuned TPU flash attention unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the native "
+                    "pallas forward + AD backward (slower backward). Set "
+                    "FLAGS_tpu_flash_impl=native to silence.",
+                    stacklevel=2)
+                _warned_fallback = True
             out = _native_flash_bhtd(qh, kh, vh, causal, sm_scale)
     return jnp.moveaxis(out, 1, 2)
+
+
+_warned_fallback = False

@@ -20,7 +20,7 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
@@ -67,7 +67,7 @@ class Adamax(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         g32 = g.astype(jnp.float32)
         m = b1 * state["moment"] + (1 - b1) * g32
@@ -94,7 +94,15 @@ class Lamb(Optimizer):
         self._epsilon = epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _wd_mode(self):
+        return "internal"  # decay enters the trust-ratio numerator
+
+    def _wd_for_param(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._lamb_wd
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
@@ -102,7 +110,7 @@ class Lamb(Optimizer):
         v = b2 * state["moment2"] + (1 - b2) * (g32 * g32)
         m_hat = m / (1 - b1 ** step)
         v_hat = v / (1 - b2 ** step)
-        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._lamb_wd * p32
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p32
         w_norm = jnp.sqrt(jnp.sum(p32 * p32))
         r_norm = jnp.sqrt(jnp.sum(r * r))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

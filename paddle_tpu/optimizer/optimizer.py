@@ -91,12 +91,17 @@ class Optimizer:
                 for name in self._accum_names}
 
     # -- update rule (override) ---------------------------------------------
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         raise NotImplementedError
 
     def _decoupled_wd(self):
         """AdamW overrides to True: decay applied to param, not grad."""
         return False
+
+    def _wd_mode(self):
+        """'grad': L2 added to grad; 'decoupled': AdamW-style param decay;
+        'internal': the rule consumes wd itself (Lamb trust ratio)."""
+        return "decoupled" if self._decoupled_wd() else "grad"
 
     def _wd_for_param(self, p):
         return self._wd
@@ -137,7 +142,12 @@ class Optimizer:
                      tuple(metas))
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = jax.jit(self._make_fused(metas), donate_argnums=(0, 2))
+            # No buffer donation here: the dygraph API hands out aliases of
+            # param/accumulator buffers (tensor.detach() shares _data,
+            # state_dict() wraps the live accumulator arrays), and donating
+            # would delete those aliases from under the user. The SPMD
+            # trainer's fused train_step owns its buffers and donates there.
+            fn = jax.jit(self._make_fused(metas))
             self._jit_cache[cache_key] = fn
         new_ps, new_states = fn(p_arrs, g_arrs, states, lr, step)
 
@@ -150,16 +160,16 @@ class Optimizer:
             self._accumulators[p.name] = new_st
 
     def _make_fused(self, metas):
-        decoupled = self._decoupled_wd()
+        wd_mode = self._wd_mode()
 
         def fused(p_arrs, g_arrs, states, lr, step):
             new_ps, new_sts = [], []
             for p, g, st, (plr, wd, _) in zip(p_arrs, g_arrs, states, metas):
                 g = g.astype(p.dtype) if g.dtype != p.dtype else g
-                if wd and not decoupled:
+                if wd and wd_mode == "grad":
                     g = g + wd * p
-                np_, nst = self._update(p, g, st, lr, step, plr)
-                if wd and decoupled:
+                np_, nst = self._update(p, g, st, lr, step, plr, wd)
+                if wd and wd_mode == "decoupled":
                     np_ = np_ - lr * plr * wd * p
                 new_ps.append(np_)
                 new_sts.append(nst)
@@ -237,7 +247,7 @@ class Optimizer:
 class SGD(Optimizer):
     """ref: python/paddle/optimizer/sgd.py."""
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         return p - (lr * param_lr) * g.astype(p.dtype), state
 
 
@@ -254,7 +264,7 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._nesterov = use_nesterov
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         g32 = g.astype(jnp.float32)
         v = self._momentum * state["velocity"] + g32
         if self._nesterov:

@@ -22,7 +22,7 @@ class Adagrad(Optimizer):
         return {"moment": jnp.full(p.data.shape, self._init_value,
                                    jnp.float32)}
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         g32 = g.astype(jnp.float32)
         mom = state["moment"] + g32 * g32
         new_p = p - (lr * param_lr) * (g32 / (jnp.sqrt(mom) + self._epsilon)
@@ -41,7 +41,7 @@ class Adadelta(Optimizer):
         self._epsilon = epsilon
         self._rho = rho
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         rho, eps = self._rho, self._epsilon
         g32 = g.astype(jnp.float32)
         sq_g = rho * state["avg_squared_grad"] + (1 - rho) * g32 * g32
@@ -65,7 +65,7 @@ class RMSProp(Optimizer):
         self._momentum = momentum
         self._centered = centered
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         rho, eps = self._rho, self._epsilon
         g32 = g.astype(jnp.float32)
         ms = rho * state["mean_square"] + (1 - rho) * g32 * g32
@@ -90,7 +90,7 @@ class ASGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         # simplified averaged-SGD: plain step (reference keeps per-batch grads)
         return p - (lr * param_lr) * g.astype(p.dtype), state
 
@@ -111,7 +111,7 @@ class Rprop(Optimizer):
                 "lr_per_w": jnp.full(p.data.shape, float(self.get_lr()),
                                      jnp.float32)}
 
-    def _update(self, p, g, state, lr, step, param_lr=1.0):
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
         eta_m, eta_p = self._etas
         lo, hi = self._lr_range
         g32 = g.astype(jnp.float32)
