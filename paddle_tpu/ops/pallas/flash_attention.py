@@ -194,6 +194,11 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
     kh = jnp.moveaxis(k, 1, 2)
     vh = jnp.moveaxis(v, 1, 2)
     impl = get_flag("FLAGS_tpu_flash_impl", "jax")
+    if causal and q.shape[1] != k.shape[1]:
+        # jax's tuned kernel masks top-left (col <= row, no cross-length
+        # offset); our semantics are bottom-right like the dense reference,
+        # so cross-length causal must use the native kernel
+        impl = "native"
     if impl == "native":
         out = _native_flash_bhtd(qh, kh, vh, causal, sm_scale)
     else:
