@@ -41,12 +41,14 @@ def main():
     mesh_mod.build_mesh(dp=1, devices=[dev])
 
     if on_tpu:
-        # ~350M-param llama, bf16, remat, seq 1024
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                          intermediate_size=2816, num_hidden_layers=16,
-                          num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=1024)
-        batch, seq, steps, warmup = 8, 1024, 10, 2
+        # Llama-2-7B layer dims (hidden 4096, inter 11008, 32 heads) with 3
+        # layers + 16k vocab so params+AdamW states fit one chip's HBM; bf16,
+        # full remat, seq 2048. MXU-saturating matmuls == honest 7B-class MFU.
+        cfg = LlamaConfig(vocab_size=16000, hidden_size=4096,
+                          intermediate_size=11008, num_hidden_layers=3,
+                          num_attention_heads=32, num_key_value_heads=32,
+                          max_position_embeddings=2048)
+        batch, seq, steps, warmup = 8, 2048, 10, 2
         dtype = jnp.bfloat16
     else:
         cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=4,
@@ -69,11 +71,9 @@ def main():
 
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * steps / dt
-    flops_tok = trainer.flops_per_token()
-    if trainer.remat:
-        # remat recomputes the forward in backward: ~8/6 of base FLOPs spent,
-        # but MFU convention counts model FLOPs only (6ND)
-        pass
+    # flops_per_token counts matmul params (6N) + causal attention term;
+    # remat recompute is excluded per MFU convention (model FLOPs only)
+    flops_tok = trainer.flops_per_token(seq)
     mfu = tok_s * flops_tok / _peak_flops(dev)
 
     print(json.dumps({

@@ -64,12 +64,21 @@ class LlamaSpmdTrainer:
     def __init__(self, config: LlamaConfig, lr=3e-4, weight_decay=0.1,
                  beta1=0.9, beta2=0.95, eps=1e-8, remat=True,
                  n_micro=None, seed=0, compute_dtype=jnp.bfloat16,
-                 from_state_dict=None):
+                 from_state_dict=None, remat_policy="full"):
         self.config = config
         self.lr = lr
         self.wd = weight_decay
         self.b1, self.b2, self.eps = beta1, beta2, eps
         self.remat = remat
+        # 'full': recompute everything in backward (min memory);
+        # 'save_dots': keep tagged matmul outputs so backward recompute is
+        # mostly elementwise — except the dense attention path (sep>1/CPU),
+        # whose O(T^2) QK^T/softmax is rematerialized either way
+        # (the reference's recompute granularity knob, RecomputeConfig)
+        if remat_policy not in ("full", "save_dots"):
+            raise ValueError(f"remat_policy must be 'full' or 'save_dots', "
+                             f"got {remat_policy!r}")
+        self.remat_policy = remat_policy
         self.compute_dtype = compute_dtype
         mesh = mesh_mod.get_mesh()
         self.pp = mesh.shape.get("pp", 1)
@@ -182,10 +191,12 @@ class LlamaSpmdTrainer:
                 + c.rms_norm_eps)
             return (out * w.astype(jnp.float32)).astype(dt)
 
+        from jax.ad_checkpoint import checkpoint_name
+
         h = rms(x, bp["ln1"])
-        q = (h @ bp["wq"]).reshape(B, T, nh, hd)
-        k = (h @ bp["wk"]).reshape(B, T, nkv, hd)
-        v = (h @ bp["wv"]).reshape(B, T, nkv, hd)
+        q = checkpoint_name((h @ bp["wq"]), "q").reshape(B, T, nh, hd)
+        k = checkpoint_name((h @ bp["wk"]), "k").reshape(B, T, nkv, hd)
+        v = checkpoint_name((h @ bp["wv"]), "v").reshape(B, T, nkv, hd)
         cos, sin = self._rope(T)
         cos = cos[None, :, None, :].astype(dt)
         sin = sin[None, :, None, :].astype(dt)
@@ -220,12 +231,12 @@ class LlamaSpmdTrainer:
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        attn = attn.reshape(B, T, nh * hd)
+        attn = checkpoint_name(attn.reshape(B, T, nh * hd), "attn_out")
         x = x + attn @ bp["wo"]
 
         h = rms(x, bp["ln2"])
-        gate = jax.nn.silu(h @ bp["wg"])
-        up = h @ bp["wu"]
+        gate = jax.nn.silu(checkpoint_name(h @ bp["wg"], "ffn_gate"))
+        up = checkpoint_name(h @ bp["wu"], "ffn_up")
         x = x + (gate * up) @ bp["wd"]
         return mesh_mod.constraint(x, "dp", "sep", None)
 
@@ -233,7 +244,12 @@ class LlamaSpmdTrainer:
         """Run this stage's layers_per_stage blocks (scan + remat)."""
         block = self._block
         if self.remat:
-            block = jax.checkpoint(block)
+            if self.remat_policy == "save_dots":
+                pol = jax.checkpoint_policies.save_only_these_names(
+                    "q", "k", "v", "attn_out", "ffn_gate", "ffn_up")
+                block = jax.checkpoint(block, policy=pol)
+            else:
+                block = jax.checkpoint(block)
 
         def body(carry, bp):
             return block(bp, carry), None
@@ -312,15 +328,21 @@ class LlamaSpmdTrainer:
         return loss
 
     # -- analytics ----------------------------------------------------------
-    def flops_per_token(self):
-        """Approximate training FLOPs/token (6 * params-in-matmuls, plus
-        attention quadratic term)."""
+    def flops_per_token(self, seq_len=None):
+        """Training FLOPs/token: 6 * params-in-matmuls plus the causal
+        attention quadratic term (QK^T and PV are 2*H*T_eff fwd flops each
+        per token with T_eff = T/2 under causal masking; backward doubles
+        the forward, so train = 3x fwd = 6*H*T per layer per token).
+        Remat recompute is NOT counted (MFU convention: model FLOPs only).
+        """
         c = self.config
         H, F, V = c.hidden_size, c.intermediate_size, c.vocab_size
+        T = seq_len or c.max_position_embeddings
         KV = c.num_key_value_heads * self.head_dim
         per_layer = 2 * H * H + 2 * H * KV + 3 * H * F
         matmul_params = c.num_hidden_layers * per_layer + 2 * V * H
-        return 6 * matmul_params
+        attn = 6 * c.num_hidden_layers * H * T
+        return 6 * matmul_params + attn
 
     def param_count(self):
         return sum(int(np.prod(l.shape)) for l in

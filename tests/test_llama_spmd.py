@@ -26,13 +26,11 @@ def _make_cfg(seq=32):
     return LlamaConfig.tiny(**c)
 
 
-def _serial_params_from(params, pp):
+def _serial_params_from(params):
     """Collapse [pp, lps, ...] block stacking to [1, pp*lps, ...]."""
-    def fix(a):
-        return np.asarray(a)
     blocks = {k: np.asarray(v).reshape((1, -1) + v.shape[2:])
               for k, v in params["blocks"].items()}
-    out = {k: fix(v) for k, v in params.items() if k != "blocks"}
+    out = {k: np.asarray(v) for k, v in params.items() if k != "blocks"}
     out["blocks"] = blocks
     return out
 
@@ -70,11 +68,8 @@ def test_hybrid_forward_and_grads_match_serial(deg, restore_mesh):
     loss, grads = jax.jit(jax.value_and_grad(trainer.loss_fn))(
         trainer.params, jnp.asarray(ids), jnp.asarray(ids))
     loss = float(loss)
-    grads_flat = [np.asarray(g).reshape(-1) for g in
-                  jax.tree_util.tree_leaves(
-                      jax.tree_util.tree_map(np.asarray, grads))]
     raw_params = _serial_params_from(
-        jax.tree_util.tree_map(np.asarray, trainer.params), deg["pp"])
+        jax.tree_util.tree_map(np.asarray, trainer.params))
 
     # serial single-device reference with identical weights
     mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
@@ -113,6 +108,28 @@ def test_train_step_loss_decreases_under_hybrid(restore_mesh):
     assert losses[-1] < losses[0], losses
 
 
+def test_save_dots_remat_matches_full(restore_mesh):
+    """remat_policy='save_dots' must give identical grads to 'full' remat."""
+    cfg = _make_cfg()
+    mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
+    ids = np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 32))
+
+    def grads_for(policy):
+        t = LlamaSpmdTrainer(cfg, compute_dtype=jnp.float32, seed=0,
+                             remat_policy=policy)
+        _, g = jax.jit(jax.value_and_grad(t.loss_fn))(
+            t.params, jnp.asarray(ids), jnp.asarray(ids))
+        return jax.tree_util.tree_map(np.asarray, g)
+
+    g_full = grads_for("full")
+    g_dots = grads_for("save_dots")
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_dots)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    with pytest.raises(ValueError):
+        LlamaSpmdTrainer(cfg, remat_policy="dots")
+
+
 def test_zero_sharding_actually_partitions_opt_state(restore_mesh):
     """ZeRO: optimizer moments must be sharded over the 'sharding' axis
     (per-device bytes < replicated bytes)."""
@@ -145,9 +162,6 @@ def test_spmd_pipeline_matches_sequential_map(restore_mesh):
 
     def stage_fn(p, xb):
         return jnp.tanh(xb @ p)
-
-    def pipelined(W, x):
-        return spmd_pipeline(stage_fn, {"w": W}, x)
 
     def sequential(W, x):
         def one(xb):
