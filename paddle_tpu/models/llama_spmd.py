@@ -207,10 +207,6 @@ class LlamaSpmdTrainer:
 
         q = q * cos + rot(q) * sin
         k = k * cos + rot(k) * sin
-        if nkv != nh:
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
 
         # sequence parallel: q stays sep-sharded; k/v gathered across 'sep'
         k = mesh_mod.constraint(k, "dp", None, "mp", None)
@@ -222,8 +218,25 @@ class LlamaSpmdTrainer:
                      and mesh_mod.mesh_axis_size("sep") == 1)
         if use_flash:
             from ..ops.pallas.flash_attention import flash_attention_blhd
+            if nkv != nh:
+                # the tuned kernel wants equal head counts
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             attn = flash_attention_blhd(q, k, v, causal=True,
                                         sm_scale=scale)
+        elif nkv != nh:
+            # grouped-query attention without materializing repeated K/V:
+            # fold the group dim into the score einsum (g = nh // nkv)
+            g = nh // nkv
+            qg = q.reshape(B, T, nkv, g, hd)
+            scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
+                                preferred_element_type=jnp.float32) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bngqk,bknd->bqngd", probs, v)
+            attn = attn.reshape(B, T, nh, hd)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                                 preferred_element_type=jnp.float32) * scale

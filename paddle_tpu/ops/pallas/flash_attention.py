@@ -32,8 +32,8 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
-                      acc_scratch, *, kv_steps, sm_scale, causal,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch,
+                      l_scratch, acc_scratch, *, kv_steps, sm_scale, causal,
                       block_q, block_k, t_k, causal_offset, mask_tail):
     """Grid: (batch*heads, q_blocks, kv_blocks). Online softmax: running max
     (m), normalizer (l) and fp32 accumulator live in VMEM scratch across the
@@ -48,58 +48,76 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         l_scratch[...] = jnp.zeros_like(l_scratch)
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
-    q = q_ref[0]                       # [block_q, d]
-    k = k_ref[0]                       # [block_k, d]
-    v = v_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    s = s * sm_scale                   # [block_q, block_k]
+    # causal block skipping: a KV block lying entirely above the (offset)
+    # diagonal contributes nothing — skip its MXU work. Only safe when
+    # t_k >= t_q (causal_offset >= 0), where no q row is fully masked.
+    q_i = pl.program_id(1)
+    if causal and causal_offset >= 0:
+        run = (q_i * block_q + block_q - 1 + causal_offset
+               >= kv_i * block_k)
+    else:
+        run = True
 
-    pad_valid = None
-    if mask_tail:
-        col = kv_i * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        pad_valid = col < t_k
-        s = jnp.where(pad_valid, s, NEG_INF)
-    if causal:
-        q_i = pl.program_id(1)
-        row = q_i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        col = kv_i * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        # causal-masked entries get NEG_INF but are NOT force-zeroed below:
-        # a fully-masked row then degrades to uniform attention, matching
-        # the dense reference (softmax of an all-NEG_INF row) and hence the
-        # AD backward of the custom_vjp.
-        s = jnp.where(row + causal_offset >= col, s, NEG_INF)
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                   # [block_q, d]
+        k = k_ref[0]                   # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale               # [block_q, block_k]
 
-    m_prev = m_scratch[...]            # [block_q, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)
-    if pad_valid is not None:
-        # padding columns must contribute exactly 0 even for rows whose
-        # running max is still NEG_INF (exp(NEG_INF - NEG_INF) == 1)
-        p = jnp.where(pad_valid, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
-    acc = acc_scratch[...] * alpha + jax.lax.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        pad_valid = None
+        if mask_tail:
+            col = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            pad_valid = col < t_k
+            s = jnp.where(pad_valid, s, NEG_INF)
+        if causal:
+            row = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            # causal-masked entries get NEG_INF but are NOT force-zeroed
+            # below: a fully-masked row then degrades to uniform attention,
+            # matching the dense reference (softmax of an all-NEG_INF row)
+            # and hence the AD backward of the custom_vjp.
+            s = jnp.where(row + causal_offset >= col, s, NEG_INF)
 
-    m_scratch[...] = m_new
-    l_scratch[...] = l_new
-    acc_scratch[...] = acc
+        m_prev = m_scratch[...]        # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        if pad_valid is not None:
+            # padding columns must contribute exactly 0 even for rows whose
+            # running max is still NEG_INF (exp(NEG_INF - NEG_INF) == 1)
+            p = jnp.where(pad_valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scratch[...] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+        m_scratch[...] = m_new
+        l_scratch[...] = l_new
+        acc_scratch[...] = acc
 
     @pl.when(kv_i == kv_steps - 1)
     def _finish():
         o_ref[0] = (acc_scratch[...] /
                     jnp.maximum(l_scratch[...], 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_scratch[...] + jnp.log(
+                jnp.maximum(l_scratch[...], 1e-30))
+            # lane-broadcast layout (jax flash kernel convention): the lse
+            # value lives in all 128 lanes of its row
+            lse_ref[0] = jnp.broadcast_to(lse, (block_q, 128))
 
 
 def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
-                      block_k=128, interpret=False):
-    """q,k,v: [BH, T, D] -> o [BH, T, D]. Handles sequence lengths that are
-    not multiples of the block size by padding + in-kernel masking."""
+                      block_k=128, interpret=False, return_lse=False):
+    """q,k,v: [BH, T, D] -> o [BH, T, D] (and lse [BH, T] if return_lse).
+    Handles sequence lengths that are not multiples of the block size by
+    padding + in-kernel masking."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -115,12 +133,24 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
         v = jnp.pad(v, ((0, 0), (0, t_k_pad - t_k), (0, 0)))
     grid = (bh, t_q_pad // block_q, t_k_pad // block_k)
 
-    kernel = functools.partial(
+    base = functools.partial(
         _flash_fwd_kernel, kv_steps=grid[2], sm_scale=sm_scale,
         causal=causal, block_q=block_q, block_k=block_k, t_k=t_k,
         causal_offset=t_k - t_q, mask_tail=(t_k_pad != t_k))
 
-    out = pl.pallas_call(
+    out_shapes = [jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))]
+    if return_lse:
+        kernel = base
+        out_shapes.append(
+            jax.ShapeDtypeStruct((bh, t_q_pad, 128), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)))
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
+            return base(q_ref, k_ref, v_ref, o_ref, None, m_s, l_s, acc_s)
+
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -128,8 +158,8 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype),
+        out_specs=out_specs if return_lse else out_specs[0],
+        out_shape=out_shapes if return_lse else out_shapes[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -141,7 +171,227 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
             if (pltpu is not None and not interpret
                 and hasattr(pltpu, "CompilerParams")) else None),
     )(q, k, v)
-    return out[:, :t_q] if t_q_pad != t_q else out
+    out, lse = outs if return_lse else (outs, None)
+    if t_q_pad != t_q:
+        out = out[:, :t_q]
+        lse = lse[:, :t_q] if lse is not None else None
+    return (out, lse[:, :, 0]) if return_lse else out
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, kv_steps, sm_scale, causal,
+                         block_q, block_k, t_k, causal_offset, mask_tail):
+    """Grid (bh, q_blocks, kv_blocks): accumulate dQ over KV blocks.
+    dS = P * (dO V^T - delta); dQ = dS K * scale  (FlashAttention-2 bwd)."""
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_i = pl.program_id(1)
+    if causal:
+        run = (q_i * block_q + block_q - 1 + causal_offset
+               >= kv_i * block_k)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        valid = None
+        if mask_tail:
+            col = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = col < t_k
+        if causal:
+            row = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            cm = row + causal_offset >= col
+            valid = cm if valid is None else (valid & cm)
+        if valid is not None:
+            s = jnp.where(valid, s, NEG_INF)
+
+        p = jnp.exp(s - lse)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot(ds.astype(k.dtype), k,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(kv_i == kv_steps - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, q_steps,
+                          sm_scale, causal, block_q, block_k, t_k,
+                          causal_offset, mask_tail):
+    """Grid (bh, kv_blocks, q_blocks): accumulate dK/dV over Q blocks.
+    dV = P^T dO; dK = dS^T Q * scale."""
+    q_i = pl.program_id(2)
+
+    @pl.when(q_i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    kv_idx = pl.program_id(1)
+    if causal:
+        run = (q_i * block_q + block_q - 1 + causal_offset
+               >= kv_idx * block_k)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        valid = None
+        if mask_tail:
+            col = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = col < t_k
+        if causal:
+            row = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            cm = row + causal_offset >= col
+            valid = cm if valid is None else (valid & cm)
+        if valid is not None:
+            s = jnp.where(valid, s, NEG_INF)
+
+        p = jnp.exp(s - lse)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        # dV += P^T dO
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dK += dS^T Q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_i == q_steps - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
+                      block_k=128, interpret=False):
+    """FlashAttention-2 backward. q,k,v,o,do: [BH, T, D]; lse: [BH, T]."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, -(-t_q // 16) * 16)
+    block_k = min(block_k, -(-t_k // 16) * 16)
+    t_q_pad = -(-t_q // block_q) * block_q
+    t_k_pad = -(-t_k // block_k) * block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    if t_q_pad != t_q:
+        pad = ((0, 0), (0, t_q_pad - t_q), (0, 0))
+        q = jnp.pad(q, pad)
+        do = jnp.pad(do, pad)
+        # padded q rows: lse=+inf makes p = exp(s - inf) = 0 everywhere
+        lse = jnp.pad(lse, ((0, 0), (0, t_q_pad - t_q)),
+                      constant_values=jnp.inf)
+        delta = jnp.pad(delta, ((0, 0), (0, t_q_pad - t_q)))
+    if t_k_pad != t_k:
+        pad = ((0, 0), (0, t_k_pad - t_k), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    # lane-broadcast layout for row statistics (see _flash_fwd_kernel)
+    lse = jnp.broadcast_to(lse[:, :, None], (bh, t_q_pad, 128))
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, t_q_pad, 128))
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, t_k=t_k, causal_offset=t_k - t_q,
+                  mask_tail=(t_k_pad != t_k))
+    cparams = (pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (pltpu is not None and not interpret
+            and hasattr(pltpu, "CompilerParams")) else None)
+
+    grid_dq = (bh, t_q_pad // block_q, t_k_pad // block_k)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, kv_steps=grid_dq[2],
+                          **common),
+        grid=grid_dq,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
+        if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=cparams,
+    )(q, k, v, do, lse, delta)
+
+    grid_dkv = (bh, t_k_pad // block_k, t_q_pad // block_q)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, q_steps=grid_dkv[2],
+                          **common),
+        grid=grid_dkv,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, t_k_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t_k_pad, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)]
+        if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=cparams,
+    )(q, k, v, do, lse, delta)
+
+    if t_q_pad != t_q:
+        dq = dq[:, :t_q]
+    if t_k_pad != t_k:
+        dk = dk[:, :t_k]
+        dv = dv[:, :t_k]
+    return dq, dk, dv
 
 
 def _mha_jnp(q, k, v, causal, sm_scale):
@@ -170,16 +420,24 @@ def _native_flash_bhtd(q, k, v, causal, sm_scale):
 
 
 def _native_fwd(q, k, v, causal, sm_scale):
-    return _native_flash_bhtd(q, k, v, causal, sm_scale), (q, k, v)
+    b, h, t, d = q.shape
+    o, lse = _flash_fwd_pallas(
+        q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
+        v.reshape(b * h, -1, d), causal, sm_scale,
+        interpret=_FORCE_INTERPRET, return_lse=True)
+    return o.reshape(b, h, t, d), (q, k, v, o.reshape(b, h, t, d), lse)
 
 
 def _native_bwd(causal, sm_scale, res, do):
-    q, k, v = res
-    # backward via AD of the reference math (XLA-fused); a hand-written
-    # pallas backward is the jax tuned path selected by default
-    _, vjp = jax.vjp(lambda q_, k_, v_: _mha_jnp(q_, k_, v_, causal,
-                                                 sm_scale), q, k, v)
-    return vjp(do)
+    q, k, v, o, lse = res
+    b, h, t, d = q.shape
+    dq, dk, dv = _flash_bwd_pallas(
+        q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
+        v.reshape(b * h, -1, d), o.reshape(b * h, t, d), lse,
+        do.reshape(b * h, t, d), causal, sm_scale,
+        interpret=_FORCE_INTERPRET)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, -1, d),
+            dv.reshape(b, h, -1, d))
 
 
 _native_flash_bhtd.defvjp(_native_fwd, _native_bwd)
@@ -194,10 +452,16 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
     kh = jnp.moveaxis(k, 1, 2)
     vh = jnp.moveaxis(v, 1, 2)
     impl = get_flag("FLAGS_tpu_flash_impl", "jax")
+    if causal and q.shape[1] > k.shape[1]:
+        # t_q > t_k causal has fully-masked rows whose forward degrades to
+        # uniform attention; the hand-written backward zeroes them instead,
+        # so use the dense path where AD matches the primal exactly
+        out = _mha_jnp(qh, kh, vh, True, sm_scale)
+        return jnp.moveaxis(out, 1, 2)
     if causal and q.shape[1] != k.shape[1]:
         # jax's tuned kernel masks top-left (col <= row, no cross-length
         # offset); our semantics are bottom-right like the dense reference,
-        # so cross-length causal must use the native kernel
+        # so cross-length causal (t_k > t_q) must use the native kernel
         impl = "native"
     if impl == "native":
         out = _native_flash_bhtd(qh, kh, vh, causal, sm_scale)

@@ -35,29 +35,38 @@ def test_flash_fwd_matches_dense(tq, tk, causal):
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
 
 
-def test_native_flash_grad_matches_dense():
+@pytest.mark.parametrize("tq,tk,causal", [
+    (64, 64, True),
+    (100, 100, True),    # ragged: padded q/kv tail + mask_tail in bwd
+    (257, 257, False),   # multi-block accumulation, non-causal
+    (257, 257, True),    # multi-block + causal block skipping
+    (64, 192, True),     # cross-length causal (offset, t_k > t_q)
+    (129, 37, False),    # ragged cross-length non-causal
+])
+def test_native_flash_grad_matches_dense(tq, tk, causal):
     import paddle_tpu.ops.pallas.flash_attention as fa
     rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.standard_normal((1, 2, 64, 16), dtype=np.float32))
-    k = jnp.asarray(rng.standard_normal((1, 2, 64, 16), dtype=np.float32))
-    v = jnp.asarray(rng.standard_normal((1, 2, 64, 16), dtype=np.float32))
+    q = jnp.asarray(rng.standard_normal((1, 2, tq, 16), dtype=np.float32))
+    k = jnp.asarray(rng.standard_normal((1, 2, tk, 16), dtype=np.float32))
+    v = jnp.asarray(rng.standard_normal((1, 2, tk, 16), dtype=np.float32))
     sm = 1.0 / np.sqrt(16)
 
     def loss_flash(q, k, v):
-        return jnp.sum(_native_flash_bhtd(q, k, v, True, sm) ** 2)
+        return jnp.sum(_native_flash_bhtd(q, k, v, causal, sm) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(_mha_jnp(q, k, v, True, sm) ** 2)
+        return jnp.sum(_mha_jnp(q, k, v, causal, sm) ** 2)
 
     fa._FORCE_INTERPRET = True
     try:
-        o_f = _native_flash_bhtd(q, k, v, True, sm)
-        o_d = _mha_jnp(q, k, v, True, sm)
+        o_f = _native_flash_bhtd(q, k, v, causal, sm)
+        o_d = _mha_jnp(q, k, v, causal, sm)
         np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d),
                                    atol=2e-5)
         gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     finally:
         fa._FORCE_INTERPRET = False
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(gf, gd):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   err_msg=f"d{name} ({tq},{tk},{causal})")
