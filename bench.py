@@ -76,6 +76,13 @@ def main():
     flops_tok = trainer.flops_per_token(seq)
     mfu = tok_s * flops_tok / _peak_flops(dev)
 
+    try:
+        from paddle_tpu.utils.op_coverage import coverage
+        cov = coverage()
+        op_cov = cov["pct"] if cov["total"] else None
+    except Exception:
+        op_cov = None
+
     print(json.dumps({
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu * 100, 2),
@@ -83,6 +90,7 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4),
         "tokens_per_sec_per_chip": round(tok_s, 1),
         "params": trainer.param_count(),
+        "op_coverage_pct": op_cov,
         "device": str(dev),
     }))
 

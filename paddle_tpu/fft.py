@@ -1,0 +1,86 @@
+"""paddle.fft — FFT family over jnp.fft (ref: /root/reference/python/
+paddle/fft.py; the reference's fft_c2c/fft_r2c/fft_c2r kernels in
+paddle/phi/kernels/gpu live behind these same public names).
+
+XLA lowers these to its native FFT HLO; on TPU that runs on the VPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.op import apply
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _make(name, jnp_fn, differentiable=True):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply(
+            lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), (x,),
+            differentiable=differentiable, op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _make_nd(name, jnp_fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply(
+            lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), (x,),
+            op_name=name)
+    op.__name__ = name
+    return op
+
+
+fft = _make("fft", jnp.fft.fft)
+ifft = _make("ifft", jnp.fft.ifft)
+rfft = _make("rfft", jnp.fft.rfft)
+irfft = _make("irfft", jnp.fft.irfft)
+hfft = _make("hfft", jnp.fft.hfft)
+ihfft = _make("ihfft", jnp.fft.ihfft)
+
+fftn = _make_nd("fftn", jnp.fft.fftn)
+ifftn = _make_nd("ifftn", jnp.fft.ifftn)
+rfftn = _make_nd("rfftn", jnp.fft.rfftn)
+irfftn = _make_nd("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), (x,),
+                 op_name="fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm),
+                 (x,), op_name="ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm),
+                 (x,), op_name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm),
+                 (x,), op_name="irfft2")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return apply(lambda: jnp.fft.fftfreq(n, d).astype(dtype or "float32"),
+                 (), differentiable=False, op_name="fftfreq")
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return apply(lambda: jnp.fft.rfftfreq(n, d).astype(dtype or "float32"),
+                 (), differentiable=False, op_name="rfftfreq")
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), (x,),
+                 op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), (x,),
+                 op_name="ifftshift")

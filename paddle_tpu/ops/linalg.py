@@ -15,6 +15,7 @@ __all__ = [
     "matrix_rank", "solve", "triangular_solve", "lstsq", "lu", "lu_unpack",
     "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
     "matrix_transpose", "householder_product", "pca_lowrank", "cdist",
+    "trace",
 ]
 
 
@@ -287,3 +288,10 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
             return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0))
         return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
     return op("cdist", impl, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """ref: python/paddle/tensor/math.py trace -> phi trace kernel."""
+    def impl(a):
+        return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+    return op("trace", impl, x)

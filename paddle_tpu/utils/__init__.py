@@ -1,0 +1,2 @@
+from . import op_coverage  # noqa: F401
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,154 @@
+"""Custom-op extension story — the TPU-native analog of the reference's
+custom C++ operator path (ref: /root/reference/paddle/fluid/framework/
+custom_operator.cc — runtime registration of user ops;
+/root/reference/python/paddle/utils/cpp_extension/cpp_extension.py —
+setuptools JIT build; tests at /root/reference/test/custom_op/).
+
+On TPU the compute path for a custom op is a user Pallas kernel (or any
+pure-jax function) registered with an optional custom VJP:
+
+    from paddle_tpu.utils.cpp_extension import register_custom_op
+
+    def my_relu_impl(x):            # jnp in / jnp out; may call pallas
+        return jnp.maximum(x, 0)
+
+    def my_relu_fwd(x):
+        return my_relu_impl(x), (x,)
+
+    def my_relu_bwd(res, dy):
+        (x,) = res
+        return (jnp.where(x > 0, dy, 0.0),)
+
+    my_relu = register_custom_op("my_relu", my_relu_impl,
+                                 fwd=my_relu_fwd, bwd=my_relu_bwd)
+    y = my_relu(paddle.to_tensor(...))   # differentiable paddle op
+
+Host-side native code (the reference's C++ op body) is supported through
+`load()`, which compiles C/C++ sources into a shared library with g++ and
+binds exported functions via ctypes — used for CPU pre/post-processing,
+not the TPU compute path.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from ..framework.op import apply
+
+__all__ = ["register_custom_op", "get_custom_op", "custom_ops", "load",
+           "CppExtension", "CUDAExtension", "setup"]
+
+custom_ops = {}
+
+
+def register_custom_op(name: str, impl: Callable, fwd: Callable = None,
+                       bwd: Callable = None, differentiable: bool = True):
+    """Register `impl` (pure jax/pallas function) as a paddle-style op.
+
+    If fwd/bwd are given they define a jax.custom_vjp (fwd returns
+    (out, residuals); bwd(residuals, grad_out) returns input cotangents).
+    The returned callable takes/returns paddle Tensors and records on the
+    autograd tape like any built-in op.
+    """
+    if (fwd is None) != (bwd is None):
+        raise ValueError("fwd and bwd must be given together")
+    if fwd is not None:
+        vjp_impl = jax.custom_vjp(impl)
+        vjp_impl.defvjp(fwd, bwd)
+        jax_fn = vjp_impl
+    else:
+        jax_fn = impl
+
+    def op(*tensor_args, **kwargs):
+        return apply(jax_fn, tensor_args, kwargs,
+                     differentiable=differentiable, op_name=name)
+
+    op.__name__ = name
+    custom_ops[name] = op
+    return op
+
+
+def get_custom_op(name: str):
+    return custom_ops[name]
+
+
+# -- host-side native extension (ctypes over g++) ---------------------------
+
+class _Extension:
+    def __init__(self, sources: Sequence[str], extra_compile_args=None,
+                 extra_link_args=None, include_dirs=None, **kw):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+class CppExtension(_Extension):
+    pass
+
+
+class CUDAExtension(_Extension):
+    """Accepted for API compatibility; CUDA sources are rejected at build
+    time on TPU hosts."""
+
+
+class _LoadedModule:
+    """ctypes CDLL wrapper; attribute access returns the exported symbol."""
+
+    def __init__(self, lib, path):
+        self._lib = lib
+        self._path = path
+
+    def __getattr__(self, item):
+        return getattr(self._lib, item)
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose: bool = False, **kw) -> _LoadedModule:
+    """JIT-compile C/C++ `sources` into a shared library and return a ctypes
+    binding (the reference's `paddle.utils.cpp_extension.load` analog for
+    host-side code; TPU compute belongs in Pallas via register_custom_op)."""
+    for s in sources:
+        if s.endswith((".cu", ".cuh")):
+            raise RuntimeError(
+                f"CUDA source {s!r} is not supported on TPU hosts; write "
+                "the device kernel in Pallas and register it with "
+                "register_custom_op")
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.sha1(
+        ("".join(sorted(sources)) + str(extra_cxx_cflags)).encode()
+    ).hexdigest()[:12]
+    lib_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(lib_path):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-o", lib_path]
+               + list(sources)
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + list(extra_cxx_cflags or []) + list(extra_ldflags or []))
+        if verbose:
+            print("building:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=not verbose, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"building extension {name!r} failed "
+                f"(exit {proc.returncode}):\n{proc.stderr or ''}")
+    return _LoadedModule(ctypes.CDLL(lib_path), lib_path)
+
+
+def setup(name=None, ext_modules=None, **kw):
+    """setuptools-style entry: eagerly builds each extension via load()."""
+    mods = []
+    for ext in ext_modules or []:
+        mods.append(load(name or "custom_ext", ext.sources,
+                         extra_cxx_cflags=ext.extra_compile_args,
+                         extra_ldflags=ext.extra_link_args,
+                         extra_include_paths=ext.include_dirs))
+    return mods

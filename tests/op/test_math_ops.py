@@ -1,0 +1,187 @@
+"""Golden op specs: math / manipulation / reduction / linalg.
+
+Each spec drives forward-vs-numpy (dygraph + to_static + bf16) and
+tape-grad-vs-numeric-diff through the OpTest harness (see op_test.py;
+reference model: eager_op_test.py:375).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(42)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _pos(*shape):
+    return (np.abs(rng.standard_normal(shape)) + 0.5).astype("float32")
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+SPECS = [
+    OpSpec("add", paddle.add, lambda a, b: a + b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, grad_inputs=("x", "y")),
+    OpSpec("subtract", paddle.subtract, lambda a, b: a - b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, grad_inputs=("x", "y")),
+    OpSpec("multiply", paddle.multiply, lambda a, b: a * b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, grad_inputs=("x", "y")),
+    OpSpec("divide", paddle.divide, lambda a, b: a / b,
+           {"x": _f(3, 4), "y": _pos(3, 4)}, grad_inputs=("x", "y")),
+    OpSpec("pow", paddle.pow, lambda x, y: x ** y,
+           {"x": _pos(3, 4)}, kwargs={"y": 2.5}, grad_inputs=("x",)),
+    OpSpec("exp", paddle.exp, np.exp, {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("log", paddle.log, np.log, {"x": _pos(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("log1p", paddle.log1p, np.log1p, {"x": _pos(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("expm1", paddle.expm1, np.expm1, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("sqrt", paddle.sqrt, np.sqrt, {"x": _pos(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("rsqrt", paddle.rsqrt, lambda x: 1.0 / np.sqrt(x),
+           {"x": _pos(3, 4)}, grad_inputs=("x",)),
+    OpSpec("square", paddle.square, np.square, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("reciprocal", paddle.reciprocal, lambda x: 1.0 / x,
+           {"x": _pos(3, 4)}, grad_inputs=("x",)),
+    OpSpec("abs", paddle.abs, np.abs, {"x": _f(3, 4) + 0.1}),
+    OpSpec("sign", paddle.sign, np.sign, {"x": _f(3, 4)},
+           check_bf16=False),
+    OpSpec("floor", paddle.floor, np.floor, {"x": _f(3, 4) * 3},
+           check_bf16=False),
+    OpSpec("ceil", paddle.ceil, np.ceil, {"x": _f(3, 4) * 3},
+           check_bf16=False),
+    OpSpec("round", paddle.round, np.round, {"x": _f(3, 4) * 3},
+           check_bf16=False),
+    OpSpec("sin", paddle.sin, np.sin, {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("cos", paddle.cos, np.cos, {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("tan", paddle.tan, np.tan, {"x": _f(3, 4) * 0.5},
+           grad_inputs=("x",)),
+    OpSpec("tanh", paddle.tanh, np.tanh, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("erf", paddle.erf,
+           lambda x: np.vectorize(__import__("math").erf)(x).astype("f4"),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("maximum", paddle.maximum, np.maximum,
+           {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("minimum", paddle.minimum, np.minimum,
+           {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("clip", paddle.clip, lambda x, min, max: np.clip(x, min, max),
+           {"x": _f(3, 4)}, kwargs={"min": -0.5, "max": 0.5}),
+    OpSpec("floor_divide", paddle.floor_divide,
+           lambda a, b: np.floor_divide(a, b),
+           {"x": rng.integers(1, 20, (3, 4)).astype("int32"),
+            "y": rng.integers(1, 5, (3, 4)).astype("int32")},
+           check_bf16=False),
+    OpSpec("mod", paddle.mod, np.mod,
+           {"x": rng.integers(0, 20, (3, 4)).astype("int32"),
+            "y": rng.integers(1, 5, (3, 4)).astype("int32")},
+           check_bf16=False),
+    OpSpec("logsumexp", paddle.logsumexp,
+           lambda x: np.log(np.sum(np.exp(x))),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    # -- linalg --
+    OpSpec("matmul", paddle.matmul, lambda a, b: a @ b,
+           {"x": _f(3, 4), "y": _f(4, 5)}, grad_inputs=("x", "y")),
+    OpSpec("bmm", paddle.bmm, lambda a, b: a @ b,
+           {"x": _f(2, 3, 4), "y": _f(2, 4, 5)}, grad_inputs=("x", "y")),
+    OpSpec("dot", paddle.dot, lambda a, b: np.sum(a * b, -1),
+           {"x": _f(6), "y": _f(6)}, grad_inputs=("x", "y")),
+    OpSpec("outer", paddle.outer, np.outer, {"x": _f(3), "y": _f(4)}),
+    OpSpec("norm_l2", lambda x: paddle.norm(x, p=2),
+           lambda x: np.sqrt(np.sum(x * x)), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("t", paddle.t, np.transpose, {"x": _f(3, 4)}),
+    # -- manipulation --
+    OpSpec("transpose", paddle.transpose,
+           lambda x, perm: np.transpose(x, perm),
+           {"x": _f(2, 3, 4)}, kwargs={"perm": [2, 0, 1]},
+           grad_inputs=("x",)),
+    OpSpec("reshape", paddle.reshape, lambda x, shape: x.reshape(shape),
+           {"x": _f(3, 4)}, kwargs={"shape": [2, 6]}, grad_inputs=("x",)),
+    OpSpec("flatten", paddle.flatten, lambda x: x.reshape(-1),
+           {"x": _f(2, 3, 4)}),
+    OpSpec("squeeze", lambda x: paddle.squeeze(x, axis=1),
+           lambda x: np.squeeze(x, 1), {"x": _f(3, 1, 4)}),
+    OpSpec("unsqueeze", lambda x: paddle.unsqueeze(x, axis=1),
+           lambda x: np.expand_dims(x, 1), {"x": _f(3, 4)}),
+    OpSpec("concat", lambda a, b: paddle.concat([a, b], axis=1),
+           lambda a, b: np.concatenate([a, b], 1),
+           {"x": _f(3, 4), "y": _f(3, 2)}, grad_inputs=("x", "y")),
+    OpSpec("stack", lambda a, b: paddle.stack([a, b], axis=0),
+           lambda a, b: np.stack([a, b], 0),
+           {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("split", lambda x: paddle.split(x, 2, axis=1),
+           lambda x: np.split(x, 2, 1), {"x": _f(3, 4)}),
+    OpSpec("tile", lambda x: paddle.tile(x, [2, 3]),
+           lambda x: np.tile(x, (2, 3)), {"x": _f(2, 2)}),
+    OpSpec("expand", lambda x: paddle.expand(x, [3, 2, 4]),
+           lambda x: np.broadcast_to(x, (3, 2, 4)), {"x": _f(2, 4)}),
+    OpSpec("tril", paddle.tril, np.tril, {"x": _f(4, 4)}),
+    OpSpec("triu", paddle.triu, np.triu, {"x": _f(4, 4)}),
+    OpSpec("roll", lambda x: paddle.roll(x, 2, axis=0),
+           lambda x: np.roll(x, 2, 0), {"x": _f(4, 3)}),
+    OpSpec("flip", lambda x: paddle.flip(x, axis=[0]),
+           lambda x: np.flip(x, 0), {"x": _f(4, 3)}),
+    OpSpec("gather", lambda x, idx: paddle.gather(x, idx, axis=0),
+           lambda x, idx: x[idx],
+           {"x": _f(5, 3), "idx": np.array([0, 2, 4])}),
+    OpSpec("index_select",
+           lambda x, idx: paddle.index_select(x, idx, axis=0),
+           lambda x, idx: x[idx],
+           {"x": _f(5, 3), "idx": np.array([1, 3])}),
+    OpSpec("where", paddle.where,
+           lambda c, a, b: np.where(c, a, b),
+           {"cond": _f(3, 4) > 0, "x": _f(3, 4), "y": _f(3, 4)},
+           check_bf16=False),
+    # -- reductions --
+    OpSpec("mean", paddle.mean, np.mean, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("sum", paddle.sum, np.sum, {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("max", paddle.max, np.max, {"x": _f(3, 4)}),
+    OpSpec("min", paddle.min, np.min, {"x": _f(3, 4)}),
+    OpSpec("prod", paddle.prod, np.prod, {"x": _pos(2, 3)},
+           grad_inputs=("x",), bf16_rtol=5e-2),
+    OpSpec("argmax", paddle.argmax, np.argmax, {"x": _f(3, 4)},
+           check_bf16=False),
+    OpSpec("argmin", paddle.argmin, np.argmin, {"x": _f(3, 4)},
+           check_bf16=False),
+    OpSpec("cumsum", lambda x: paddle.cumsum(x, axis=1),
+           lambda x: np.cumsum(x, 1), {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("topk", lambda x: paddle.topk(x, k=2, axis=-1),
+           lambda x: (np.sort(x, -1)[:, ::-1][:, :2],
+                      np.argsort(-x, -1, kind="stable")[:, :2]),
+           {"x": _f(3, 6)}, check_bf16=False),
+    OpSpec("sort", lambda x: paddle.sort(x, axis=-1),
+           lambda x: np.sort(x, -1), {"x": _f(3, 6)}, check_bf16=False),
+    # -- comparison / logical --
+    OpSpec("equal", paddle.equal, lambda a, b: a == b,
+           {"x": np.array([1, 2, 3]), "y": np.array([1, 0, 3])},
+           check_bf16=False),
+    OpSpec("greater_than", paddle.greater_than, lambda a, b: a > b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("less_than", paddle.less_than, lambda a, b: a < b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("logical_and", paddle.logical_and, np.logical_and,
+           {"x": _f(3, 4) > 0, "y": _f(3, 4) > 0}, check_bf16=False),
+    OpSpec("isnan", paddle.isnan, np.isnan,
+           {"x": np.array([1.0, np.nan, 2.0], "float32")},
+           check_bf16=False),
+    OpSpec("isinf", paddle.isinf, np.isinf,
+           {"x": np.array([1.0, np.inf, 2.0], "float32")},
+           check_bf16=False),
+]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

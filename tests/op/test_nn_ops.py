@@ -1,0 +1,120 @@
+"""Golden op specs: nn functional (activations, norms, losses)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(7)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _gelu_np(x):
+    from math import erf
+    return (x * 0.5 * (1 + np.vectorize(erf)(x / np.sqrt(2)))).astype("f4")
+
+
+def _layer_norm_np(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return ((x - mu) / np.sqrt(var + eps)) * w + b
+
+
+def _ce_np(logits, label):
+    lp = np.log(_softmax_np(logits))
+    return -np.take_along_axis(lp, label[:, None], 1).mean()
+
+
+SPECS = [
+    OpSpec("relu", F.relu, lambda x: np.maximum(x, 0), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("relu6", F.relu6, lambda x: np.clip(x, 0, 6),
+           {"x": _f(3, 4) * 4}),
+    OpSpec("gelu", F.gelu, _gelu_np, {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("silu", F.silu, lambda x: x / (1 + np.exp(-x)),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("softplus", F.softplus, lambda x: np.log1p(np.exp(x)),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("elu", F.elu,
+           lambda x: np.where(x > 0, x, np.exp(x) - 1).astype("f4"),
+           {"x": _f(3, 4)}),
+    OpSpec("leaky_relu", F.leaky_relu,
+           lambda x: np.where(x > 0, x, 0.01 * x).astype("f4"),
+           {"x": _f(3, 4)}),
+    OpSpec("mish", F.mish,
+           lambda x: (x * np.tanh(np.log1p(np.exp(x)))).astype("f4"),
+           {"x": _f(3, 4)}),
+    OpSpec("hardshrink", F.hardshrink,
+           lambda x: np.where(np.abs(x) > 0.5, x, 0).astype("f4"),
+           {"x": _f(3, 4)}),
+    OpSpec("softmax", F.softmax, _softmax_np, {"x": _f(3, 6)},
+           grad_inputs=("x",)),
+    OpSpec("log_softmax", F.log_softmax,
+           lambda x: np.log(_softmax_np(x)), {"x": _f(3, 6)},
+           grad_inputs=("x",)),
+    OpSpec("one_hot", lambda x: F.one_hot(x, num_classes=5),
+           lambda x: np.eye(5, dtype="f4")[x],
+           {"x": np.array([0, 2, 4])}, check_bf16=False),
+    OpSpec("linear", F.linear, lambda x, w, b: x @ w + b,
+           {"x": _f(3, 4), "w": _f(4, 5), "b": _f(5)},
+           grad_inputs=("x", "w", "b")),
+    OpSpec("embedding",
+           lambda ids, w: F.embedding(ids, w),
+           lambda ids, w: w[ids],
+           {"ids": np.array([[0, 2], [1, 3]]), "w": _f(5, 4)},
+           check_bf16=False),
+    OpSpec("layer_norm",
+           lambda x, w, b: F.layer_norm(x, normalized_shape=[4], weight=w,
+                                        bias=b),
+           _layer_norm_np,
+           {"x": _f(3, 4), "w": _f(4), "b": _f(4)},
+           grad_inputs=("x", "w", "b"), grad_atol=1e-2, grad_rtol=1e-2),
+    OpSpec("mse_loss", F.mse_loss,
+           lambda a, b: np.mean((a - b) ** 2),
+           {"input": _f(3, 4), "label": _f(3, 4)},
+           grad_inputs=("input",)),
+    OpSpec("l1_loss", F.l1_loss, lambda a, b: np.mean(np.abs(a - b)),
+           {"input": _f(3, 4), "label": _f(3, 4)}),
+    OpSpec("cross_entropy", F.cross_entropy, _ce_np,
+           {"input": _f(6, 5), "label": rng.integers(0, 5, (6,))},
+           grad_inputs=("input",), check_bf16=False),
+    OpSpec("binary_cross_entropy", F.binary_cross_entropy,
+           lambda p, y: -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)),
+           {"input": (rng.random((3, 4)) * 0.8 + 0.1).astype("f4"),
+            "label": rng.integers(0, 2, (3, 4)).astype("f4")},
+           grad_inputs=("input",)),
+    OpSpec("kl_div", F.kl_div,
+           lambda lp, t: np.mean(t * (np.log(t) - lp)),
+           {"input": np.log(_softmax_np(_f(3, 4))),
+            "label": _softmax_np(_f(3, 4))}),
+    OpSpec("cosine_similarity", F.cosine_similarity,
+           lambda a, b: (np.sum(a * b, -1)
+                         / (np.linalg.norm(a, axis=-1)
+                            * np.linalg.norm(b, axis=-1))).astype("f4"),
+           {"x1": _f(3, 8), "x2": _f(3, 8)}),
+    OpSpec("normalize", F.normalize,
+           lambda x, axis: x / np.linalg.norm(x, axis=axis, keepdims=True),
+           {"x": _f(3, 8)}, kwargs={"axis": -1}),
+    OpSpec("pad", lambda x: F.pad(x, [1, 2], value=0.0),
+           lambda x: np.pad(x, ((0, 0), (1, 2))),
+           {"x": _f(3, 4)}),
+]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

@@ -1,0 +1,68 @@
+"""Custom-op extension story (ref: /root/reference/paddle/fluid/framework/
+custom_operator.cc registration; test/custom_op/ test layout)."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import (
+    CppExtension, load, register_custom_op)
+
+
+def test_register_custom_op_forward_and_grad():
+    def impl(x):
+        return jnp.maximum(x, 0) * 2.0
+
+    def fwd(x):
+        return impl(x), (x,)
+
+    def bwd(res, dy):
+        (x,) = res
+        return (jnp.where(x > 0, 2.0 * dy, 0.0),)
+
+    my_op = register_custom_op("my_double_relu", impl, fwd=fwd, bwd=bwd)
+    x = paddle.to_tensor(np.array([-1.0, 2.0, 3.0], "float32"))
+    x.stop_gradient = False
+    y = my_op(x)
+    np.testing.assert_allclose(y.numpy(), [0.0, 4.0, 6.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+    # registered and retrievable
+    from paddle_tpu.utils.cpp_extension import get_custom_op
+    assert get_custom_op("my_double_relu") is my_op
+
+
+def test_register_custom_pallas_op():
+    """A user Pallas kernel as a custom op (interpret mode on CPU)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 3.0
+
+    def impl(x):
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    op = register_custom_op("triple", impl, differentiable=False)
+    x = paddle.to_tensor(np.ones((8, 128), "float32"))
+    np.testing.assert_allclose(op(x).numpy(), 3.0 * np.ones((8, 128)))
+
+
+def test_load_host_cpp_extension(tmp_path):
+    src = tmp_path / "ext.cc"
+    src.write_text("""
+extern "C" long long add_ll(long long a, long long b) { return a + b; }
+""")
+    mod = load("test_ext", [str(src)], build_directory=str(tmp_path))
+    import ctypes
+    mod.add_ll.restype = ctypes.c_longlong
+    assert mod.add_ll(20, 22) == 42
+
+
+def test_load_rejects_cuda_sources(tmp_path):
+    with pytest.raises(RuntimeError, match="Pallas"):
+        load("bad", ["kernel.cu"], build_directory=str(tmp_path))
