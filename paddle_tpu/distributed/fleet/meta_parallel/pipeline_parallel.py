@@ -15,8 +15,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 from ....framework.tensor import Tensor
 from .meta_parallel_base import MetaParallelBase
 from .pp_layers import PipelineLayer
@@ -60,6 +58,9 @@ class PipelineParallel(MetaParallelBase):
         pipeline_parallel.py:174)."""
         micros = self._split_micro(data)
         total = None
+        # Accumulate the loss on-device; a float()/numpy() inside this loop
+        # would host-sync per micro-batch and serialize device work
+        # (flagged in round-1 review).
         for inputs in micros:
             x, label = inputs if len(inputs) == 2 else (inputs[0], None)
             out = self._layers.forward(x)
@@ -68,10 +69,9 @@ class PipelineParallel(MetaParallelBase):
             if scaler is not None:
                 scaled = scaler.scale(scaled)
             scaled.backward()
-            total = float(loss.numpy()) if total is None else \
-                total + float(loss.numpy())
-        avg = total / len(micros)
-        self.total_loss = Tensor(np.asarray(avg, np.float32))
+            ldata = loss.detach().data
+            total = ldata if total is None else total + ldata
+        self.total_loss = Tensor(total / len(micros))
         return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
@@ -90,15 +90,16 @@ class PipelineParallel(MetaParallelBase):
     def eval_batch(self, data, compute_loss=True):
         self._layers.eval()
         micros = self._split_micro(data)
-        total = 0.0
+        total = None
         from ....framework.autograd import no_grad
         with no_grad():
             for inputs in micros:
                 x, label = inputs if len(inputs) == 2 else (inputs[0], None)
                 out = self._layers.forward(x)
                 loss = self._layers.loss(out, label) if compute_loss else out
-                total += float(loss.numpy())
-        return Tensor(np.asarray(total / len(micros), np.float32))
+                ldata = loss.detach().data
+                total = ldata if total is None else total + ldata
+        return Tensor(total / len(micros))
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

@@ -16,7 +16,7 @@ __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
 
 
 def _make(name, jnp_fn, differentiable=True):
-    def op(x, n=None, axis=-1, norm="backward", name_=None):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
         return apply(
             lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), (x,),
             differentiable=differentiable, op_name=name)
@@ -24,8 +24,8 @@ def _make(name, jnp_fn, differentiable=True):
     return op
 
 
-def _make_nd(name, jnp_fn):
-    def op(x, s=None, axes=None, norm="backward", name_=None):
+def _make_nd(name, jnp_fn, default_axes=None):
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
         return apply(
             lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), (x,),
             op_name=name)
@@ -45,25 +45,10 @@ ifftn = _make_nd("ifftn", jnp.fft.ifftn)
 rfftn = _make_nd("rfftn", jnp.fft.rfftn)
 irfftn = _make_nd("irfftn", jnp.fft.irfftn)
 
-
-def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), (x,),
-                 op_name="fft2")
-
-
-def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm),
-                 (x,), op_name="ifft2")
-
-
-def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm),
-                 (x,), op_name="rfft2")
-
-
-def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm),
-                 (x,), op_name="irfft2")
+fft2 = _make_nd("fft2", jnp.fft.fft2, default_axes=(-2, -1))
+ifft2 = _make_nd("ifft2", jnp.fft.ifft2, default_axes=(-2, -1))
+rfft2 = _make_nd("rfft2", jnp.fft.rfft2, default_axes=(-2, -1))
+irfft2 = _make_nd("irfft2", jnp.fft.irfft2, default_axes=(-2, -1))
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -33,6 +33,30 @@ def wrap(x, stop_gradient=True):
     return Tensor(x, stop_gradient=stop_gradient)
 
 
+def _check_nan_inf(op_name, outs):
+    """Per-op numerical sanitizer behind FLAGS_check_nan_inf (the TPU analog
+    of the reference's post-kernel scan, ref: /root/reference/paddle/fluid/
+    framework/operator.cc:2010 + framework/details/nan_inf_utils_detail.cu).
+
+    Device-side reduction (jnp.isfinite(...).all()) then one host sync to
+    raise — debug mode only, so the sync is the point."""
+    for i, o in enumerate(outs):
+        if not hasattr(o, "dtype") or not jnp.issubdtype(o.dtype, jnp.floating):
+            continue
+        if isinstance(o, jax.core.Tracer):
+            # inside a jit trace the value is symbolic — a host-side bool()
+            # would crash the trace. Compiled paths are checked at their
+            # concrete boundaries (outputs of the jitted call re-enter apply).
+            continue
+        if not bool(jnp.isfinite(o).all()):
+            n_nan = int(jnp.isnan(o).sum())
+            n_inf = int(jnp.isinf(o).sum())
+            raise RuntimeError(
+                f"Operator {op_name or 'op'} output {i} contains NaN/Inf "
+                f"(nan={n_nan}, inf={n_inf}, shape={tuple(o.shape)}, "
+                f"dtype={o.dtype}). Triggered by FLAGS_check_nan_inf.")
+
+
 def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
           differentiable=True, op_name=None):
     """Run `impl(*arrays, **kwargs)` with autograd recording.
@@ -75,6 +99,9 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+    from ..flags import get_flag
+    if get_flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(op_name or getattr(impl, "__name__", None), outs)
     out_tensors = [wrap(o, stop_gradient=not needs_grad) for o in outs]
     if needs_grad:
         autograd.record(vjp_fn, input_tensors, out_tensors, multi=multi)

@@ -75,12 +75,26 @@ def shard_tensor_data(data, spec: PartitionSpec):
     return jax.device_put(data, NamedSharding(get_mesh(), spec))
 
 
+_constraint_warned: set = set()
+
+
 def constraint(x, *spec):
-    """with_sharding_constraint that is a no-op outside jit."""
+    """with_sharding_constraint that is a no-op outside jit.
+
+    A dropped constraint is loud (warned once per spec): silently discarding
+    sharding constraints can turn an SPMD program into a replicated one."""
     try:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(get_mesh(), PartitionSpec(*spec)))
-    except Exception:
+    except Exception as e:  # outside jit, or axis not in the current mesh
+        key = spec
+        if key not in _constraint_warned:
+            _constraint_warned.add(key)
+            import warnings
+            warnings.warn(
+                f"sharding constraint {spec} dropped ({type(e).__name__}: {e})"
+                " — expected outside jit; inside jit this means the program "
+                "is NOT sharded as annotated", stacklevel=2)
         return x
 
 

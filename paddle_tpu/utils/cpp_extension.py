@@ -124,9 +124,17 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
     build_dir = build_directory or os.path.join(
         tempfile.gettempdir(), "paddle_tpu_extensions")
     os.makedirs(build_dir, exist_ok=True)
-    tag = hashlib.sha1(
-        ("".join(sorted(sources)) + str(extra_cxx_cflags)).encode()
-    ).hexdigest()[:12]
+    # Key the cache on source *contents* + all flags, so edits rebuild
+    # instead of silently reusing a stale .so.
+    h = hashlib.sha1()
+    for s in sorted(sources):
+        h.update(s.encode() + b"\0")
+        with open(s, "rb") as f:
+            h.update(f.read())
+        h.update(b"\0")
+    h.update(repr((extra_cxx_cflags, extra_ldflags,
+                   extra_include_paths)).encode())
+    tag = h.hexdigest()[:12]
     lib_path = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(lib_path):
         cmd = (["g++", "-O2", "-shared", "-fPIC", "-o", lib_path]

@@ -1,0 +1,48 @@
+"""Numerical sanitizer + sharding-constraint diagnostics.
+
+Ref: FLAGS_check_nan_inf post-kernel scan at
+/root/reference/paddle/fluid/framework/operator.cc:2010 and
+framework/details/nan_inf_utils_detail.cu.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_nan_detected(nan_inf_flag):
+    x = paddle.to_tensor(np.array([1.0, np.nan, 2.0], np.float32))
+    y = paddle.to_tensor(np.ones(3, np.float32))
+    with pytest.raises(RuntimeError, match="NaN/Inf"):
+        paddle.add(x, y)
+
+
+def test_inf_detected_from_op(nan_inf_flag):
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    with pytest.raises(RuntimeError, match="NaN/Inf"):
+        paddle.divide(paddle.to_tensor(np.ones(2, np.float32)), x)
+
+
+def test_clean_op_passes(nan_inf_flag):
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    out = paddle.add(x, x)
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones(4, np.float32))
+
+
+def test_int_outputs_ignored(nan_inf_flag):
+    x = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+    out = paddle.add(x, x)
+    assert out.numpy().tolist() == [2, 4, 6]
+
+
+def test_flag_off_no_raise():
+    x = paddle.to_tensor(np.array([np.nan], np.float32))
+    out = paddle.add(x, x)  # no error when the flag is off
+    assert np.isnan(out.numpy()).all()
