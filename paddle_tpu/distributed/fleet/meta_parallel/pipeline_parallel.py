@@ -69,7 +69,7 @@ class PipelineParallel(MetaParallelBase):
             if scaler is not None:
                 scaled = scaler.scale(scaled)
             scaled.backward()
-            ldata = loss.detach().data
+            ldata = loss.detach().data.astype("float32")
             total = ldata if total is None else total + ldata
         self.total_loss = Tensor(total / len(micros))
         return self.total_loss
@@ -97,7 +97,7 @@ class PipelineParallel(MetaParallelBase):
                 x, label = inputs if len(inputs) == 2 else (inputs[0], None)
                 out = self._layers.forward(x)
                 loss = self._layers.loss(out, label) if compute_loss else out
-                ldata = loss.detach().data
+                ldata = loss.detach().data.astype("float32")
                 total = ldata if total is None else total + ldata
         return Tensor(total / len(micros))
 

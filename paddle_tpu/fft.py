@@ -15,21 +15,21 @@ __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
-def _make(name, jnp_fn, differentiable=True):
+def _make(op_name, jnp_fn, differentiable=True):
     def op(x, n=None, axis=-1, norm="backward", name=None):
         return apply(
             lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), (x,),
-            differentiable=differentiable, op_name=name)
-    op.__name__ = name
+            differentiable=differentiable, op_name=op_name)
+    op.__name__ = op_name
     return op
 
 
-def _make_nd(name, jnp_fn, default_axes=None):
+def _make_nd(op_name, jnp_fn, default_axes=None):
     def op(x, s=None, axes=default_axes, norm="backward", name=None):
         return apply(
             lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), (x,),
-            op_name=name)
-    op.__name__ = name
+            op_name=op_name)
+    op.__name__ = op_name
     return op
 
 

@@ -41,8 +41,8 @@ def _check_nan_inf(op_name, outs):
     Device-side reduction (jnp.isfinite(...).all()) then one host sync to
     raise — debug mode only, so the sync is the point."""
     for i, o in enumerate(outs):
-        if not hasattr(o, "dtype") or not jnp.issubdtype(o.dtype, jnp.floating):
-            continue
+        if not hasattr(o, "dtype") or not jnp.issubdtype(o.dtype, jnp.inexact):
+            continue  # inexact = floating + complex (fft outputs)
         if isinstance(o, jax.core.Tracer):
             # inside a jit trace the value is symbolic — a host-side bool()
             # would crash the trace. Compiled paths are checked at their

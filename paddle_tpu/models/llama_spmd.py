@@ -11,8 +11,9 @@ global mesh:
 - pp:        decoder stack split into stages, stacked on a 'pp'-sharded
              leading dim, scheduled by the shard_map ppermute pipeline
              (parallel/pipeline.py); backward = AD through the schedule
-- sep (SP):  activations sharded over sequence between blocks; k/v gathered
-             only inside attention (ring attention kernel: ops/pallas)
+- sep (SP):  activations and K/V stay sequence-sharded end to end; attention
+             is blockwise ring attention with K/V ppermuted around the sep
+             ring (parallel/ring_attention.py) — no full K/V gather
 - ZeRO:      AdamW moments + fp32 master weights sharded over 'sharding'
 - bf16 compute, fp32 master accumulate; per-block jax.checkpoint (remat)
 
@@ -170,19 +171,32 @@ class LlamaSpmdTrainer:
         d = self.head_dim
         inv = 1.0 / (self.config.rope_theta **
                      (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-        t = jnp.arange(offset, offset + T, dtype=jnp.float32)
+        # offset may be traced (axis_index under sequence parallelism)
+        t = jnp.arange(T, dtype=jnp.float32) + offset
         freqs = jnp.outer(t, inv)
         emb = jnp.concatenate([freqs, freqs], axis=-1)
         return jnp.cos(emb), jnp.sin(emb)
 
     def _block(self, bp, x):
-        """One decoder block. x: [B, T, H] (dp on B, sep on T)."""
+        """One decoder block. x: [B, T, H] (dp on B, sep on T).
+
+        Runs in two sharding regimes: plain GSPMD (pp==1), where T is the
+        global sequence and 'sep' sharding is a constraint; or inside the
+        pipeline's shard_map where 'sep' is a MANUAL axis (jax cannot nest
+        new manual axes), T is the per-shard chunk, and rope/attention use
+        global positions via axis_index('sep')."""
         c = self.config
         nh = c.num_attention_heads
         nkv = c.num_key_value_heads
         hd = self.head_dim
         dt = x.dtype
         B, T, H = x.shape
+        sep_manual = (mesh_mod.mesh_axis_size("sep") > 1
+                      and mesh_mod.inside_spmd_region("sep"))
+
+        # under a manual 'sep' the T dim is structurally local;
+        # mesh_mod.constraint drops manual-axis entries automatically
+        cstr = mesh_mod.constraint
 
         def rms(h, w):
             h32 = h.astype(jnp.float32)
@@ -197,7 +211,8 @@ class LlamaSpmdTrainer:
         q = checkpoint_name((h @ bp["wq"]), "q").reshape(B, T, nh, hd)
         k = checkpoint_name((h @ bp["wk"]), "k").reshape(B, T, nkv, hd)
         v = checkpoint_name((h @ bp["wv"]), "v").reshape(B, T, nkv, hd)
-        cos, sin = self._rope(T)
+        offset = jax.lax.axis_index("sep") * T if sep_manual else 0
+        cos, sin = self._rope(T, offset)
         cos = cos[None, :, None, :].astype(dt)
         sin = sin[None, :, None, :].astype(dt)
 
@@ -208,15 +223,20 @@ class LlamaSpmdTrainer:
         q = q * cos + rot(q) * sin
         k = k * cos + rot(k) * sin
 
-        # sequence parallel: q stays sep-sharded; k/v gathered across 'sep'
-        k = mesh_mod.constraint(k, "dp", None, "mp", None)
-        v = mesh_mod.constraint(v, "dp", None, "mp", None)
-        q = mesh_mod.constraint(q, "dp", "sep", "mp", None)
-
         scale = 1.0 / math.sqrt(hd)
+        sep_n = mesh_mod.mesh_axis_size("sep")
         use_flash = (_on_tpu() and hd % 64 == 0 and T % 128 == 0
-                     and mesh_mod.mesh_axis_size("sep") == 1)
-        if use_flash:
+                     and sep_n == 1)
+        if sep_n > 1:
+            # sequence parallel: q/k/v all stay sep-sharded on T; ring
+            # attention circulates K/V blocks over the sep axis — per-step
+            # score memory O((T/sep)^2), never a full K/V gather
+            from ..parallel.ring_attention import ring_attention
+            q = cstr(q, "dp", "sep", "mp", None)
+            k = cstr(k, "dp", "sep", "mp", None)
+            v = cstr(v, "dp", "sep", "mp", None)
+            attn = ring_attention(q, k, v, causal=True, sm_scale=scale)
+        elif use_flash:
             from ..ops.pallas.flash_attention import flash_attention_blhd
             if nkv != nh:
                 # the tuned kernel wants equal head counts
@@ -251,7 +271,7 @@ class LlamaSpmdTrainer:
         gate = jax.nn.silu(checkpoint_name(h @ bp["wg"], "ffn_gate"))
         up = checkpoint_name(h @ bp["wu"], "ffn_up")
         x = x + (gate * up) @ bp["wd"]
-        return mesh_mod.constraint(x, "dp", "sep", None)
+        return cstr(x, "dp", "sep", None)
 
     def _stage_fn(self, stage_params, x):
         """Run this stage's layers_per_stage blocks (scan + remat)."""
@@ -279,7 +299,17 @@ class LlamaSpmdTrainer:
             assert B % self.n_micro == 0, "batch must divide n_micro"
             mb = B // self.n_micro
             x_micro = x.reshape((self.n_micro, mb) + x.shape[1:])
-            out = spmd_pipeline(self._stage_fn, params["blocks"], x_micro)
+            sep_n = mesh_mod.mesh_axis_size("sep")
+            if sep_n > 1:
+                # 'sep' must be manual inside the pipeline region (no
+                # nested manual axes in jax) — activations stay
+                # sequence-sharded on dim 2 throughout the schedule
+                out = spmd_pipeline(self._stage_fn, params["blocks"],
+                                    x_micro, manual_axes={"sep"},
+                                    x_spec=P(None, None, "sep"))
+            else:
+                out = spmd_pipeline(self._stage_fn, params["blocks"],
+                                    x_micro)
             x = out.reshape((B,) + out.shape[2:])
         else:
             stage = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])

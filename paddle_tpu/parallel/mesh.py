@@ -78,14 +78,50 @@ def shard_tensor_data(data, spec: PartitionSpec):
 _constraint_warned: set = set()
 
 
+def _current_mesh():
+    """The mesh to annotate against: inside a shard_map/use_mesh trace this
+    is the context's AbstractMesh (whose axis_types mark manual axes);
+    otherwise the concrete global mesh."""
+    try:
+        from jax._src import mesh as _jm
+        am = _jm.get_abstract_mesh()
+        if am is not None and am.axis_names:
+            return am
+    except Exception:
+        pass
+    return get_mesh()
+
+
+def _manual_axes(m):
+    try:
+        from jax.sharding import AxisType
+        return {n for n, t in zip(m.axis_names, m.axis_types)
+                if t == AxisType.Manual}
+    except Exception:
+        return set()
+
+
 def constraint(x, *spec):
     """with_sharding_constraint that is a no-op outside jit.
 
-    A dropped constraint is loud (warned once per spec): silently discarding
-    sharding constraints can turn an SPMD program into a replicated one."""
+    Inside a partial-manual shard_map region (e.g. the pp/sep pipeline),
+    entries naming a manual axis are dropped — those dims are structurally
+    local there — and the sharding is built on the context's AbstractMesh so
+    axis types agree. A fully dropped constraint is loud (warned once per
+    spec): silently discarding sharding constraints can turn an SPMD
+    program into a replicated one."""
+    m = _current_mesh()
+    manual = _manual_axes(m)
+    if manual:
+        def filt(s):
+            if isinstance(s, (tuple, list)):
+                kept = tuple(a for a in s if a not in manual)
+                return kept if kept else None
+            return None if s in manual else s
+        spec = tuple(filt(s) for s in spec)
     try:
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(get_mesh(), PartitionSpec(*spec)))
+            x, NamedSharding(m, PartitionSpec(*spec)))
     except Exception as e:  # outside jit, or axis not in the current mesh
         key = spec
         if key not in _constraint_warned:

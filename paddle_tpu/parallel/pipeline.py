@@ -24,13 +24,20 @@ from . import mesh as mesh_mod
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
-                  axis: str = "pp", extra_spec=None):
+                  axis: str = "pp", manual_axes=(), x_spec=None):
     """Run `stage_fn(params_slice, x_mb) -> y_mb` as a pipeline.
 
     stage_params: pytree whose leaves have leading dim n_stages (sharded
     over `axis`). x_micro: [n_micro, mb, ...] array of micro-batched inputs
     (replicated over `axis`). Returns [n_micro, mb, ...] outputs (replicated
     over `axis`). Activations must have the same shape/dtype across stages.
+
+    manual_axes: extra mesh axes to make manual inside the region (jax does
+    not support introducing new manual axes in a nested shard_map, so e.g.
+    the 'sep' ring-attention axis must become manual HERE when sequence
+    parallelism runs inside a pipeline stage). x_spec: PartitionSpec of
+    x_micro over those manual axes (e.g. P(None, None, 'sep') for
+    [n_micro, mb, T(sep), ...]); activations keep this layout across stages.
     """
     mesh = mesh_mod.get_mesh()
     n_stages = mesh.shape[axis]
@@ -73,12 +80,13 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
             jnp.where(stage == n_stages - 1, outputs, 0.0), axis)
         return outputs
 
+    xs = x_spec if x_spec is not None else P()
     sm = jax.shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
-        axis_names={axis},
+        in_specs=(P(axis), xs),
+        out_specs=xs,
+        axis_names={axis} | set(manual_axes),
         check_vma=False,
     )
     return sm(stage_params, x_micro)

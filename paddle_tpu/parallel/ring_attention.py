@@ -1,0 +1,130 @@
+"""Ring attention over the 'sep' (sequence/context parallel) mesh axis.
+
+The reference has NO sequence parallelism (SURVEY.md §2.4 — absent in the
+snapshot); long-context support there stops at single-device flash/memory-
+efficient attention kernels (/root/reference/paddle/phi/kernels/fusion/
+cutlass/memory_efficient_attention.cu). Here SP is first-class: activations
+are sequence-sharded over the 'sep' axis between blocks, and attention runs
+blockwise — each shard keeps only its own K/V block resident and the blocks
+circulate around the ring via ppermute, one hop per step, overlapping the
+ICI transfer with the block's compute. Per-step score memory is
+O((T/sep)^2) instead of the O(T * T/sep) a full K/V gather costs, which is
+the whole point of SP.
+
+Softmax is computed online (flash-attention style running max/sum), so the
+result is exactly softmax(QK^T)V over the full sequence. Causal masking
+uses global positions, so blocks entirely in the future contribute nothing
+and blocks entirely in the past need no mask.
+
+Differentiable: reverse-mode AD of ppermute is the reverse ring shift, so
+the backward pass is itself a ring schedule (à la Ring Attention,
+Liu et al. 2023 — see PAPERS.md).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+_NEG = -1e30  # finite mask value: keeps exp() well-defined for masked rows
+
+
+def _ring_local(q, k, v, *, axis, n, causal, sm_scale):
+    """Per-shard body. q: [B, Tq, nh, hd]; k/v: [B, Tk, nkv, hd] — the local
+    sequence chunk of each. Runs inside shard_map manual on `axis`."""
+    idx = jax.lax.axis_index(axis) if n > 1 else 0
+    B, Tq, nh, hd = q.shape
+    Tk, nkv = k.shape[1], k.shape[2]
+    g = nh // nkv  # GQA group size; == 1 for MHA
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qpos = idx * Tq + jnp.arange(Tq)
+    qg = q.reshape(B, Tq, nkv, g, hd)
+
+    o = jnp.zeros((B, Tq, nkv, g, hd), jnp.float32)
+    m = jnp.full((B, nkv, g, Tq), _NEG, jnp.float32)
+    l = jnp.zeros((B, nkv, g, Tq), jnp.float32)
+
+    # Unrolled ring: n is the (static) mesh axis size. Step s processes the
+    # K/V block that originated on shard (idx - s) mod n; XLA overlaps the
+    # ppermute for step s+1 with step s's einsums.
+    for s in range(n):
+        j = (idx - s) % n
+        scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * Tk + jnp.arange(Tk)
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask[None, None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * corr + p.sum(-1)
+        o = o * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+            "bngqk,bknd->bqngd", p, v.astype(jnp.float32))
+        m = m_new
+        if s < n - 1:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+
+    out = o / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(B, Tq, nh, hd).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis: str = "sep", causal: bool = True,
+                   sm_scale=None):
+    """Exact full-sequence attention with K/V ring-circulated over `axis`.
+
+    q: [B, T, nh, hd], k/v: [B, T, nkv, hd] with T sharded over `axis`.
+    The shard_map region is manual on `axis` ONLY — batch/head dims sharded
+    over other mesh axes (dp/mp) stay under GSPMD, so this composes with TP
+    and with the pp pipeline's own shard_map. Returns [B, T, nh, hd],
+    T sharded over `axis`.
+    """
+    n = mesh_mod.mesh_axis_size(axis)
+    if n == 1:
+        return _ring_local(q, k, v, axis=None, n=1, causal=causal,
+                           sm_scale=sm_scale)
+    if mesh_mod.inside_spmd_region(axis):
+        # `axis` is already manual in the enclosing shard_map (e.g. the
+        # pp pipeline made it manual — jax can't nest new manual axes);
+        # q/k/v are already per-shard local chunks.
+        return _ring_local(q, k, v, axis=axis, n=n, causal=causal,
+                           sm_scale=sm_scale)
+
+    mesh = mesh_mod.get_mesh()
+    spec = P(None, axis, None, None)
+    body = functools.partial(_ring_local, axis=axis, n=n, causal=causal,
+                             sm_scale=sm_scale)
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False,
+    )
+    return sm(q, k, v)
+
+
+def _dense_reference(q, k, v, causal=True, sm_scale=None):
+    """O(T^2) single-device reference used by parity tests."""
+    B, T, nh, hd = q.shape
+    nkv = k.shape[2]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    qg = q.reshape(B, T, nkv, g, hd)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, nh, hd).astype(q.dtype)
