@@ -66,6 +66,7 @@ from . import models  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from .framework import autograd as _autograd_mod  # noqa: E402
 from . import autograd  # noqa: F401,E402
 

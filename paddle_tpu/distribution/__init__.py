@@ -1,0 +1,40 @@
+"""paddle.distribution analog (ref: /root/reference/python/paddle/
+distribution/__init__.py — same export surface, plus the newer families
+Exponential/Gamma/Poisson/Binomial/StudentT/ContinuousBernoulli).
+
+TPU-native: every density/entropy/KL is pure jnp routed through the op
+layer (differentiable on the tape, fuses under jit); sampling uses
+functional jax.random keys from the global generator.
+"""
+from . import transform
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .categorical import Categorical
+from .cauchy import Cauchy
+from .dirichlet import Dirichlet
+from .distribution import Distribution, ExponentialFamily
+from .exponential import (Binomial, ContinuousBernoulli, Exponential, Gamma,
+                          Poisson, StudentT)
+from .geometric import Geometric
+from .gumbel import Gumbel
+from .independent import Independent
+from .kl import kl_divergence, register_kl
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .multinomial import Multinomial
+from .normal import Normal
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform,
+                        TanhTransform)
+from .transformed_distribution import TransformedDistribution
+from .uniform import Uniform
+
+__all__ = [
+    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy",
+    "ContinuousBernoulli", "Dirichlet", "Distribution", "Exponential",
+    "ExponentialFamily", "Gamma", "Geometric", "Gumbel", "Independent",
+    "Laplace", "LogNormal", "Multinomial", "Normal", "Poisson", "StudentT",
+    "TransformedDistribution", "Uniform", "kl_divergence", "register_kl",
+] + transform.__all__

@@ -1,0 +1,68 @@
+"""Bernoulli distribution (ref: /root/reference/python/paddle/distribution/
+bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import ExponentialFamily, _op, _t
+
+_EPS = 1e-7
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        self.logits = jnp.log(self.probs + _EPS) - jnp.log1p(
+            -self.probs + _EPS)
+        super().__init__(self.probs.shape, ())
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    @property
+    def _natural_parameters(self):
+        return (self.logits,)
+
+    def _log_normalizer(self, x):
+        return jnp.log1p(jnp.exp(x))
+
+    def sample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        return Tensor(jax.random.bernoulli(
+            self._key(), self.probs, shape).astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (ref bernoulli.py rsample — the
+        reparameterized sample is a relaxed Bernoulli)."""
+        shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(self._key(), shape, minval=_EPS,
+                               maxval=1. - _EPS)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+
+        def impl(logits):
+            return jax.nn.sigmoid((logits + logistic) / temperature)
+        return _op(impl, self.logits, op_name="bernoulli_rsample")
+
+    def entropy(self):
+        def impl(p):
+            q = 1 - p
+            return -(p * jnp.log(p + _EPS) + q * jnp.log(q + _EPS))
+        return _op(impl, self.probs, op_name="bernoulli_entropy")
+
+    def log_prob(self, value):
+        def impl(v, p):
+            return v * jnp.log(p + _EPS) + (1 - v) * jnp.log1p(-p + _EPS)
+        return _op(impl, _t(value), self.probs,
+                   op_name="bernoulli_log_prob")
+
+    def cdf(self, value):
+        def impl(v, p):
+            return jnp.where(v < 0, 0., jnp.where(v < 1, 1 - p, 1.))
+        return _op(impl, _t(value), self.probs, op_name="bernoulli_cdf")

@@ -1,0 +1,52 @@
+"""Beta distribution (ref: /root/reference/python/paddle/distribution/
+beta.py — built on Dirichlet there; direct here)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from ..framework.tensor import Tensor
+from .distribution import ExponentialFamily, _op, _pt, _t
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _pt(alpha)
+        self.beta = _pt(beta)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(alpha)),
+                                     jnp.shape(_t(beta)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        a, b = _t(self.alpha), _t(self.beta)
+        return Tensor(jnp.broadcast_to(a / (a + b), self.batch_shape))
+
+    @property
+    def variance(self):
+        a, b = _t(self.alpha), _t(self.beta)
+        s = a + b
+        return Tensor(jnp.broadcast_to(
+            a * b / (s ** 2 * (s + 1)), self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        a = jnp.broadcast_to(_t(self.alpha), shape)
+        b = jnp.broadcast_to(_t(self.beta), shape)
+        return _op(lambda a_, b_: jax.random.beta(self._key(), a_, b_),
+                   a, b, op_name="beta_rsample")
+
+    def entropy(self):
+        def impl(a, b):
+            s = a + b
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b) + (s - 2) * digamma(s))
+        return _op(impl, self.alpha, self.beta, op_name="beta_entropy")
+
+    def log_prob(self, value):
+        def impl(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+        return _op(impl, _t(value), self.alpha, self.beta,
+                   op_name="beta_log_prob")

@@ -1,0 +1,66 @@
+"""Categorical distribution (ref: /root/reference/python/paddle/
+distribution/categorical.py — paddle's Categorical takes *logits* and
+normalizes internally)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _t
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(self.logits.shape[:-1], ())
+        self._n = self.logits.shape[-1]
+
+    @property
+    def probs_param(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.categorical(
+            self._key(), jnp.log(self.probs_param + 1e-30), shape=shape)
+        return Tensor(out)
+
+    def entropy(self):
+        def impl(logits):
+            p = jax.nn.softmax(logits, axis=-1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -(p * logp).sum(-1)
+        return _op(impl, self.logits, op_name="categorical_entropy")
+
+    @staticmethod
+    def _gather(table, v):
+        """Gather per-index values, broadcasting `v` against the batch
+        dims: v may carry extra leading sample dims (paddle semantics)."""
+        v = v.astype(jnp.int32)
+        t = jnp.broadcast_to(table, v.shape + table.shape[-1:])
+        return jnp.take_along_axis(t, v[..., None], axis=-1)[..., 0]
+
+    def probs(self, value):
+        """Probability of each index in `value` (paddle semantics:
+        categorical.probs gathers normalized probabilities)."""
+        def impl(logits, v):
+            return self._gather(jax.nn.softmax(logits, axis=-1), v)
+        return _op(impl, self.logits, _int(value),
+                   op_name="categorical_probs")
+
+    def log_prob(self, value):
+        def impl(logits, v):
+            return self._gather(jax.nn.log_softmax(logits, axis=-1), v)
+        return _op(impl, self.logits, _int(value),
+                   op_name="categorical_log_prob")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+def _int(x):
+    if isinstance(x, Tensor):
+        x = x.data
+    return jnp.asarray(x)

@@ -1,0 +1,53 @@
+"""Cauchy distribution (ref: /root/reference/python/paddle/distribution/
+cauchy.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _op, _pt, _t
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(loc)), jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(self._key(), shape, _t(self.loc).dtype,
+                               minval=1e-7, maxval=1. - 1e-7)
+        return _op(lambda l, s: l + s * jnp.tan(math.pi * (u - 0.5)),
+                   self.loc, self.scale, op_name="cauchy_rsample")
+
+    def entropy(self):
+        return _op(lambda s: jnp.broadcast_to(
+            math.log(4 * math.pi) + jnp.log(s), self.batch_shape),
+            self.scale, op_name="cauchy_entropy")
+
+    def log_prob(self, value):
+        def impl(v, l, s):
+            z = (v - l) / s
+            return -math.log(math.pi) - jnp.log(s) - jnp.log1p(z ** 2)
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="cauchy_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+                   _t(value), self.loc, self.scale, op_name="cauchy_cdf")

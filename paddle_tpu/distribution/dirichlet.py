@@ -1,0 +1,53 @@
+"""Dirichlet distribution (ref: /root/reference/python/paddle/distribution/
+dirichlet.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..framework.tensor import Tensor
+from .distribution import ExponentialFamily, _op, _t
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError(
+                "concentration must be at least 1-dimensional")
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(-1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        conc = jnp.broadcast_to(self.concentration, shape)
+        return _op(lambda c: jax.random.dirichlet(
+            self._key(), c), conc, op_name="dirichlet_rsample")
+
+    def entropy(self):
+        def impl(c):
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            lnB = gammaln(c).sum(-1) - gammaln(a0)
+            return (lnB + (a0 - k) * digamma(a0)
+                    - ((c - 1) * digamma(c)).sum(-1))
+        return _op(impl, self.concentration, op_name="dirichlet_entropy")
+
+    def log_prob(self, value):
+        def impl(v, c):
+            lnB = gammaln(c).sum(-1) - gammaln(c.sum(-1))
+            return ((c - 1) * jnp.log(v)).sum(-1) - lnB
+        return _op(impl, _t(value), self.concentration,
+                   op_name="dirichlet_log_prob")

@@ -1,0 +1,142 @@
+"""Distribution base class (ref: /root/reference/python/paddle/distribution/
+distribution.py:33 — batch_shape/event_shape semantics, sample/entropy/
+log_prob/probs surface).
+
+TPU-native design: all math is pure jnp routed through the op layer
+(`framework.op.apply`) so log_prob/rsample are differentiable on the tape
+and fuse under jit; sampling draws functional PRNG keys from the global
+generator (framework/random.py) so it is reproducible under paddle.seed and
+jit-safe under key_scope.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.op import apply as _apply
+from ..framework.tensor import Tensor
+
+
+def _t(x, dtype=None):
+    """Unwrap Tensor / coerce python scalars to a jnp array."""
+    if isinstance(x, Tensor):
+        x = x.data
+    a = jnp.asarray(x)
+    if dtype is not None and a.dtype != dtype:
+        a = a.astype(dtype)
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _pt(x):
+    """Param-preserving coercion: a live (grad-requiring) Tensor is kept so
+    log_prob/rsample stay differentiable w.r.t. distribution parameters;
+    anything else becomes a jnp array. Use _t(param) for raw-array math."""
+    if isinstance(x, Tensor) and not x.stop_gradient:
+        return x
+    return _t(x)
+
+
+def _op(fn, *args, op_name=None):
+    """Differentiable op application: Tensor args join the autograd tape."""
+    return _apply(fn, args, op_name=op_name)
+
+
+class Distribution:
+    """Abstract base for probability distributions."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(
+            int(d) for d in np.atleast_1d(batch_shape).tolist()) \
+            if not isinstance(batch_shape, tuple) else batch_shape
+        self._event_shape = tuple(
+            int(d) for d in np.atleast_1d(event_shape).tolist()) \
+            if not isinstance(event_shape, tuple) else event_shape
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Draw (non-reparameterized) samples; gradient-stopped."""
+        out = self.rsample(shape)
+        if isinstance(out, Tensor):
+            out = Tensor(out.data, stop_gradient=True)
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _op(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def probs(self, value):
+        # paddle legacy alias (ref distribution.py:118)
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    def _key(self):
+        return _random.next_key()
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (ref: exponential_family.py).
+
+    Subclasses expose natural parameters + log normalizer; the generic
+    Bregman-divergence entropy (ref `_entropy` via autodiff of the log
+    normalizer) is provided for subclasses that don't override entropy().
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """Generic entropy via the Bregman identity for p(x) =
+        h(x)·exp(η·T(x) − A(η)):  H = A(η) − η·∇A(η) − E[log h(x)]
+        (the reference computes the same thing with static-graph autodiff,
+        exponential_family.py `_entropy`)."""
+        nat = tuple(_t(p) for p in self._natural_parameters)
+        grads = jax.grad(lambda ps: self._log_normalizer(*ps).sum())(nat)
+        ent = self._log_normalizer(*nat) - self._mean_carrier_measure
+        for eta, g in zip(nat, grads):
+            ent = ent - eta * g
+        return Tensor(ent)

@@ -1,0 +1,283 @@
+"""Exponential, Gamma, Poisson, Binomial, StudentT, ContinuousBernoulli —
+families beyond the reference snapshot's exports (upstream paddle gained
+them after 2.5; API matches). Ref base: /root/reference/python/paddle/
+distribution/distribution.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, ExponentialFamily, _op, _pt, _t
+
+_EPS = 1e-7
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _pt(rate)
+        super().__init__(jnp.shape(_t(rate)), ())
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / _t(self.rate))
+
+    @property
+    def variance(self):
+        return Tensor(_t(self.rate) ** -2)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        e = jax.random.exponential(self._key(), shape, _t(self.rate).dtype)
+        return _op(lambda r: e / r, self.rate, op_name="exponential_rsample")
+
+    def entropy(self):
+        return _op(lambda r: 1.0 - jnp.log(r), self.rate,
+                   op_name="exponential_entropy")
+
+    def log_prob(self, value):
+        return _op(lambda v, r: jnp.log(r) - r * v, _t(value), self.rate,
+                   op_name="exponential_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, r: -jnp.expm1(-r * v), _t(value), self.rate,
+                   op_name="exponential_cdf")
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _pt(concentration)
+        self.rate = _pt(rate)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(concentration)),
+                                     jnp.shape(_t(rate)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            _t(self.concentration) / _t(self.rate), self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            _t(self.concentration) / _t(self.rate) ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        a = jnp.broadcast_to(_t(self.concentration), shape)
+        return _op(lambda a_, r: jax.random.gamma(self._key(), a_) / r,
+                   a, self.rate, op_name="gamma_rsample")
+
+    def entropy(self):
+        def impl(a, r):
+            return (a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
+        return _op(impl, self.concentration, self.rate,
+                   op_name="gamma_entropy")
+
+    def log_prob(self, value):
+        def impl(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - gammaln(a))
+        return _op(impl, _t(value), self.concentration, self.rate,
+                   op_name="gamma_log_prob")
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(jnp.shape(self.rate), ())
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        return Tensor(jax.random.poisson(
+            self._key(), self.rate, shape).astype(jnp.float32))
+
+    rsample = sample
+
+    def entropy(self):
+        """Exact via truncated support sum (matches upstream paddle's
+        enumeration approach for moderate rates)."""
+        def impl(r):
+            try:
+                n = int(max(20, float(jnp.max(r)) * 3 + 20))
+            except Exception:
+                # traced rate (inside jit): static generous truncation so
+                # the support sum stays shape-static and compilable
+                n = 200
+            s = jnp.arange(0., n).reshape((-1,) + (1,) * r.ndim)
+            logp = s * jnp.log(r + 1e-30) - r - gammaln(s + 1)
+            p = jnp.exp(logp)
+            return -(p * logp).sum(0)
+        return _op(impl, self.rate, op_name="poisson_entropy")
+
+    def log_prob(self, value):
+        return _op(lambda v, r: v * jnp.log(r + 1e-30) - r - gammaln(v + 1),
+                   _t(value), self.rate, op_name="poisson_log_prob")
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.shape(self.probs), ())
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        b = jax.random.bernoulli(
+            self._key(), self.probs, (self.total_count,) + shape)
+        return Tensor(b.sum(0).astype(jnp.float32))
+
+    rsample = sample
+
+    def entropy(self):
+        def impl(p):
+            n = float(self.total_count)
+            s = jnp.arange(0., n + 1.).reshape((-1,) + (1,) * p.ndim)
+            logp = (gammaln(n + 1) - gammaln(s + 1) - gammaln(n - s + 1)
+                    + s * jnp.log(p + _EPS) + (n - s) * jnp.log1p(-p + _EPS))
+            pr = jnp.exp(logp)
+            return -(pr * logp).sum(0)
+        return _op(impl, self.probs, op_name="binomial_entropy")
+
+    def log_prob(self, value):
+        def impl(v, p):
+            n = float(self.total_count)
+            return (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                    + v * jnp.log(p + _EPS) + (n - v) * jnp.log1p(-p + _EPS))
+        return _op(impl, _t(value), self.probs, op_name="binomial_log_prob")
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _pt(df)
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(df)), jnp.shape(_t(loc)),
+                                     jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        df = _t(self.df)
+        return Tensor(jnp.broadcast_to(
+            jnp.where(df > 1, _t(self.loc), jnp.nan), self.batch_shape))
+
+    @property
+    def variance(self):
+        df, s = _t(self.df), _t(self.scale)
+        var = jnp.where(
+            df > 2, s ** 2 * df / (df - 2),
+            jnp.where(df > 1, jnp.inf, jnp.nan))
+        return Tensor(jnp.broadcast_to(var, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        df = jnp.broadcast_to(_t(self.df), shape)
+        t = jax.random.t(self._key(), df, shape)
+        return _op(lambda l, s: l + s * t, self.loc, self.scale,
+                   op_name="studentt_rsample")
+
+    def entropy(self):
+        def impl(df, s):
+            h = df / 2
+            return (jnp.log(s) + jnp.log(jnp.sqrt(df) )
+                    + gammaln(h) + 0.5 * math.log(math.pi)
+                    - gammaln(h + 0.5)
+                    + (h + 0.5) * (digamma(h + 0.5) - digamma(h)))
+        return _op(impl, self.df, self.scale, op_name="studentt_entropy")
+
+    def log_prob(self, value):
+        def impl(v, df, l, s):
+            z = (v - l) / s
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return _op(impl, _t(value), self.df, self.loc, self.scale,
+                   op_name="studentt_log_prob")
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli (Loaiza-Ganem & Cunningham 2019); upstream
+    paddle API."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.clip(_t(probs), _EPS, 1 - _EPS)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs), ())
+
+    def _cut(self, p):
+        lo, hi = self._lims
+        return (p > lo) & (p < hi)
+
+    def _log_C(self, p):
+        """log normalizing constant, Taylor-stabilized near p=0.5."""
+        safe = jnp.where(self._cut(p), 0.25, p)
+        logC = jnp.log(jnp.abs(
+            2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)))
+        x = p - 0.5
+        taylor = math.log(2.) + (4. / 3) * x ** 2 + (104. / 45) * x ** 4
+        return jnp.where(self._cut(p), taylor, logC)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._cut(p), 0.25, p)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        x = p - 0.5
+        taylor = 0.5 + x / 3 + (16. / 45) * x ** 3
+        return Tensor(jnp.where(self._cut(p), taylor, m))
+
+    @property
+    def variance(self):
+        p = self.probs
+        safe = jnp.where(self._cut(p), 0.25, p)
+        t = 2 * jnp.arctanh(1 - 2 * safe)
+        v = safe * (safe - 1) / (1 - 2 * safe) ** 2 + 1 / t ** 2
+        x = p - 0.5
+        taylor = 1. / 12 - (1. / 15) * x ** 2
+        return Tensor(jnp.where(self._cut(p), taylor, v))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(self._key(), shape, minval=_EPS,
+                               maxval=1 - _EPS)
+
+        def impl(p):
+            safe = jnp.where(self._cut(p), 0.25, p)
+            icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                    / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(self._cut(p), u, icdf)
+        return _op(impl, self.probs, op_name="cb_rsample")
+
+    def log_prob(self, value):
+        def impl(v, p):
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_C(p))
+        return _op(impl, _t(value), self.probs, op_name="cb_log_prob")
+
+    def entropy(self):
+        def impl(p):
+            m = jnp.asarray(self.mean.data if hasattr(self.mean, "data")
+                            else self.mean)
+            return -(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                     + self._log_C(p))
+        return _op(impl, self.probs, op_name="cb_entropy")

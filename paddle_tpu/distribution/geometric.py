@@ -1,0 +1,51 @@
+"""Geometric distribution (ref: /root/reference/python/paddle/distribution/
+geometric.py — support {0, 1, 2, ...}: number of failures before success)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _t
+
+_EPS = 1e-7
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(jnp.shape(self.probs), ())
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt((1 - self.probs)) / self.probs)
+
+    def sample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(self._key(), shape, minval=_EPS,
+                               maxval=1. - _EPS)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    rsample = sample  # no reparameterization for a discrete support
+
+    def entropy(self):
+        def impl(p):
+            q = 1 - p
+            return -(q * jnp.log(q + _EPS) + p * jnp.log(p + _EPS)) / p
+        return _op(impl, self.probs, op_name="geometric_entropy")
+
+    def log_prob(self, value):
+        return _op(lambda v, p: v * jnp.log1p(-p + _EPS) + jnp.log(p + _EPS),
+                   _t(value), self.probs, op_name="geometric_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, p: 1 - jnp.power(1 - p, jnp.floor(v) + 1),
+                   _t(value), self.probs, op_name="geometric_cdf")

@@ -1,0 +1,59 @@
+"""Gumbel distribution (ref: /root/reference/python/paddle/distribution/
+gumbel.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt, _t
+
+_EULER = 0.57721566490153286060
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(loc)), jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            _t(self.loc) + _EULER * _t(self.scale),
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * _t(self.scale) ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi / math.sqrt(6)) * _t(self.scale), self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        g = jax.random.gumbel(self._key(), shape, _t(self.loc).dtype)
+        return _op(lambda l, s: l + s * g, self.loc, self.scale,
+                   op_name="gumbel_rsample")
+
+    def entropy(self):
+        return _op(lambda s: jnp.broadcast_to(jnp.log(s) + 1 + _EULER,
+                                              self.batch_shape),
+                   self.scale, op_name="gumbel_entropy")
+
+    def log_prob(self, value):
+        def impl(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="gumbel_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+                   _t(value), self.loc, self.scale, op_name="gumbel_cdf")

@@ -1,0 +1,46 @@
+"""Independent wrapper (ref: /root/reference/python/paddle/distribution/
+independent.py — reinterprets trailing batch dims as event dims)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op
+
+
+class Independent(Distribution):
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        if not (0 < reinterpreted_batch_rank <= len(base.batch_shape)):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {reinterpreted_batch_rank}")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        cut = len(base.batch_shape) - self._rank
+        super().__init__(shape[:cut], shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def _sum_rightmost(self, value, n):
+        return _op(
+            lambda v: v.sum(tuple(range(v.ndim - n, v.ndim))) if n else v,
+            value, op_name="independent_sum")
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self._base.log_prob(value), self._rank)
+
+    def entropy(self):
+        return self._sum_rightmost(self._base.entropy(), self._rank)

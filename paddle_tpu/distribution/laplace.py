@@ -1,0 +1,66 @@
+"""Laplace distribution (ref: /root/reference/python/paddle/distribution/
+laplace.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt, _t
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(loc)), jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(_t(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * _t(self.scale) ** 2,
+                                       self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(math.sqrt(2.) * _t(self.scale),
+                                       self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        # inverse-CDF on a symmetric uniform (ref laplace.py rsample)
+        u = jax.random.uniform(self._key(), shape, _t(self.loc).dtype,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return _op(lambda l, s: l - s * jnp.sign(u)
+                   * jnp.log1p(-2 * jnp.abs(u)),
+                   self.loc, self.scale, op_name="laplace_rsample")
+
+    def entropy(self):
+        return _op(lambda s: jnp.broadcast_to(1 + jnp.log(2 * s),
+                                              self.batch_shape),
+                   self.scale, op_name="laplace_entropy")
+
+    def log_prob(self, value):
+        return _op(lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   _t(value), self.loc, self.scale,
+                   op_name="laplace_log_prob")
+
+    def cdf(self, value):
+        def impl(v, l, s):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="laplace_cdf")
+
+    def icdf(self, value):
+        def impl(p, l, s):
+            term = p - 0.5
+            return l - s * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term))
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="laplace_icdf")

@@ -1,0 +1,53 @@
+"""LogNormal distribution (ref: /root/reference/python/paddle/distribution/
+lognormal.py — implemented there as TransformedDistribution(Normal, Exp);
+here directly for numerics)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt, _t
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(loc)), jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.exp(_t(self.loc) + _t(self.scale) ** 2 / 2),
+            self.batch_shape))
+
+    @property
+    def variance(self):
+        s2 = _t(self.scale) ** 2
+        return Tensor(jnp.broadcast_to(
+            (jnp.exp(s2) - 1) * jnp.exp(2 * _t(self.loc) + s2),
+            self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        eps = jax.random.normal(self._key(), shape, _t(self.loc).dtype)
+        return _op(lambda l, s: jnp.exp(l + s * eps), self.loc, self.scale,
+                   op_name="lognormal_rsample")
+
+    def entropy(self):
+        return _op(lambda l, s: jnp.broadcast_to(
+            l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            self.batch_shape), self.loc, self.scale,
+            op_name="lognormal_entropy")
+
+    def log_prob(self, value):
+        def impl(v, l, s):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s ** 2) - logv - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="lognormal_log_prob")

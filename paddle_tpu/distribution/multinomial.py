@@ -1,0 +1,62 @@
+"""Multinomial distribution (ref: /root/reference/python/paddle/
+distribution/multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _t
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        k = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            self._key(), jnp.log(self.probs + 1e-30),
+            shape=(self.total_count,) + shape)
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def entropy(self):
+        """Exact entropy via the binomial-marginal decomposition (same
+        formula as ref multinomial.py:162-179):
+        H = n·H(cat) − lgamma(n+1) + Σ_k E_{s~Binom(n,p_k)}[lgamma(s+1)]."""
+        def impl(p):
+            n = float(self.total_count)
+            cat_ent = -(p * jnp.log(p + 1e-30)).sum(-1)
+            # support s = 1..n, broadcast against p's batch/event dims
+            s = jnp.arange(1., n + 1.).reshape(
+                (-1,) + (1,) * p.ndim)
+            log_binom = (gammaln(n + 1) - gammaln(s + 1)
+                         - gammaln(n - s + 1)
+                         + s * jnp.log(p + 1e-30)
+                         + (n - s) * jnp.log1p(-p + 1e-30))
+            binom_pmf = jnp.exp(log_binom)
+            return (n * cat_ent - gammaln(n + 1)
+                    + (binom_pmf * gammaln(s + 1)).sum((0, -1)))
+        return _op(impl, self.probs, op_name="multinomial_entropy")
+
+    def log_prob(self, value):
+        def impl(v, p):
+            n = jnp.asarray(float(self.total_count))
+            return (gammaln(n + 1) - gammaln(v + 1).sum(-1)
+                    + (v * jnp.log(p + 1e-30)).sum(-1))
+        return _op(impl, _t(value), self.probs,
+                   op_name="multinomial_log_prob")

@@ -1,0 +1,67 @@
+"""Normal distribution (ref: /root/reference/python/paddle/distribution/
+normal.py — sample/rsample/entropy/log_prob/probs/kl_divergence surface)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt, _t
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(loc)), jnp.shape(_t(scale)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(_t(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(_t(self.scale) ** 2,
+                                       self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(_t(self.scale), self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        eps = jax.random.normal(self._key(), shape, _t(self.loc).dtype)
+        return _op(lambda l, s: l + s * eps, self.loc, self.scale,
+                   op_name="normal_rsample")
+
+    def entropy(self):
+        return _op(
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.scale, op_name="normal_entropy")
+
+    def log_prob(self, value):
+        def impl(v, l, s):
+            var = s ** 2
+            return (-((v - l) ** 2) / (2 * var) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+        return _op(impl, _t(value), self.loc, self.scale,
+                   op_name="normal_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, l, s: 0.5 * (1 + jax.scipy.special.erf(
+            (v - l) / (s * math.sqrt(2)))), _t(value), self.loc, self.scale,
+            op_name="normal_cdf")
+
+    def icdf(self, value):
+        return _op(lambda v, l, s: l + s * jax.scipy.special.erfinv(
+            2 * v - 1) * math.sqrt(2), _t(value), self.loc, self.scale,
+            op_name="normal_icdf")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)

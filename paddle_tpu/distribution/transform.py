@@ -1,0 +1,297 @@
+"""Bijective transforms (ref: /root/reference/python/paddle/distribution/
+transform.py — Transform base with forward/inverse/log-det-Jacobian and
+the 13 concrete transforms in its __all__)."""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .distribution import _op, _pt, _t
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    """Base class. Subclasses implement _forward / _inverse /
+    _forward_log_det_jacobian on jnp arrays; the public methods handle
+    Tensor interop and autograd recording."""
+
+    _event_rank = 0  # event dims consumed by the transform
+
+    def forward(self, x):
+        # _pt keeps grad-requiring Tensors on the tape
+        return _op(self._forward, _pt(x),
+                   op_name=f"{type(self).__name__}.forward")
+
+    def inverse(self, y):
+        return _op(self._inverse, _pt(y),
+                   op_name=f"{type(self).__name__}.inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(self._forward_log_det_jacobian, _pt(x),
+                   op_name=f"{type(self).__name__}.fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        def impl(y_):
+            return -self._forward_log_det_jacobian(self._inverse(y_))
+        return _op(impl, _pt(y), op_name=f"{type(self).__name__}.ildj")
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # -- jnp-level hooks -----------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch (ref AbsTransform.inverse returns y)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2. * (math.log(2.) - x - jax.nn.softplus(-2. * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex (ref StickBreakingTransform)."""
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(
+            jnp.ones_like(x), axis=-1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+             jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.cumsum(
+            jnp.ones_like(y_crop), axis=-1) + 1
+        sf = 1 - jnp.cumsum(y_crop, axis=-1)
+        return (jnp.log(y_crop) - jnp.log(sf)) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # log|det J| = sum_k [log z_k + sum_{j<k} log(1-z_j)]
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), axis=-1) + 1
+        logz = jax.nn.log_sigmoid(x - jnp.log(offset))
+        log1mz = jax.nn.log_sigmoid(-(x - jnp.log(offset)))
+        csum = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumsum(log1mz[..., :-1], axis=-1)], axis=-1)
+        return (logz + csum).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(
+                np.prod(self.out_event_shape)):
+            raise ValueError("in/out event sizes must match")
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        self._event_rank = max(
+            (t._event_rank for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.
+        for t in self.transforms:
+            ldj = t._forward_log_det_jacobian(x)
+            # reduce finer-grained ldj over dims this chain treats as event
+            extra = self._event_rank - t._event_rank
+            if extra > 0 and hasattr(ldj, "ndim") and ldj.ndim >= extra:
+                ldj = ldj.sum(tuple(range(ldj.ndim - extra, ldj.ndim)))
+            total = total + ldj
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Promote batch dims of a base transform to event dims
+    (ref IndependentTransform)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        r = self.reinterpreted_batch_rank
+        return ldj.sum(tuple(range(ldj.ndim - r, ldj.ndim)))
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along `axis`
+    (ref StackTransform)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        parts = [getattr(t, fn_name)(xi) for t, xi in zip(
+            self.transforms,
+            jnp.split(x, len(self.transforms), axis=self.axis))]
+        return jnp.concatenate(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

@@ -1,0 +1,65 @@
+"""TransformedDistribution (ref: /root/reference/python/paddle/
+distribution/transformed_distribution.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self._base = base
+        self._transform = ChainTransform(list(transforms))
+        base_shape = base.batch_shape + base.event_shape
+        out_shape = self._transform.forward_shape(base_shape)
+        event_rank = max(len(base.event_shape),
+                         self._transform._event_rank)
+        cut = len(out_shape) - event_rank
+        super().__init__(tuple(out_shape[:cut]), tuple(out_shape[cut:]))
+
+    def sample(self, shape=()):
+        y = self._transform.forward(self._base.sample(shape))
+        if isinstance(y, Tensor):
+            y = Tensor(y.data, stop_gradient=True)
+        return y
+
+    def rsample(self, shape=()):
+        return self._transform.forward(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        # log p_Y(y) = log p_X(T^-1 y) - log|det J_T(T^-1 y)|, with the
+        # base log_prob reduced over dims the transform treats as event.
+        # Differentiable w.r.t. `value` AND the base's (Tensor) parameters:
+        # both are explicit op inputs; inside the traced body the base's
+        # params are temporarily rebound to the traced arrays and the tape
+        # is disabled (inner apply() calls must not record tape nodes over
+        # tracers — they would leak out of the trace).
+        base = self._base
+        extra = self._transform._event_rank - len(base.event_shape)
+        pnames = [k for k, v in vars(base).items() if isinstance(v, Tensor)]
+
+        def impl(v_, *param_arrays):
+            from ..framework.autograd import no_grad
+            saved = {k: getattr(base, k) for k in pnames}
+            try:
+                for k, a in zip(pnames, param_arrays):
+                    setattr(base, k, a)
+                with no_grad():
+                    x = self._transform._inverse(v_)
+                    lp = base.log_prob(Tensor(x, stop_gradient=True))
+                    lp = lp.data if isinstance(lp, Tensor) else lp
+                    ldj = self._transform._forward_log_det_jacobian(x)
+            finally:
+                for k in pnames:
+                    setattr(base, k, saved[k])
+            if extra > 0:
+                lp = lp.sum(tuple(range(lp.ndim - extra, lp.ndim)))
+            return lp - ldj
+
+        return _op(impl, _pt(value), *[getattr(base, k) for k in pnames],
+                   op_name="transformed_log_prob")

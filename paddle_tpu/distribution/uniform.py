@@ -1,0 +1,50 @@
+"""Uniform distribution (ref: /root/reference/python/paddle/distribution/
+uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .distribution import Distribution, _op, _pt, _t
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _pt(low)
+        self.high = _pt(high)
+        batch = jnp.broadcast_shapes(jnp.shape(_t(low)), jnp.shape(_t(high)))
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((_t(self.low) + _t(self.high)) / 2,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (_t(self.high) - _t(self.low)) ** 2 / 12,
+                                       self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(self._key(), shape, _t(self.low).dtype)
+        return _op(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high,
+                   op_name="uniform_rsample")
+
+    def entropy(self):
+        return _op(lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo),
+                                                   self.batch_shape),
+                   self.low, self.high, op_name="uniform_entropy")
+
+    def log_prob(self, value):
+        def impl(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _op(impl, _t(value), self.low, self.high,
+                   op_name="uniform_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda v, lo, hi: jnp.clip((v - lo) / (hi - lo), 0., 1.),
+                   _t(value), self.low, self.high, op_name="uniform_cdf")

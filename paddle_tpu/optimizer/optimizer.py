@@ -135,7 +135,8 @@ class Optimizer:
                 float(getattr(p.regularizer, "_coeff",
                               getattr(p.regularizer, "coeff", 0.0)))
             wd = wd or self._wd_for_param(p)
-            metas.append((float(p.optimize_attr.get("learning_rate", 1.0)),
+            oattr = getattr(p, "optimize_attr", None) or {}
+            metas.append((float(oattr.get("learning_rate", 1.0)),
                           wd, master is not None))
 
         cache_key = (tuple((a.shape, str(a.dtype)) for a in p_arrs),

@@ -1,0 +1,314 @@
+"""paddle.distribution parity tests (ref test model: the reference's
+test/distribution/ suite checks log_prob/entropy against scipy.stats and
+KL against closed forms)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+paddle.seed(7)
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+# ---- log_prob / entropy vs scipy ------------------------------------------
+
+CASES = [
+    (lambda: D.Normal(1.0, 2.0), st.norm(1.0, 2.0), 0.3),
+    (lambda: D.Uniform(0.0, 3.0), st.uniform(0, 3), 1.5),
+    (lambda: D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5), 0.3),
+    (lambda: D.LogNormal(0.2, 0.7), st.lognorm(s=0.7, scale=np.exp(0.2)),
+     1.1),
+    (lambda: D.Cauchy(0.0, 1.0), st.cauchy(0, 1), 0.4),
+    (lambda: D.Gumbel(0.3, 1.2), st.gumbel_r(0.3, 1.2), 0.9),
+    (lambda: D.Beta(2.0, 3.0), st.beta(2, 3), 0.4),
+    (lambda: D.Exponential(1.5), st.expon(scale=1 / 1.5), 0.8),
+    (lambda: D.Gamma(2.0, 3.0), st.gamma(2.0, scale=1 / 3.0), 0.6),
+    (lambda: D.StudentT(5.0, 0.0, 1.0), st.t(5.0), 0.7),
+    (lambda: D.Geometric(0.4), st.geom(0.4, loc=-1), 2.0),
+    (lambda: D.Poisson(3.0), st.poisson(3.0), 2.0),
+    (lambda: D.Binomial(10, 0.3), st.binom(10, 0.3), 4.0),
+]
+
+
+@pytest.mark.parametrize("make,ref,x", CASES,
+                         ids=[c[0]().__class__.__name__ for c in CASES])
+def test_log_prob_matches_scipy(make, ref, x):
+    d = make()
+    got = float(_np(d.log_prob(x)))
+    want = (ref.logpdf(x) if hasattr(ref.dist, "pdf") else ref.logpmf(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("make,ref,x", CASES,
+                         ids=[c[0]().__class__.__name__ for c in CASES])
+def test_entropy_matches_scipy(make, ref, x):
+    d = make()
+    got = float(_np(d.entropy()))
+    np.testing.assert_allclose(got, ref.entropy(), rtol=1e-3, atol=1e-4)
+
+
+def test_bernoulli_scipy():
+    d = D.Bernoulli(0.3)
+    np.testing.assert_allclose(float(_np(d.log_prob(1.0))), np.log(0.3),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.bernoulli(0.3).entropy(), rtol=1e-3)
+
+
+def test_categorical_logprob_entropy():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    d = D.Categorical(paddle.to_tensor(logits))
+    np.testing.assert_allclose(float(_np(d.log_prob(2))), np.log(0.5),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+    np.testing.assert_allclose(_np(d.probs(np.array([0, 1, 2]))),
+                               [0.2, 0.3, 0.5], rtol=1e-5)
+
+
+def test_dirichlet_scipy():
+    conc = np.array([2.0, 3.0, 4.0], np.float32)
+    d = D.Dirichlet(conc)
+    x = np.array([0.2, 0.3, 0.5])
+    np.testing.assert_allclose(float(_np(d.log_prob(x))),
+                               st.dirichlet(conc).logpdf(x), rtol=1e-4)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.dirichlet(conc).entropy(), rtol=1e-4)
+
+
+def test_multinomial_logprob():
+    d = D.Multinomial(10, np.array([0.2, 0.3, 0.5], np.float32))
+    x = np.array([2.0, 3.0, 5.0])
+    np.testing.assert_allclose(
+        float(_np(d.log_prob(x))),
+        st.multinomial(10, [0.2, 0.3, 0.5]).logpmf([2, 3, 5]), rtol=1e-4)
+
+
+# ---- sampling moments ------------------------------------------------------
+
+@pytest.mark.parametrize("make,mean,var", [
+    (lambda: D.Normal(1.0, 2.0), 1.0, 4.0),
+    (lambda: D.Uniform(0.0, 2.0), 1.0, 1 / 3),
+    (lambda: D.Laplace(0.0, 1.0), 0.0, 2.0),
+    (lambda: D.Exponential(2.0), 0.5, 0.25),
+    (lambda: D.Gamma(4.0, 2.0), 2.0, 1.0),
+    (lambda: D.Gumbel(0.0, 1.0), 0.5772, np.pi ** 2 / 6),
+    (lambda: D.Beta(2.0, 2.0), 0.5, 0.05),
+    (lambda: D.Geometric(0.5), 1.0, 2.0),
+    (lambda: D.Poisson(4.0), 4.0, 4.0),
+    (lambda: D.Binomial(20, 0.25), 5.0, 3.75),
+], ids=["Normal", "Uniform", "Laplace", "Exponential", "Gamma", "Gumbel",
+        "Beta", "Geometric", "Poisson", "Binomial"])
+def test_sample_moments(make, mean, var):
+    d = make()
+    s = _np(d.sample((20000,)))
+    assert s.shape[0] == 20000
+    np.testing.assert_allclose(s.mean(), mean, atol=4 * np.sqrt(var / 20000)
+                               + 0.02)
+    np.testing.assert_allclose(s.var(), var, rtol=0.15, atol=0.02)
+
+
+def test_property_mean_variance():
+    d = D.Normal(np.array([1.0, 2.0], np.float32), 3.0)
+    np.testing.assert_allclose(_np(d.mean), [1, 2])
+    np.testing.assert_allclose(_np(d.variance), [9, 9])
+    assert d.batch_shape == (2,)
+
+
+# ---- KL --------------------------------------------------------------------
+
+def _mc_kl(p, q, n=200_000):
+    s = p.sample((n,))
+    return float(np.mean(_np(p.log_prob(s)) - _np(q.log_prob(s))))
+
+
+@pytest.mark.parametrize("p,q", [
+    (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)),
+    (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+    (D.Beta(2.0, 3.0), D.Beta(4.0, 2.0)),
+    (D.Exponential(1.0), D.Exponential(2.5)),
+    (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+    (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 1.5)),
+    (D.Geometric(0.4), D.Geometric(0.6)),
+    (D.Poisson(2.0), D.Poisson(4.0)),
+], ids=["Normal", "Laplace", "Beta", "Exponential", "Gamma", "Gumbel",
+        "Geometric", "Poisson"])
+def test_kl_closed_form_vs_monte_carlo(p, q):
+    kl = float(_np(D.kl_divergence(p, q)))
+    mc = _mc_kl(p, q)
+    np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02)
+
+
+def test_kl_categorical_bernoulli_uniform():
+    p = D.Categorical(np.log(np.array([0.3, 0.7], np.float32)))
+    q = D.Categorical(np.log(np.array([0.5, 0.5], np.float32)))
+    want = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), want,
+                               rtol=1e-4)
+    pb, qb = D.Bernoulli(0.3), D.Bernoulli(0.6)
+    want = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+    np.testing.assert_allclose(float(_np(D.kl_divergence(pb, qb))), want,
+                               rtol=1e-3)
+    pu, qu = D.Uniform(0.0, 1.0), D.Uniform(0.0, 2.0)
+    np.testing.assert_allclose(float(_np(D.kl_divergence(pu, qu))),
+                               np.log(2.0), rtol=1e-5)
+
+
+def test_kl_dirichlet():
+    p = D.Dirichlet(np.array([2.0, 3.0], np.float32))
+    q = D.Dirichlet(np.array([4.0, 2.0], np.float32))
+    kl = float(_np(D.kl_divergence(p, q)))
+    # MC check on the simplex with a hand-rolled logpdf
+    from scipy.special import gammaln
+
+    def logpdf(x, a):
+        a = np.asarray(a, np.float64)
+        return (((a - 1) * np.log(x)).sum(-1)
+                - (gammaln(a).sum() - gammaln(a.sum())))
+
+    s = _np(p.sample((100_000,))).clip(1e-6, 1)
+    s = s / s.sum(-1, keepdims=True)
+    mc = np.mean(logpdf(s, [2, 3]) - logpdf(s, [4, 2]))
+    np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0., 1.), D.Beta(1., 1.))
+
+
+# ---- rsample differentiability --------------------------------------------
+
+def test_rsample_reparameterized_gradient():
+    loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    d = D.Normal(loc, scale)
+    s = d.rsample((256,))
+    loss = (s * s).mean()
+    loss.backward()
+    assert loc.grad is not None and scale.grad is not None
+    # d/dloc E[(loc+scale*eps)^2] = 2*loc
+    np.testing.assert_allclose(float(_np(loc.grad)), 2 * 0.5, atol=0.4)
+
+
+def test_log_prob_gradient_flows():
+    loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+    d = D.Normal(loc, 1.0)
+    lp = d.log_prob(np.float32(1.0))
+    lp.backward()
+    np.testing.assert_allclose(float(_np(loc.grad)), 1.0, atol=1e-5)
+
+
+# ---- transforms ------------------------------------------------------------
+
+def test_affine_exp_tanh_transforms_roundtrip():
+    x = np.linspace(-1.5, 1.5, 7).astype(np.float32)
+    for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+              D.TanhTransform(), D.SigmoidTransform()]:
+        y = _np(t.forward(x))
+        back = _np(t.inverse(y))
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_transform_log_det_matches_autodiff():
+    import jax
+    x = np.array([0.3, -0.7, 1.2], np.float32)
+    for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+              D.TanhTransform(), D.SigmoidTransform(),
+              D.PowerTransform(2.0)]:
+        xs = np.abs(x) + 0.5 if isinstance(t, D.PowerTransform) else x
+        ldj = _np(t.forward_log_det_jacobian(xs))
+        want = np.log(np.abs(np.array(
+            [jax.grad(lambda v: t._forward(v))(np.float32(v))
+             for v in xs])))
+        np.testing.assert_allclose(ldj, want, rtol=1e-4, atol=1e-5)
+
+
+def test_stickbreaking_simplex():
+    t = D.StickBreakingTransform()
+    x = np.array([0.2, -0.3, 0.5], np.float32)
+    y = _np(t.forward(x))
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_and_reshape():
+    chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+    x = np.array([0.1, 0.2], np.float32)
+    y = _np(chain.forward(x))
+    np.testing.assert_allclose(y, np.exp(2 * x), rtol=1e-5)
+    r = D.ReshapeTransform((2, 3), (6,))
+    z = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert _np(r.forward(z)).shape == (6,)
+    np.testing.assert_allclose(_np(r.inverse(_np(r.forward(z)))), z)
+
+
+def test_transformed_distribution_lognormal_equiv():
+    base = D.Normal(0.2, 0.7)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.2, 0.7)
+    for x in [0.5, 1.0, 2.5]:
+        np.testing.assert_allclose(float(_np(td.log_prob(x))),
+                                   float(_np(ln.log_prob(x))),
+                                   rtol=1e-4)
+    s = _np(td.sample((50_000,)))
+    np.testing.assert_allclose(s.mean(), float(_np(ln.mean)), rtol=0.1)
+
+
+def test_independent_log_prob_sums():
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(_np(ind.log_prob(x)),
+                               _np(base.log_prob(x)).sum(-1), rtol=1e-5)
+    kl = D.kl_divergence(
+        D.Independent(D.Normal(np.zeros(4, np.float32), 1.0), 1),
+        D.Independent(D.Normal(np.ones(4, np.float32), 1.0), 1))
+    np.testing.assert_allclose(float(_np(kl)), 4 * 0.5, rtol=1e-5)
+
+
+def test_transformed_distribution_differentiable():
+    loc = paddle.to_tensor(np.float32(0.3), stop_gradient=False)
+    td = D.TransformedDistribution(D.Normal(loc, 1.0), [D.ExpTransform()])
+    s = td.rsample((32,))
+    assert not s.stop_gradient
+    s.mean().backward()
+    assert loc.grad is not None
+    v = paddle.to_tensor(np.float32(1.7), stop_gradient=False)
+    lp = td.log_prob(v)
+    lp.backward()
+    assert v.grad is not None
+    # d/dv log p(v) for LogNormal(0.3, 1): -(log v - loc)/v - 1/v
+    want = -(np.log(1.7) - 0.3) / 1.7 - 1 / 1.7
+    np.testing.assert_allclose(float(_np(v.grad)), want, rtol=1e-4)
+
+
+def test_studentt_rsample_shape():
+    s = _np(D.StudentT(5.0, 0.0, 1.0).rsample((2000,)))
+    assert s.shape == (2000,)
+    np.testing.assert_allclose(s.mean(), 0.0, atol=0.15)
+
+
+def test_poisson_entropy_under_jit():
+    import jax
+    e = jax.jit(lambda r: D.Poisson(r).entropy().data)(
+        np.array([2.0, 5.0], np.float32))
+    np.testing.assert_allclose(np.asarray(e)[0],
+                               st.poisson(2.0).entropy(), rtol=1e-3)
+
+
+def test_multinomial_binomial_sample_counts():
+    d = D.Multinomial(20, np.array([0.5, 0.5], np.float32))
+    s = _np(d.sample((500,)))
+    assert s.shape == (500, 2)
+    np.testing.assert_allclose(s.sum(-1), 20.0)
+    np.testing.assert_allclose(s[:, 0].mean(), 10.0, atol=0.5)

@@ -80,3 +80,4 @@ def test_no_full_kv_gather_in_hlo(sep_mesh):
     # ring uses collective-permute; a gather implementation would emit
     # all-gather on the kv operands instead
     assert "collective-permute" in txt
+    assert "all-gather" not in txt
