@@ -32,10 +32,13 @@ class Beta(ExponentialFamily):
 
     def rsample(self, shape=()):
         shape = self._extend_shape(tuple(shape))
-        a = jnp.broadcast_to(_t(self.alpha), shape)
-        b = jnp.broadcast_to(_t(self.beta), shape)
-        return _op(lambda a_, b_: jax.random.beta(self._key(), a_, b_),
-                   a, b, op_name="beta_rsample")
+        key = self._key()
+
+        def impl(a, b):
+            # jax.random.beta is implicitly differentiable in (a, b)
+            return jax.random.beta(key, jnp.broadcast_to(a, shape),
+                                   jnp.broadcast_to(b, shape))
+        return _op(impl, self.alpha, self.beta, op_name="beta_rsample")
 
     def entropy(self):
         def impl(a, b):

@@ -67,9 +67,13 @@ class Gamma(ExponentialFamily):
 
     def rsample(self, shape=()):
         shape = self._extend_shape(tuple(shape))
-        a = jnp.broadcast_to(_t(self.concentration), shape)
-        return _op(lambda a_, r: jax.random.gamma(self._key(), a_) / r,
-                   a, self.rate, op_name="gamma_rsample")
+        key = self._key()
+
+        def impl(a, r):
+            # jax.random.gamma is implicitly differentiable in `a`
+            return jax.random.gamma(key, jnp.broadcast_to(a, shape)) / r
+        return _op(impl, self.concentration, self.rate,
+                   op_name="gamma_rsample")
 
     def entropy(self):
         def impl(a, r):
@@ -106,19 +110,19 @@ class Poisson(ExponentialFamily):
     rsample = sample
 
     def entropy(self):
-        """Exact via truncated support sum (matches upstream paddle's
-        enumeration approach for moderate rates)."""
+        """Exact truncated support sum for small rates; asymptotic
+        expansion H ≈ ½log(2πeλ) − 1/(12λ) − 1/(24λ²) for large rates.
+        Static shapes, so eager and jit agree (the r≤50 branch's mass
+        beyond 200 terms is < 1e-40)."""
         def impl(r):
-            try:
-                n = int(max(20, float(jnp.max(r)) * 3 + 20))
-            except Exception:
-                # traced rate (inside jit): static generous truncation so
-                # the support sum stays shape-static and compilable
-                n = 200
-            s = jnp.arange(0., n).reshape((-1,) + (1,) * r.ndim)
-            logp = s * jnp.log(r + 1e-30) - r - gammaln(s + 1)
+            rs = jnp.minimum(r, 50.0)  # keep the exact branch in range
+            s = jnp.arange(0., 200.).reshape((-1,) + (1,) * r.ndim)
+            logp = s * jnp.log(rs + 1e-30) - rs - gammaln(s + 1)
             p = jnp.exp(logp)
-            return -(p * logp).sum(0)
+            exact = -(p * logp).sum(0)
+            asym = (0.5 * jnp.log(2 * jnp.pi * jnp.e * r)
+                    - 1 / (12 * r) - 1 / (24 * r ** 2))
+            return jnp.where(r <= 50.0, exact, asym)
         return _op(impl, self.rate, op_name="poisson_entropy")
 
     def log_prob(self, value):
@@ -191,9 +195,12 @@ class StudentT(Distribution):
 
     def rsample(self, shape=()):
         shape = self._extend_shape(tuple(shape))
-        df = jnp.broadcast_to(_t(self.df), shape)
-        t = jax.random.t(self._key(), df, shape)
-        return _op(lambda l, s: l + s * t, self.loc, self.scale,
+        key = self._key()
+
+        def impl(df, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(df, shape), shape)
+            return l + s * t
+        return _op(impl, self.df, self.loc, self.scale,
                    op_name="studentt_rsample")
 
     def entropy(self):

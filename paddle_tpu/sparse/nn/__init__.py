@@ -1,0 +1,124 @@
+"""paddle.sparse.nn analog (ref: /root/reference/python/paddle/sparse/nn/
+__init__.py — ReLU/ReLU6/LeakyReLU/Softmax activations, BatchNorm,
+Conv3D/SubmConv3D, MaxPool3D).
+
+Activations/norms operate on the values array; the 3-D point-cloud convs
+and pooling use an explicit dense detour (XLA's dense conv is the fast
+path on TPU; the sparse formats are storage, not compute, here — see the
+package docstring)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from . import functional
+from .functional import attention  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of the values array
+    (ref sparse/nn/layer/norm.py — normalizes nonzero entries only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        from .. import _same_format
+        return _same_format(x, self._bn(x.values()))
+
+
+SyncBatchNorm = BatchNorm  # one-process TPU analog; GSPMD syncs stats
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv via dense detour (ref sparse/nn/layer/conv.py).
+    Input: SparseCooTensor [N, D, H, W, C]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from ...nn import Conv3D as DenseConv3D
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=weight_attr,
+                                 bias_attr=bias_attr,
+                                 data_format="NCDHW")
+
+    def forward(self, x):
+        from .. import _dense_to_coo
+        d = x.to_dense()  # [N, D, H, W, C]
+        from ...ops.manipulation import transpose as tp
+        y = self._conv(tp(d, [0, 4, 1, 2, 3]))
+        y = tp(y, [0, 2, 3, 4, 1])
+        return _dense_to_coo(y, 4)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold conv: output sparsity restricted to the input's active
+    sites (ref subm_conv3d semantics). Gathers the dense conv output at
+    the input's indices directly — no intermediate host-side sparsify."""
+
+    def forward(self, x):
+        from .. import SparseCooTensor, _op
+        from ...ops.manipulation import transpose as tp
+        y = self._conv(tp(x.to_dense(), [0, 4, 1, 2, 3]))
+        y = tp(y, [0, 2, 3, 4, 1])
+        idx = x._indices  # [4, nnz] over N,D,H,W
+        vals = _op(lambda d: d[tuple(idx)], y, op_name="subm_mask")
+        return SparseCooTensor(idx, vals, tuple(y.shape), True)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        from ...nn import MaxPool3D as DenseMaxPool3D
+        self._pool = DenseMaxPool3D(kernel_size, stride=stride,
+                                    padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        from .. import _dense_to_coo
+        from ...ops.manipulation import transpose as tp
+        d = x.to_dense()
+        y = self._pool(tp(d, [0, 4, 1, 2, 3]))
+        y = tp(y, [0, 2, 3, 4, 1])
+        return _dense_to_coo(y, 4)

@@ -1,0 +1,132 @@
+"""paddle.sparse.nn.functional analog (ref: /root/reference/python/paddle/
+sparse/nn/functional/__init__.py — relu/relu6/leaky_relu/softmax, conv3d/
+subm_conv3d, max_pool3d, attention)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from .. import _op
+
+
+def _vals_map(x, fn, op_name):
+    from .. import _same_format
+    return _same_format(x, _op(fn, x.values(), op_name=op_name))
+
+
+def relu(x, name=None):
+    return _vals_map(x, lambda v: jnp.maximum(v, 0), "sparse_relu")
+
+
+def relu6(x, name=None):
+    return _vals_map(x, lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _vals_map(
+        x, lambda v: jnp.where(v >= 0, v, negative_slope * v),
+        "sparse_leaky_relu")
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over each row's NONZERO entries (ref activation.py softmax:
+    only the stored values participate; zeros stay zero). CSR: per-row
+    segments; COO: per leading index group."""
+    from .. import SparseCooTensor, SparseCsrTensor, _same_format
+    if axis != -1:
+        raise ValueError("sparse softmax only supports axis=-1")
+    if isinstance(x, SparseCsrTensor):
+        rows = x._row_indices()
+        nrows = x.shape[0]
+    else:
+        coo = x.coalesce() if not x._coalesced else x
+        x = coo
+        rows = coo._flat_index() // coo.shape[-1]
+        nrows = 1
+        for d in coo.shape[:-1]:
+            nrows *= d
+
+    def impl(v):
+        vmax = jax.ops.segment_max(v, rows, num_segments=nrows)
+        e = jnp.exp(v - vmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+        return e / denom[rows]
+    return _same_format(x, _op(impl, x.values(), op_name="sparse_softmax"))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    from .. import _dense_to_coo
+    from ...nn import functional as F
+    from ...ops.manipulation import transpose as tp
+    d = tp(x.to_dense(), [0, 4, 1, 2, 3])
+    y = F.conv3d(d, weight, bias=bias, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups)
+    return _dense_to_coo(tp(y, [0, 2, 3, 4, 1]), 4)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    from .. import SparseCooTensor
+    from ...nn import functional as F
+    from ...ops.manipulation import transpose as tp
+    d = tp(x.to_dense(), [0, 4, 1, 2, 3])
+    y = F.conv3d(d, weight, bias=bias, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups)
+    y = tp(y, [0, 2, 3, 4, 1])
+    idx = x._indices
+    vals = _op(lambda dd: dd[tuple(idx)], y, op_name="subm_mask")
+    return SparseCooTensor(idx, vals, tuple(y.shape), True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    from .. import _dense_to_coo
+    from ...nn import functional as F
+    from ...ops.manipulation import transpose as tp
+    d = tp(x.to_dense(), [0, 4, 1, 2, 3])
+    y = F.max_pool3d(d, kernel_size, stride=stride, padding=padding,
+                     ceil_mode=ceil_mode)
+    return _dense_to_coo(tp(y, [0, 2, 3, 4, 1]), 4)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """softmax(QK^T/sqrt(d) restricted to sparse_mask's pattern) @ V
+    (ref transformer.py:22 — the CUDA path stores the attention matrix as
+    CSR; here SDDMM + sparse softmax + SpMM over the same pattern).
+
+    query/key/value: [B, H, T, D]; sparse_mask: SparseCsrTensor with
+    shape [B*H, T, T]-like 2-D blocks is simplified to a shared [T, T]
+    pattern (the reference requires the same layout per head)."""
+    from .. import SparseCsrTensor
+    q = query.data if isinstance(query, Tensor) else jnp.asarray(query)
+    B, H, T, D = q.shape
+    coo = sparse_mask.to_sparse_coo() if isinstance(
+        sparse_mask, SparseCsrTensor) else sparse_mask
+    rows, cols = coo._indices[-2], coo._indices[-1]
+    scale = 1.0 / math.sqrt(D)
+
+    def impl(q_, k_, v_, kpm, am):
+        scores = (q_[..., rows, :] * k_[..., cols, :]).sum(-1) * scale
+        if am is not None:
+            scores = scores + am[..., rows, cols]
+        if kpm is not None:
+            scores = scores + kpm[:, None, cols]
+        vmax = jax.ops.segment_max(
+            jnp.moveaxis(scores, -1, 0), rows, num_segments=T)
+        e = jnp.exp(jnp.moveaxis(scores, -1, 0) - vmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=T)
+        p = e / denom[rows]                      # [nnz, B, H]
+        pv = p[..., None] * jnp.moveaxis(v_, 2, 0)[cols]  # [nnz,B,H,D]
+        out = jax.ops.segment_sum(pv, rows, num_segments=T)
+        return jnp.moveaxis(out, 0, 2)
+
+    kpm = key_padding_mask.data if isinstance(key_padding_mask, Tensor) \
+        else key_padding_mask
+    am = attn_mask.data if isinstance(attn_mask, Tensor) else attn_mask
+    return _op(lambda q_, k_, v_: impl(q_, k_, v_, kpm, am),
+               query, key, value, op_name="sparse_attention")

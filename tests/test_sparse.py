@@ -1,0 +1,220 @@
+"""paddle.sparse parity tests (ref test model: test/legacy_test sparse op
+tests check against dense equivalents)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def _rand_coo(shape=(4, 5), density=0.4, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal(shape).astype(np.float32)
+    dense[rng.random(shape) > density] = 0.0
+    idx = np.stack(np.nonzero(dense), 0)
+    vals = dense[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, shape), dense
+
+
+def test_coo_create_roundtrip():
+    sp, dense = _rand_coo()
+    np.testing.assert_allclose(_np(sp.to_dense()), dense)
+    assert sp.nnz() == int((dense != 0).sum())
+    assert sp.is_sparse_coo() and not sp.is_sparse_csr()
+
+
+def test_coo_infer_shape():
+    sp = sparse.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]], [1., 2., 3.])
+    assert sp.shape == (3, 3)
+
+
+def test_coo_duplicate_indices_coalesce():
+    sp = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]], [1., 2., 3.],
+                                  (2, 2))
+    c = sp.coalesce()
+    assert c.nnz() == 2
+    np.testing.assert_allclose(_np(c.to_dense()),
+                               [[0., 3.], [3., 0.]])
+
+
+def test_csr_create_and_convert():
+    sp = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1], [1., 2., 3.],
+                                  (2, 3))
+    want = np.array([[1., 0., 2.], [0., 3., 0.]], np.float32)
+    np.testing.assert_allclose(_np(sp.to_dense()), want)
+    coo = sp.to_sparse_coo()
+    np.testing.assert_allclose(_np(coo.to_dense()), want)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(_np(back.crows()), [0, 2, 3])
+    np.testing.assert_allclose(_np(back.cols()), [0, 2, 1])
+
+
+def test_tensor_to_sparse_methods():
+    dense = paddle.to_tensor(
+        np.array([[1., 0.], [0., 2.]], np.float32))
+    coo = dense.to_sparse_coo(2)
+    assert coo.nnz() == 2
+    csr = dense.to_sparse_csr()
+    np.testing.assert_allclose(_np(csr.to_dense()), _np(dense))
+
+
+@pytest.mark.parametrize("name", ["sin", "tanh", "sqrt", "square", "log1p",
+                                  "abs", "neg", "expm1", "asinh", "atan"])
+def test_unary_matches_dense(name):
+    sp, dense = _rand_coo(seed=3)
+    if name in ("sqrt", "log1p"):
+        sp = sparse.abs(sp)
+        dense = np.abs(dense)
+    out = getattr(sparse, name)(sp)
+    fn = {"neg": lambda x: -x}.get(name, getattr(np, name, None))
+    want = np.where(dense != 0, fn(np.where(dense == 0, 1, dense)), 0)
+    np.testing.assert_allclose(_np(out.to_dense()), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_add_subtract_matmul_mv():
+    a, da = _rand_coo(seed=1)
+    b, db = _rand_coo(seed=2)
+    np.testing.assert_allclose(_np(sparse.add(a, b).to_dense()), da + db,
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(sparse.subtract(a, b).to_dense()),
+                               da - db, rtol=1e-5)
+    d = np.random.default_rng(5).standard_normal((5, 3)).astype(np.float32)
+    np.testing.assert_allclose(_np(sparse.matmul(a, paddle.to_tensor(d))),
+                               da @ d, rtol=1e-4, atol=1e-5)
+    v = d[:, 0]
+    np.testing.assert_allclose(_np(sparse.mv(a, paddle.to_tensor(v))),
+                               da @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_multiply_divide():
+    a, da = _rand_coo(seed=1)
+    b, db = _rand_coo(seed=2)
+    np.testing.assert_allclose(_np(sparse.multiply(a, b).to_dense()),
+                               da * db, rtol=1e-5)
+    got = _np(sparse.divide(a, b).to_dense())
+    want = np.where(db != 0, da / np.where(db == 0, 1, db), 0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = rng.standard_normal((6, 4)).astype(np.float32)
+    mask, dm = _rand_coo((4, 4), seed=4)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    want = np.where(dm != 0, x @ y, 0)
+    np.testing.assert_allclose(_np(out.to_dense()), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_addmm():
+    a, da = _rand_coo((4, 5), seed=1)
+    rng = np.random.default_rng(0)
+    y = rng.standard_normal((5, 3)).astype(np.float32)
+    inp = rng.standard_normal((4, 3)).astype(np.float32)
+    out = sparse.addmm(paddle.to_tensor(inp), a, paddle.to_tensor(y),
+                       beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(_np(out), 0.5 * inp + 2.0 * (da @ y),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transpose_reshape_sum():
+    sp, dense = _rand_coo((3, 4), seed=7)
+    np.testing.assert_allclose(_np(sparse.transpose(sp, [1, 0]).to_dense()),
+                               dense.T)
+    np.testing.assert_allclose(_np(sparse.reshape(sp, (4, 3)).to_dense()),
+                               dense.reshape(4, 3))
+    np.testing.assert_allclose(float(_np(sparse.sum(sp))), dense.sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(sparse.sum(sp, axis=0)), dense.sum(0),
+                               rtol=1e-5)
+    assert sparse.is_same_shape(sp, sp)
+
+
+def test_csr_matmul():
+    sp, dense = _rand_coo((4, 5), seed=9)
+    csr = sp.to_sparse_csr()
+    d = np.random.default_rng(1).standard_normal((5, 2)).astype(np.float32)
+    np.testing.assert_allclose(_np(sparse.matmul(csr, paddle.to_tensor(d))),
+                               dense @ d, rtol=1e-4, atol=1e-5)
+
+
+def test_matmul_gradient_flows():
+    sp, dense = _rand_coo((3, 4), seed=11)
+    vals = paddle.to_tensor(_np(sp.values()), stop_gradient=False)
+    sp2 = sparse.sparse_coo_tensor(_np(sp.indices()), vals, (3, 4))
+    d = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((4, 2)).astype(np.float32),
+        stop_gradient=False)
+    out = sparse.matmul(sp2, d)
+    out.sum().backward()
+    assert vals.grad is not None and d.grad is not None
+    # d(loss)/d(dense) = sum over rows of sparse: A^T @ ones
+    np.testing.assert_allclose(_np(d.grad), dense.T @ np.ones((3, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_nn_activations():
+    sp, dense = _rand_coo(seed=13)
+    out = sparse.nn.functional.relu(sp)
+    np.testing.assert_allclose(_np(out.to_dense()), np.maximum(dense, 0))
+    lr = sparse.nn.LeakyReLU(0.1)(sp)
+    np.testing.assert_allclose(
+        _np(lr.to_dense()), np.where(dense >= 0, dense, 0.1 * dense),
+        rtol=1e-5)
+
+
+def test_csr_softmax_rows():
+    sp, dense = _rand_coo((4, 6), seed=15)
+    csr = sp.to_sparse_csr()
+    out = sparse.nn.functional.softmax(csr)
+    got = _np(out.to_dense())
+    for i in range(4):
+        nz = dense[i] != 0
+        if nz.sum() == 0:
+            continue
+        e = np.exp(dense[i][nz] - dense[i][nz].max())
+        np.testing.assert_allclose(got[i][nz], e / e.sum(), rtol=1e-5)
+    assert (got[dense == 0] == 0).all()
+
+
+def test_sparse_conv3d_and_pool():
+    rng = np.random.default_rng(0)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    pts = rng.integers(0, 4, (5, 3))
+    for p in pts:
+        dense[0, p[0], p[1], p[2]] = rng.standard_normal(2)
+    x = paddle.to_tensor(dense).to_sparse_coo(4)
+    conv = sparse.nn.Conv3D(2, 3, 3, padding=1)
+    y = conv(x)
+    assert y.shape == (1, 4, 4, 4, 3)
+    sub = sparse.nn.SubmConv3D(2, 3, 3, padding=1)
+    ys = sub(x)
+    # submanifold: output active sites == input active sites
+    assert ys.nnz() == x.nnz()
+    pool = sparse.nn.MaxPool3D(2, stride=2)
+    yp = pool(x)
+    assert yp.shape == (1, 2, 2, 2, 2)
+
+
+def test_sparse_attention():
+    rng = np.random.default_rng(0)
+    B, H, T, D = 1, 2, 4, 8
+    q, k, v = (rng.standard_normal((B, H, T, D)).astype(np.float32)
+               for _ in range(3))
+    # full mask -> must equal dense softmax attention
+    mask = paddle.to_tensor(np.ones((T, T), np.float32)).to_sparse_csr()
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        mask)
+    scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = p @ v
+    np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
