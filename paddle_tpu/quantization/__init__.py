@@ -1,0 +1,33 @@
+"""paddle.quantization analog (ref: /root/reference/python/paddle/
+quantization/__init__.py — QuantConfig/BaseQuanter/BaseObserver/quanter/
+QAT/PTQ; imperative quantizers in quantization/imperative/ptq_quantizer.py;
+static PTQ in /root/reference/python/paddle/static/quantization/
+post_training_quantization.py).
+
+TPU-native stance: int8 matmul lowers to lax.dot_general with int32
+accumulation (the MXU's native int8 path); fake-quant for QAT is a
+straight-through estimator, which is jit-fusable; observers are plain
+Layers collecting calibration stats on forward.
+"""
+from .base import BaseObserver, BaseQuanter, QuanterFactory, quanter
+from .config import QuantConfig, SingleLayerConfig
+from .observers import (AbsmaxObserver, AbsmaxQuantizer, HistObserver,
+                        HistQuantizer, KLObserver, KLQuantizer,
+                        MinMaxObserver, PerChannelAbsmaxObserver,
+                        PerChannelAbsmaxQuantizer)
+from .functional import (dequantize, fake_quant, quantize,
+                         quantized_matmul)
+from .qat import QAT, QuantedConv2D, QuantedLinear
+from .ptq import PTQ, ObservedLayer, QuantizedConv2D, QuantizedLinear
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "BaseQuanter", "BaseObserver",
+    "quanter", "QuanterFactory", "QAT", "PTQ",
+    "AbsmaxObserver", "PerChannelAbsmaxObserver", "MinMaxObserver",
+    "HistObserver", "KLObserver",
+    "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
+    "KLQuantizer",
+    "quantize", "dequantize", "fake_quant", "quantized_matmul",
+    "QuantedLinear", "QuantedConv2D", "QuantizedLinear", "QuantizedConv2D",
+    "ObservedLayer",
+]

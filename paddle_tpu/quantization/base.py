@@ -1,0 +1,67 @@
+"""Observer/quanter bases + factory (ref: /root/reference/python/paddle/
+quantization/base_observer.py, base_quanter.py, factory.py)."""
+from __future__ import annotations
+
+import abc
+
+from ..nn.layer.layers import Layer
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Built into QAT-quantized layers: simulates quantization on forward
+    (ref base_quanter.py)."""
+
+    @abc.abstractmethod
+    def forward(self, input):
+        ...
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    def bit_length(self):
+        return getattr(self, "_bits", 8)
+
+    def quant_axis(self):
+        return getattr(self, "_axis", None)
+
+    def zero_points(self):
+        return None  # symmetric quantization
+
+
+class BaseObserver(BaseQuanter, metaclass=abc.ABCMeta):
+    """Collects calibration statistics during PTQ (ref base_observer.py).
+    cal_thresholds() finalizes the statistic into a threshold/scale."""
+
+    def cal_thresholds(self):
+        pass
+
+
+class QuanterFactory:
+    """Partially-applied quanter constructor, bindable in a QuantConfig
+    (ref factory.py:QuanterFactory / ObserverFactory)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+    def __call__(self):
+        return self._instance()
+
+
+def quanter(class_name):
+    """Class decorator: registers a BaseQuanter subclass and replaces it
+    with a factory of the given name (ref factory.py:quanter). Returns the
+    class; the factory is installed in this module's globals."""
+    def deco(cls):
+        def factory(*args, **kwargs):
+            return QuanterFactory(cls, *args, **kwargs)
+        factory.__name__ = class_name
+        import sys
+        setattr(sys.modules[cls.__module__], class_name, factory)
+        return cls
+    return deco

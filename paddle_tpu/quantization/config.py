@@ -1,0 +1,85 @@
+"""QuantConfig (ref: /root/reference/python/paddle/quantization/config.py
+— per-layer / per-name / per-type quanter bindings with that priority)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn.layer.layers import Layer
+from .base import QuanterFactory
+
+
+class SingleLayerConfig:
+    """ref config.py:35."""
+
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    """ref config.py:60. Priority: layer > name > type > global."""
+
+    def __init__(self, activation: Optional[QuanterFactory] = None,
+                 weight: Optional[QuanterFactory] = None):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_configs = []   # (layer_obj, cfg)
+        self._name_configs = {}    # name -> cfg
+        self._type_configs = {}    # type -> cfg
+        self._qat_layer_mapping = {}
+        self._customized_leaves = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._name_configs[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            assert isinstance(t, type) and issubclass(t, Layer)
+            self._type_configs[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source: type, target: type):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type: type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    def _get_config_by_layer(self, layer, name=None) -> SingleLayerConfig:
+        for l, cfg in self._layer_configs:
+            if l is layer:
+                return cfg
+        if name is not None and name in self._name_configs:
+            return self._name_configs[name]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global
+
+    def _need_quant(self, layer, name=None):
+        cfg = self._get_config_by_layer(layer, name)
+        return cfg.activation is not None or cfg.weight is not None

@@ -1,0 +1,157 @@
+"""Calibration observers (ref: /root/reference/python/paddle/quantization/
+imperative/ptq_quantizer.py — AbsmaxQuantizer:141, PerChannelAbsmaxQuantizer,
+KLQuantizer:219, HistQuantizer; and the static PTQ algos in
+static/quantization/post_training_quantization.py: abs_max, avg, hist, KLD,
+mse)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .base import BaseObserver
+
+
+def _data(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (ref AbsmaxQuantizer:141)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        self._max = max(self._max, float(jnp.max(jnp.abs(
+            x.data if isinstance(x, Tensor) else x))))
+        return x
+
+    def scales(self):
+        return self._max if self._max > 0 else 1e-8
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel abs-max over the given axis (weights)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__()
+        self._bits = quant_bits
+        self._axis = quant_axis
+        self._max = None
+
+    def forward(self, x):
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(a.ndim)
+                     if i != (self._axis % a.ndim))
+        m = jnp.max(jnp.abs(a), axis=axes)
+        self._max = m if self._max is None else jnp.maximum(self._max, m)
+        return x
+
+    def scales(self):
+        if self._max is None:
+            return 1e-8
+        return jnp.maximum(self._max, 1e-8)
+
+
+class MinMaxObserver(BaseObserver):
+    """EMA of batch abs-max ('avg' algo in static PTQ)."""
+
+    def __init__(self, quant_bits=8, momentum=0.9):
+        super().__init__()
+        self._bits = quant_bits
+        self._m = momentum
+        self._ema = None
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x.data if isinstance(x, Tensor) else x)))
+        self._ema = m if self._ema is None else \
+            self._m * self._ema + (1 - self._m) * m
+        return x
+
+    def scales(self):
+        return self._ema if self._ema else 1e-8
+
+
+class HistObserver(BaseObserver):
+    """Histogram percentile threshold (ref HistQuantizer — hist_percent)."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__()
+        self._bits = quant_bits
+        self._bins = bins
+        self._percent = percent
+        self._hist = None
+        self._edges = None
+
+    def forward(self, x):
+        a = np.abs(_data(x)).ravel()
+        top = a.max() if a.size else 1.0
+        if self._hist is None:
+            self._edges = np.linspace(0, max(top, 1e-8), self._bins + 1)
+            self._hist = np.histogram(a, self._edges)[0].astype(np.float64)
+        else:
+            if top > self._edges[-1]:
+                # grow the range, rebin the old histogram
+                new_edges = np.linspace(0, top, self._bins + 1)
+                centers = (self._edges[:-1] + self._edges[1:]) / 2
+                moved = np.histogram(centers, new_edges,
+                                     weights=self._hist)[0]
+                self._hist, self._edges = moved, new_edges
+            self._hist += np.histogram(a, self._edges)[0]
+        return x
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):
+        if self._hist is None:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / max(self._hist.sum(), 1)
+        idx = int(np.searchsorted(cdf, self._percent))
+        idx = min(idx, self._bins - 1)
+        return float(self._edges[idx + 1])
+
+
+class KLObserver(HistObserver):
+    """KL-divergence threshold selection (ref KLQuantizer:219 /
+    cal_kl_threshold in static PTQ): pick the clip that minimizes
+    KL(P_hist || Q_quantized)."""
+
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+
+    def scales(self):
+        if self._hist is None:
+            return 1e-8
+        hist = self._hist / max(self._hist.sum(), 1)
+        levels = 2 ** (self._bits - 1)  # 128 for int8
+        best, best_kl = self._bins - 1, np.inf
+        for i in range(levels, self._bins + 1, max(1, self._bins // 128)):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()  # clip tail mass into last bin
+            # quantize the first i bins to `levels` buckets
+            factor = i / levels
+            q = np.zeros(i)
+            for b in range(levels):
+                lo, hi = int(b * factor), max(int((b + 1) * factor),
+                                              int(b * factor) + 1)
+                mass = p[lo:hi].sum()
+                nz = (p[lo:hi] > 0).sum()
+                if nz:
+                    q[lo:hi] = np.where(p[lo:hi] > 0, mass / nz, 0)
+            mask = p > 0
+            kl = np.sum(p[mask] * np.log(p[mask] /
+                                         np.maximum(q[mask], 1e-12)))
+            if kl < best_kl:
+                best_kl, best = kl, i
+        return float(self._edges[best])
+
+
+# paddle-2.x imperative aliases (ref ptq_quantizer.py class names)
+AbsmaxQuantizer = AbsmaxObserver
+PerChannelAbsmaxQuantizer = PerChannelAbsmaxObserver
+HistQuantizer = HistObserver
+KLQuantizer = KLObserver

@@ -1,0 +1,147 @@
+"""Post-training quantization (ref: /root/reference/python/paddle/
+quantization/ptq.py:24 — PTQ.quantize wraps layers with observers;
+convert() freezes observed scales into quantized inference layers. The
+heavyweight static-graph pipeline is post_training_quantization.py; here
+calibration runs eagerly and the frozen model jits)."""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import nn as pnn
+from .config import QuantConfig
+from .functional import quantized_matmul, quantize
+from .observers import AbsmaxObserver, PerChannelAbsmaxObserver
+
+
+class ObservedLayer(Layer):
+    """Pass-through wrapper feeding the activation observer during
+    calibration."""
+
+    def __init__(self, layer, act_observer, wt_observer):
+        super().__init__()
+        self._inner = layer
+        self._act = act_observer
+        self._wt = wt_observer
+        if self._wt is not None:
+            self._wt(layer.weight)  # weights are static: observe once
+
+    def forward(self, *args, **kwargs):
+        if self._act is not None and args:
+            self._act(args[0])
+        return self._inner(*args, **kwargs)
+
+
+class QuantizedLinear(Layer):
+    """Inference linear over int8 weights (weight-only by default; feeds
+    the int8 x int8 MXU path when an activation scale was calibrated)."""
+
+    def __init__(self, linear, wt_scale, act_scale=None, bits=8):
+        super().__init__()
+        self._bits = bits
+        self._wt_scale = jnp.asarray(wt_scale, jnp.float32)
+        self._act_scale = None if act_scale is None else float(act_scale)
+        w = linear.weight
+        axis = -1 if jnp.ndim(self._wt_scale) else None
+        self.weight_int8 = quantize(w, self._wt_scale, bits=bits,
+                                    axis=axis)
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        out = quantized_matmul(x, self.weight_int8, self._wt_scale,
+                               x_scale=self._act_scale, bits=self._bits)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, conv, wt_scale, act_scale=None, bits=8, axis=0):
+        super().__init__()
+        self._bits = bits
+        self._conv = conv
+        self._wt_scale = jnp.asarray(wt_scale, jnp.float32)
+        self._act_scale = None if act_scale is None else float(act_scale)
+        self._axis = axis if jnp.ndim(self._wt_scale) else None
+        self.weight_int8 = quantize(conv.weight, self._wt_scale, bits=bits,
+                                    axis=self._axis)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        from .functional import dequantize, fake_quant
+        if self._act_scale is not None:
+            # snap activations onto the calibrated int8 grid so the
+            # conv sees exactly the quantization error calibration
+            # measured (XLA has no int8 conv; the grid is the contract)
+            x = fake_quant(x, self._act_scale, bits=self._bits)
+        w = dequantize(self.weight_int8, self._wt_scale, bits=self._bits,
+                       axis=self._axis)
+        return F.conv2d(x, w, bias=getattr(self._conv, "bias", None),
+                        stride=self._conv._stride,
+                        padding=self._conv._padding,
+                        dilation=self._conv._dilation,
+                        groups=self._conv._groups)
+
+
+class PTQ:
+    """ref ptq.py:24."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        """Insert observers. Run calibration batches through the returned
+        model, then call convert()."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._insert(model)
+        return model
+
+    def _insert(self, layer: Layer):
+        for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, (pnn.Linear, pnn.Conv2D)) and \
+                    self._config._need_quant(child, name):
+                cfg = self._config._get_config_by_layer(child, name)
+                act = cfg.activation() if cfg.activation is not None \
+                    else None
+                wt = cfg.weight() if cfg.weight is not None else \
+                    PerChannelAbsmaxObserver(
+                        quant_axis=-1 if isinstance(child, pnn.Linear)
+                        else 0)
+                layer._sub_layers[name] = ObservedLayer(child, act, wt)
+                setattr(layer, name, layer._sub_layers[name])
+            else:
+                self._insert(child)
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        _finalize_quantized(model)
+        return model
+
+
+def _finalize_quantized(layer: Layer):
+    from .qat import _FakeQuantWrapper
+    for name, child in list(layer._sub_layers.items()):
+        if isinstance(child, (ObservedLayer, _FakeQuantWrapper)):
+            inner = child._inner
+            wt_scale = child._wt.scales() if child._wt is not None else \
+                float(jnp.max(jnp.abs(inner.weight.data)))
+            act_scale = child._act.scales() if child._act is not None \
+                else None
+            if isinstance(inner, pnn.Linear):
+                q = QuantizedLinear(inner, wt_scale, act_scale)
+            elif isinstance(inner, pnn.Conv2D):
+                axis = child._wt.quant_axis() if child._wt is not None \
+                    else 0
+                q = QuantizedConv2D(inner, wt_scale, act_scale,
+                                    axis=axis if axis is not None else 0)
+            else:
+                continue
+            layer._sub_layers[name] = q
+            setattr(layer, name, q)
+        else:
+            _finalize_quantized(child)

@@ -1,0 +1,118 @@
+"""Quantization-aware training (ref: /root/reference/python/paddle/
+quantization/qat.py:23 QAT.quantize replaces quantizable layers with
+fake-quant wrappers; quanted layer zoo in nn/quant/qat/)."""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from .. import nn as pnn
+from .config import QuantConfig
+from .functional import fake_quant
+from .observers import AbsmaxObserver, PerChannelAbsmaxObserver
+
+
+class _FakeQuantWrapper(Layer):
+    """Holds observers that double as fake quanters during training."""
+
+    def __init__(self, layer, act_observer, wt_observer):
+        super().__init__()
+        self._inner = layer
+        self._act = act_observer
+        self._wt = wt_observer
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+
+class QuantedLinear(_FakeQuantWrapper):
+    """Linear with fake-quantized activations + weights (STE backward)."""
+
+    def forward(self, x):
+        if self._act is not None:
+            self._act(x)
+            x = fake_quant(x, self._act.scales(),
+                           bits=self._act.bit_length())
+        w = self._inner.weight
+        if self._wt is not None:
+            self._wt(w)
+            w = fake_quant(w, self._wt.scales(),
+                           bits=self._wt.bit_length(),
+                           axis=self._wt.quant_axis())
+        out = x @ w
+        if getattr(self._inner, "bias", None) is not None:
+            out = out + self._inner.bias
+        return out
+
+
+class QuantedConv2D(_FakeQuantWrapper):
+    def forward(self, x):
+        from ..nn import functional as F
+        if self._act is not None:
+            self._act(x)
+            x = fake_quant(x, self._act.scales(),
+                           bits=self._act.bit_length())
+        w = self._inner.weight
+        if self._wt is not None:
+            self._wt(w)
+            w = fake_quant(w, self._wt.scales(),
+                           bits=self._wt.bit_length(),
+                           axis=self._wt.quant_axis())
+        return F.conv2d(x, w, bias=getattr(self._inner, "bias", None),
+                        stride=self._inner._stride,
+                        padding=self._inner._padding,
+                        dilation=self._inner._dilation,
+                        groups=self._inner._groups)
+
+
+_DEFAULT_QAT_MAPPING = {pnn.Linear: QuantedLinear,
+                        pnn.Conv2D: QuantedConv2D}
+
+
+class QAT:
+    """ref qat.py:23."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: Layer):
+        mapping = dict(_DEFAULT_QAT_MAPPING)
+        mapping.update(self._config._qat_layer_mapping)
+        for name, child in list(layer._sub_layers.items()):
+            target = None
+            for src, tgt in mapping.items():
+                if type(child) is src:
+                    target = tgt
+                    break
+            if target is not None and self._config._need_quant(child, name):
+                cfg = self._config._get_config_by_layer(child, name)
+                act = cfg.activation() if cfg.activation is not None \
+                    else None
+                # weights are ALWAYS fake-quantized in QAT (convert()
+                # freezes them to int8, so training must see the same
+                # grid — an activation-only config would otherwise be a
+                # train/infer mismatch)
+                wt = cfg.weight() if cfg.weight is not None else \
+                    PerChannelAbsmaxObserver(
+                        quant_axis=-1 if target is QuantedLinear else 0)
+                layer._sub_layers[name] = target(child, act, wt)
+                setattr(layer, name, layer._sub_layers[name])
+            else:
+                self._convert(child)
+
+    def convert(self, model: Layer, inplace=False):
+        """Strip fake-quant wrappers into real int8 inference layers."""
+        from .ptq import _finalize_quantized
+        if not inplace:
+            model = copy.deepcopy(model)
+        _finalize_quantized(model)
+        return model

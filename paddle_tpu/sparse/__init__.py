@@ -356,8 +356,8 @@ def matmul(x, y, name=None):
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         dense = matmul(x, y.to_dense())
         return _dense_to_coo(dense, 2)
-    coo = _coo_of(x).coalesce() if not getattr(x, "_coalesced", True) \
-        else _coo_of(x)
+    # no coalesce needed: the scatter-add below sums duplicate indices
+    coo = _coo_of(x)
     if coo.sparse_dim != 2 or coo.dense_dim != 0:
         raise ValueError("sparse matmul supports 2-D sparse operands")
     rows, cols = coo._indices[0], coo._indices[1]

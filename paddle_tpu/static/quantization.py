@@ -1,0 +1,91 @@
+"""paddle.static.quantization analog (ref: /root/reference/python/paddle/
+static/quantization/post_training_quantization.py — the offline PTQ
+pipeline: feed calibration data, collect per-tensor thresholds by
+algo {abs_max, avg, hist, KL}, emit a quantized inference model).
+
+TPU-native shape: calibration runs the dygraph model eagerly (no separate
+static program needed — jit IS the static mode); the result is a model of
+QuantizedLinear/QuantizedConv2D layers whose int8 weights + scales ride
+inside a single jitted program.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from ..quantization import (AbsmaxObserver, HistObserver, KLObserver,
+                            MinMaxObserver, PTQ, QuantConfig)
+from ..quantization.base import QuanterFactory
+
+_ALGO = {
+    "abs_max": AbsmaxObserver,
+    "avg": MinMaxObserver,
+    "hist": HistObserver,
+    "KL": KLObserver,
+    "mse": HistObserver,  # percentile search stands in for mse sweep
+}
+
+
+class PostTrainingQuantization:
+    """ref post_training_quantization.py:116 (class of the same name).
+
+    Args mirror the reference's: a model (here: Layer, not a saved
+    program), a sample/data loader, batch counts and the threshold algo.
+    """
+
+    def __init__(self, model: Layer = None, data_loader=None,
+                 batch_nums=10, algo="KL", quant_bits=8,
+                 executor=None, model_dir=None, **kwargs):
+        if model is None:
+            raise ValueError(
+                "pass the Layer to quantize (the reference's saved-program "
+                "path maps to paddle_tpu.jit.load + this class)")
+        if algo not in _ALGO:
+            raise ValueError(f"algo must be one of {sorted(_ALGO)}")
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._bits = quant_bits
+        obs = _ALGO[algo]
+        self._ptq = PTQ(QuantConfig(
+            activation=QuanterFactory(obs, quant_bits=quant_bits),
+            weight=None))
+
+    def quantize(self):
+        observed = self._ptq.quantize(self._model, inplace=False)
+        if self._loader is not None:
+            for i, batch in enumerate(self._loader):
+                if i >= self._batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                observed(x)
+        return self._ptq.convert(observed, inplace=True)
+
+    def save_quantized_model(self, save_model_path, model=None,
+                             input_spec=None):
+        from .. import jit
+        jit.save(model if model is not None else self.quantize(),
+                 save_model_path, input_spec=input_spec)
+        return save_model_path
+
+
+class WeightOnlyInt8Quantization:
+    """Weight-only int8 (no activation calibration) — the dominant TPU
+    serving mode."""
+
+    def __init__(self, model: Layer, quant_bits=8):
+        from .. import nn as pnn
+        from ..quantization import PerChannelAbsmaxObserver
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(
+            pnn.Linear, weight=QuanterFactory(
+                PerChannelAbsmaxObserver, quant_bits=quant_bits,
+                quant_axis=-1))
+        cfg.add_type_config(
+            pnn.Conv2D, weight=QuanterFactory(
+                PerChannelAbsmaxObserver, quant_bits=quant_bits,
+                quant_axis=0))
+        self._ptq = PTQ(cfg)
+        self._model = model
+
+    def quantize(self):
+        observed = self._ptq.quantize(self._model, inplace=False)
+        return self._ptq.convert(observed, inplace=True)
