@@ -153,8 +153,10 @@ class HybridParallelOptimizer:
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    return HybridParallelOptimizer(optimizer, _fleet.hcg,
-                                   strategy or _fleet.strategy)
+    strategy = strategy or _fleet.strategy
+    from .meta_optimizers import apply_meta_optimizers
+    optimizer = apply_meta_optimizers(optimizer, strategy)
+    return HybridParallelOptimizer(optimizer, _fleet.hcg, strategy)
 
 
 class UserDefinedRoleMaker:

@@ -1,0 +1,38 @@
+"""Fleet meta-optimizers (ref: /root/reference/python/paddle/distributed/
+fleet/meta_optimizers/ — strategy-pattern optimizer rewrites composed via
+DistributedStrategy flags: gradient_merge_optimizer.py, lars_optimizer.py,
+dgc_optimizer.py, localsgd_optimizer.py).
+
+The reference rewrites static-graph programs; here each meta-optimizer is
+a wrapper (or optimizer subclass) applied by fleet.distributed_optimizer
+when the matching strategy flag is on — the compiled step stays one XLA
+program."""
+from .gradient_merge import GradientMergeOptimizer
+from .lars import LarsMomentum, LarsOptimizer
+from .dgc import DGCMomentum, DGCOptimizer
+from .localsgd import LocalSGDOptimizer
+
+__all__ = ["GradientMergeOptimizer", "LarsMomentum", "LarsOptimizer",
+           "DGCMomentum", "DGCOptimizer", "LocalSGDOptimizer"]
+
+
+def apply_meta_optimizers(optimizer, strategy):
+    """Compose wrappers per strategy flags (the reference's
+    _choose_meta_optimizer ordering: dgc/lars replace the rule, then
+    gradient-merge and localsgd wrap the schedule)."""
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "lars", False):
+        optimizer = LarsOptimizer(optimizer, strategy).target_optimizer()
+    if getattr(strategy, "dgc", False):
+        optimizer = DGCOptimizer(optimizer, strategy).target_optimizer()
+    if getattr(strategy, "gradient_merge", False):
+        cfg = strategy.gradient_merge_configs
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
+    if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", {})
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1) if cfg else 1)
+    return optimizer

@@ -1,0 +1,44 @@
+"""Gradient merge (ref: /root/reference/python/paddle/distributed/fleet/
+meta_optimizers/gradient_merge_optimizer.py — accumulate grads for k
+steps, apply once).
+
+TPU-native: the tape already accumulates into param.grad across
+backward() calls, so merging = deferring step()/clear_grad() to the k-th
+call (and scaling by 1/k for avg) — no extra buffers, no graph rewrite."""
+from __future__ import annotations
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def _is_boundary(self):
+        return self._count % self.k_steps == 0
+
+    def step(self):
+        self._count += 1
+        if not self._is_boundary():
+            return  # keep accumulating into p.grad
+        if self.avg and self.k_steps > 1:
+            for p in self._inner_opt._parameter_list_flat():
+                if p.grad is not None:
+                    p.grad.set_value(p.grad * (1.0 / self.k_steps))
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        if self._is_boundary():
+            self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

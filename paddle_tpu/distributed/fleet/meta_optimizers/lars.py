@@ -1,0 +1,75 @@
+"""LARS (ref: /root/reference/python/paddle/distributed/fleet/
+meta_optimizers/lars_optimizer.py — swaps Momentum for lars_momentum,
+paddle/phi/kernels/gpu/lars_momentum_kernel.cu for the rule)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Momentum
+
+
+class LarsMomentum(Momentum):
+    """Layer-wise Adaptive Rate Scaling momentum:
+    local_lr = lr * coeff * ||w|| / (||g|| + wd * ||w|| + eps)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, exclude_from_weight_decay=None,
+                 epsilon=1e-9, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         grad_clip=grad_clip,
+                         multi_precision=multi_precision, name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = exclude_from_weight_decay or []
+        self._eps = epsilon
+
+    def _wd_mode(self):
+        return "internal"  # the rule consumes weight decay itself
+
+    def _wd_for_param(self, p):
+        name = getattr(p, "name", "") or ""
+        if any(tag in name for tag in self._exclude):
+            return 0.0
+        return self._lars_wd
+
+    def _update(self, p, g, state, lr, step, param_lr=1.0, wd=0.0):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.sqrt((p32 * p32).sum())
+        g_norm = jnp.sqrt((g32 * g32).sum())
+        trust = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm / (g_norm + wd * p_norm + self._eps),
+            1.0)
+        local_lr = lr * param_lr * trust
+        v = self._momentum * state["velocity"] + local_lr * (g32 + wd * p32)
+        new_p = (p32 - v).astype(p.dtype)
+        return new_p, {"velocity": v}
+
+
+class LarsOptimizer:
+    """Meta-optimizer shell (ref lars_optimizer.py): converts a user
+    Momentum into LarsMomentum, inheriting its hyperparameters."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._user_opt = optimizer
+        self._cfg = getattr(strategy, "lars_configs", None) or {}
+
+    def target_optimizer(self):
+        opt = self._user_opt
+        if isinstance(opt, LarsMomentum):
+            return opt
+        if not isinstance(opt, Momentum):
+            return opt  # reference also falls through for non-Momentum
+        lars = LarsMomentum(
+            learning_rate=opt._lr, momentum=opt._momentum,
+            parameters=opt._parameter_list,
+            lars_coeff=self._cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=self._cfg.get("lars_weight_decay", 0.0005),
+            exclude_from_weight_decay=self._cfg.get(
+                "exclude_from_weight_decay", None),
+            epsilon=self._cfg.get("epsilon", 1e-9))
+        lars._grad_clip = opt._grad_clip
+        return lars

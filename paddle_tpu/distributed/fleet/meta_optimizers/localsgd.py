@@ -1,0 +1,47 @@
+"""Local SGD (ref: /root/reference/python/paddle/distributed/fleet/
+meta_optimizers/localsgd_optimizer.py — workers step locally, parameters
+are averaged across the data-parallel group every k steps).
+
+Single-controller GSPMD keeps parameters globally consistent, so the
+averaging is a real collective only under multi-process launch
+(jax.process_count() > 1 with per-process param copies); otherwise the
+wrapper preserves the schedule/API and the average is the identity."""
+from __future__ import annotations
+
+
+class LocalSGDOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
+        self._inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._begin = int(begin_step)
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+        self._count += 1
+        if self._count >= self._begin and self._count % self.k_steps == 0:
+            self._average_params()
+
+    def _average_params(self):
+        import jax
+        if jax.process_count() <= 1:
+            return  # params already globally consistent under GSPMD
+        from ...communication import all_reduce
+        n = jax.process_count()
+        for p in self._inner_opt._parameter_list_flat():
+            all_reduce(p)
+            p.set_value(p / n)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

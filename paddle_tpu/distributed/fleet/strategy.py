@@ -60,8 +60,15 @@ class DistributedStrategy:
                                                tensor_init_seed=-1)
         self.lamb = False
         self.lars = False
+        self.lars_configs = _Config(lars_coeff=0.001,
+                                    lars_weight_decay=0.0005,
+                                    epsilon=1e-9,
+                                    exclude_from_weight_decay=[])
         self.dgc = False
+        self.dgc_configs = _Config(rampup_begin_step=0, rampup_step=1,
+                                   sparsity=[0.999])
         self.localsgd = False
+        self.localsgd_configs = _Config(k_steps=1, begin_step=1)
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

@@ -1,0 +1,52 @@
+"""Parameter-server API stubs (ref: /root/reference/python/paddle/
+distributed/ps/the_one_ps.py + paddle/fluid/distributed/ps/ — the brpc
+PS, HeterPS and BoxPS stacks).
+
+DESCOPED BY DESIGN (SURVEY.md §7): the brpc/GPU parameter server is a
+CUDA-cluster-specific serving of huge sparse embeddings; the TPU-native
+counterpart is sharded embeddings over the mesh (mp/sharding axes) with
+XLA all-to-all — see fleet.layers.mpu.VocabParallelEmbedding and the
+'sharding' axis in models/llama_spmd. These stubs keep the reference's
+import surface alive so PS-mode scripts fail at RUN time with a pointed
+message, not at import."""
+from __future__ import annotations
+
+__all__ = ["TheOnePSRuntime", "PsProgramBuilder", "DistributedInfer",
+           "ParameterServerRuntime"]
+
+_MSG = ("the brpc/Heter parameter server is descoped on TPU "
+        "(SURVEY.md §7): use mesh-sharded embeddings "
+        "(paddle_tpu.distributed.fleet.layers.mpu.VocabParallelEmbedding "
+        "or the auto_parallel 'sharding' axis) instead of PS tables")
+
+
+class _PsStub:
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def _raise(self):
+        raise NotImplementedError(_MSG)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+
+        def method(*a, **kw):
+            raise NotImplementedError(_MSG)
+        return method
+
+
+class TheOnePSRuntime(_PsStub):
+    """ref: distributed/ps/the_one_ps.py."""
+
+
+class ParameterServerRuntime(_PsStub):
+    """ref: fleet/runtime/the_one_ps.py."""
+
+
+class PsProgramBuilder(_PsStub):
+    """ref: distributed/ps/utils/ps_program_builder.py."""
+
+
+class DistributedInfer(_PsStub):
+    """ref: distributed/ps/utils/public.py DistributedInfer."""

@@ -106,6 +106,12 @@ class Optimizer:
     def _wd_for_param(self, p):
         return self._wd
 
+    def _extra_cache_key(self):
+        """Subclass hook: python-level values the update rule closes over
+        (baked into the trace) must be part of the jit-cache key — e.g.
+        DGC's ramp-up sparsity."""
+        return ()
+
     # -- step ----------------------------------------------------------------
     @autograd.no_grad()
     def step(self):
@@ -140,7 +146,7 @@ class Optimizer:
                           wd, master is not None))
 
         cache_key = (tuple((a.shape, str(a.dtype)) for a in p_arrs),
-                     tuple(metas))
+                     tuple(metas), self._extra_cache_key())
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             # No buffer donation here: the dygraph API hands out aliases of

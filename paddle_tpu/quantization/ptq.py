@@ -39,15 +39,19 @@ class QuantizedLinear(Layer):
     """Inference linear over int8 weights (weight-only by default; feeds
     the int8 x int8 MXU path when an activation scale was calibrated)."""
 
-    def __init__(self, linear, wt_scale, act_scale=None, bits=8):
+    def __init__(self, linear, wt_scale, act_scale=None, bits=8, axis=-1):
         super().__init__()
         self._bits = bits
         self._wt_scale = jnp.asarray(wt_scale, jnp.float32)
         self._act_scale = None if act_scale is None else float(act_scale)
+        self._axis = axis if jnp.ndim(self._wt_scale) else None
+        if self._axis not in (None, -1, 1):
+            raise ValueError(
+                "QuantizedLinear needs per-out-channel scales "
+                f"(quant_axis=-1); got quant_axis={self._axis}")
         w = linear.weight
-        axis = -1 if jnp.ndim(self._wt_scale) else None
         self.weight_int8 = quantize(w, self._wt_scale, bits=bits,
-                                    axis=axis)
+                                    axis=self._axis)
         self.bias = getattr(linear, "bias", None)
 
     def forward(self, x):
@@ -62,7 +66,14 @@ class QuantizedConv2D(Layer):
     def __init__(self, conv, wt_scale, act_scale=None, bits=8, axis=0):
         super().__init__()
         self._bits = bits
-        self._conv = conv
+        # copy hyperparams + bias; do NOT hold the float conv (its float
+        # weight would ride along in parameters()/state_dict, defeating
+        # the int8 storage win)
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self.bias = getattr(conv, "bias", None)
         self._wt_scale = jnp.asarray(wt_scale, jnp.float32)
         self._act_scale = None if act_scale is None else float(act_scale)
         self._axis = axis if jnp.ndim(self._wt_scale) else None
@@ -79,11 +90,9 @@ class QuantizedConv2D(Layer):
             x = fake_quant(x, self._act_scale, bits=self._bits)
         w = dequantize(self.weight_int8, self._wt_scale, bits=self._bits,
                        axis=self._axis)
-        return F.conv2d(x, w, bias=getattr(self._conv, "bias", None),
-                        stride=self._conv._stride,
-                        padding=self._conv._padding,
-                        dilation=self._conv._dilation,
-                        groups=self._conv._groups)
+        return F.conv2d(x, w, bias=self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
 
 
 class PTQ:
@@ -100,11 +109,12 @@ class PTQ:
         self._insert(model)
         return model
 
-    def _insert(self, layer: Layer):
+    def _insert(self, layer: Layer, prefix=""):
         for name, child in list(layer._sub_layers.items()):
+            full = prefix + name  # hierarchical name ('encoder.fc')
             if isinstance(child, (pnn.Linear, pnn.Conv2D)) and \
-                    self._config._need_quant(child, name):
-                cfg = self._config._get_config_by_layer(child, name)
+                    self._config._need_quant(child, full):
+                cfg = self._config._get_config_by_layer(child, full)
                 act = cfg.activation() if cfg.activation is not None \
                     else None
                 wt = cfg.weight() if cfg.weight is not None else \
@@ -114,7 +124,7 @@ class PTQ:
                 layer._sub_layers[name] = ObservedLayer(child, act, wt)
                 setattr(layer, name, layer._sub_layers[name])
             else:
-                self._insert(child)
+                self._insert(child, full + ".")
 
     def convert(self, model: Layer, inplace=False):
         if not inplace:
@@ -128,17 +138,25 @@ def _finalize_quantized(layer: Layer):
     for name, child in list(layer._sub_layers.items()):
         if isinstance(child, (ObservedLayer, _FakeQuantWrapper)):
             inner = child._inner
-            wt_scale = child._wt.scales() if child._wt is not None else \
+            wt = child._wt
+            _m = getattr(wt, "_max", 1) if wt is not None else 1
+            if wt is not None and (
+                    _m is None or (isinstance(_m, float) and _m == 0.0)):
+                # QAT weight observers only run during forward; converting
+                # a model that never forwarded would otherwise freeze with
+                # the 1e-8 fallback scale and destroy the weights
+                wt(inner.weight)
+            wt_scale = wt.scales() if wt is not None else \
                 float(jnp.max(jnp.abs(inner.weight.data)))
             act_scale = child._act.scales() if child._act is not None \
                 else None
+            axis = wt.quant_axis() if wt is not None else None
             if isinstance(inner, pnn.Linear):
-                q = QuantizedLinear(inner, wt_scale, act_scale)
+                q = QuantizedLinear(inner, wt_scale, act_scale,
+                                    axis=-1 if axis is None else axis)
             elif isinstance(inner, pnn.Conv2D):
-                axis = child._wt.quant_axis() if child._wt is not None \
-                    else 0
                 q = QuantizedConv2D(inner, wt_scale, act_scale,
-                                    axis=axis if axis is not None else 0)
+                                    axis=0 if axis is None else axis)
             else:
                 continue
             layer._sub_layers[name] = q

@@ -84,17 +84,18 @@ class QAT:
         self._convert(model)
         return model
 
-    def _convert(self, layer: Layer):
+    def _convert(self, layer: Layer, prefix=""):
         mapping = dict(_DEFAULT_QAT_MAPPING)
         mapping.update(self._config._qat_layer_mapping)
         for name, child in list(layer._sub_layers.items()):
+            full = prefix + name  # hierarchical name ('encoder.fc')
             target = None
             for src, tgt in mapping.items():
                 if type(child) is src:
                     target = tgt
                     break
-            if target is not None and self._config._need_quant(child, name):
-                cfg = self._config._get_config_by_layer(child, name)
+            if target is not None and self._config._need_quant(child, full):
+                cfg = self._config._get_config_by_layer(child, full)
                 act = cfg.activation() if cfg.activation is not None \
                     else None
                 # weights are ALWAYS fake-quantized in QAT (convert()
@@ -107,7 +108,7 @@ class QAT:
                 layer._sub_layers[name] = target(child, act, wt)
                 setattr(layer, name, layer._sub_layers[name])
             else:
-                self._convert(child)
+                self._convert(child, full + ".")
 
     def convert(self, model: Layer, inplace=False):
         """Strip fake-quant wrappers into real int8 inference layers."""

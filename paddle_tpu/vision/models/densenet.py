@@ -1,0 +1,124 @@
+"""DenseNet (ref: /root/reference/python/paddle/vision/models/densenet.py
+— dense blocks with bottleneck layers + transition downsampling)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class BNACConvLayer(nn.Layer):
+    """BN -> ReLU -> Conv."""
+
+    def __init__(self, in_c, out_c, k, stride=1, pad=0):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                              bias_attr=False)
+
+    def forward(self, x):
+        return self.conv(self.relu(self.bn(x)))
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.dropout = dropout
+        self.bn_ac_func1 = BNACConvLayer(in_c, bn_size * growth_rate, 1)
+        self.bn_ac_func2 = BNACConvLayer(bn_size * growth_rate,
+                                         growth_rate, 3, pad=1)
+        if dropout:
+            self.dropout_func = nn.Dropout(dropout)
+
+    def forward(self, x):
+        out = self.bn_ac_func2(self.bn_ac_func1(x))
+        if self.dropout:
+            out = self.dropout_func(out)
+        return concat([x, out], axis=1)
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.conv_ac_func = BNACConvLayer(in_c, out_c, 1)
+        self.pool2d_avg = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool2d_avg(self.conv_ac_func(x))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        assert layers in _CFG, f"supported layers: {sorted(_CFG)}"
+        num_init_features, growth_rate, block_config = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1_func = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features), nn.ReLU())
+        self.pool2d_max = nn.MaxPool2D(3, 2, 1)
+
+        blocks, ch = [], num_init_features
+        for i, n in enumerate(block_config):
+            for _ in range(n):
+                blocks.append(DenseLayer(ch, growth_rate, bn_size,
+                                         dropout))
+                ch += growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(TransitionLayer(ch, ch // 2))
+                ch = ch // 2
+        self.dense_blocks = nn.Sequential(*blocks)
+        self.batch_norm = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.out = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool2d_max(self.conv1_func(x))
+        x = self.relu(self.batch_norm(self.dense_blocks(x)))
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.out(flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,96 @@
+"""GoogLeNet / InceptionV1 (ref: /root/reference/python/paddle/vision/
+models/googlenet.py — inception blocks + two aux classifier heads)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = nn.Sequential(
+            nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+            nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.branch3 = nn.Sequential(
+            nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+            nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.branch4 = nn.Sequential(
+            nn.MaxPool2D(3, 1, 1),
+            nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x),
+                       self.branch3(x), self.branch4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self._conv = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU())
+        self._pool = nn.MaxPool2D(3, 2)  # no padding: aux heads expect
+        # the reference's 13x13 grid at ince4a (fc_o1 in=1152=128*3*3)
+        self._conv_1 = nn.Sequential(nn.Conv2D(64, 64, 1), nn.ReLU())
+        self._conv_2 = nn.Sequential(
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU())
+
+        self._ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self._ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self._ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self._ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self._ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self._ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self._ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self._ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self._ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self._pool_5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._drop = nn.Dropout(0.4)
+            self._fc_out = nn.Linear(1024, num_classes)
+            # aux heads (training-time deep supervision)
+            self._pool_o1 = nn.AvgPool2D(5, 3)
+            self._conv_o1 = nn.Sequential(
+                nn.Conv2D(512, 128, 1), nn.ReLU())
+            self._fc_o1 = nn.Linear(1152, 1024)
+            self._drop_o1 = nn.Dropout(0.7)
+            self._out1 = nn.Linear(1024, num_classes)
+            self._pool_o2 = nn.AvgPool2D(5, 3)
+            self._conv_o2 = nn.Sequential(
+                nn.Conv2D(528, 128, 1), nn.ReLU())
+            self._fc_o2 = nn.Linear(1152, 1024)
+            self._drop_o2 = nn.Dropout(0.7)
+            self._out2 = nn.Linear(1024, num_classes)
+
+    def forward(self, inputs):
+        x = self._pool(self._conv(inputs))
+        x = self._pool(self._conv_2(self._conv_1(x)))
+        x = self._pool(self._ince3b(self._ince3a(x)))
+        ince4a = self._ince4a(x)
+        ince4d = self._ince4d(self._ince4c(self._ince4b(ince4a)))
+        x = self._pool(self._ince4e(ince4d))
+        x = self._ince5b(self._ince5a(x))
+        if self.with_pool:
+            x = self._pool_5(x)
+        if self.num_classes > 0:
+            out = self._fc_out(flatten(self._drop(x), 1))
+            o1 = self._conv_o1(self._pool_o1(ince4a))
+            o1 = nn.functional.relu(self._fc_o1(flatten(o1, 1)))
+            out1 = self._out1(self._drop_o1(o1))
+            o2 = self._conv_o2(self._pool_o2(ince4d))
+            o2 = nn.functional.relu(self._fc_o2(flatten(o2, 1)))
+            out2 = self._out2(self._drop_o2(o2))
+            return [out, out1, out2]
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

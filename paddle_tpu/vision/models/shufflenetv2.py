@@ -1,0 +1,141 @@
+"""ShuffleNetV2 (ref: /root/reference/python/paddle/vision/models/
+shufflenetv2.py — channel-shuffle units, x0_25..x2_0 + swish variant)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten, reshape, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    per = c // groups
+    x = reshape(x, [b, groups, per, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn_act(in_c, out_c, k, stride, pad, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self._stride = stride
+        branch = out_c // 2
+        self._conv_pw = _conv_bn_act(in_c // 2, branch, 1, 1, 0, act=act)
+        self._conv_dw = _conv_bn_act(branch, branch, 3, stride, 1,
+                                     groups=branch, act="none")
+        self._conv_linear = _conv_bn_act(branch, branch, 1, 1, 0, act=act)
+
+    def forward(self, x):
+        c = x.shape[1] // 2
+        x1, x2 = x[:, :c], x[:, c:]
+        out = self._conv_linear(self._conv_dw(self._conv_pw(x2)))
+        return channel_shuffle(concat([x1, out], axis=1), 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Downsampling unit (stride 2, both branches convolved)."""
+
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        branch = out_c // 2
+        self._conv_dw_1 = _conv_bn_act(in_c, in_c, 3, stride, 1,
+                                       groups=in_c, act="none")
+        self._conv_linear_1 = _conv_bn_act(in_c, branch, 1, 1, 0, act=act)
+        self._conv_pw_2 = _conv_bn_act(in_c, branch, 1, 1, 0, act=act)
+        self._conv_dw_2 = _conv_bn_act(branch, branch, 3, stride, 1,
+                                       groups=branch, act="none")
+        self._conv_linear_2 = _conv_bn_act(branch, branch, 1, 1, 0,
+                                           act=act)
+
+    def forward(self, x):
+        x1 = self._conv_linear_1(self._conv_dw_1(x))
+        x2 = self._conv_linear_2(self._conv_dw_2(self._conv_pw_2(x)))
+        return channel_shuffle(concat([x1, x2], axis=1), 2)
+
+
+_STAGE_REPEATS = [4, 8, 4]
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_out = _STAGE_OUT[scale]
+        self._conv1 = _conv_bn_act(3, stage_out[0], 3, 2, 1, act=act)
+        self._max_pool = nn.MaxPool2D(3, 2, 1)
+        blocks = []
+        in_c = stage_out[0]
+        for stage, rep in enumerate(_STAGE_REPEATS):
+            out_c = stage_out[stage + 1]
+            for i in range(rep):
+                if i == 0:
+                    blocks.append(InvertedResidualDS(in_c, out_c, 2, act))
+                else:
+                    blocks.append(InvertedResidual(out_c, out_c, 1, act))
+            in_c = out_c
+        self._blocks = nn.Sequential(*blocks)
+        self._last_conv = _conv_bn_act(in_c, stage_out[-1], 1, 1, 0,
+                                       act=act)
+        if with_pool:
+            self._pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self._max_pool(self._conv1(x))
+        x = self._last_conv(self._blocks(x))
+        if self.with_pool:
+            x = self._pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self._fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -1,0 +1,195 @@
+"""Vision zoo forward shapes + meta-optimizer behavior + PS stubs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _img(n=1, c=3, hw=64):
+    return paddle.to_tensor(np.random.default_rng(0)
+                            .standard_normal((n, c, hw, hw))
+                            .astype(np.float32))
+
+
+SMALL_BUILDERS = [
+    ("mobilenet_v1", dict(scale=0.25)),
+    ("mobilenet_v2", dict(scale=0.25)),
+    ("mobilenet_v3_small", dict(scale=0.5)),
+    ("shufflenet_v2_x0_25", {}),
+    ("shufflenet_v2_swish", {}),
+    ("densenet121", {}),
+    ("resnext50_32x4d", {}),
+]
+
+
+@pytest.mark.parametrize("name,kw", SMALL_BUILDERS,
+                         ids=[b[0] for b in SMALL_BUILDERS])
+def test_model_forward_64(name, kw):
+    m = getattr(models, name)(num_classes=10, **kw)
+    m.eval()
+    out = m(_img())
+    assert list(out.shape) == [1, 10]
+
+
+def test_lenet_alexnet_vgg_squeezenet():
+    m = models.LeNet()
+    assert list(m(paddle.to_tensor(
+        np.zeros((2, 1, 28, 28), np.float32))).shape) == [2, 10]
+    for build in (models.alexnet, models.squeezenet1_1):
+        m = build(num_classes=7)
+        m.eval()
+        assert list(m(_img(hw=224)).shape) == [1, 7]
+    m = models.vgg11(num_classes=5)
+    m.eval()
+    assert list(m(_img(hw=224)).shape) == [1, 5]
+
+
+def test_googlenet_aux_heads_and_inception():
+    m = models.googlenet(num_classes=6)
+    m.eval()
+    outs = m(_img(hw=224))
+    assert [list(o.shape) for o in outs] == [[1, 6]] * 3
+    m = models.inception_v3(num_classes=4)
+    m.eval()
+    assert list(m(_img(hw=299)).shape) == [1, 4]
+
+
+def test_vision_models_train_step():
+    m = models.mobilenet_v2(scale=0.25, num_classes=4)
+    opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                learning_rate=1e-3)
+    x = _img(4, hw=32)
+    y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    losses = []
+    for _ in range(5):
+        loss = paddle.nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+# ---- meta-optimizers -------------------------------------------------------
+
+def _toy():
+    m = paddle.nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal((8, 4)).astype(np.float32))
+
+    def loss_fn():
+        return ((m(x) - y) ** 2).mean()
+    return m, loss_fn
+
+
+def test_gradient_merge_equivalence():
+    """k accumulation steps + merge == one step on the averaged grad."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        GradientMergeOptimizer
+    paddle.seed(0)
+    m1, loss1 = _toy()
+    paddle.seed(0)
+    m2, loss2 = _toy()
+    w0 = m1.weight.numpy().copy()
+    np.testing.assert_allclose(w0, m2.weight.numpy())
+
+    sgd1 = paddle.optimizer.SGD(parameters=m1.parameters(),
+                                learning_rate=0.1)
+    gm = GradientMergeOptimizer(
+        paddle.optimizer.SGD(parameters=m2.parameters(),
+                             learning_rate=0.1), k_steps=4, avg=True)
+    # reference: average of 4 identical grads == single grad
+    l = loss1()
+    l.backward()
+    sgd1.step()
+    sgd1.clear_grad()
+    for _ in range(4):
+        l = loss2()
+        l.backward()
+        gm.step()
+        gm.clear_grad()
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lars_momentum_trains_and_excludes():
+    from paddle_tpu.distributed.fleet.meta_optimizers import LarsMomentum
+    m, loss_fn = _toy()
+    opt = LarsMomentum(learning_rate=0.5, momentum=0.9,
+                       parameters=m.parameters())
+    l0 = float(loss_fn().numpy())
+    for _ in range(30):
+        l = loss_fn()
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(l.numpy()) < l0
+
+
+def test_dgc_momentum_trains_with_sparsity():
+    from paddle_tpu.distributed.fleet.meta_optimizers import DGCMomentum
+    m, loss_fn = _toy()
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                      parameters=m.parameters(),
+                      rampup_begin_step=0, sparsity=(0.75,))
+    l0 = float(loss_fn().numpy())
+    for _ in range(60):
+        l = loss_fn()
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    # residual accumulation means even 75%-sparse updates converge
+    assert float(l.numpy()) < l0 * 0.5
+
+
+def test_strategy_wires_meta_optimizers():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+    m, loss_fn = _toy()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(parameters=m.parameters(),
+                             learning_rate=0.1))
+    w0 = m.weight.numpy().copy()
+    l = loss_fn()
+    l.backward()
+    opt.step()
+    opt.clear_grad()
+    np.testing.assert_allclose(m.weight.numpy(), w0)  # not a boundary yet
+    l = loss_fn()
+    l.backward()
+    opt.step()
+    opt.clear_grad()
+    assert not np.allclose(m.weight.numpy(), w0)  # merged step applied
+
+
+def test_localsgd_schedule_single_process():
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        LocalSGDOptimizer
+    m, loss_fn = _toy()
+    opt = LocalSGDOptimizer(
+        paddle.optimizer.SGD(parameters=m.parameters(),
+                             learning_rate=0.1), k_steps=2)
+    l0 = float(loss_fn().numpy())
+    for _ in range(6):
+        l = loss_fn()
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(l.numpy()) < l0
+
+
+def test_ps_stubs_import_and_raise():
+    from paddle_tpu.distributed import ps
+    rt = ps.TheOnePSRuntime()
+    with pytest.raises(NotImplementedError, match="descoped"):
+        rt.init_server()
+    with pytest.raises(NotImplementedError, match="VocabParallelEmbedding"):
+        ps.DistributedInfer().init_distributed_infer_env(None, None)
