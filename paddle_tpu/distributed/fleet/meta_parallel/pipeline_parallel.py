@@ -107,6 +107,9 @@ class PipelineParallel(MetaParallelBase):
 
 class PipelineParallelWithInterleave(PipelineParallel):
     """Virtual-stage interleave (ref: pipeline_parallel.py:551). The
-    single-controller schedule is identical; interleaving changes only the
-    stacked-stage layout in parallel/pipeline.py."""
+    single-controller grad-accum schedule here is identical; the compiled
+    interleaved ring schedule lives in parallel/pipeline.py
+    (spmd_pipeline(n_virtual=v) — chunk j of stage s hosts logical stage
+    j*n+s, with per-stage remat for the 1F1B memory footprint), which the
+    flagship SPMD trainer drives via LlamaSpmdTrainer(n_virtual=...)."""
     pass

@@ -65,7 +65,8 @@ class LlamaSpmdTrainer:
     def __init__(self, config: LlamaConfig, lr=3e-4, weight_decay=0.1,
                  beta1=0.9, beta2=0.95, eps=1e-8, remat=True,
                  n_micro=None, seed=0, compute_dtype=jnp.bfloat16,
-                 from_state_dict=None, remat_policy="full"):
+                 from_state_dict=None, remat_policy="full",
+                 n_virtual=1, remat_stage=False):
         self.config = config
         self.lr = lr
         self.wd = weight_decay
@@ -84,9 +85,16 @@ class LlamaSpmdTrainer:
         mesh = mesh_mod.get_mesh()
         self.pp = mesh.shape.get("pp", 1)
         self.n_micro = n_micro or max(2 * self.pp, 1)
+        # interleaved virtual stages (ref PipelineParallelWithInterleave,
+        # pipeline_parallel.py:551): each stage owns n_virtual
+        # non-adjacent chunks
+        self.n_virtual = int(n_virtual)
+        self.remat_stage = remat_stage
         L = config.num_hidden_layers
-        assert L % self.pp == 0, "layers must divide pp degree"
-        self.layers_per_stage = L // self.pp
+        n_chunks = self.pp * self.n_virtual
+        assert L % n_chunks == 0, \
+            "layers must divide pp_degree * n_virtual"
+        self.layers_per_stage = L // n_chunks
         self.head_dim = config.hidden_size // config.num_attention_heads
         self._stepno = 0
         self.params = self._init_params(seed)
@@ -137,12 +145,29 @@ class LlamaSpmdTrainer:
         }
         blocks = {}
         blk_specs = self._param_specs()
+        staged = self.n_virtual > 1 and self.pp > 1
         for i, (name, (shape, spec)) in enumerate(blk_specs.items()):
-            full_shape = (self.pp, self.layers_per_stage) + shape
-            full_spec = ("pp", None) + spec
+            # leading dim = logical chunks (pp * n_virtual), pp-sharded;
+            # with interleave the chunks are rearranged ONCE here into the
+            # staged [pp, v, ...] layout (per-step rearrangement would
+            # shuffle weights across pp shards every step)
+            full_shape = (self.pp * self.n_virtual,
+                          self.layers_per_stage) + shape
+            full_spec = (("pp", None, None) if staged else
+                         ("pp", None)) + spec
             ones = name.startswith("ln")
-            blocks[name] = init(keys[3 + i], full_shape, full_spec,
-                                scale=std, ones=ones)
+            if staged:
+                from ..parallel.pipeline import interleave_stage_params
+                if ones:
+                    a = jnp.ones(full_shape, dt) + jnp.zeros((), dt)
+                else:
+                    a = (std * jax.random.normal(
+                        keys[3 + i], full_shape)).astype(dt)
+                a = interleave_stage_params(a, self.pp, self.n_virtual)
+                blocks[name] = _place(a, *full_spec)
+            else:
+                blocks[name] = init(keys[3 + i], full_shape, full_spec,
+                                    scale=std, ones=ones)
         params["blocks"] = blocks
         return params
 
@@ -276,7 +301,10 @@ class LlamaSpmdTrainer:
     def _stage_fn(self, stage_params, x):
         """Run this stage's layers_per_stage blocks (scan + remat)."""
         block = self._block
-        if self.remat:
+        # remat_stage checkpoints the whole stage in the pipeline; nesting
+        # per-block checkpoints under it would recompute blocks twice in
+        # backward for no extra memory win
+        if self.remat and not self.remat_stage:
             if self.remat_policy == "save_dots":
                 pol = jax.checkpoint_policies.save_only_these_names(
                     "q", "k", "v", "attn_out", "ffn_gate", "ffn_up")
@@ -300,19 +328,23 @@ class LlamaSpmdTrainer:
             mb = B // self.n_micro
             x_micro = x.reshape((self.n_micro, mb) + x.shape[1:])
             sep_n = mesh_mod.mesh_axis_size("sep")
+            kw = dict(n_virtual=self.n_virtual,
+                      remat_stage=self.remat_stage)
             if sep_n > 1:
                 # 'sep' must be manual inside the pipeline region (no
                 # nested manual axes in jax) — activations stay
                 # sequence-sharded on dim 2 throughout the schedule
-                out = spmd_pipeline(self._stage_fn, params["blocks"],
-                                    x_micro, manual_axes={"sep"},
-                                    x_spec=P(None, None, "sep"))
-            else:
-                out = spmd_pipeline(self._stage_fn, params["blocks"],
-                                    x_micro)
+                kw.update(manual_axes={"sep"},
+                          x_spec=P(None, None, "sep"))
+            out = spmd_pipeline(self._stage_fn, params["blocks"], x_micro,
+                                params_layout="staged" if
+                                self.n_virtual > 1 else "logical", **kw)
             x = out.reshape((B,) + out.shape[2:])
         else:
-            stage = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+            # pp==1: chunks are logical-order, so fold them into one
+            # [chunks*layers_per_stage] stage and run a single stage_fn
+            stage = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), params["blocks"])
             x = self._stage_fn(stage, x)
         x32 = x.astype(jnp.float32)
         x32 = x32 * jax.lax.rsqrt(

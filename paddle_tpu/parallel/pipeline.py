@@ -2,14 +2,27 @@
 
 The reference schedules 1F1B by exchanging activations over NCCL p2p between
 per-stage processes (ref: /root/reference/python/paddle/distributed/fleet/
-meta_parallel/pipeline_parallel.py:174, pp_utils/p2p_communication.py:329).
-On TPU the whole schedule is compiled: stage weights are stacked on a
-leading dim sharded over 'pp', and a shard_map (manual on 'pp' only — other
-axes stay under GSPMD) runs the classic scan-with-ppermute pipeline: at
-step t each stage processes one micro-batch and ppermutes its activation to
-the next stage. Forward+backward through this region is differentiable
-(ppermute's transpose is the reverse shift), so 1F1B falls out of
-reverse-mode AD over the loop — the same dataflow, scheduled by XLA.
+meta_parallel/pipeline_parallel.py:174, pp_utils/p2p_communication.py:329),
+and interleaved virtual stages via PipelineParallelWithInterleave
+(:551). On TPU the whole schedule is compiled: stage weights are stacked on
+a leading dim sharded over 'pp', and a shard_map (manual on 'pp' only —
+other axes stay under GSPMD) runs the ring schedule: at step t each stage
+processes one micro-batch and ppermutes its activation to the next stage.
+
+Memory (the 1F1B concern): reverse-mode AD through the scan stores each
+step's saved intermediates. With ``remat_stage=True`` the per-step stage
+computation is wrapped in jax.checkpoint, so AD keeps only the per-step
+carried activation (one micro-batch in flight per stage — the 1F1B
+footprint) and recomputes the stage interior in backward.
+
+Interleave (``n_virtual`` = v > 1): each physical stage owns v
+non-adjacent layer chunks (chunk j on stage s hosts logical stage j*n+s,
+the reference's virtual-stage assignment). The ring wrap (stage n-1 → 0)
+naturally carries an activation from chunk j to chunk j+1, so one longer
+ring schedule runs all v*n logical stages; micro-batches are fed in groups
+of n (collision-free), total steps = (n_micro/n)*v*n + n - 1 — the same
+single fill/drain bubble as the non-interleaved schedule while each stage
+holds only 1/v of contiguous layers.
 """
 from __future__ import annotations
 
@@ -23,36 +36,81 @@ from jax.sharding import PartitionSpec as P
 from . import mesh as mesh_mod
 
 
+def interleave_stage_params(tree, n_stages: int, n_virtual: int):
+    """Rearrange logical-chunk-major params [v*n, ...] into the staged
+    layout [n, v, ...] (chunk j of stage s = logical stage j*n + s). Do
+    this ONCE at init — doing it per step inside jit would shuffle weights
+    across 'pp' shards every forward/backward."""
+    def rearrange(a):
+        if a.shape[0] != n_virtual * n_stages:
+            raise ValueError(
+                f"interleaved params need leading dim "
+                f"{n_virtual * n_stages}, got {a.shape[0]}")
+        b = a.reshape((n_virtual, n_stages) + a.shape[1:])
+        return jnp.swapaxes(b, 0, 1)
+    return jax.tree_util.tree_map(rearrange, tree)
+
+
 def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
-                  axis: str = "pp", manual_axes=(), x_spec=None):
+                  axis: str = "pp", manual_axes=(), x_spec=None,
+                  n_virtual: int = 1, remat_stage: bool = False,
+                  params_layout: str = "logical"):
     """Run `stage_fn(params_slice, x_mb) -> y_mb` as a pipeline.
 
-    stage_params: pytree whose leaves have leading dim n_stages (sharded
-    over `axis`). x_micro: [n_micro, mb, ...] array of micro-batched inputs
-    (replicated over `axis`). Returns [n_micro, mb, ...] outputs (replicated
-    over `axis`). Activations must have the same shape/dtype across stages.
+    stage_params: pytree whose leaves have leading dim n_stages; with
+    n_virtual>1 either v*n chunks in LOGICAL layer order
+    (params_layout="logical", rearranged here — convenient but costs a
+    cross-shard shuffle per step under jit) or already
+    [n_stages, v, ...] staged (params_layout="staged", produced once by
+    interleave_stage_params — the hot-path form). Sharded over `axis`.
+    x_micro: [n_micro, mb, ...] micro-batched inputs (replicated over
+    `axis`). Returns [n_micro, mb, ...] outputs. Activations must have
+    the same shape/dtype across stages.
 
     manual_axes: extra mesh axes to make manual inside the region (jax does
     not support introducing new manual axes in a nested shard_map, so e.g.
     the 'sep' ring-attention axis must become manual HERE when sequence
     parallelism runs inside a pipeline stage). x_spec: PartitionSpec of
-    x_micro over those manual axes (e.g. P(None, None, 'sep') for
-    [n_micro, mb, T(sep), ...]); activations keep this layout across stages.
+    x_micro over those manual axes.
     """
     mesh = mesh_mod.get_mesh()
     n_stages = mesh.shape[axis]
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
     if n_stages == 1:
         def apply_one(x):
+            if n_virtual > 1:
+                if params_layout == "staged":
+                    chunks = jax.tree_util.tree_map(
+                        lambda a: a[0], stage_params)  # [v, ...]
+                else:
+                    chunks = stage_params  # logical [v, ...]
+                out, _ = jax.lax.scan(
+                    lambda c, ch: (stage_fn(ch, c), None), x, chunks)
+                return out
             p = jax.tree_util.tree_map(lambda a: a[0], stage_params)
             return stage_fn(p, x)
         return jax.lax.map(apply_one, x_micro)
 
     n_micro = x_micro.shape[0]
-    T = n_micro + n_stages - 1
+    v = int(n_virtual)
+    if v > 1:
+        if n_micro % n_stages != 0:
+            raise ValueError(
+                f"interleaved schedule needs n_micro ({n_micro}) divisible "
+                f"by the stage count ({n_stages})")
+        if params_layout != "staged":
+            stage_params = interleave_stage_params(stage_params, n_stages,
+                                                   v)
+
+    groups = n_micro // n_stages if v > 1 else None
+    vn = v * n_stages
+    T = (groups * vn + n_stages - 1) if v > 1 else \
+        (n_micro + n_stages - 1)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def per_stage(params_local, x):
-        # params_local leaves: [1, ...] (this stage's slice)
+        # params_local leaves: [1, ...] (v=1) or [1, v, ...] (interleave)
         params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
         stage = jax.lax.axis_index(axis)
         mb_shape = x.shape[1:]
@@ -61,15 +119,36 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
 
         def body(carry, t):
             state, outputs = carry
-            x_t = jax.lax.dynamic_index_in_dim(
-                x, jnp.clip(t, 0, n_micro - 1), keepdims=False)
-            inp = jnp.where(stage == 0, x_t, state)
-            y = stage_fn(params_local, inp)
-            idx = t - (n_stages - 1)
-            upd = jax.lax.dynamic_update_index_in_dim(
-                outputs, y, jnp.clip(idx, 0, n_micro - 1), axis=0)
-            take = jnp.logical_and(stage == n_stages - 1, idx >= 0)
-            outputs = jnp.where(take, upd, outputs)
+            u = t - stage
+            if v > 1:
+                g = u // vn
+                rem = u % vn
+                chunk = rem // n_stages
+                m = g * n_stages + (u % n_stages)
+                active = jnp.logical_and(u >= 0, g < groups)
+                # stage 0 ingests a fresh micro-batch while in chunk 0
+                # (rem < n); stage n-1 emits while in the last chunk
+                feed = jnp.logical_and(stage == 0, rem < n_stages)
+                emit = jnp.logical_and(stage == n_stages - 1,
+                                       rem >= vn - n_stages)
+                pc = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, jnp.clip(chunk, 0, v - 1), keepdims=False),
+                    params_local)
+            else:
+                m = u
+                active = jnp.logical_and(u >= 0, u < n_micro)
+                feed = stage == 0
+                emit = jnp.logical_and(stage == n_stages - 1, active)
+                pc = params_local
+            m_c = jnp.clip(m, 0, n_micro - 1)
+            x_t = jax.lax.dynamic_index_in_dim(x, m_c, keepdims=False)
+            inp = jnp.where(feed, x_t, state)
+            y = stage_fn(pc, inp)
+            upd = jax.lax.dynamic_update_index_in_dim(outputs, y, m_c,
+                                                      axis=0)
+            outputs = jnp.where(jnp.logical_and(emit, active), upd,
+                                outputs)
             state = jax.lax.ppermute(y, axis, perm)
             return (state, outputs), None
 

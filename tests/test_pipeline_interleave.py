@@ -1,0 +1,115 @@
+"""Interleaved virtual-stage pipeline + 1F1B memory profile.
+
+Ref contract: PipelineParallelWithInterleave
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:551) — virtual chunk assignment must be numerically
+identical to the serial model; remat_stage bounds AD's activation storage
+(the 1F1B memory concern).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.pipeline import spmd_pipeline
+
+
+@pytest.fixture
+def pp4_mesh():
+    mesh_mod.build_mesh(pp=4, dp=2)
+    yield
+    mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
+
+
+def _setup(n_chunks=8, n_micro=8, mb=2, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    Ws = jnp.asarray(rng.standard_normal((n_chunks, d, d)) * 0.2,
+                     jnp.float32)
+    x = jnp.asarray(rng.standard_normal((n_micro, mb, d)), jnp.float32)
+    return Ws, x
+
+
+def _stage(W, x):
+    return jnp.tanh(x @ W)
+
+
+def _serial(Ws, xm):
+    def per(x):
+        for i in range(Ws.shape[0]):
+            x = _stage(Ws[i], x)
+        return x
+    return jax.vmap(per)(xm)
+
+
+@pytest.mark.parametrize("n_micro", [4, 8, 12])
+@pytest.mark.parametrize("remat", [False, True])
+def test_interleave_matches_serial(n_micro, remat, pp4_mesh):
+    Ws, xm = _setup(n_micro=n_micro)
+    got = jax.jit(lambda W, x: spmd_pipeline(
+        _stage, W, x, n_virtual=2, remat_stage=remat))(Ws, xm)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_serial(Ws, xm)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleave_grads_match_serial(pp4_mesh):
+    Ws, xm = _setup()
+
+    def loss_pipe(W, x):
+        return (spmd_pipeline(_stage, W, x, n_virtual=2,
+                              remat_stage=True) ** 2).sum()
+
+    def loss_ser(W, x):
+        return (_serial(W, x) ** 2).sum()
+
+    g1 = jax.jit(jax.grad(loss_pipe))(Ws, xm)
+    g2 = jax.grad(loss_ser)(Ws, xm)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_interleave_rejects_bad_micro(pp4_mesh):
+    Ws, xm = _setup(n_micro=6)  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(lambda W, x: spmd_pipeline(
+            _stage, W, x, n_virtual=2))(Ws, xm)
+
+
+def test_remat_stage_reduces_activation_memory(pp4_mesh):
+    """The VERDICT contract: measured backward activation (temp) memory
+    with per-step checkpointing < the store-everything schedule."""
+    Ws, xm = _setup(n_chunks=4, n_micro=8, d=32)
+
+    def make(remat):
+        def loss(W, x):
+            return (spmd_pipeline(_stage, W, x,
+                                  remat_stage=remat) ** 2).sum()
+        return jax.jit(jax.grad(loss)).lower(Ws, xm).compile()
+
+    plain = make(False).memory_analysis().temp_size_in_bytes
+    remat = make(True).memory_analysis().temp_size_in_bytes
+    assert remat < plain, (remat, plain)
+
+
+def test_llama_trainer_interleave_parity(pp4_mesh):
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+    mesh_mod.build_mesh(pp=2, mp=2, dp=2)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=4,
+                           kv_heads=2, inter=64, seq=16)
+    ids = np.random.default_rng(0).integers(0, 64, (8, 16))
+    tr1 = LlamaSpmdTrainer(cfg, remat=False, compute_dtype=jnp.float32,
+                           seed=3, n_micro=4)
+    tr2 = LlamaSpmdTrainer(cfg, remat=False, compute_dtype=jnp.float32,
+                           seed=3, n_micro=4, n_virtual=2,
+                           remat_stage=True)
+    l1 = float(jax.jit(tr1.loss_fn)(tr1.params, jnp.asarray(ids),
+                                    jnp.asarray(ids)))
+    l2 = float(jax.jit(tr2.loss_fn)(tr2.params, jnp.asarray(ids),
+                                    jnp.asarray(ids)))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    first = float(tr2.train_step(ids))
+    for _ in range(4):
+        last = float(tr2.train_step(ids))
+    assert last < first
