@@ -24,6 +24,15 @@ from ... import nn
 from ...nn import functional as F
 
 
+def _use_decode_kernel():
+    from ...flags import get_flag
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        on_tpu = False
+    return on_tpu and bool(get_flag("FLAGS_enable_pallas_kernels", True))
+
+
 class FusedMultiHeadAttention(nn.Layer):
     """ref: fused_transformer.py FusedMultiHeadAttention — pre/post LN +
     qkv proj + attention + out proj + residual in one call."""
@@ -177,9 +186,25 @@ class FusedMultiTransformer(nn.Layer):
                     return jnp.stack([kc, vc])
                 cache = apply(upd, (cache, k, v), op_name="cache_kv")
                 new_caches.append(cache)
-                k_full = transpose(cache[0], [0, 2, 1, 3])[:, :t + l]
-                v_full = transpose(cache[1], [0, 2, 1, 3])[:, :t + l]
-                attn = F.scaled_dot_product_attention(q, k_full, v_full)
+                if l == 1 and _use_decode_kernel():
+                    # flash-decoding over the static cache (ref
+                    # fused_multi_transformer_op.cu.h:835 masked mha)
+                    from ...ops.pallas.decode_attention import \
+                        decode_attention
+
+                    def dec(c, q_):
+                        kc = jnp.swapaxes(c[0], 1, 2)  # [B, S, H, D]
+                        vc = jnp.swapaxes(c[1], 1, 2)
+                        lens = jnp.full((q_.shape[0],), t + 1, jnp.int32)
+                        return decode_attention(q_[:, 0], kc, vc,
+                                                lens)[:, None]
+                    attn = apply(dec, (cache, q),
+                                 op_name="decode_attention")
+                else:
+                    k_full = transpose(cache[0], [0, 2, 1, 3])[:, :t + l]
+                    v_full = transpose(cache[1], [0, 2, 1, 3])[:, :t + l]
+                    attn = F.scaled_dot_product_attention(q, k_full,
+                                                          v_full)
             else:
                 attn = F.scaled_dot_product_attention(
                     q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)

@@ -1,2 +1,22 @@
 """Pallas TPU kernels: the fused-op layer (the reference's CUDA
-fused/cutlass kernels, SURVEY.md §2.1 phi/kernels/fusion)."""
+fused/cutlass kernels, SURVEY.md §2.1 phi/kernels/fusion).
+
+- flash_attention:  fwd+bwd flash attention (flash_attn_kernel.cu analog)
+- fused_norm:       rmsnorm/layernorm + residual in one pass
+                    (fused_layernorm_residual_dropout_bias.h analog)
+- fused_adamw:      one-pass AdamW update (fused_adam_kernel.cu analog)
+- grouped_gemm:     MoE expert grouped GEMM (cutlass moe_kernel.cu analog)
+- decode_attention: cache-KV flash-decoding
+                    (fused_multi_transformer_op.cu.h:835 analog)
+
+All kernels run in interpret mode on CPU for tests and compile via
+Mosaic on TPU.
+"""
+from .decode_attention import (decode_attention,  # noqa: F401
+                               decode_attention_reference)
+from .flash_attention import flash_attention_blhd  # noqa: F401
+from .fused_adamw import fused_adamw_update  # noqa: F401
+from .fused_norm import (fused_layer_norm,  # noqa: F401
+                         fused_layer_norm_residual, fused_rms_norm,
+                         fused_rms_norm_residual)
+from .grouped_gemm import gmm, gmm_reference, make_group_metadata  # noqa: F401

@@ -1,0 +1,260 @@
+"""Pallas fused-kernel parity tests (interpret mode on CPU; the same
+kernels compile via Mosaic on TPU).
+
+Ref kernels being mirrored: fused_layernorm_residual_dropout_bias.h,
+fused_adam_kernel.cu, cutlass moe_kernel.cu,
+fused_multi_transformer_op.cu.h:835.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.decode_attention import (
+    decode_attention, decode_attention_reference)
+from paddle_tpu.ops.pallas.fused_adamw import fused_adamw_update
+from paddle_tpu.ops.pallas.fused_norm import (
+    fused_layer_norm, fused_layer_norm_residual, fused_rms_norm,
+    fused_rms_norm_residual)
+from paddle_tpu.ops.pallas.grouped_gemm import (
+    gmm, gmm_reference, make_group_metadata)
+
+rng = np.random.default_rng(0)
+
+
+def _rand(*shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+def _rms_ref(z, w, eps=1e-6):
+    return z * jax.lax.rsqrt(jnp.mean(z * z, -1, keepdims=True) + eps) * w
+
+
+def _ln_ref(z, w, b, eps=1e-5):
+    mu = z.mean(-1, keepdims=True)
+    xc = z - mu
+    return xc * jax.lax.rsqrt((xc * xc).mean(-1, keepdims=True)
+                              + eps) * w + b
+
+
+class TestFusedNorm:
+    def test_rms_forward(self):
+        x, w = _rand(4, 8, 128), _rand(128)
+        np.testing.assert_allclose(
+            np.asarray(fused_rms_norm(x, w)),
+            np.asarray(_rms_ref(x, w)), atol=1e-5, rtol=1e-5)
+
+    def test_rms_residual_forward(self):
+        x, r, w = _rand(4, 8, 128), _rand(4, 8, 128), _rand(128)
+        y, z = fused_rms_norm_residual(x, r, w)
+        np.testing.assert_allclose(np.asarray(z), np.asarray(x + r),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(_rms_ref(x + r, w)),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rms_grads(self):
+        x, r, w = _rand(2, 4, 128), _rand(2, 4, 128), _rand(128)
+
+        def f(x, r, w):
+            y, z = fused_rms_norm_residual(x, r, w)
+            return (y ** 2).sum() + (z ** 3).sum()
+
+        def ref(x, r, w):
+            z = x + r
+            return (_rms_ref(z, w) ** 2).sum() + (z ** 3).sum()
+
+        g1 = jax.grad(f, argnums=(0, 1, 2))(x, r, w)
+        g2 = jax.grad(ref, argnums=(0, 1, 2))(x, r, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=1e-4)
+
+    def test_layernorm_forward_and_grads(self):
+        x, r = _rand(2, 4, 128), _rand(2, 4, 128)
+        w, b = _rand(128), _rand(128)
+        y, z = fused_layer_norm_residual(x, r, w, b)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(_ln_ref(x + r, w, b)),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(fused_layer_norm(x, w, b)),
+            np.asarray(_ln_ref(x, w, b)), atol=1e-5, rtol=1e-5)
+
+        def f(x, r, w, b):
+            y, _ = fused_layer_norm_residual(x, r, w, b)
+            return (y ** 2).sum()
+
+        def ref(x, r, w, b):
+            return (_ln_ref(x + r, w, b) ** 2).sum()
+
+        g1 = jax.grad(f, argnums=(0, 1, 2, 3))(x, r, w, b)
+        g2 = jax.grad(ref, argnums=(0, 1, 2, 3))(x, r, w, b)
+        for a, b2 in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       atol=2e-4, rtol=1e-4)
+
+    def test_bf16_io(self):
+        x = _rand(4, 4, 128).astype(jnp.bfloat16)
+        w = _rand(128).astype(jnp.bfloat16)
+        y = fused_rms_norm(x, w)
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32),
+            np.asarray(_rms_ref(x.astype(jnp.float32),
+                                w.astype(jnp.float32))),
+            atol=0.05, rtol=0.05)
+
+
+class TestFusedAdamW:
+    def test_matches_reference_update(self):
+        shape = (33, 77)  # ragged: exercises lane padding
+        p = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        master = p.astype(jnp.float32)
+        g = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        m = _rand(*shape) * 0.1
+        v = jnp.abs(_rand(*shape)) * 0.01
+        lr, b1, b2, eps, wd, step = 1e-3, 0.9, 0.95, 1e-8, 0.1, 7.0
+        np_, nm, nv, nmaster = fused_adamw_update(
+            p, g, m, v, master, lr, b1, b2, eps, wd, step)
+        g32 = np.asarray(g, np.float32)
+        m_r = b1 * np.asarray(m) + (1 - b1) * g32
+        v_r = b2 * np.asarray(v) + (1 - b2) * g32 * g32
+        upd = (m_r / (1 - b1 ** step)
+               / (np.sqrt(v_r / (1 - b2 ** step)) + eps)
+               + wd * np.asarray(master))
+        master_r = np.asarray(master) - lr * upd
+        np.testing.assert_allclose(np.asarray(nm), m_r, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nv), v_r, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nmaster), master_r,
+                                   rtol=1e-5, atol=1e-6)
+        assert np_.dtype == jnp.bfloat16
+
+    def test_traced_scalars_under_jit(self):
+        p = _rand(16, 128)
+        st = dict(m=jnp.zeros_like(p), v=jnp.zeros_like(p), master=p)
+
+        @jax.jit
+        def step(p, g, st, lr, n):
+            return fused_adamw_update(p, g, st["m"], st["v"],
+                                      st["master"], lr, 0.9, 0.95, 1e-8,
+                                      0.0, n)
+        out = step(p, _rand(16, 128), st, jnp.float32(1e-3),
+                   jnp.float32(1.0))
+        assert out[0].shape == p.shape
+
+
+class TestGroupedGemm:
+    def test_matches_per_expert_matmul(self):
+        E, K, N, bm = 4, 64, 96, 8
+        sizes = [13, 0, 21, 6]
+        offsets, block_expert, M = make_group_metadata(sizes, block_m=bm)
+        lhs = _rand(M, K)
+        rhs = _rand(E, K, N)
+        out = gmm(lhs, rhs, block_expert, block_m=bm, block_n=32,
+                  block_k=16)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(gmm_reference(lhs, rhs, block_expert, block_m=bm)),
+            atol=1e-4, rtol=1e-4)
+        for e in range(E):
+            lo, hi = offsets[e], offsets[e] + sizes[e]
+            if sizes[e]:
+                np.testing.assert_allclose(
+                    np.asarray(out[lo:hi]), np.asarray(lhs[lo:hi] @ rhs[e]),
+                    rtol=1e-4, atol=1e-4)
+
+    def test_metadata(self):
+        offsets, be, total = make_group_metadata([5, 8, 0, 1], block_m=8)
+        assert total == 24 and list(offsets) == [0, 8, 16, 16, 24]
+        assert list(be) == [0, 1, 3]
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("nh,nkv", [(8, 4), (4, 4)])
+    def test_matches_dense(self, nh, nkv):
+        B, S, hd = 3, 64, 32
+        q = _rand(B, nh, hd)
+        kc, vc = _rand(B, S, nkv, hd), _rand(B, S, nkv, hd)
+        lens = jnp.asarray([5, 64, 17], jnp.int32)
+        out = decode_attention(q, kc, vc, lens, block_s=16)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(decode_attention_reference(q, kc, vc, lens)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_fused_transformer_decode_uses_cache_correctly(self):
+        """End-to-end: FusedMultiTransformer decode equals the dense
+        path (the kernel is TPU-gated; this exercises the jnp fallback +
+        the kernel reference on the same cache layout)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.seed(0)
+        m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                                  dim_feedforward=64, num_layers=2)
+        caches = m.gen_cache(2, 16)
+        x0 = paddle.to_tensor(rng.standard_normal((2, 4, 32))
+                              .astype(np.float32))
+        out, caches = m(x0, caches=caches, time_step=0)
+        x1 = paddle.to_tensor(rng.standard_normal((2, 1, 32))
+                              .astype(np.float32))
+        out1, caches = m(x1, caches=caches, time_step=4)
+        assert out1.shape == [2, 1, 32]
+        # kernel parity on the resulting cache layout
+        c = caches[0]
+        kc = jnp.swapaxes(c.data[0], 1, 2)
+        vc = jnp.swapaxes(c.data[1], 1, 2)
+        q = _rand(2, 4, 8)
+        lens = jnp.asarray([5, 5], jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(decode_attention(q, kc, vc, lens, block_s=8)),
+            np.asarray(decode_attention_reference(q, kc, vc, lens)),
+            atol=1e-5, rtol=1e-5)
+
+
+class TestLlamaPallasFusedPath:
+    def test_fused_block_matches_jnp_block(self):
+        """Force the single-chip fused path (interpret mode on CPU) and
+        check the trainer's loss + grads match the jnp path."""
+        from paddle_tpu.parallel import mesh as mesh_mod
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+        mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
+        cfg = LlamaConfig.tiny(vocab=64, hidden=128, layers=2, heads=4,
+                               kv_heads=2, inter=128, seq=16)
+        ids = rng.integers(0, 64, (2, 16))
+        tr = LlamaSpmdTrainer(cfg, remat=False,
+                              compute_dtype=jnp.float32, seed=1)
+        base = float(tr.loss_fn(tr.params, jnp.asarray(ids),
+                                jnp.asarray(ids)))
+        tr._pallas_fused = True  # interpret-mode kernels on CPU
+        fused = float(tr.loss_fn(tr.params, jnp.asarray(ids),
+                                 jnp.asarray(ids)))
+        np.testing.assert_allclose(fused, base, rtol=1e-5)
+        g1 = jax.grad(tr.loss_fn)(tr.params, jnp.asarray(ids),
+                                  jnp.asarray(ids))
+        tr._pallas_fused = False
+        g2 = jax.grad(tr.loss_fn)(tr.params, jnp.asarray(ids),
+                                  jnp.asarray(ids))
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_fused_adamw_train_step(self):
+        from paddle_tpu.parallel import mesh as mesh_mod
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+        mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
+        cfg = LlamaConfig.tiny(vocab=64, hidden=128, layers=2, heads=4,
+                               kv_heads=2, inter=128, seq=16)
+        ids = rng.integers(0, 64, (2, 16))
+        tr = LlamaSpmdTrainer(cfg, remat=False,
+                              compute_dtype=jnp.float32, seed=1)
+        tr._pallas_fused = True
+        first = float(tr.train_step(ids))
+        for _ in range(4):
+            last = float(tr.train_step(ids))
+        assert last < first
