@@ -69,6 +69,9 @@ from . import utils  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 from .framework import autograd as _autograd_mod  # noqa: E402
 from . import autograd  # noqa: F401,E402
 

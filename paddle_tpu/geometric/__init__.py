@@ -1,0 +1,188 @@
+"""paddle.geometric analog — graph message passing + sampling.
+
+Ref: /root/reference/python/paddle/geometric/ (send_u_recv/send_ue_recv/
+send_uv message passing over graph_send_recv kernels, segment_* pooling
+over segment_pool_kernel, reindex_graph / weighted_sample_neighbors in
+paddle/phi/kernels/gpu/graph_*).
+
+TPU-native: message passing is jax.ops.segment_* (sorted-scatter XLA
+path); sampling/reindex are host-side (data-dependent shapes)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import apply as _apply
+from ..framework.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "segment_pool",
+           "reindex_graph", "weighted_sample_neighbors",
+           "sample_neighbors"]
+
+
+def _op(fn, *args, op_name=None):
+    return _apply(fn, args, op_name=op_name)
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,  # handled explicitly
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _reduce(msg, dst, num, pool_type):
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=num)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype),
+                                dst, num_segments=num)
+        return s / jnp.maximum(c, 1.0)[(...,) + (None,) * (msg.ndim - 1)]
+    out = _REDUCERS[pool_type](msg, dst, num_segments=num)
+    if pool_type in ("max", "min"):
+        # empty segments come back +-inf; paddle zeros them
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and reduce onto dst (ref graph_send_recv)."""
+    si, di = _arr(src_index).astype(jnp.int32), \
+        _arr(dst_index).astype(jnp.int32)
+    num = int(out_size) if out_size is not None else None
+
+    def impl(xa):
+        n = num if num is not None else xa.shape[0]
+        return _reduce(xa[si], di, n, reduce_op)
+    return _op(impl, x, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = x[src] (op) edge_feature y, reduced onto dst."""
+    si, di = _arr(src_index).astype(jnp.int32), \
+        _arr(dst_index).astype(jnp.int32)
+    num = int(out_size) if out_size is not None else None
+
+    def impl(xa, ya):
+        m = xa[si]
+        msg = {"add": m + ya, "sub": m - ya, "mul": m * ya,
+               "div": m / ya}[message_op]
+        n = num if num is not None else xa.shape[0]
+        return _reduce(msg, di, n, reduce_op)
+    return _op(impl, x, y, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (ref graph_send_uv)."""
+    si, di = _arr(src_index).astype(jnp.int32), \
+        _arr(dst_index).astype(jnp.int32)
+
+    def impl(xa, ya):
+        a, b = xa[si], ya[di]
+        return {"add": a + b, "sub": a - b, "mul": a * b,
+                "div": a / b}[message_op]
+    return _op(impl, x, y, op_name="send_uv")
+
+
+def _segment(pool):
+    def op(data, segment_ids, name=None):
+        ids = _arr(segment_ids).astype(jnp.int32)
+
+        def impl(d):
+            n = int(jnp.max(ids)) + 1 if ids.size else 0
+            return _reduce(d, ids, n, pool)
+        return _op(impl, data, op_name=f"segment_{pool}")
+    op.__name__ = f"segment_{pool}"
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+def segment_pool(data, segment_ids, pool_type="sum", name=None):
+    """ref segment_pool op: dispatch by pool_type string."""
+    return _segment(pool_type.lower())(data, segment_ids)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Compact a sampled subgraph's node ids (ref graph_reindex): returns
+    (reindexed_src, reindexed_dst, out_nodes) where out_nodes = unique
+    nodes in first-seen order (x first, then new neighbors)."""
+    xs = np.asarray(_arr(x)).reshape(-1)
+    nb = np.asarray(_arr(neighbors)).reshape(-1)
+    ct = np.asarray(_arr(count)).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for v in xs:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    src = np.empty(nb.shape[0], np.int64)
+    for i, v in enumerate(nb):
+        if int(v) not in mapping:
+            mapping[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+        src[i] = mapping[int(v)]
+    dst = np.repeat(np.arange(len(xs)), ct)
+    return (Tensor(jnp.asarray(src)),
+            Tensor(jnp.asarray(dst.astype(np.int64))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling over CSC graph storage (ref
+    weighted_sample_neighbors kernel). Host-side: sampling is
+    data-dependent input-pipeline work."""
+    rows = np.asarray(_arr(row)).reshape(-1)
+    cptr = np.asarray(_arr(colptr)).reshape(-1)
+    w = np.asarray(_arr(edge_weight)).reshape(-1)
+    nodes = np.asarray(_arr(input_nodes)).reshape(-1)
+    # seed from the framework generator so paddle.seed reproduces samples
+    from ..framework import random as _random
+    seed = int(np.asarray(jax.random.key_data(
+        _random.next_key())).ravel()[-1])
+    rng = np.random.default_rng(seed)
+    out, counts, eids = [], [], []
+    for v in nodes:
+        lo, hi = int(cptr[v]), int(cptr[v + 1])
+        neigh = rows[lo:hi]
+        wv = w[lo:hi]
+        k = len(neigh) if sample_size < 0 else min(sample_size,
+                                                   len(neigh))
+        if k == 0:
+            counts.append(0)
+            continue
+        p = wv / wv.sum() if wv.sum() > 0 else None
+        pick = rng.choice(len(neigh), size=k, replace=False, p=p)
+        out.extend(neigh[pick].tolist())
+        eids.extend((lo + pick).tolist())
+        counts.append(k)
+    res = (Tensor(jnp.asarray(np.asarray(out, np.int64))),
+           Tensor(jnp.asarray(np.asarray(counts, np.int64))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(np.asarray(eids, np.int64))),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling (ref graph_sample_neighbors)."""
+    ones = jnp.ones_like(_arr(row), jnp.float32)
+    return weighted_sample_neighbors(row, colptr, ones, input_nodes,
+                                     sample_size, return_eids)

@@ -49,3 +49,4 @@ def _install_top_level():
 
 
 _install_top_level()
+from . import utils  # noqa: F401,E402

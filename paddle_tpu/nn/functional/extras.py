@@ -1,0 +1,330 @@
+"""Long-tail functional ops: sequence losses, decoding and sampling
+helpers closing out the reference yaml op registry.
+
+Refs: warpctc/warprnnt ops (/root/reference/paddle/phi/kernels/gpu/
+warpctc_kernel.cu, warprnnt), hsigmoid_loss
+(hsigmoid_loss_kernel), gather_tree (gather_tree_kernel),
+class_center_sample + margin_cross_entropy
+(class_center_sample_kernel.cu, margin_cross_entropy_kernel.cu),
+edit_distance (edit_distance_kernel), max unpooling (unpool_kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import apply as _apply
+from ...framework.tensor import Tensor
+
+__all__ = ["ctc_loss", "rnnt_loss", "hsigmoid_loss", "gather_tree",
+           "class_center_sample", "margin_cross_entropy",
+           "edit_distance", "max_unpool2d", "max_unpool3d"]
+
+
+def _op(fn, *args, op_name=None, differentiable=True):
+    return _apply(fn, args, op_name=op_name,
+                  differentiable=differentiable)
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (ref warpctc op). log_probs: [T, B, C] logits (paddle
+    feeds unnormalized logits); labels: [B, L]."""
+    il = _arr(input_lengths).astype(jnp.int32)
+    ll = _arr(label_lengths).astype(jnp.int32)
+
+    def impl(lp, lab):
+        import optax
+        T, B, C = lp.shape
+        logits = jnp.swapaxes(lp, 0, 1)          # [B, T, C]
+        logit_pad = (jnp.arange(T)[None, :] >= il[:, None]
+                     ).astype(jnp.float32)
+        L = lab.shape[1]
+        label_pad = (jnp.arange(L)[None, :] >= ll[:, None]
+                     ).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad,
+                                 lab.astype(jnp.int32), label_pad,
+                                 blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(il.astype(per_seq.dtype), 1)
+        if reduction == "mean":
+            # paddle mean: per-sample loss / label_len, then batch mean
+            return (per_seq / jnp.maximum(ll.astype(per_seq.dtype),
+                                          1)).mean()
+        if reduction == "sum":
+            return per_seq.sum()
+        return per_seq
+    return _op(impl, log_probs, _arr(labels), op_name="warpctc")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss (ref warprnnt op). input: [B, T, U+1, C]
+    log-prob lattice; label: [B, U].
+
+    fastemit_lambda: only 0.0 is supported (the FastEmit gradient
+    rescaling of the reference's warprnnt is not implemented) — a
+    nonzero value raises rather than silently doing nothing."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda != 0 is not implemented in the TPU rnnt_loss")
+    il = _arr(input_lengths).astype(jnp.int32)
+    ul = _arr(label_lengths).astype(jnp.int32)
+
+    def impl(acts, lab):
+        logp = jax.nn.log_softmax(acts, axis=-1)
+        B, T, U1, C = logp.shape
+
+        def one(lp, y, t_len, u_len):
+            # alpha DP over the (T, U+1) lattice in log space
+            blank_lp = lp[:, :, blank]                       # [T, U+1]
+            y_full = jnp.concatenate(
+                [y, jnp.zeros((1,), y.dtype)])[:U1]
+            emit_lp = jnp.take_along_axis(
+                lp, y_full[None, :, None].astype(jnp.int32),
+                axis=2)[:, :, 0]                             # [T, U+1]
+            NEG = -1e30
+
+            def row(alpha_prev, t):
+                # alpha[t, u] = logsumexp(alpha[t-1, u] + blank[t-1, u],
+                #                         alpha[t, u-1] + emit[t, u-1])
+                def col(carry, u):
+                    a_t = carry
+                    from_blank = jnp.where(
+                        t > 0, alpha_prev[u] + blank_lp[t - 1, u], NEG)
+                    from_emit = jnp.where(
+                        u > 0, a_t[u - 1] + emit_lp[t, u - 1], NEG)
+                    init = jnp.where((t == 0) & (u == 0), 0.0, NEG)
+                    val = jnp.logaddexp(jnp.logaddexp(from_blank,
+                                                      from_emit), init)
+                    return a_t.at[u].set(val), None
+                a_t0 = jnp.full((U1,), NEG)
+                a_t, _ = jax.lax.scan(col, a_t0, jnp.arange(U1))
+                return a_t, a_t
+            _, alphas = jax.lax.scan(row, jnp.full((U1,), NEG),
+                                     jnp.arange(T))
+            final = alphas[t_len - 1, u_len] \
+                + blank_lp[t_len - 1, u_len]
+            return -final
+        per = jax.vmap(one)(logp, lab.astype(jnp.int32), il, ul)
+        if reduction == "mean":
+            return per.mean()
+        if reduction == "sum":
+            return per.sum()
+        return per
+    return _op(impl, input, _arr(label), op_name="warprnnt")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (ref hsigmoid_loss_kernel): default
+    complete binary tree over num_classes leaves, or a custom tree via
+    path_table (per-label nonleaf node ids, -1 padded) + path_code
+    (per-label branch bits)."""
+    if (path_table is None) != (path_code is None):
+        raise ValueError("path_table and path_code must be given together")
+    if path_table is not None:
+        pt = _arr(path_table).astype(jnp.int32)
+        pc = _arr(path_code).astype(jnp.int32)
+
+        def impl_custom(x, lab, w, *rest):
+            b = rest[0] if bias is not None else None
+            rows = pt[lab.reshape(-1)]           # [B, L]
+            bits = pc[lab.reshape(-1)]           # [B, L]
+            valid = rows >= 0
+            widx = jnp.clip(rows, 0, w.shape[0] - 1)
+            logit = jnp.einsum("bh,blh->bl", x, w[widx])
+            if b is not None:
+                logit = logit + b.reshape(-1)[widx]
+            t = bits.astype(x.dtype)
+            bce = jnp.maximum(logit, 0) - logit * t + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            return jnp.where(valid, bce, 0.0).sum(-1, keepdims=True)
+        args = (input, _arr(label), weight) + \
+            ((bias,) if bias is not None else ())
+        return _op(impl_custom, *args, op_name="hsigmoid_loss")
+
+    def impl(x, lab, w, *rest):
+        b = rest[0] if bias is not None else None
+        B = x.shape[0]
+        # default tree: codes are the bits of (label + num_classes) walked
+        # from the MSB below the root, matching the reference's simple
+        # Huffman-free layout
+        code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+        lab_i = lab.reshape(-1).astype(jnp.int32)
+        node = lab_i + num_classes
+        losses = jnp.zeros((B,), x.dtype)
+        for _ in range(code_len):
+            parent = node // 2
+            bit = (node % 2).astype(x.dtype)     # 1 = right child
+            idx = parent - 1                     # nonleaf index
+            valid = parent >= 1
+            wrow = w[jnp.clip(idx, 0, w.shape[0] - 1)]
+            logit = (x * wrow).sum(-1)
+            if b is not None:
+                logit = logit + b.reshape(-1)[
+                    jnp.clip(idx, 0, b.size - 1)]
+            # label for sigmoid: left child -> 1, right -> 0 (paddle code
+            # convention: path_code bit true means take the "1" branch)
+            t = 1.0 - bit
+            bce = jnp.maximum(logit, 0) - logit * t + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            losses = losses + jnp.where(valid, bce, 0.0)
+            node = parent
+        return losses[:, None]
+    args = (input, _arr(label), weight) + \
+        ((bias,) if bias is not None else ())
+    return _op(impl, *args, op_name="hsigmoid_loss")
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (ref gather_tree_kernel). ids/parents:
+    [T, B, beam] -> full sequences [T, B, beam]."""
+    def impl(idv, par):
+        T = idv.shape[0]
+
+        def back(beam_idx, t):
+            # beam_idx: [B, beam] selects which beam each output row
+            # followed at step t+1
+            out_t = jnp.take_along_axis(idv[t], beam_idx, axis=1)
+            prev = jnp.take_along_axis(par[t], beam_idx, axis=1)
+            return prev.astype(jnp.int32), out_t
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=jnp.int32)[None],
+            idv.shape[1:]).astype(jnp.int32)
+        _, outs = jax.lax.scan(back, init, jnp.arange(T), reverse=True)
+        return outs
+    return _op(impl, ids, _arr(parents), op_name="gather_tree",
+               differentiable=False)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers + remap labels (ref
+    class_center_sample_kernel; PartialFC training). Host-side sampling
+    (data-dependent), deterministic under paddle.seed."""
+    lab = np.asarray(_arr(label)).reshape(-1)
+    pos = np.unique(lab)
+    from ...framework import random as _random
+    key = _random.next_key()
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, min(num_samples, num_classes) - len(pos))
+    if n_extra > 0 and len(rest) > 0:
+        perm = np.asarray(jax.random.permutation(key, len(rest)))
+        sampled = np.concatenate([pos, rest[perm[:n_extra]]])
+    else:
+        sampled = pos
+    sampled = np.sort(sampled)
+    remap = -np.ones((num_classes,), np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace/CosFace-style margin softmax (ref
+    margin_cross_entropy_kernel): cos(m1*theta + m2) - m3 on the target
+    logit, then scaled cross entropy."""
+    def impl(lg, lab):
+        lab_i = lab.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab_i, lg.shape[-1], dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -(onehot * logp).sum(-1, keepdims=True)
+        if reduction == "mean":
+            lossr = loss.mean()
+        elif reduction == "sum":
+            lossr = loss.sum()
+        else:
+            lossr = loss
+        if return_softmax:
+            return lossr, jnp.exp(logp)
+        return lossr
+    return _op(impl, logits, _arr(label), op_name="margin_cross_entropy")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per sequence pair (ref edit_distance_kernel).
+    Host-side DP (data-dependent control flow; a metric, not a training
+    op). Returns (distance [B, 1], sequence_num)."""
+    a = np.asarray(_arr(input))
+    b = np.asarray(_arr(label))
+    il = np.asarray(_arr(input_length)).reshape(-1) \
+        if input_length is not None else np.full(a.shape[0], a.shape[1])
+    ll = np.asarray(_arr(label_length)).reshape(-1) \
+        if label_length is not None else np.full(b.shape[0], b.shape[1])
+    ignored = set(ignored_tokens or [])
+    dists = []
+    for i in range(a.shape[0]):
+        s1 = [t for t in a[i][:il[i]].tolist() if t not in ignored]
+        s2 = [t for t in b[i][:ll[i]].tolist() if t not in ignored]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.float64)
+        for x in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = x
+            for y in range(1, n + 1):
+                dp[y] = min(prev[y] + 1, dp[y - 1] + 1,
+                            prev[y - 1] + (s1[x - 1] != s2[y - 1]))
+        d = dp[n]
+        if normalized:
+            d = d / max(n, 1)
+        dists.append(d)
+    return (Tensor(jnp.asarray(np.asarray(dists, np.float32)
+                               .reshape(-1, 1))),
+            Tensor(jnp.asarray([a.shape[0]], jnp.int64)))
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size,
+            ndim, op_name):
+    def impl(xa, idx):
+        spatial_in = xa.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size[-ndim:])
+        else:
+            k = (kernel_size,) * ndim if isinstance(kernel_size, int) \
+                else tuple(kernel_size)
+            s = k if stride is None else (
+                (stride,) * ndim if isinstance(stride, int)
+                else tuple(stride))
+            p = (padding,) * ndim if isinstance(padding, int) \
+                else tuple(padding)
+            out_sp = tuple((spatial_in[i] - 1) * s[i] - 2 * p[i] + k[i]
+                           for i in range(ndim))
+        B, C = xa.shape[:2]
+        flat_sp = int(np.prod(out_sp))
+        out = jnp.zeros((B, C, flat_sp), xa.dtype)
+        xf = xa.reshape(B, C, -1)
+        idxf = idx.reshape(B, C, -1).astype(jnp.int32)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, idxf, xf)
+        return out.reshape((B, C) + out_sp)
+    return _op(impl, x, _arr(indices), op_name=op_name)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """ref unpool op: scatter pooled values back to argmax positions."""
+    return _unpool(x, indices, kernel_size, stride, padding, output_size,
+                   2, "unpool")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """ref unpool3d op."""
+    return _unpool(x, indices, kernel_size, stride, padding, output_size,
+                   3, "unpool3d")

@@ -1,0 +1,103 @@
+"""paddle.nn.utils analog — parametrization helpers.
+
+Ref: spectral_norm kernel /root/reference/paddle/phi/kernels/
+spectral_norm_kernel_impl.h; python/paddle/nn/utils/
+(spectral_norm_hook.py, weight_norm_hook.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import apply as _apply
+from ..framework.tensor import Tensor
+
+
+def _op(fn, *args, op_name=None):
+    return _apply(fn, args, op_name=op_name)
+
+
+def spectral_norm_value(weight, u=None, dim=0, power_iters=1, eps=1e-12):
+    """Functional spectral normalization (ref spectral_norm op):
+    W / sigma_max(W) with sigma estimated by power iteration. Returns
+    (normalized_weight, new_u)."""
+    def impl(w, u0):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u_ = u0
+        v_ = None
+        for _ in range(max(power_iters, 1)):
+            v_ = wm.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = wm @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        sigma = u_ @ (wm @ v_)
+        return w / sigma, u_
+    w = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    h = w.shape[dim]
+    if u is None:
+        u0 = jax.random.normal(jax.random.PRNGKey(0), (h,), w.dtype)
+        u0 = u0 / (jnp.linalg.norm(u0) + eps)
+    else:
+        u0 = u.data if isinstance(u, Tensor) else jnp.asarray(u)
+    return _op(impl, weight, u0, op_name="spectral_norm")
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Wrap a Layer so `name` is spectrally normalized on every forward
+    (ref spectral_norm_hook.py)."""
+    if dim is None:
+        dim = 0
+    orig = getattr(layer, name)
+    raw_name = name + "_orig"
+    setattr(layer, raw_name, orig)
+    state = {"u": None}
+
+    old_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        w = getattr(layer, raw_name)
+        out = spectral_norm_value(w, state["u"], dim=dim,
+                                  power_iters=n_power_iterations, eps=eps)
+        wn, u = out
+        state["u"] = Tensor(u.data if isinstance(u, Tensor)
+                            else jnp.asarray(u), stop_gradient=True)
+        setattr(layer, name, wn)
+        return old_forward(*args, **kwargs)
+
+    layer.forward = forward
+    return layer
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """ref weight_norm_hook.py: reparametrize weight = g * v / ||v||."""
+    w = getattr(layer, name)
+    wd = w.data if isinstance(w, Tensor) else jnp.asarray(w)
+    axes = tuple(i for i in range(wd.ndim) if i != dim)
+    g0 = jnp.sqrt((wd * wd).sum(axes, keepdims=True))
+    layer.add_parameter(name + "_g", Tensor(g0, stop_gradient=False)) \
+        if hasattr(layer, "add_parameter") else \
+        setattr(layer, name + "_g", Tensor(g0, stop_gradient=False))
+    setattr(layer, name + "_v", w)
+
+    old_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        v = getattr(layer, name + "_v")
+        g = getattr(layer, name + "_g")
+
+        def impl(vv, gg):
+            norm = jnp.sqrt((vv * vv).sum(axes, keepdims=True) + 1e-12)
+            return gg * vv / norm
+        setattr(layer, name, _op(impl, v, g, op_name="weight_norm"))
+        return old_forward(*args, **kwargs)
+
+    layer.forward = forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = getattr(layer, name + "_v", None)
+    if v is not None:
+        setattr(layer, name, v)
+    return layer

@@ -29,7 +29,8 @@ __all__ = [
     "reciprocal_", "round_", "ceil_", "floor_", "tanh_", "sigmoid_",
     "quantile", "trapezoid", "cumulative_trapezoid", "rot90", "logit",
     "log_normalize", "renorm", "inverse", "digamma", "lgamma", "polygamma",
-    "nextafter", "ldexp", "copysign", "signbit", "i0", "sinc", "take",
+    "nextafter", "ldexp", "copysign", "signbit", "i0", "i0e", "i1",
+    "i1e", "multiplex", "sinc", "take",
     "broadcast_shape", "mm", "vander", "led_to_default",
 ]
 
@@ -708,3 +709,32 @@ def tanh_(x, name=None):
 
 def sigmoid_(x, name=None):
     return apply_inplace(x, jax.nn.sigmoid, (x,))
+
+def i0e(x, name=None):
+    """Exponentially scaled modified Bessel I0 (ref i0e op)."""
+    from jax.scipy.special import i0e as _i0e
+    return op("i0e", _i0e, x)
+
+
+def i1(x, name=None):
+    from jax.scipy.special import i1 as _i1
+    return op("i1", _i1, x)
+
+
+def i1e(x, name=None):
+    from jax.scipy.special import i1e as _i1e
+    return op("i1e", _i1e, x)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (ref multiplex op):
+    out[i] = inputs[index[i]][i]."""
+    from ..framework.op import apply as _ap
+
+    def impl(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)           # [K, B, ...]
+        ii = idx.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ii, rows]
+    return _ap(lambda idx, *xs: impl(idx, *xs),
+               (index,) + tuple(inputs), op_name="multiplex")

@@ -1,0 +1,124 @@
+"""paddle.signal analog (ref: /root/reference/python/paddle/signal.py —
+frame/overlap_add/stft/istft over the frame_kernel / overlap_add_kernel
+phi ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.op import apply as _apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _op(fn, *args, op_name=None):
+    return _apply(fn, args, op_name=op_name)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (ref frame op): [..., T] ->
+    [..., frame_length, n_frames] for axis=-1."""
+    def impl(a):
+        if axis in (0,):
+            a = jnp.moveaxis(a, 0, -1)
+        T = a.shape[-1]
+        n = 1 + (T - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n)[None, :])
+        out = a[..., idx]          # [..., frame_length, n]
+        if axis in (0,):
+            out = jnp.moveaxis(out, (-2, -1), (1, 0))
+        return out
+    return _op(impl, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame with summation on overlaps (ref overlap_add op):
+    [..., frame_length, n_frames] -> [..., T]."""
+    def impl(a):
+        if axis in (0,):
+            a = jnp.moveaxis(a, (0, 1), (-1, -2))
+        fl, n = a.shape[-2:]
+        T = (n - 1) * hop_length + fl
+        idx = (jnp.arange(fl)[:, None]
+               + hop_length * jnp.arange(n)[None, :])
+        out = jnp.zeros(a.shape[:-2] + (T,), a.dtype)
+        out = out.at[..., idx].add(a)
+        if axis in (0,):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return _op(impl, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """ref signal.py stft: frame -> window -> FFT."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(a, w):
+        pad = (n_fft - win_length) // 2  # center window in the frame
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1)
+                        + [(n_fft // 2, n_fft // 2)], mode=pad_mode)
+        T = a.shape[-1]
+        n = 1 + (T - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(n)[None, :])
+        frames = a[..., idx]                   # [..., n_fft, n]
+        if w is not None:
+            wfull = jnp.zeros((n_fft,), a.dtype).at[
+                pad:pad + win_length].set(w) if win_length < n_fft else w
+            frames = frames * wfull[:, None]
+        fft = jnp.fft.rfft(frames, axis=-2) if onesided else \
+            jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            fft = fft / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return fft
+    from .framework.tensor import Tensor
+    w = window.data if isinstance(window, Tensor) else window
+    return _op(lambda a: impl(a, None if w is None else jnp.asarray(w)),
+               x, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref signal.py istft: iFFT -> window -> overlap-add with window
+    envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(a, w):
+        frames = jnp.fft.irfft(a, n=n_fft, axis=-2) if onesided else \
+            jnp.fft.ifft(a, axis=-2).real
+        if normalized:
+            frames = frames * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if w is None:
+            wfull = jnp.ones((n_fft,), frames.dtype)
+        elif win_length < n_fft:
+            pad = (n_fft - win_length) // 2
+            wfull = jnp.zeros((n_fft,), frames.dtype).at[
+                pad:pad + win_length].set(w)
+        else:
+            wfull = w
+        frames = frames * wfull[:, None]
+        n = frames.shape[-1]
+        T = (n - 1) * hop_length + n_fft
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(n)[None, :])
+        out = jnp.zeros(frames.shape[:-2] + (T,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        env = jnp.zeros((T,), frames.dtype).at[idx].add(
+            (wfull ** 2)[:, None] * jnp.ones((n_fft, n), frames.dtype))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:T - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    from .framework.tensor import Tensor
+    w = window.data if isinstance(window, Tensor) else window
+    return _op(lambda a: impl(a, None if w is None else jnp.asarray(w)),
+               x, op_name="istft")

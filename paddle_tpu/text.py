@@ -1,0 +1,77 @@
+"""paddle.text analog — sequence decoding utilities.
+
+Ref: viterbi_decode kernel /root/reference/paddle/phi/kernels/gpu/
+viterbi_decode_kernel.cu (+ paddle.text.ViterbiDecoder)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.op import apply as _apply
+from .framework.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (ref viterbi_decode_kernel). potentials:
+    [B, T, N]; transition: [N, N]; lengths: [B]. Returns
+    (scores [B], paths [B, T]); positions beyond a row's length repeat
+    that row's final tag."""
+    lens = _arr(lengths).astype(jnp.int32)
+
+    def impl(emit, trans):
+        B, T, N = emit.shape
+        if include_bos_eos_tag:
+            # paddle convention: tag N-2 = BOS, N-1 = EOS
+            start = trans[N - 2][None, :]
+            stop = trans[:, N - 1][None, :]
+        else:
+            start = jnp.zeros((1, N), emit.dtype)
+            stop = jnp.zeros((1, N), emit.dtype)
+        alpha0 = emit[:, 0] + start
+
+        def fwd(alpha, t):
+            scores = alpha[:, :, None] + trans[None]     # [B, from, to]
+            best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+            best = jnp.max(scores, axis=1) + emit[:, t]
+            valid = (t < lens)[:, None]
+            return jnp.where(valid, best, alpha), best_prev
+
+        alpha, hist = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+        final = alpha + stop
+        scores = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+        def back(tag, x):
+            h, i = x  # h: best_prev into step i+1
+            prev = jnp.take_along_axis(h, tag[:, None], 1)[:, 0]
+            tag_i = jnp.where((i + 1) < lens, prev, tag)
+            return tag_i, tag_i
+
+        _, path_rev = jax.lax.scan(back, last,
+                                   (hist, jnp.arange(T - 1)),
+                                   reverse=True)
+        path = jnp.concatenate([path_rev, last[None]], axis=0)  # [T, B]
+        return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return _apply(impl, (potentials, transition_params),
+                  op_name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    """ref paddle.text.ViterbiDecoder: callable wrapper holding the
+    transitions."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

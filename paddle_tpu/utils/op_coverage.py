@@ -102,6 +102,18 @@ _ALIASES = {
     "npu_identity": "assign",
     "merge_selected_rows": "assign",
     "coalesce_tensor": "assign",
+    # long-tail ops: public names of the new modules
+    "multiclass_nms3": "multiclass_nms",
+    "deformable_conv": "deform_conv2d",
+    "depthwise_conv2d_transpose": "conv2d_transpose",
+    "warpctc": "ctc_loss",
+    "warprnnt": "rnnt_loss",
+    "unpool": "max_unpool2d",
+    "unpool3d": "max_unpool3d",
+    "segment_pool": "segment_pool",
+    "spectral_norm": "spectral_norm_value",
+    "reindex_graph": "reindex_graph",
+    "weighted_sample_neighbors": "weighted_sample_neighbors",
 }
 
 # yaml ops with trailing underscore are in-place/param-update kernels; they
@@ -146,10 +158,16 @@ def _implemented(name: str) -> bool:
                             "rprop": "Rprop"}.get(base, base.title()))
     namespaces = [paddle, F, paddle.Tensor, paddle.nn]
     for ns_name in ("linalg", "fft", "incubate", "signal", "geometric",
-                    "metric", "amp", "distribution", "sparse"):
+                    "metric", "amp", "distribution", "sparse", "text"):
         ns = getattr(paddle, ns_name, None)
         if ns is not None:
             namespaces.append(ns)
+    vops = getattr(getattr(paddle, "vision", None), "ops", None)
+    if vops is not None:
+        namespaces.append(vops)
+    nutils = getattr(paddle.nn, "utils", None)
+    if nutils is not None:
+        namespaces.append(nutils)
     for cand in candidates:
         if not cand:
             continue

@@ -1,3 +1,4 @@
 """paddle.vision (ref: /root/reference/python/paddle/vision/)."""
 from . import datasets, models, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
+from . import ops  # noqa: F401,E402

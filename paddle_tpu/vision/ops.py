@@ -1,0 +1,718 @@
+"""paddle.vision.ops analog — detection/vision operators.
+
+Ref kernels: /root/reference/paddle/phi/kernels/gpu/{nms_kernel.cu,
+roi_align_kernel.cu, roi_pool_kernel.cu, psroi_pool_kernel.cu,
+yolo_box_kernel.cu, yolo_loss_kernel.cu, prior_box_kernel.cu,
+box_coder_kernel.cu, generate_proposals_kernel.cu,
+distribute_fpn_proposals_kernel.cu, matrix_nms_kernel.cpp} and
+deformable_conv_kernel.cu.
+
+TPU-first shape: everything is fixed-shape jnp math (masked O(n^2) NMS
+instead of data-dependent loops; gather-based bilinear sampling for
+roi_align/deform_conv), so all of it jits. Data-dependent result sizes
+(nms keep-lists, proposals) return index/score tensors with -1 padding,
+matching how XLA-friendly detection heads consume them.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import apply as _apply
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "nms", "matrix_nms", "multiclass_nms", "roi_align", "roi_pool",
+    "psroi_pool", "yolo_box", "yolo_loss", "prior_box", "box_coder",
+    "deform_conv2d", "generate_proposals", "distribute_fpn_proposals",
+    "decode_jpeg",
+]
+
+
+def _op(fn, *args, op_name=None, differentiable=True):
+    return _apply(fn, args, op_name=op_name, differentiable=differentiable)
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (ref nms_kernel.cu). Returns kept indices sorted by
+    descending score. Fixed-shape masked algorithm: box i is kept iff no
+    higher-scored kept box overlaps it above the threshold."""
+    def impl(b, s):
+        n = b.shape[0]
+        order = jnp.argsort(-s)
+        bs = b[order]
+        iou = _iou_matrix(bs)
+        # greedy suppress via scan over rank order
+        def body(keep, i):
+            sup = (iou[i] > iou_threshold) & keep & \
+                (jnp.arange(n) < i)
+            keep_i = ~jnp.any(sup)
+            return keep.at[i].set(keep_i), None
+        keep0 = jnp.ones((n,), bool)
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+        kept_sorted = jnp.where(keep, jnp.arange(n), n)
+        perm = jnp.argsort(kept_sorted)
+        out = jnp.where(jnp.sort(kept_sorted) < n, order[perm], -1)
+        return out
+    b = _arr(boxes)
+    s = _arr(scores) if scores is not None else \
+        jnp.arange(b.shape[0], 0, -1, dtype=jnp.float32)
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so cross-category
+        # pairs never overlap (torchvision batched_nms trick)
+        c = _arr(category_idxs).astype(jnp.float32)
+        off = (c * (b.max() + 1.0))[:, None]
+        b = b + off
+    idx = _op(impl, b, s, op_name="nms", differentiable=False)
+    idx_np = np.asarray(idx.numpy() if isinstance(idx, Tensor) else idx)
+    idx_np = idx_np[idx_np >= 0]
+    if top_k is not None:
+        idx_np = idx_np[:top_k]
+    return Tensor(jnp.asarray(idx_np, jnp.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; ref matrix_nms_kernel.cpp): soft decay by the
+    max IoU with any higher-scored box of the same class."""
+    def impl(b, s):
+        C, N = s.shape
+        out_scores = []
+        for c in range(C):
+            if c == background_label:
+                out_scores.append(jnp.zeros((N,)))
+                continue
+            sc = s[c]
+            order = jnp.argsort(-sc)
+            bs = b[order]
+            ss = sc[order]
+            iou = _iou_matrix(bs)
+            upper = jnp.tril(iou, k=-1)  # IoU with higher-scored boxes
+            max_iou = upper.max(axis=1)
+            comp = upper.max(axis=0)
+            if use_gaussian:
+                decay = jnp.exp(-(max_iou ** 2 - comp ** 2)
+                                / gaussian_sigma)
+            else:
+                decay = (1 - max_iou) / jnp.maximum(1 - comp, 1e-10)
+            dec = ss * decay
+            inv = jnp.argsort(order)
+            out_scores.append(dec[inv] * (sc > score_threshold))
+        return jnp.stack(out_scores)
+    b, s = _arr(bboxes), _arr(scores)
+    decayed = _op(impl, b, s, op_name="matrix_nms", differentiable=False)
+    d = np.asarray(decayed.numpy() if isinstance(decayed, Tensor)
+                   else decayed)
+    bnp = np.asarray(b)
+    outs, idxs = [], []
+    C, N = d.shape
+    for c in range(C):
+        if c == background_label:
+            continue
+        keep = np.nonzero(d[c] > post_threshold)[0]
+        for i in keep:
+            outs.append([c, d[c, i], *bnp[i]])
+            idxs.append(i)
+    outs = sorted(outs, key=lambda r: -r[1])[:keep_top_k]
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    res = [Tensor(jnp.asarray(out))]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(idxs[:keep_top_k],
+                                                 np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray([out.shape[0]], jnp.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """Per-class hard NMS + global top-k (ref multiclass_nms3 op)."""
+    b = np.asarray(_arr(bboxes))
+    s = np.asarray(_arr(scores))
+    C, N = s.shape
+    results, indices = [], []
+    for c in range(C):
+        if c == background_label:
+            continue
+        mask = s[c] > score_threshold
+        if not mask.any():
+            continue
+        cand = np.nonzero(mask)[0]
+        keep = np.asarray(nms(b[cand], nms_threshold,
+                              s[c][cand]).numpy())
+        for i in keep:
+            gi = cand[i]
+            results.append([c, s[c, gi], *b[gi]])
+            indices.append(gi)
+    order = np.argsort([-r[1] for r in results])[:keep_top_k] \
+        if results else []
+    out = np.asarray([results[i] for i in order], np.float32
+                     ).reshape(-1, 6)
+    idx = np.asarray([indices[i] for i in order], np.int64)
+    res = [Tensor(jnp.asarray(out))]
+    if return_index:
+        res.append(Tensor(jnp.asarray(idx)))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray([out.shape[0]], jnp.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+multiclass_nms3 = multiclass_nms
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shape float coords -> [C, ...]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    def at(yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+        return feat[:, yi, xi]
+    valid = (y > -1) & (y < H) & (x > -1) & (x < W)
+    out = (at(y0, x0) * (1 - wy1) * (1 - wx1)
+           + at(y0, x0 + 1) * (1 - wy1) * wx1
+           + at(y0 + 1, x0) * wy1 * (1 - wx1)
+           + at(y0 + 1, x0 + 1) * wy1 * wx1)
+    return jnp.where(valid, out, 0.0)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref roi_align_kernel.cu: bilinear-sampled average pooling over each
+    RoI bin. boxes: [R, 4] xyxy in input coords; boxes_num: rois per
+    image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(feat, rois, rois_n):
+        # map each roi to its batch image
+        R = rois.shape[0]
+        img_id = jnp.searchsorted(jnp.cumsum(rois_n), jnp.arange(R),
+                                  side="right")
+        offset = 0.5 if aligned else 0.0
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one_roi(r, iid):
+            fx = feat[iid]
+            x1, y1, x2, y2 = r * spatial_scale - offset
+            rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+            rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+            bh, bw = rh / ph, rw / pw
+            iy = (jnp.arange(ph)[:, None, None, None]
+                  * bh + y1 + (jnp.arange(sr)[None, None, :, None]
+                               + 0.5) * bh / sr)
+            ix = (jnp.arange(pw)[None, :, None, None]
+                  * bw + x1 + (jnp.arange(sr)[None, None, None, :]
+                               + 0.5) * bw / sr)
+            yy = jnp.broadcast_to(iy, (ph, pw, sr, sr))
+            xx = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+            samp = _bilinear_sample(fx, yy, xx)  # [C, ph, pw, sr, sr]
+            return samp.mean(axis=(-1, -2))
+        return jax.vmap(one_roi)(rois, img_id)
+    return _op(impl, x, boxes, _arr(boxes_num).astype(jnp.int32),
+               op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """ref roi_pool_kernel.cu: max pooling over quantized RoI bins —
+    implemented as dense max over a sampled grid (8x8 per bin), matching
+    the quantized-max semantics for typical box sizes."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(feat, rois, rois_n):
+        R = rois.shape[0]
+        img_id = jnp.searchsorted(jnp.cumsum(rois_n), jnp.arange(R),
+                                  side="right")
+
+        def one_roi(r, iid):
+            fx = feat[iid]
+            H, W = fx.shape[-2:]
+            x1 = jnp.round(r[0] * spatial_scale)
+            y1 = jnp.round(r[1] * spatial_scale)
+            x2 = jnp.round(r[2] * spatial_scale)
+            y2 = jnp.round(r[3] * spatial_scale)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            bh, bw = rh / ph, rw / pw
+            sr = 8
+            iy = (jnp.arange(ph)[:, None, None, None] * bh + y1
+                  + jnp.arange(sr)[None, None, :, None] * bh / sr)
+            ix = (jnp.arange(pw)[None, :, None, None] * bw + x1
+                  + jnp.arange(sr)[None, None, None, :] * bw / sr)
+            yi = jnp.clip(iy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(ix.astype(jnp.int32), 0, W - 1)
+            yy = jnp.broadcast_to(yi, (ph, pw, sr, sr))
+            xx = jnp.broadcast_to(xi, (ph, pw, sr, sr))
+            vals = fx[:, yy, xx]
+            return vals.max(axis=(-1, -2))
+        return jax.vmap(one_roi)(rois, img_id)
+    return _op(impl, x, boxes, _arr(boxes_num).astype(jnp.int32),
+               op_name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (ref psroi_pool_kernel.cu):
+    output channel (c, i, j) pools input channel c*ph*pw + i*pw + j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(feat, rois, rois_n):
+        B, C, H, W = feat.shape
+        out_c = C // (ph * pw)
+        R = rois.shape[0]
+        img_id = jnp.searchsorted(jnp.cumsum(rois_n), jnp.arange(R),
+                                  side="right")
+
+        def one_roi(r, iid):
+            fx = feat[iid].reshape(out_c, ph, pw, H, W)
+            x1, y1, x2, y2 = r * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rw = jnp.maximum(x2 - x1, 0.1)
+            bh, bw = rh / ph, rw / pw
+            sr = 4
+            iy = (jnp.arange(ph)[:, None, None, None] * bh + y1
+                  + (jnp.arange(sr)[None, None, :, None] + 0.5)
+                  * bh / sr)
+            ix = (jnp.arange(pw)[None, :, None, None] * bw + x1
+                  + (jnp.arange(sr)[None, None, None, :] + 0.5)
+                  * bw / sr)
+            yi = jnp.clip(iy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(ix.astype(jnp.int32), 0, W - 1)
+            yy = jnp.broadcast_to(yi, (ph, pw, sr, sr))
+            xx = jnp.broadcast_to(xi, (ph, pw, sr, sr))
+            # position-sensitive: bin (i,j) reads its own channel group
+            vals = fx[:, jnp.arange(ph)[:, None, None, None],
+                      jnp.arange(pw)[None, :, None, None], yy, xx]
+            return vals.mean(axis=(-1, -2))
+        return jax.vmap(one_roi)(rois, img_id)
+    return _op(impl, x, boxes, _arr(boxes_num).astype(jnp.int32),
+               op_name="psroi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """ref box_coder_kernel: encode/decode between corner boxes and
+    center-size offsets."""
+    def impl(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        phh = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / phh,
+                             jnp.log(tw / pw), jnp.log(th / phh)], -1)
+            if pbv is not None:
+                out = out / pbv
+            return out
+        # decode
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * phh + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * phh
+        sub = 0 if box_normalized else 1
+        return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                          ocx + ow / 2 - sub, ocy + oh / 2 - sub], -1)
+    pbv = None if prior_box_var is None else _arr(prior_box_var)
+    return _op(lambda pb, tb: impl(pb, pbv, tb), prior_box, target_box,
+               op_name="box_coder")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (ref prior_box_kernel): anchors per feature-map
+    cell. Host-side numpy (static given shapes)."""
+    fh, fw = np.asarray(_arr(input)).shape[-2:]
+    ih, iw = np.asarray(_arr(image)).shape[-2:]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes, vars_ = [], []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    bs = math.sqrt(ms * max_sizes[k])
+                    cell.append((cx, cy, bs, bs))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    cell.append((cx, cy, ms * math.sqrt(ar),
+                                 ms / math.sqrt(ar)))
+            for cx_, cy_, bw, bh in cell:
+                boxes.append([(cx_ - bw / 2) / iw, (cy_ - bh / 2) / ih,
+                              (cx_ + bw / 2) / iw, (cy_ + bh / 2) / ih])
+                vars_.append(list(variance))
+    n_per_cell = len(boxes) // (fh * fw)
+    b = np.asarray(boxes, np.float32).reshape(fh, fw, n_per_cell, 4)
+    if clip:
+        b = b.clip(0, 1)
+    v = np.asarray(vars_, np.float32).reshape(fh, fw, n_per_cell, 4)
+    return Tensor(jnp.asarray(b)), Tensor(jnp.asarray(v))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """ref yolo_box_kernel: decode YOLOv3 head output into boxes+scores."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = anchors.shape[0]
+
+    def impl(xin, imgs):
+        B, C, H, W = xin.shape
+        p = xin.reshape(B, na, 5 + class_num, H, W)
+        gx = jnp.arange(W)[None, None, None, :]
+        gy = jnp.arange(H)[None, None, :, None]
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1) / 2
+        bx = (gx + sx) / W
+        by = (gy + sy) / H
+        bw = jnp.exp(p[:, :, 2]) * anchors[None, :, 0, None, None] \
+            / (W * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * anchors[None, :, 1, None, None] \
+            / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        probs = jax.nn.sigmoid(p[:, :, 5:])
+        score = conf[:, :, None] * probs
+        keep = conf > conf_thresh
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) \
+            * keep[..., None].astype(x1.dtype)
+        scores = score * keep[:, :, None].astype(score.dtype)
+        boxes = boxes.reshape(B, -1, 4)
+        scores = scores.transpose(0, 2, 1, 3, 4).reshape(B, class_num, -1)
+        return boxes, scores
+    return _op(impl, x, _arr(img_size), op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (ref yolo_loss_kernel): coordinate + objectness +
+    classification terms over assigned anchors."""
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+
+    def impl(xin, gbox, glabel):
+        B, C, H, W = xin.shape
+        p = xin.reshape(B, na, 5 + class_num, H, W)
+        an = jnp.asarray(anchors_np[np.asarray(mask)])
+        # build targets: each gt assigned to best anchor (by wh IoU over
+        # the masked set) at its center cell
+        def per_image(pb, gb, gl):
+            tx = jnp.zeros((na, H, W))
+            ty = jnp.zeros((na, H, W))
+            tw = jnp.zeros((na, H, W))
+            th = jnp.zeros((na, H, W))
+            tobj = jnp.zeros((na, H, W))
+            tcls = jnp.zeros((na, class_num, H, W))
+
+            def assign(carry, g):
+                tx, ty, tw, th, tobj, tcls, gl_i = carry
+                box, label = g
+                gx, gy, gw, gh = box
+                valid = gw > 0
+                ci = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+                ri = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+                inter = jnp.minimum(gw, an[:, 0] / (W * downsample_ratio)) \
+                    * jnp.minimum(gh, an[:, 1] / (H * downsample_ratio))
+                union = gw * gh + (an[:, 0] * an[:, 1])  \
+                    / (W * downsample_ratio * H * downsample_ratio) - inter
+                best = jnp.argmax(inter / jnp.maximum(union, 1e-10))
+                upd = lambda t, v: jnp.where(
+                    valid, t.at[best, ri, ci].set(v), t)
+                tx = upd(tx, gx * W - ci)
+                ty = upd(ty, gy * H - ri)
+                tw = upd(tw, jnp.log(jnp.maximum(
+                    gw * W * downsample_ratio / an[best, 0], 1e-9)))
+                th = upd(th, jnp.log(jnp.maximum(
+                    gh * H * downsample_ratio / an[best, 1], 1e-9)))
+                tobj = upd(tobj, 1.0)
+                tcls = jnp.where(valid, tcls.at[best, label, ri, ci]
+                                 .set(1.0), tcls)
+                return (tx, ty, tw, th, tobj, tcls, gl_i), None
+
+            (tx, ty, tw, th, tobj, tcls, _), _ = jax.lax.scan(
+                assign, (tx, ty, tw, th, tobj, tcls, 0),
+                (gb, gl.astype(jnp.int32)))
+            obj_mask = tobj > 0
+            # ignore mask (ref yolo_loss_kernel): predictions whose
+            # decoded box overlaps ANY gt above ignore_thresh contribute
+            # no negative-objectness loss
+            gx = jnp.arange(W)[None, None, :]
+            gy = jnp.arange(H)[None, :, None]
+            sxy = lambda v: jax.nn.sigmoid(v) * scale_x_y \
+                - (scale_x_y - 1) / 2
+            px = (gx + sxy(pb[:, 0])) / W
+            py = (gy + sxy(pb[:, 1])) / H
+            pw = jnp.exp(jnp.clip(pb[:, 2], -10, 10)) \
+                * an[:, 0, None, None] / (W * downsample_ratio)
+            phh = jnp.exp(jnp.clip(pb[:, 3], -10, 10)) \
+                * an[:, 1, None, None] / (H * downsample_ratio)
+
+            def iou_with_gt(gbox_one):
+                bx, by, bw2, bh2 = gbox_one
+                ix = jnp.maximum(
+                    jnp.minimum(px + pw / 2, bx + bw2 / 2)
+                    - jnp.maximum(px - pw / 2, bx - bw2 / 2), 0)
+                iy = jnp.maximum(
+                    jnp.minimum(py + phh / 2, by + bh2 / 2)
+                    - jnp.maximum(py - phh / 2, by - bh2 / 2), 0)
+                inter = ix * iy
+                union = pw * phh + bw2 * bh2 - inter
+                return jnp.where(union > 0, inter / union, 0.0)
+            max_iou = jax.vmap(iou_with_gt)(gb).max(0)
+            noobj_ignore = (max_iou > ignore_thresh) & ~obj_mask
+            bce = lambda lo, t: jnp.maximum(lo, 0) - lo * t + \
+                jnp.log1p(jnp.exp(-jnp.abs(lo)))
+            loss_xy = jnp.where(obj_mask,
+                                bce(pb[:, 0], tx) + bce(pb[:, 1], ty),
+                                0).sum()
+            loss_wh = jnp.where(obj_mask,
+                                jnp.abs(pb[:, 2] - tw)
+                                + jnp.abs(pb[:, 3] - th), 0).sum()
+            loss_obj = jnp.where(noobj_ignore, 0.0,
+                                 bce(pb[:, 4],
+                                     tobj.astype(pb.dtype))).sum()
+            # ref label smooth: positive target 1 - 1/C, negative 1/C
+            smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+            tcls_s = tcls * (1.0 - 2.0 * smooth) + smooth
+            loss_cls = jnp.where(obj_mask[:, None],
+                                 bce(pb[:, 5:], tcls_s), 0).sum()
+            return loss_xy + loss_wh + loss_obj + loss_cls
+        return jax.vmap(per_image)(p, gbox, glabel).astype(xin.dtype)
+    return _op(impl, x, gt_box, _arr(gt_label), op_name="yolo_loss")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (ref deformable_conv_kernel.cu): gather
+    bilinear-sampled patches at learned offsets, then a dense GEMM."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def impl(xin, off, w, *rest):
+        msk = rest[0] if mask is not None else None
+        B, C, H, W = xin.shape
+        O, Cg, kh, kw = w.shape
+        oh = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+
+        def per_image(fx, fo, fm):
+            # base sampling grid: yy[i,j,k] = i*s - p + (k // kw)*dil
+            base_y = jnp.arange(oh) * s[0] - p[0]
+            base_x = jnp.arange(ow) * s[1] - p[1]
+            ky = jnp.repeat(jnp.arange(kh) * d[0], kw)   # [K]
+            kx = jnp.tile(jnp.arange(kw) * d[1], kh)     # [K]
+            yy = jnp.broadcast_to(
+                base_y[:, None, None] + ky[None, None, :], (oh, ow, K)
+            ).astype(jnp.float32)
+            xx = jnp.broadcast_to(
+                base_x[None, :, None] + kx[None, None, :], (oh, ow, K)
+            ).astype(jnp.float32)
+            o = fo.reshape(deformable_groups, K, 2, oh, ow)
+            # paddle offset layout: [dg * K * 2, oh, ow], (dy, dx) pairs
+            dy = o[:, :, 0].transpose(2, 3, 0, 1)
+            dx = o[:, :, 1].transpose(2, 3, 0, 1)
+            cg = C // deformable_groups
+            cols = []
+            for gdg in range(deformable_groups):
+                ys = yy + dy[:, :, gdg]
+                xs = xx + dx[:, :, gdg]
+                sampled = _bilinear_sample(
+                    fx[gdg * cg:(gdg + 1) * cg], ys, xs)  # [cg,oh,ow,K]
+                if fm is not None:
+                    m = fm.reshape(deformable_groups, K, oh, ow)
+                    sampled = sampled * m[gdg].transpose(1, 2, 0)
+                cols.append(sampled)
+            col = jnp.concatenate(cols, 0)        # [C, oh, ow, K]
+            col = col.transpose(0, 3, 1, 2).reshape(C * K, oh * ow)
+            wmat = w.reshape(O, Cg * K)
+            if groups == 1:
+                out = wmat @ col.reshape(C * K, oh * ow)
+            else:
+                og = O // groups
+                outs = []
+                for gi in range(groups):
+                    outs.append(
+                        wmat[gi * og:(gi + 1) * og]
+                        @ col.reshape(groups, Cg * K, oh * ow)[gi])
+                out = jnp.concatenate(outs, 0)
+            return out.reshape(O, oh, ow)
+        if msk is None:
+            out = jax.vmap(lambda a, b2: per_image(a, b2, None))(xin, off)
+        else:
+            out = jax.vmap(per_image)(xin, off, msk)
+        if bias is not None:
+            out = out + _arr(bias)[None, :, None, None]
+        return out
+    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+    return _op(impl, *args, op_name="deformable_conv")
+
+
+deformable_conv = deform_conv2d
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation (ref generate_proposals_kernel):
+    decode anchors + deltas, clip, filter small, NMS, top-k."""
+    sc = np.asarray(_arr(scores))
+    bd = np.asarray(_arr(bbox_deltas))
+    im = np.asarray(_arr(img_size))
+    an = np.asarray(_arr(anchors)).reshape(-1, 4)
+    va = np.asarray(_arr(variances)).reshape(-1, 4)
+    B = sc.shape[0]
+    all_rois, all_nums, all_scores = [], [], []
+    for b in range(B):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        aw = a[:, 2] - a[:, 0] + (1 if pixel_offset else 0)
+        ah = a[:, 3] - a[:, 1] + (1 if pixel_offset else 0)
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        sub = 1 if pixel_offset else 0
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - sub, cy + h / 2 - sub], -1)
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, im[b, 1] - 1)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, im[b, 0] - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if boxes.shape[0]:
+            kept = np.asarray(nms(boxes, nms_thresh, s).numpy())
+            kept = kept[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes)
+        all_scores.append(s)
+        all_nums.append(boxes.shape[0])
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              .astype(np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0)
+                                 .astype(np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(all_nums,
+                                                 jnp.int32))
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """ref distribute_fpn_proposals_kernel: route each RoI to an FPN
+    level by its scale."""
+    rois = np.asarray(_arr(fpn_rois))
+    off = 1 if pixel_offset else 0
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + off)
+        * (rois[:, 3] - rois[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = lvl.clip(min_level, max_level).astype(np.int64)
+    outs, index = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        index.append(idx)
+    restore = np.argsort(np.concatenate(index)) if index else \
+        np.zeros((0,), np.int64)
+    res_num = [Tensor(jnp.asarray([len(i)], jnp.int32)) for i in index]
+    return outs, Tensor(jnp.asarray(restore.astype(np.int64)
+                                    .reshape(-1, 1))), res_num
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """ref decode_jpeg op (nvjpeg-backed). Host-side via PIL — image
+    decode is input-pipeline work, not accelerator work, on TPU."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs Pillow on the host") from e
+    raw = bytes(np.asarray(_arr(x)).astype(np.uint8).tolist())
+    img = Image.open(io.BytesIO(raw))
+    if mode != "unchanged":
+        img = img.convert("L" if mode == "gray" else "RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -1,0 +1,439 @@
+"""Long-tail op tests: vision detection ops, signal, geometric, text,
+sequence losses (the final 36 yaml ops -> 100% coverage)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+rng = np.random.default_rng(0)
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---- NMS family ------------------------------------------------------------
+
+def _nms_ref(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            x1 = max(boxes[i, 0], boxes[j, 0])
+            y1 = max(boxes[i, 1], boxes[j, 1])
+            x2 = min(boxes[i, 2], boxes[j, 2])
+            y2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a1 + a2 - inter) > thr:
+                sup[j] = True
+    return keep
+
+
+def test_nms_matches_greedy_reference():
+    boxes = rng.uniform(0, 90, (30, 2)).astype(np.float32)
+    boxes = np.concatenate([boxes, boxes + rng.uniform(5, 30, (30, 2))
+                            .astype(np.float32)], -1)
+    scores = rng.random(30).astype(np.float32)
+    got = _np(vops.nms(_t(boxes), 0.4, _t(scores))).tolist()
+    assert got == _nms_ref(boxes, scores, 0.4)
+
+
+def test_multiclass_and_matrix_nms_smoke():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                       np.float32)
+    scores = np.asarray([[0.9, 0.85, 0.7], [0.1, 0.2, 0.8]], np.float32)
+    out, idx, num = vops.multiclass_nms(_t(boxes), _t(scores),
+                                        score_threshold=0.3,
+                                        background_label=-1,
+                                        return_index=True)
+    o = _np(out)
+    assert o.shape[1] == 6 and int(_np(num)[0]) == o.shape[0]
+    assert o.shape[0] >= 2  # overlapping pair suppressed per class
+    out2 = vops.matrix_nms(_t(boxes), _t(scores), score_threshold=0.3,
+                           post_threshold=0.1, background_label=-1,
+                           return_index=False, return_rois_num=False)
+    assert _np(out2).shape[1] == 6
+
+
+# ---- RoI ops ---------------------------------------------------------------
+
+def test_roi_align_constant_field():
+    # constant feature map: any aligned average is that constant
+    feat = np.full((1, 3, 16, 16), 2.5, np.float32)
+    boxes = np.asarray([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+    out = vops.roi_align(_t(feat), _t(boxes), _t(np.asarray([2])), 4)
+    assert _np(out).shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(_np(out), 2.5, rtol=1e-5)
+
+
+def test_roi_align_linear_field_center():
+    # f(x, y) = x: bin centers reproduce the coordinate
+    feat = np.tile(np.arange(16, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 16, 1))
+    boxes = np.asarray([[4, 4, 8, 8]], np.float32)
+    out = _np(vops.roi_align(_t(feat), _t(boxes),
+                             _t(np.asarray([1])), 2))
+    np.testing.assert_allclose(out[0, 0, 0], [4.5, 6.5], atol=0.1)
+
+
+def test_roi_pool_max_and_psroi():
+    feat = np.zeros((1, 4, 8, 8), np.float32)
+    feat[0, :, 5, 5] = 7.0
+    boxes = np.asarray([[2, 2, 7, 7]], np.float32)
+    out = _np(vops.roi_pool(_t(feat), _t(boxes), _t(np.asarray([1])), 2))
+    assert out.max() == 7.0
+    ps = _np(vops.psroi_pool(_t(np.ones((1, 8, 8, 8), np.float32)),
+                             _t(boxes), _t(np.asarray([1])), 2))
+    assert ps.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(ps, 1.0, rtol=1e-5)
+
+
+# ---- box transforms --------------------------------------------------------
+
+def test_box_coder_roundtrip():
+    priors = rng.uniform(0, 50, (10, 2)).astype(np.float32)
+    priors = np.concatenate([priors, priors + 10], -1)
+    targets = priors + rng.uniform(-3, 3, (10, 4)).astype(np.float32)
+    enc = vops.box_coder(_t(priors), None, _t(targets),
+                         code_type="encode_center_size")
+    dec = vops.box_coder(_t(priors), None, enc,
+                         code_type="decode_center_size")
+    np.testing.assert_allclose(_np(dec), targets, atol=1e-3, rtol=1e-4)
+
+
+def test_prior_box_counts():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes, vars_ = vops.prior_box(_t(feat), _t(img), min_sizes=[16.0],
+                                  aspect_ratios=[2.0], flip=True,
+                                  clip=True)
+    b = _np(boxes)
+    assert b.shape == (4, 4, 3, 4)  # 1 min + 2 ARs (2.0 + flipped 0.5)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_yolo_box_shapes_and_range():
+    B, na, cls, H = 1, 3, 5, 4
+    x = rng.standard_normal((B, na * (5 + cls), H, H)).astype(np.float32)
+    boxes, scores = vops.yolo_box(_t(x), _t(np.asarray([[64, 64]])),
+                                  anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=cls, conf_thresh=0.0,
+                                  downsample_ratio=16)
+    assert _np(boxes).shape == (B, na * H * H, 4)
+    assert _np(scores).shape == (B, cls, na * H * H)
+
+
+def test_yolo_loss_decreases():
+    B, na, cls, H = 1, 3, 4, 4
+    x = paddle.to_tensor(
+        rng.standard_normal((B, na * (5 + cls), H, H)).astype(np.float32)
+        * 0.1, stop_gradient=False)
+    gt_box = _t(np.asarray([[[0.5, 0.5, 0.3, 0.4]]], np.float32))
+    gt_label = _t(np.asarray([[1]], np.int64))
+    loss = F.yolo_loss if hasattr(F, "yolo_loss") else vops.yolo_loss
+    l0 = loss(x, gt_box, gt_label, anchors=[10, 13, 16, 30, 33, 23],
+              anchor_mask=[0, 1, 2], class_num=cls, ignore_thresh=0.5,
+              downsample_ratio=16)
+    l0.sum().backward()
+    assert x.grad is not None and np.isfinite(_np(x.grad)).all()
+
+
+def test_generate_proposals_and_fpn_distribute():
+    H = W = 4
+    A = 3
+    scores = rng.random((1, A, H, W)).astype(np.float32)
+    deltas = (rng.standard_normal((1, 4 * A, H, W)) * 0.1
+              ).astype(np.float32)
+    anchors = rng.uniform(0, 40, (H, W, A, 2)).astype(np.float32)
+    anchors = np.concatenate([anchors, anchors + 16], -1)
+    var = np.full((H, W, A, 4), 1.0, np.float32)
+    rois, rscores, num = vops.generate_proposals(
+        _t(scores), _t(deltas), _t(np.asarray([[64, 64]], np.float32)),
+        _t(anchors), _t(var), post_nms_top_n=10)
+    r = _np(rois)
+    assert r.shape[1] == 4 and int(_np(num)[0]) == r.shape[0]
+    outs, restore, nums = vops.distribute_fpn_proposals(
+        _t(np.concatenate([r, r * 4], 0)), 2, 5, 4, 224)
+    assert len(outs) == 4
+    total = sum(int(_np(n)[0]) for n in nums)
+    assert total == 2 * r.shape[0]
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    got = _np(vops.deform_conv2d(_t(x), _t(off), _t(w)))
+    want = _np(F.conv2d(_t(x), _t(w)))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+# ---- signal ----------------------------------------------------------------
+
+def test_frame_overlap_add_roundtrip():
+    x = rng.standard_normal((2, 64)).astype(np.float32)
+    fr = paddle.signal.frame(_t(x), 16, 16)  # non-overlapping
+    assert _np(fr).shape == (2, 16, 4)
+    back = paddle.signal.overlap_add(fr, 16)
+    np.testing.assert_allclose(_np(back), x, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    x = rng.standard_normal((1, 256)).astype(np.float32)
+    w = np.hanning(64).astype(np.float32)
+    spec = paddle.signal.stft(_t(x), 64, hop_length=16, window=_t(w))
+    back = paddle.signal.istft(spec, 64, hop_length=16, window=_t(w),
+                               length=256)
+    np.testing.assert_allclose(_np(back), x, atol=1e-4, rtol=1e-4)
+
+
+# ---- geometric -------------------------------------------------------------
+
+def test_send_u_recv_and_variants():
+    x = np.asarray([[1.0], [2.0], [3.0]], np.float32)
+    src = np.asarray([0, 1, 2, 0])
+    dst = np.asarray([1, 2, 1, 0])
+    out = _np(paddle.geometric.send_u_recv(_t(x), _t(src), _t(dst),
+                                           "sum"))
+    np.testing.assert_allclose(out, [[1], [4], [2]])
+    out = _np(paddle.geometric.send_u_recv(_t(x), _t(src), _t(dst),
+                                           "max"))
+    np.testing.assert_allclose(out, [[1], [3], [2]])
+    e = np.asarray([[10.], [20.], [30.], [40.]], np.float32)
+    out = _np(paddle.geometric.send_ue_recv(_t(x), _t(e), _t(src),
+                                            _t(dst), "add", "sum"))
+    np.testing.assert_allclose(out, [[41], [44], [22]])
+    out = _np(paddle.geometric.send_uv(_t(x), _t(x), _t(src), _t(dst),
+                                       "mul"))
+    np.testing.assert_allclose(out, [[2], [6], [6], [1]])
+
+
+def test_segment_ops():
+    d = np.asarray([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    ids = np.asarray([0, 0, 1])
+    np.testing.assert_allclose(
+        _np(paddle.geometric.segment_sum(_t(d), _t(ids))),
+        [[4, 6], [5, 6]])
+    np.testing.assert_allclose(
+        _np(paddle.geometric.segment_mean(_t(d), _t(ids))),
+        [[2, 3], [5, 6]])
+    np.testing.assert_allclose(
+        _np(paddle.geometric.segment_pool(_t(d), _t(ids), "max")),
+        [[3, 4], [5, 6]])
+
+
+def test_reindex_and_sampling():
+    src, dst, nodes = paddle.geometric.reindex_graph(
+        _t(np.asarray([10, 20])), _t(np.asarray([20, 30, 10, 40])),
+        _t(np.asarray([2, 2])))
+    assert _np(nodes).tolist() == [10, 20, 30, 40]
+    assert _np(src).tolist() == [1, 2, 0, 3]
+    assert _np(dst).tolist() == [0, 0, 1, 1]
+    # CSC graph: node 0 has neighbors {1, 2}; node 1 has {0}
+    row = np.asarray([1, 2, 0])
+    colptr = np.asarray([0, 2, 3])
+    w = np.asarray([1.0, 1.0, 1.0], np.float32)
+    out, counts = paddle.geometric.weighted_sample_neighbors(
+        _t(row), _t(colptr), _t(w), _t(np.asarray([0, 1])), 2)
+    assert _np(counts).tolist() == [2, 1]
+    assert set(_np(out)[:2].tolist()) == {1, 2}
+
+
+# ---- text / sequence -------------------------------------------------------
+
+def test_viterbi_matches_brute_force():
+    B, T, N = 2, 4, 3
+    emit = rng.standard_normal((B, T, N)).astype(np.float32)
+    trans = rng.standard_normal((N, N)).astype(np.float32)
+    lens = np.asarray([4, 3])
+    scores, path = paddle.text.viterbi_decode(
+        _t(emit), _t(trans), _t(lens), include_bos_eos_tag=False)
+    import itertools
+    for b in range(B):
+        best, best_p = -1e30, None
+        L = lens[b]
+        for p in itertools.product(range(N), repeat=L):
+            s = emit[b, 0, p[0]] + sum(
+                trans[p[i - 1], p[i]] + emit[b, i, p[i]]
+                for i in range(1, L))
+            if s > best:
+                best, best_p = s, p
+        np.testing.assert_allclose(_np(scores)[b], best, rtol=1e-5)
+        assert _np(path)[b][:L].tolist() == list(best_p)
+
+
+def test_edit_distance():
+    a = np.asarray([[1, 2, 3, 4]], np.int64)
+    b = np.asarray([[1, 3, 3, 9]], np.int64)
+    d, n = F.edit_distance(_t(a), _t(b), normalized=False)
+    assert float(_np(d)[0, 0]) == 2.0
+    d, _ = F.edit_distance(_t(a), _t(b), normalized=True)
+    np.testing.assert_allclose(float(_np(d)[0, 0]), 0.5)
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2
+    ids = np.asarray([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.asarray([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    out = _np(F.gather_tree(_t(ids), _t(parents)))
+    # beam 0's final step came from parent beam 1 at t=2
+    assert out[:, 0, 0].tolist() == [1, 4, 5]
+    assert out[:, 0, 1].tolist() == [1, 3, 6]
+
+
+# ---- losses ----------------------------------------------------------------
+
+def test_ctc_loss_perfect_alignment_low():
+    T, B, C = 8, 1, 4
+    logits = np.full((T, B, C), -5.0, np.float32)
+    labels = np.asarray([[1, 2, 3]], np.int64)
+    # strongly peak the right path: 1,1,2,2,3,3 + blanks
+    path = [1, 1, 2, 2, 3, 3, 0, 0]
+    for t, c in enumerate(path):
+        logits[t, 0, c] = 5.0
+    good = float(_np(F.ctc_loss(_t(logits), _t(labels),
+                                _t(np.asarray([8])),
+                                _t(np.asarray([3])), blank=0,
+                                reduction="none"))[0])
+    bad = float(_np(F.ctc_loss(_t(-logits), _t(labels),
+                               _t(np.asarray([8])),
+                               _t(np.asarray([3])), blank=0,
+                               reduction="none"))[0])
+    assert good < bad
+
+
+def test_rnnt_loss_matches_brute_force_tiny():
+    # T=2, U=1, C=2 (blank=0): enumerate the two paths
+    acts = rng.standard_normal((1, 2, 2, 2)).astype(np.float32)
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(acts), -1))
+    y = 1
+    # paths: emit@t0 then blanks, or blank@t0, emit@t1, blank
+    p1 = lp[0, 0, 0, y] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+    p2 = lp[0, 0, 0, 0] + lp[0, 1, 0, y] + lp[0, 1, 1, 0]
+    want = -np.logaddexp(p1, p2)
+    got = float(_np(F.rnnt_loss(_t(acts), _t(np.asarray([[y]])),
+                                _t(np.asarray([2])),
+                                _t(np.asarray([1])),
+                                reduction="none"))[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_margin_cross_entropy_reduces_to_ce():
+    logits = (rng.random((4, 6)).astype(np.float32) - 0.5) * 1.8
+    label = np.asarray([0, 2, 4, 5], np.int64)
+    got = _np(F.margin_cross_entropy(_t(logits), _t(label), margin1=1.0,
+                                     margin2=0.0, margin3=0.0, scale=1.0,
+                                     reduction="none"))
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(
+        np.clip(logits, -1, 1)), -1))
+    want = -lp[np.arange(4), label][:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_loss_trains():
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.standard_normal((9, 16)).astype(np.float32)
+                         * 0.1, stop_gradient=False)
+    lab = _t(np.asarray([0, 1, 2, 3, 4, 5, 6, 7], np.int64))
+    loss = F.hsigmoid_loss(x, lab, 10, w)
+    assert _np(loss).shape == (8, 1)
+    loss.sum().backward()
+    assert w.grad is not None and np.isfinite(_np(w.grad)).all()
+
+
+def test_hsigmoid_custom_tree():
+    x = _t(rng.standard_normal((2, 8)).astype(np.float32))
+    w = _t(rng.standard_normal((4, 8)).astype(np.float32))
+    lab = _t(np.asarray([0, 1], np.int64))
+    pt = np.asarray([[0, 1, -1], [0, 2, 3]], np.int64)
+    pc = np.asarray([[0, 1, 0], [1, 0, 1]], np.int64)
+    loss = F.hsigmoid_loss(x, lab, 4, w, path_table=_t(pt),
+                           path_code=_t(pc))
+    got = _np(loss)
+    # manual: sum of bce over the valid path nodes
+    xn, wn = _np(x), _np(w)
+
+    def bce(lo, t):
+        return max(lo, 0) - lo * t + np.log1p(np.exp(-abs(lo)))
+    want0 = bce(xn[0] @ wn[0], 0) + bce(xn[0] @ wn[1], 1)
+    want1 = (bce(xn[1] @ wn[0], 1) + bce(xn[1] @ wn[2], 0)
+             + bce(xn[1] @ wn[3], 1))
+    np.testing.assert_allclose(got[:, 0], [want0, want1], rtol=1e-5)
+
+
+def test_stft_istft_short_window():
+    x = rng.standard_normal((1, 256)).astype(np.float32)
+    w = np.hanning(32).astype(np.float32)
+    spec = paddle.signal.stft(_t(x), 64, hop_length=8, win_length=32,
+                              window=_t(w))
+    assert np.abs(_np(spec)).max() > 0
+    back = paddle.signal.istft(spec, 64, hop_length=8, win_length=32,
+                               window=_t(w), length=256)
+    np.testing.assert_allclose(_np(back)[0, 32:-32], x[0, 32:-32],
+                               atol=1e-4)
+
+
+def test_class_center_sample():
+    paddle.seed(0)
+    label = _t(np.asarray([2, 5, 2, 9], np.int64))
+    remapped, sampled = F.class_center_sample(label, 20, 6)
+    s = _np(sampled)
+    assert 2 in s and 5 in s and 9 in s and len(s) <= 6
+    r = _np(remapped)
+    assert (s[r] == np.asarray([2, 5, 2, 9])).all()
+
+
+# ---- misc ------------------------------------------------------------------
+
+def test_i0e_and_multiplex():
+    x = np.linspace(-3, 3, 7).astype(np.float32)
+    np.testing.assert_allclose(_np(paddle.i0e(_t(x))),
+                               scipy.special.i0e(x), rtol=1e-5)
+    a = np.asarray([[1., 1.], [2., 2.]], np.float32)
+    b = np.asarray([[3., 3.], [4., 4.]], np.float32)
+    idx = np.asarray([[1], [0]], np.int32)
+    out = _np(paddle.multiplex([_t(a), _t(b)], _t(idx)))
+    np.testing.assert_allclose(out, [[3, 3], [2, 2]])
+
+
+def test_max_unpool2d_roundtrip():
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    pooled, idx = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+    up = F.max_unpool2d(pooled, idx, 2, stride=2)
+    u = _np(up)
+    assert u.shape == (1, 2, 8, 8)
+    # every pooled max value must land back somewhere
+    np.testing.assert_allclose(np.sort(u[u != 0]),
+                               np.sort(_np(pooled).ravel()))
+
+
+def test_spectral_norm_unit_sigma():
+    from paddle_tpu.nn.utils import spectral_norm_value
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    wn, u = spectral_norm_value(_t(w), power_iters=50)
+    sigma = np.linalg.svd(_np(wn), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_op_coverage_100():
+    from paddle_tpu.utils.op_coverage import coverage
+    cov = coverage()
+    assert cov["pct"] == 100.0, cov["missing"]
