@@ -48,6 +48,89 @@ _define("FLAGS_tpu_fused_block", "xla",
         "graph) | pallas (this repo's fused_norm/fused_adamw kernels)")
 _define("FLAGS_low_precision_op_list", 0)
 
+# Remaining reference flags (ref flags.cc defines 89): registered for API
+# parity — get_flags/set_flags/env-override all work — with the subset
+# meaningful on a TPU runtime consumed above. Flags that tune CUDA
+# subsystems we delegate to XLA (allocator internals, cudnn algo search,
+# CINN, PS/graph-engine) are accepted and ignored, mirroring how the
+# reference itself ignores GPU flags on CPU-only builds.
+_define("FLAGS_check_nan_inf_level", 0,
+        "0: raise on nan/inf; higher levels only log in the reference")
+_define("FLAGS_cudnn_exhaustive_search", False)
+_define("FLAGS_cudnn_batchnorm_spatial_persistent", False)
+_define("FLAGS_conv2d_disable_cudnn", False)
+_define("FLAGS_cublaslt_exhaustive_search_times", 0)
+_define("FLAGS_use_fast_math", False)
+_define("FLAGS_gemm_use_half_precision_compute_type", False)
+_define("FLAGS_enable_cudnn_frontend", False)
+_define("FLAGS_embedding_deterministic_level", 0)
+_define("FLAGS_fraction_of_cpu_memory_to_use", 1.0)
+_define("FLAGS_fraction_of_cuda_pinned_memory_to_use", 0.5)
+_define("FLAGS_initial_gpu_memory_in_mb", 0)
+_define("FLAGS_reallocate_gpu_memory_in_mb", 0)
+_define("FLAGS_memory_fraction_of_eager_deletion", 1.0)
+_define("FLAGS_fast_eager_deletion_mode", True)
+_define("FLAGS_use_pinned_memory", True)
+_define("FLAGS_use_cuda_managed_memory", False)
+_define("FLAGS_gpu_allocator_retry_time", 2000)
+_define("FLAGS_use_stream_safe_cuda_allocator", True)
+_define("FLAGS_use_virtual_memory_auto_growth", False)
+_define("FLAGS_auto_growth_chunk_size_in_mb", 0)
+_define("FLAGS_free_idle_chunk", False)
+_define("FLAGS_free_when_no_cache_hit", False)
+_define("FLAGS_init_allocated_mem", False)
+_define("FLAGS_sync_nccl_allreduce", True)
+_define("FLAGS_nccl_blocking_wait", False)
+_define("FLAGS_allreduce_record_one_event", False)
+_define("FLAGS_enable_sparse_inner_gather", False)
+_define("FLAGS_sort_sum_gradient", False)
+_define("FLAGS_max_inplace_grad_add", 0)
+_define("FLAGS_retain_grad_for_all_tensor", False)
+_define("FLAGS_new_executor_serial_run", False)
+_define("FLAGS_new_executor_use_inplace", False)
+_define("FLAGS_new_executor_use_local_scope", True)
+_define("FLAGS_new_executor_use_cuda_graph", False)
+_define("FLAGS_use_cinn", False)
+_define("FLAGS_allow_cinn_ops", "")
+_define("FLAGS_deny_cinn_ops", "")
+_define("FLAGS_use_mkldnn", False)
+_define("FLAGS_tracer_mkldnn_ops_on", "")
+_define("FLAGS_tracer_mkldnn_ops_off", "")
+_define("FLAGS_inner_op_parallelism", 0)
+_define("FLAGS_enable_api_kernel_fallback", True)
+_define("FLAGS_run_kp_kernel", False)
+_define("FLAGS_jit_engine_type", "Predictor")
+_define("FLAGS_tensor_operants_mode", "eager")
+_define("FLAGS_set_to_1d", True)
+_define("FLAGS_print_ir", False)
+_define("FLAGS_call_stack_level", 1,
+        "error-report verbosity (enforce.cc analog)")
+_define("FLAGS_enable_eager_mode", True)
+_define("FLAGS_use_system_allocator", False)
+_define("FLAGS_reader_queue_speed_test_mode", False)
+_define("FLAGS_enable_opt_get_features", False)
+_define("FLAGS_gpugraph_storage_mode", 1)
+_define("FLAGS_gpugraph_hbm_table_load_factor", 0.75)
+_define("FLAGS_gpugraph_enable_gpu_direct_access", False)
+_define("FLAGS_graph_load_in_parallel", False)
+_define("FLAGS_graph_get_neighbor_id", False)
+_define("FLAGS_use_shm_cache", False)
+_define("FLAGS_multiple_of_cupti_buffer_size", 1)
+_define("FLAGS_enable_host_event_recorder_hook", False,
+        "host events are always recorded via paddle_tpu.profiler instead")
+_define("FLAGS_max_body_size", 2147483647)
+_define("FLAGS_rpc_retry_times", 3)
+_define("FLAGS_apply_pass_to_program", False)
+_define("FLAGS_save_static_runtime_data", False)
+_define("FLAGS_static_runtime_data_save_path", "./")
+_define("FLAGS_trt_ibuilder_cache", False)
+_define("FLAGS_npu_storage_format", False)
+_define("FLAGS_use_autotune_v2", False)
+_define("FLAGS_search_cache_max_number", 1000000)
+_define("FLAGS_einsum_opt", False)
+_define("FLAGS_dygraph_debug", False)
+_define("FLAGS_enable_unused_var_check", False)
+
 
 def get_flags(flags):
     if isinstance(flags, str):

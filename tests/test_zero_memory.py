@@ -1,0 +1,61 @@
+"""ZeRO sharding must actually shrink per-device optimizer-state bytes
+(the round-1 review flagged that no test asserted this)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel import mesh as mesh_mod
+
+
+@pytest.fixture
+def sharding_mesh():
+    mesh_mod.build_mesh(sharding=4, dp=2)
+    yield
+    mesh_mod.build_mesh(dp=1, devices=jax.devices()[:1])
+
+
+def _shard_bytes(arr):
+    """Bytes of the first device's shard."""
+    sh = arr.addressable_shards[0]
+    return int(np.prod(sh.data.shape)) * arr.dtype.itemsize
+
+
+def test_trainer_opt_state_sharded_over_zero_axis(sharding_mesh):
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+    cfg = LlamaConfig.tiny(vocab=64, hidden=64, layers=2, heads=4,
+                           kv_heads=4, inter=128, seq=16)
+    tr = LlamaSpmdTrainer(cfg, remat=False, compute_dtype=jnp.float32)
+    total_full = 0
+    total_shard = 0
+    for leaf in jax.tree_util.tree_leaves(tr.opt_state):
+        total_full += leaf.size * leaf.dtype.itemsize
+        total_shard += _shard_bytes(leaf)
+    # sharding=4: per-device optimizer bytes must be well under the
+    # replicated footprint (most dims divide 4; allow slack for the
+    # handful of tiny norm vectors that stay replicated)
+    assert total_shard < 0.5 * total_full, (total_shard, total_full)
+
+
+def test_fleet_shard_accumulators_partitions_states(sharding_mesh):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import \
+        shard_accumulators
+    lin = paddle.nn.Linear(64, 64)
+    opt = paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                 learning_rate=1e-3)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 64)).astype(np.float32))
+    (lin(x) ** 2).mean().backward()
+    opt.step()  # materialize accumulators
+    opt.clear_grad()
+    full = sum(_shard_bytes(s[k]) for s in opt._accumulators.values()
+               for k in s)
+    shard_accumulators(opt, axis="sharding")
+    shard = sum(_shard_bytes(s[k]) for s in opt._accumulators.values()
+                for k in s)
+    assert shard <= full // 2, (shard, full)
+    # training still works on sharded states
+    (lin(x) ** 2).mean().backward()
+    opt.step()
