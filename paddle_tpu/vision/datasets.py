@@ -122,3 +122,134 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     pass
+
+class DatasetFolder(Dataset):
+    """Local-directory dataset: one subfolder per class (ref:
+    /root/reference/python/paddle/vision/datasets/folder.py). No
+    download machinery — TPU input pipelines read from mounted storage."""
+
+    _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self._EXTS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(DatasetFolder):
+    """Flat/recursive image folder without labels (ref folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self._EXTS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class Flowers(DatasetFolder):
+    """Flowers-102 over a local extracted copy (ref flowers.py; the
+    reference downloads — here pass data_file pointing at the extracted
+    class-folder layout)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError(
+                "dataset downloads are disabled in this environment; "
+                "point data_file at an extracted local copy")
+        if data_file is None:
+            raise ValueError("data_file is required (no-download build)")
+        super().__init__(data_file, transform=transform)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation over a local extracted copy (ref
+    voc2012.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import os
+        if download:
+            raise RuntimeError(
+                "dataset downloads are disabled in this environment; "
+                "point data_file at an extracted VOCdevkit/VOC2012")
+        if data_file is None:
+            raise ValueError("data_file is required (no-download build)")
+        self.root = data_file
+        self.transform = transform
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "val": "val"}[mode]
+        lst = os.path.join(data_file, "ImageSets", "Segmentation",
+                           split + ".txt")
+        with open(lst) as f:
+            self.ids = [l.strip() for l in f if l.strip()]
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        import os
+        from PIL import Image
+        name = self.ids[idx]
+        img = Image.open(os.path.join(
+            self.root, "JPEGImages", name + ".jpg")).convert("RGB")
+        lab = Image.open(os.path.join(
+            self.root, "SegmentationClass", name + ".png"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
