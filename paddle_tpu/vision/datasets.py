@@ -13,7 +13,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -130,13 +131,21 @@ class DatasetFolder(Dataset):
 
     _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
 
+    @classmethod
+    def _scan(cls, root, extensions, is_valid_file):
+        exts = tuple(e.lower() for e in (extensions or cls._EXTS))
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                if is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(exts):
+                    yield path
+
     def __init__(self, root, loader=None, extensions=None,
                  transform=None, is_valid_file=None):
-        import os
         self.root = root
         self.transform = transform
         self.loader = loader or self._default_loader
-        exts = tuple(e.lower() for e in (extensions or self._EXTS))
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         if not classes:
@@ -145,14 +154,13 @@ class DatasetFolder(Dataset):
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
         self.samples = []
         for c in classes:
-            cdir = os.path.join(root, c)
-            for dirpath, _, files in sorted(os.walk(cdir)):
-                for fname in sorted(files):
-                    path = os.path.join(dirpath, fname)
-                    ok = is_valid_file(path) if is_valid_file else \
-                        fname.lower().endswith(exts)
-                    if ok:
-                        self.samples.append((path, self.class_to_idx[c]))
+            for path in self._scan(os.path.join(root, c), extensions,
+                                   is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files under {root!r} matching the given "
+                "extensions/is_valid_file (ref DatasetFolder raises too)")
 
     @staticmethod
     def _default_loader(path):
@@ -176,19 +184,14 @@ class ImageFolder(DatasetFolder):
 
     def __init__(self, root, loader=None, extensions=None,
                  transform=None, is_valid_file=None):
-        import os
         self.root = root
         self.transform = transform
         self.loader = loader or self._default_loader
-        exts = tuple(e.lower() for e in (extensions or self._EXTS))
-        self.samples = []
-        for dirpath, _, files in sorted(os.walk(root)):
-            for fname in sorted(files):
-                path = os.path.join(dirpath, fname)
-                ok = is_valid_file(path) if is_valid_file else \
-                    fname.lower().endswith(exts)
-                if ok:
-                    self.samples.append(path)
+        self.samples = list(self._scan(root, extensions, is_valid_file))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files under {root!r} matching the given "
+                "extensions/is_valid_file")
 
     def __len__(self):
         return len(self.samples)
@@ -213,6 +216,20 @@ class Flowers(DatasetFolder):
                 "point data_file at an extracted local copy")
         if data_file is None:
             raise ValueError("data_file is required (no-download build)")
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(f"mode must be train/valid/test, got {mode!r}")
+        # split by per-mode subdirectory when the extracted copy has one
+        # (the reference splits via setid.mat, which the no-download
+        # layout doesn't ship); otherwise the full set is used for every
+        # mode and we say so rather than silently mixing splits
+        sub = os.path.join(data_file, mode)
+        if os.path.isdir(sub):
+            data_file = sub
+        else:
+            import warnings
+            warnings.warn(
+                f"Flowers: no {mode!r} subfolder under {data_file!r}; "
+                "using the full directory for every mode")
         super().__init__(data_file, transform=transform)
 
 
@@ -222,7 +239,6 @@ class VOC2012(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
-        import os
         if download:
             raise RuntimeError(
                 "dataset downloads are disabled in this environment; "
@@ -231,8 +247,12 @@ class VOC2012(Dataset):
             raise ValueError("data_file is required (no-download build)")
         self.root = data_file
         self.transform = transform
-        split = {"train": "train", "valid": "val", "test": "val",
-                 "val": "val"}[mode]
+        splits = {"train": "train", "valid": "val", "test": "val",
+                  "val": "val"}
+        if mode not in splits:
+            raise ValueError(
+                f"mode must be one of {sorted(splits)}, got {mode!r}")
+        split = splits[mode]
         lst = os.path.join(data_file, "ImageSets", "Segmentation",
                            split + ".txt")
         with open(lst) as f:
@@ -242,7 +262,6 @@ class VOC2012(Dataset):
         return len(self.ids)
 
     def __getitem__(self, idx):
-        import os
         from PIL import Image
         name = self.ids[idx]
         img = Image.open(os.path.join(

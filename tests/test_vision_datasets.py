@@ -1,0 +1,65 @@
+"""DatasetFolder/ImageFolder/Flowers/VOC2012 (ref: paddle/vision/
+datasets/folder.py, flowers.py, voc2012.py — local-disk layouts)."""
+import os
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers,
+                                        ImageFolder, VOC2012)
+
+
+@pytest.fixture
+def image_root():
+    from PIL import Image
+    root = tempfile.mkdtemp()
+    for c in ("cat", "dog"):
+        os.makedirs(os.path.join(root, c))
+        for i in range(3):
+            Image.fromarray(
+                np.random.randint(0, 255, (8, 8, 3), np.uint8)
+            ).save(os.path.join(root, c, f"{i}.png"))
+    return root
+
+
+def test_dataset_folder(image_root):
+    ds = DatasetFolder(image_root)
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, y = ds[0]
+    assert y == 0 and img.size == (8, 8)
+
+
+def test_image_folder_unlabeled(image_root):
+    ds = ImageFolder(image_root)
+    assert len(ds) == 6
+    (img,) = ds[0]
+    assert img.size == (8, 8)
+
+
+def test_empty_scan_raises():
+    empty = tempfile.mkdtemp()
+    os.makedirs(os.path.join(empty, "cls"))
+    with pytest.raises(RuntimeError, match="Found 0 files"):
+        DatasetFolder(empty)
+
+
+def test_flowers_mode_split(image_root):
+    from PIL import Image
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        full = Flowers(data_file=image_root, mode="test")
+        assert len(full) == 6 and w  # warned: no split dir
+    os.makedirs(os.path.join(image_root, "train", "cat"))
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+        os.path.join(image_root, "train", "cat", "a.png"))
+    assert len(Flowers(data_file=image_root, mode="train")) == 1
+
+
+def test_download_disabled_and_mode_validation(image_root):
+    with pytest.raises(RuntimeError, match="downloads are disabled"):
+        Flowers(data_file=image_root, download=True)
+    with pytest.raises(ValueError, match="mode must be"):
+        VOC2012(data_file=image_root, mode="Train")
