@@ -62,6 +62,52 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             tensor._data = out
             return tensor
         return wrap(out)
+    arr0 = unwrap(tensor)
+    from jax.sharding import SingleDeviceSharding
+    if jax.process_count() > 1 and isinstance(
+            getattr(arr0, "sharding", None), SingleDeviceSharding):
+        # true multi-controller: each process holds a process-LOCAL value
+        # (single-device array); lift to a global [n_devices, ...] array
+        # over the group axis (world maps to 'dp'), reduce under jit
+        # (Gloo/ICI collective), read the replicated result back. This is
+        # the ProcessGroup::AllReduce semantic of the reference
+        # (process_group_nccl.cc:174). Global/replicated jax.Arrays fall
+        # through to the GSPMD path below, where allreduce-of-synced
+        # values is the identity.
+        import numpy as _np
+        ax = axis or "dp"
+        mesh = mesh_mod.get_mesh()
+        n = mesh.shape[ax]
+        local_n = jax.local_device_count()
+        a = _np.asarray(arr0)
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            # each process contributes its value on local_n device rows;
+            # pre-divide so the device-sum equals the process-sum
+            tile = _np.broadcast_to(a[None] / local_n,
+                                    (local_n,) + a.shape)
+        elif op in (ReduceOp.MAX, ReduceOp.MIN):
+            tile = _np.broadcast_to(a[None], (local_n,) + a.shape)
+        else:
+            raise NotImplementedError(
+                f"multi-process all_reduce op {op!r} with "
+                f"{local_n} local devices is not supported")
+        gs = NamedSharding(mesh, PartitionSpec(ax))
+        garr = jax.make_array_from_process_local_data(
+            gs, _np.ascontiguousarray(tile), (n,) + tuple(a.shape))
+        word = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+                ReduceOp.MIN: jnp.min, ReduceOp.AVG: jnp.mean}[op]
+        out = jax.jit(lambda g: word(g, axis=0),
+                      out_shardings=NamedSharding(
+                          mesh, PartitionSpec()))(garr)
+        if op == ReduceOp.AVG:
+            # mean over device rows already divides by n; undo the
+            # per-process pre-division
+            out = out * local_n
+        local = jnp.asarray(out.addressable_data(0))
+        if isinstance(tensor, Tensor):
+            tensor._data = local
+            return tensor
+        return wrap(local)
     # eager/global view
     dim = _sharded_axis(tensor, axis) if axis else None
     if dim is None:

@@ -78,13 +78,21 @@ def init_parallel_env():
         "MASTER_ADDR")
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    if coord and nprocs > 1 and jax.process_count() == 1:
+    # NB: do not call jax.process_count() here — it would initialize the
+    # backend and make jax.distributed.initialize impossible
+    already = jax.distributed.is_initialized()
+    if coord and nprocs > 1 and not already:
         port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
         try:
-            jax.distributed.initialize(f"{coord}:{port}", num_processes=nprocs,
+            jax.distributed.initialize(addr, num_processes=nprocs,
                                        process_id=rank)
-        except Exception:
-            pass
+        except Exception as e:
+            # a dead rendezvous must be loud: silently continuing would
+            # train nprocs independent replicas
+            raise RuntimeError(
+                f"jax.distributed.initialize({addr!r}, num_processes="
+                f"{nprocs}, process_id={rank}) failed") from e
     mesh_mod.get_mesh()
     dist_env.mark_initialized()
     from .communication.group import get_world_group

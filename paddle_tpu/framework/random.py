@@ -17,10 +17,25 @@ import numpy as np
 
 
 class _RNGState(threading.local):
+    """The key is created LAZILY: building a PRNGKey at import time would
+    initialize the XLA backend, which forbids a later
+    jax.distributed.initialize (multi-controller startup)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._key = None
         self.injected = None  # traced key during jit capture
         self.injected_count = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(
+                np.random.randint(0, 2**31 - 1))
+        return self._key
+
+    @key.setter
+    def key(self, value):
+        self._key = value
 
 
 _state = _RNGState()

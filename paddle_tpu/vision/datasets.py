@@ -225,6 +225,14 @@ class Flowers(DatasetFolder):
         sub = os.path.join(data_file, mode)
         if os.path.isdir(sub):
             data_file = sub
+        elif any(os.path.isdir(os.path.join(data_file, m))
+                 for m in ("train", "valid", "test")):
+            # some OTHER mode has a split dir: scanning the full tree
+            # would leak that split's images (and its dir name as a
+            # class) into this mode — refuse instead
+            raise ValueError(
+                f"Flowers: {data_file!r} has per-mode subfolders but none "
+                f"named {mode!r}; create {sub!r} or pass the right mode")
         else:
             import warnings
             warnings.warn(

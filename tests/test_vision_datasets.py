@@ -56,6 +56,9 @@ def test_flowers_mode_split(image_root):
     Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
         os.path.join(image_root, "train", "cat", "a.png"))
     assert len(Flowers(data_file=image_root, mode="train")) == 1
+    # other modes must not silently leak the train split
+    with pytest.raises(ValueError, match="per-mode subfolders"):
+        Flowers(data_file=image_root, mode="test")
 
 
 def test_download_disabled_and_mode_validation(image_root):
