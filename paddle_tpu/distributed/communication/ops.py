@@ -80,17 +80,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         n = mesh.shape[ax]
         local_n = jax.local_device_count()
         a = _np.asarray(arr0)
-        if op in (ReduceOp.SUM, ReduceOp.AVG):
-            # each process contributes its value on local_n device rows;
-            # pre-divide so the device-sum equals the process-sum
-            tile = _np.broadcast_to(a[None] / local_n,
-                                    (local_n,) + a.shape)
-        elif op in (ReduceOp.MAX, ReduceOp.MIN):
-            tile = _np.broadcast_to(a[None], (local_n,) + a.shape)
-        else:
+        if op not in (ReduceOp.SUM, ReduceOp.AVG, ReduceOp.MAX,
+                      ReduceOp.MIN):
             raise NotImplementedError(
-                f"multi-process all_reduce op {op!r} with "
-                f"{local_n} local devices is not supported")
+                f"multi-process all_reduce op {op!r} is not supported")
+        # each process contributes its value on local_n device rows
+        # (dtype-preserving: no pre-scaling); SUM over-counts by local_n
+        # and is corrected after the reduce — exactly divisible, so
+        # integer tensors keep their dtype. AVG/MAX/MIN need no
+        # correction (each process is equally over-represented).
+        tile = _np.broadcast_to(a[None], (local_n,) + a.shape)
         gs = NamedSharding(mesh, PartitionSpec(ax))
         garr = jax.make_array_from_process_local_data(
             gs, _np.ascontiguousarray(tile), (n,) + tuple(a.shape))
@@ -99,10 +98,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         out = jax.jit(lambda g: word(g, axis=0),
                       out_shardings=NamedSharding(
                           mesh, PartitionSpec()))(garr)
-        if op == ReduceOp.AVG:
-            # mean over device rows already divides by n; undo the
-            # per-process pre-division
-            out = out * local_n
+        if op == ReduceOp.SUM and local_n > 1:
+            if jnp.issubdtype(out.dtype, jnp.integer):
+                out = out // local_n
+            else:
+                out = out / local_n
         local = jnp.asarray(out.addressable_data(0))
         if isinstance(tensor, Tensor):
             tensor._data = local

@@ -8,7 +8,9 @@ work into the GEMMs, with the flash-attention pallas kernel on the score
 path. The classes keep the reference's weight-list API."""
 from .fused_transformer import (FusedFeedForward, FusedMultiHeadAttention,  # noqa: F401
                                 FusedMultiTransformer,
+                                FusedMultiTransformerInt8,
                                 FusedTransformerEncoderLayer)
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedMultiTransformerInt8"]

@@ -151,6 +151,7 @@ class FusedMultiTransformer(nn.Layer):
             blk.ffn1 = nn.Linear(embed_dim, dim_feedforward)
             blk.ffn2 = nn.Linear(dim_feedforward, embed_dim)
             self.layers.append(blk)
+        self._act_name = activation
         self.activation = getattr(F, activation)
 
     def gen_cache(self, batch, max_len, dtype="float32"):
@@ -158,6 +159,10 @@ class FusedMultiTransformer(nn.Layer):
         return [paddle.zeros([2, batch, self.num_heads, max_len,
                               self.head_dim], dtype=dtype)
                 for _ in range(self.num_layers)]
+
+    def _proj(self, i, blk, name, x):
+        """Linear-projection hook; the int8 subclass overrides this."""
+        return getattr(blk, name)(x)
 
     def forward(self, src, attn_mask=None, caches=None, time_step=None,
                 **kwargs):
@@ -168,7 +173,7 @@ class FusedMultiTransformer(nn.Layer):
         for i, blk in enumerate(self.layers):
             residual = x
             h = blk.ln(x) if self.normalize_before else x
-            q, k, v = split(blk.qkv(h), 3, axis=-1)
+            q, k, v = split(self._proj(i, blk, "qkv", h), 3, axis=-1)
             q = reshape(q, [b, l, self.num_heads, self.head_dim])
             k = reshape(k, [b, l, self.num_heads, self.head_dim])
             v = reshape(v, [b, l, self.num_heads, self.head_dim])
@@ -203,23 +208,89 @@ class FusedMultiTransformer(nn.Layer):
                 else:
                     k_full = transpose(cache[0], [0, 2, 1, 3])[:, :t + l]
                     v_full = transpose(cache[1], [0, 2, 1, 3])[:, :t + l]
-                    attn = F.scaled_dot_product_attention(q, k_full,
-                                                          v_full)
+                    # cross-length causal: query i sees cache pos <= t+i
+                    mask = None
+                    if l > 1:
+                        qpos = t + jnp.arange(l)[:, None]
+                        kpos = jnp.arange(t + l)[None, :]
+                        mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                                      .astype(jnp.float32))
+                    attn = F.scaled_dot_product_attention(
+                        q, k_full, v_full, attn_mask=mask)
             else:
                 attn = F.scaled_dot_product_attention(
                     q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
                 if caches is not None:
                     new_caches.append(caches[i])
-            attn = blk.out_proj(reshape(attn, [b, l, self.embed_dim]))
+            attn = self._proj(i, blk, "out_proj",
+                              reshape(attn, [b, l, self.embed_dim]))
             x = residual + attn
             if not self.normalize_before:
                 x = blk.ln(x)
             residual = x
             h = blk.ffn_ln(x) if self.normalize_before else x
-            h = blk.ffn2(self.activation(blk.ffn1(h)))
+            h = self._proj(i, blk, "ffn2", self.activation(
+                self._proj(i, blk, "ffn1", h)))
             x = residual + h
             if not self.normalize_before:
                 x = blk.ffn_ln(x)
         if caches is not None:
             return x, new_caches
         return x
+
+class FusedMultiTransformerInt8(FusedMultiTransformer):
+    """Int8 weight-quantized decoder stack (ref: fused_multi_transformer
+    _int8 op, /root/reference/paddle/fluid/operators/fused/
+    fused_multi_transformer_int8_op.cu + attn_gemm_int8.h's cublasLt int8
+    GEMMs — here the MXU int8 path via quantization.quantized_matmul).
+
+    Construct with float weights (same signature as FusedMultiTransformer)
+    then call `quantize_weights()` — per-out-channel abs-max int8 — or
+    build from a trained FusedMultiTransformer with `from_float(model)`.
+    Activations stay bf16/fp32 (weight-only), the dominant TPU serving
+    mode. The forward schedule is inherited; only the linear projections
+    (_proj) change."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._quantized = False
+
+    def quantize_weights(self, bits=8):
+        import jax.numpy as _jnp
+        from ...quantization.functional import quantize as _quantize
+        self._int8 = []
+        for blk in self.layers:
+            entry = {}
+            for name in ("qkv", "out_proj", "ffn1", "ffn2"):
+                lin = getattr(blk, name)
+                w = lin.weight.data
+                # all-zero channels would give scale 0 -> NaN int8
+                scale = _jnp.maximum(_jnp.max(_jnp.abs(w), axis=0), 1e-8)
+                entry[name] = (
+                    _quantize(lin.weight, scale, bits=bits, axis=-1),
+                    scale, lin.bias)
+            self._int8.append(entry)
+        self._quantized = True
+        return self
+
+    @classmethod
+    def from_float(cls, model: "FusedMultiTransformer", bits=8):
+        m = cls(model.embed_dim, model.num_heads,
+                model.layers[0].ffn1.weight.shape[1],
+                activation=model._act_name,
+                num_layers=model.num_layers,
+                normalize_before=model.normalize_before)
+        for dst, srcb in zip(m.layers, model.layers):
+            for name in ("ln", "qkv", "out_proj", "ffn_ln", "ffn1",
+                         "ffn2"):
+                setattr(dst, name, getattr(srcb, name))
+        return m.quantize_weights(bits=bits)
+
+    def _proj(self, i, blk, name, x):
+        if not self._quantized:
+            raise RuntimeError("call quantize_weights() (or from_float) "
+                               "before forward")
+        from ...quantization.functional import quantized_matmul
+        wq, scale, bias = self._int8[i][name]
+        out = quantized_matmul(x, wq, scale)
+        return out + bias if bias is not None else out

@@ -35,6 +35,10 @@ _WORKER = textwrap.dedent("""
     got = t.numpy()
     assert np.allclose(got, 3.0), got          # 1 + 2
 
+    ti = paddle.to_tensor(np.asarray([rank + 10], np.int32))
+    dist.all_reduce(ti)
+    assert ti.numpy().dtype == np.int32 and int(ti.numpy()[0]) == 21
+
     # data-parallel step: different per-rank data, synced grads ->
     # identical params on both ranks
     paddle.seed(0)
@@ -72,9 +76,14 @@ def test_two_process_allreduce_and_dp_step():
             [sys.executable, "-c", _WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=280)
-        outs.append(out.decode())
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out.decode())
+    finally:
+        for p in procs:  # never leak a worker stuck on the barrier
+            if p.poll() is None:
+                p.kill()
     for rank, out in enumerate(outs):
         assert procs[rank].returncode == 0, f"rank {rank}:\n{out[-2000:]}"
     sums = [line for out in outs for line in out.splitlines()

@@ -202,6 +202,32 @@ def test_config_priority():
     assert isinstance(observed.fc2, nn.Linear)  # excluded by name
 
 
+def test_fused_multi_transformer_int8():
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+    paddle.seed(0)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                              dim_feedforward=64, num_layers=2)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 6, 32)).astype(np.float32))
+    ref = m(x).numpy()
+    qm = FusedMultiTransformerInt8.from_float(m)
+    got = qm(x).numpy()
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.1, rel
+    # cached prefill (causal mask) + decode must match the uncached
+    # causal forward at the decoded position
+    full = qm(x[:, :5]).numpy()
+    caches = qm.gen_cache(2, 8)
+    pre, caches = qm(x[:, :4], caches=caches, time_step=0)
+    np.testing.assert_allclose(pre.numpy(), full[:, :4], rtol=1e-4,
+                               atol=1e-5)
+    out1, _ = qm(x[:, 4:5], caches=caches, time_step=4)
+    assert out1.shape == [2, 1, 32]
+    np.testing.assert_allclose(out1.numpy()[:, 0], full[:, 4], rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_post_training_quantization_facade():
     from paddle_tpu.static.quantization import PostTrainingQuantization
     net = Net()
