@@ -36,23 +36,24 @@ def test_lenet_alexnet_vgg_squeezenet():
     m = models.LeNet()
     assert list(m(paddle.to_tensor(
         np.zeros((2, 1, 28, 28), np.float32))).shape) == [2, 10]
+    # adaptive pooling makes small inputs valid — keeps CPU CI fast
     for build in (models.alexnet, models.squeezenet1_1):
         m = build(num_classes=7)
         m.eval()
-        assert list(m(_img(hw=224)).shape) == [1, 7]
+        assert list(m(_img(hw=64)).shape) == [1, 7]
     m = models.vgg11(num_classes=5)
     m.eval()
-    assert list(m(_img(hw=224)).shape) == [1, 5]
+    assert list(m(_img(hw=64)).shape) == [1, 5]
 
 
 def test_googlenet_aux_heads_and_inception():
     m = models.googlenet(num_classes=6)
     m.eval()
-    outs = m(_img(hw=224))
+    outs = m(_img(hw=224))  # aux heads require the 224 grid
     assert [list(o.shape) for o in outs] == [[1, 6]] * 3
     m = models.inception_v3(num_classes=4)
     m.eval()
-    assert list(m(_img(hw=299)).shape) == [1, 4]
+    assert list(m(_img(hw=128)).shape) == [1, 4]
 
 
 def test_vision_models_train_step():
