@@ -29,3 +29,4 @@ def segment_sum(data, segment_ids, name=None):
 
     return apply(lambda d, s: jax.ops.segment_sum(d, s), (data, segment_ids),
                  op_name="segment_sum")
+from . import asp  # noqa: F401,E402

@@ -1,0 +1,141 @@
+"""paddle.incubate.asp — Automatic SParsity (2:4 structured pruning).
+
+Ref: /root/reference/python/paddle/incubate/asp/ (asp.py —
+prune_model/decorate/calculate_density; utils.py — n:m mask algorithms
+get_mask_1d/get_mask_2d_greedy). The reference targets Ampere sparse
+tensor cores; on TPU the n:m masks are a model-compression format (the
+MXU has no sparse mode), so ASP here preserves training semantics: prune
+to n:m, and `decorate` re-applies the masks after every optimizer step so
+sparsity survives training.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer", "get_mask_1d", "get_mask_2d_greedy"]
+
+_excluded: set = set()
+_supported_types: List[type] = []
+_masks: Dict[str, np.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (ref asp.py:calculate_density)."""
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float((a != 0).sum() / max(a.size, 1))
+
+
+def get_mask_1d(mat, n=2, m=4):
+    """Per-row groups of m: keep the n largest |values| (ref
+    utils.py:get_mask_1d)."""
+    a = np.asarray(mat)
+    flat = a.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    return mask.reshape(a.shape)
+
+
+def get_mask_2d_greedy(mat, n=2, m=4):
+    """Greedy 2-D n:m mask (ref utils.py:get_mask_2d_greedy): mask m x m
+    blocks keeping n entries per row AND per column."""
+    a = np.abs(np.asarray(mat))
+    h, w = a.shape
+    mask = np.zeros_like(a)
+    for bi in range(0, h, m):
+        for bj in range(0, w, m):
+            blk = a[bi:bi + m, bj:bj + m]
+            sub = np.zeros_like(blk)
+            order = np.argsort(-blk, axis=None)
+            rows = np.zeros(blk.shape[0], int)
+            cols = np.zeros(blk.shape[1], int)
+            for idx in order:
+                r, c = divmod(int(idx), blk.shape[1])
+                if rows[r] < n and cols[c] < n:
+                    sub[r, c] = 1.0
+                    rows[r] += 1
+                    cols[c] += 1
+            mask[bi:bi + m, bj:bj + m] = sub
+    return mask
+
+
+def _supported(layer):
+    from ... import nn
+    # defaults are always supported; add_supported_layer EXTENDS them
+    return isinstance(layer, tuple([nn.Linear] + _supported_types))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def add_supported_layer(layer_type):
+    _supported_types.append(layer_type)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True,
+                sharding=False):
+    """Prune every supported layer's weight to n:m sparsity (ref
+    asp.py:prune_model). Returns {param_name: mask}."""
+    algo = {"mask_1d": get_mask_1d,
+            "mask_2d_greedy": get_mask_2d_greedy}[mask_algo]
+    excluded = _excluded
+    out = {}
+    for name, layer in _walk(model):
+        if not _supported(layer):
+            continue
+        w = layer.weight
+        if w.name in excluded or w.data.ndim != 2 \
+                or w.data.shape[0] % m:
+            continue
+        mask = algo(np.asarray(w.numpy()).T, n=n, m=m).T
+        w.set_value(Tensor(jnp.asarray(np.asarray(w.numpy()) * mask)))
+        if with_mask:
+            _masks[w.name] = mask
+        out[w.name] = mask
+    return out
+
+
+def _walk(model, prefix=""):
+    yield prefix, model
+    for name, child in model._sub_layers.items():
+        yield from _walk(child, prefix + name + ".")
+
+
+class OptimizerWithSparsityGuarantee:
+    """ref asp.py: wraps an optimizer so the n:m masks are re-applied
+    after every step (pruned entries stay zero through training)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self, *args, **kwargs):
+        out = self._inner_opt.step(*args, **kwargs)  # closure-style too
+        for p in self._inner_opt._parameter_list_flat():
+            mask = _masks.get(p.name)
+            if mask is not None:
+                p._data = p.data * jnp.asarray(mask, p.data.dtype)
+        return out
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

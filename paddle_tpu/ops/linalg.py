@@ -16,7 +16,7 @@ __all__ = [
     "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
     "matrix_transpose", "householder_product", "pca_lowrank", "cdist",
     "trace",
-]
+           "matrix_exp", "svd_lowrank"]
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
@@ -295,3 +295,38 @@ def trace(x, offset=0, axis1=0, axis2=1, name=None):
     def impl(a):
         return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
     return op("trace", impl, x)
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (ref matrix_exp op): expm via jax.scipy."""
+    from jax.scipy.linalg import expm as _expm
+    return op("matrix_exp", _expm, x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (ref svd_lowrank, Halko et al. 2011):
+    subspace iteration on a Gaussian sketch — MXU-friendly (tall
+    matmuls + small QR)."""
+    from ..framework import random as _random
+    key = _random.next_key()
+
+    def impl(a, *rest):
+        m_ = rest[0] if M is not None else None
+        if m_ is not None:
+            a = a - m_
+        mdim, ndim = a.shape[-2:]
+        k = min(q if q is not None else 6, mdim, ndim)
+        omega = jax.random.normal(key, a.shape[:-2] + (ndim, k), a.dtype)
+        y = a @ omega
+        qmat, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            z = jnp.swapaxes(a, -1, -2) @ qmat
+            qz, _ = jnp.linalg.qr(z)
+            y = a @ qz
+            qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        u = qmat @ u_b
+        return u, s, jnp.swapaxes(vh, -1, -2)
+    args = (x,) + ((M,) if M is not None else ())
+    return op("svd_lowrank", impl, *args)
+

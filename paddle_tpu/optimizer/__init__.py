@@ -3,6 +3,7 @@ from . import lr  # noqa: F401
 from .optimizer import Momentum, Optimizer, SGD  # noqa: F401
 from .adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
 from .others import Adadelta, Adagrad, ASGD, RMSProp, Rprop  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Lamb",
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Lamb", "LBFGS",
            "Adagrad", "Adadelta", "RMSProp", "ASGD", "Rprop", "lr"]

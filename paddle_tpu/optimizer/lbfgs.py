@@ -1,0 +1,171 @@
+"""L-BFGS optimizer (ref: /root/reference/python/paddle/incubate/
+optimizer/lbfgs.py — closure-driven step with two-loop recursion and
+strong-Wolfe line search; upstream paddle.optimizer.LBFGS API).
+
+TPU shape: the closure re-evaluates loss+grads (jit-compiled by the
+caller's model as usual); the two-loop recursion is tiny host-side
+vector math over flattened parameters.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import autograd
+from ..framework.tensor import Tensor
+from .optimizer import Optimizer
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List[np.ndarray] = []
+        self._y: List[np.ndarray] = []
+        self._n_evals = 0
+
+    # -- flat views ----------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list_flat()
+                if not p.stop_gradient]
+
+    def _flat(self, tensors):
+        return np.concatenate([np.asarray(t, np.float64).ravel()
+                               for t in tensors])
+
+    def _gather_grad(self):
+        return self._flat([np.asarray(p.grad.data) if p.grad is not None
+                           else np.zeros(p.data.shape)
+                           for p in self._params()])
+
+    def _set_params(self, flat):
+        i = 0
+        for p in self._params():
+            n = int(np.prod(p.data.shape))
+            p._data = jnp.asarray(
+                flat[i:i + n].reshape(p.data.shape)).astype(p.data.dtype)
+            i += n
+
+    def _eval(self, closure, flat):
+        self._n_evals += 1
+        self._set_params(flat)
+        with autograd.enable_grad():
+            loss = closure()
+        params = self._params()
+        if self._grad_clip is not None:
+            pgs = [(p, p.grad) for p in params if p.grad is not None]
+            for (p, _), (_, g) in zip(pgs, self._grad_clip(pgs)):
+                p._grad = g
+        g = self._gather_grad()
+        if self._wd:
+            # L2 regularization in the objective: grad += wd * x
+            g = g + float(self._wd if not hasattr(self._wd, "_coeff")
+                          else self._wd._coeff) * flat
+        return float(loss), g
+
+    # -- direction + line search --------------------------------------------
+    def _two_loop(self, g):
+        q = g.copy()
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / max(float(y @ s), 1e-20)
+            a = rho * (s @ q)
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if self._y:
+            y, s = self._y[-1], self._s[-1]
+            q *= float(s @ y) / max(float(y @ y), 1e-20)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * (y @ q)
+            q += (a - b) * s
+        return -q
+
+    def _strong_wolfe(self, closure, x, d, f0, g0, lr):
+        c1, c2 = 1e-4, 0.9
+        dg0 = float(g0 @ d)
+        t, t_prev = lr, 0.0
+        f_prev = f0
+        for _ in range(25):
+            f_t, g_t = self._eval(closure, x + t * d)
+            if f_t > f0 + c1 * t * dg0 or f_t >= f_prev and t_prev > 0:
+                return self._zoom(closure, x, d, f0, dg0, t_prev, t,
+                                  f_prev, f_t)
+            dg_t = float(g_t @ d)
+            if abs(dg_t) <= -c2 * dg0:
+                return t, f_t, g_t
+            if dg_t >= 0:
+                return self._zoom(closure, x, d, f0, dg0, t, t_prev,
+                                  f_t, f_prev)
+            t_prev, f_prev = t, f_t
+            t *= 2.0
+        return t, f_t, g_t
+
+    def _zoom(self, closure, x, d, f0, dg0, lo, hi, f_lo, f_hi):
+        c1, c2 = 1e-4, 0.9
+        for _ in range(25):
+            t = 0.5 * (lo + hi)
+            f_t, g_t = self._eval(closure, x + t * d)
+            if f_t > f0 + c1 * t * dg0 or f_t >= f_lo:
+                hi, f_hi = t, f_t
+            else:
+                dg_t = float(g_t @ d)
+                if abs(dg_t) <= -c2 * dg0:
+                    return t, f_t, g_t
+                if dg_t * (hi - lo) >= 0:
+                    hi, f_hi = lo, f_lo
+                lo, f_lo = t, f_t
+        return t, f_t, g_t
+
+    # -- step ----------------------------------------------------------------
+    def step(self, closure: Callable = None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the loss (call backward inside)")
+        self._n_evals = 0
+        x = self._flat([np.asarray(p.data) for p in self._params()])
+        loss, g = self._eval(closure, x)
+        lr = float(self.get_lr())
+        for _ in range(self.max_iter):
+            if float(np.abs(g).max()) <= self.tol_grad:
+                break
+            if self._n_evals >= self.max_eval:  # ref lbfgs.py:404
+                break
+            d = self._two_loop(g)
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_g = self._strong_wolfe(
+                    closure, x, d, loss, g, lr)
+            else:
+                t = lr
+                new_loss, new_g = self._eval(closure, x + t * d)
+            s = t * d
+            if float(np.abs(s).max()) <= self.tol_change:
+                x = x + s
+                loss, g = new_loss, new_g
+                break
+            yk = new_g - g
+            if float(yk @ s) > 1e-10:
+                self._s.append(s)
+                self._y.append(yk)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            x = x + s
+            loss, g = new_loss, new_g
+        self._set_params(x)
+        self._step_count += 1
+        return Tensor(jnp.asarray(loss, jnp.float32))
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params():
+            p.clear_grad()

@@ -1,0 +1,77 @@
+"""LBFGS optimizer, incubate.asp 2:4 sparsity, linalg tail
+(matrix_exp/svd_lowrank)."""
+import numpy as np
+import pytest
+import scipy.linalg
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+
+def test_lbfgs_solves_least_squares_exactly():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(6, 1, bias_attr=False)
+    A = np.random.default_rng(0).standard_normal((32, 6)).astype(np.float32)
+    wt = np.random.default_rng(1).standard_normal((6, 1)).astype(np.float32)
+    x = paddle.to_tensor(A)
+    y = paddle.to_tensor(A @ wt)
+    opt = paddle.optimizer.LBFGS(parameters=lin.parameters(),
+                                 line_search_fn="strong_wolfe",
+                                 max_iter=30)
+
+    def closure():
+        opt.clear_grad()
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-8
+    np.testing.assert_allclose(np.asarray(lin.weight.numpy()), wt,
+                               atol=1e-3)
+
+
+def test_lbfgs_requires_closure():
+    lin = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.LBFGS(parameters=lin.parameters())
+    with pytest.raises(ValueError, match="closure"):
+        opt.step()
+
+
+def test_asp_prune_and_training_keeps_sparsity():
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 8)
+    asp.prune_model(net, n=2, m=4)
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+    opt = asp.decorate(paddle.optimizer.SGD(
+        parameters=net.parameters(), learning_rate=0.1))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 16)).astype(np.float32))
+    for _ in range(3):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w = np.asarray(net.weight.numpy())
+    grp = (w != 0).T.reshape(8, 4, 4).sum(-1)
+    assert (grp == 2).all()  # every group of 4 keeps exactly 2
+
+
+def test_asp_mask_2d_greedy_rowcol_budget():
+    m = asp.get_mask_2d_greedy(
+        np.random.default_rng(0).standard_normal((8, 8)), n=2, m=4)
+    blk = m.reshape(2, 4, 2, 4)
+    assert (blk.sum(3) <= 2).all() and (blk.sum(1) <= 2).all()
+
+
+def test_matrix_exp_and_svd_lowrank():
+    a = np.random.default_rng(0).standard_normal((4, 4)) \
+        .astype(np.float32) * 0.3
+    got = np.asarray(paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy())
+    np.testing.assert_allclose(got, scipy.linalg.expm(a), rtol=1e-4,
+                               atol=1e-5)
+    x = np.random.default_rng(1).standard_normal((20, 8)).astype(np.float32)
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(x), q=8, niter=4)
+    rec = (np.asarray(u.numpy()) * np.asarray(s.numpy())) \
+        @ np.asarray(v.numpy()).T
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-3)
