@@ -277,3 +277,84 @@ def fused_layer_norm(x, w, b, eps=1e-5):
 def fused_layer_norm_residual(x, residual, w, b, eps=1e-5):
     """z = x + residual; y = layernorm(z) * w + b. Returns (y, z)."""
     return _ln_core(x, residual, w, b, float(eps))
+
+
+# -- dropout-fused variants (ref fused_layernorm_residual_dropout_bias.h:
+# the CUDA kernel applies dropout to x BEFORE the residual add + norm) ---
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate):
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pltpu unavailable")
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0] + i)  # distinct stream per row-block
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.int32)
+    # uniform in [0, 1): low 24 bits are non-negative in int32 (Mosaic
+    # has no uint32->f32 cast)
+    u = (bits & 0xFFFFFF).astype(jnp.float32) * (1.0 / (1 << 24))
+    keep = (u >= rate).astype(jnp.float32)
+    o_ref[...] = (x_ref[...].astype(jnp.float32) * keep
+                  / (1.0 - rate)).astype(o_ref.dtype)
+
+
+def _fused_dropout(x, rate, seed):
+    """One-pass inverted dropout with the on-core PRNG."""
+    orig_shape = x.shape
+    h = orig_shape[-1]
+    x2 = x.reshape(-1, h)
+    n_blocks, br, _ = _row_grid(x2)
+    spec = pl.BlockSpec((br, h), lambda i: (i, 0))
+    if _interpret():
+        # interpret mode has no TPU PRNG: jax.random path, same contract
+        import jax.random as jrandom
+        keep = (jrandom.uniform(jrandom.PRNGKey(seed), x2.shape)
+                >= rate).astype(x2.dtype)
+        return (x2 * keep / (1.0 - rate)).reshape(orig_shape)
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    out = pl.pallas_call(
+        functools.partial(_dropout_kernel, rate=float(rate)),
+        grid=(n_blocks,),
+        in_specs=[sspec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+    )(jnp.asarray([seed], jnp.int32), x2)
+    return out.reshape(orig_shape)
+
+
+def fused_rms_norm_residual_dropout(x, residual, w, eps=1e-6,
+                                    dropout_rate=0.0, seed=0):
+    """z = dropout(x) + residual; y = rmsnorm(z) * w — the reference's
+    fused_layernorm_residual_dropout pattern with RMS normalization.
+    Dropout uses the on-core TPU PRNG (pltpu.prng_random_bits); backward
+    treats the dropout mask as part of the saved z (exact, since
+    z = dropout(x) + residual is what the vjp differentiates through)."""
+    if dropout_rate > 0.0:
+        x = _dropout_via_vjp(x, dropout_rate, seed)
+    return _rms_core(x, residual, w, float(eps))
+
+
+def fused_layer_norm_residual_dropout(x, residual, w, b, eps=1e-5,
+                                      dropout_rate=0.0, seed=0):
+    """z = dropout(x) + residual; y = layernorm(z) * w + b (ref
+    fused_layernorm_residual_dropout_bias.h)."""
+    if dropout_rate > 0.0:
+        x = _dropout_via_vjp(x, dropout_rate, seed)
+    return _ln_core(x, residual, w, b, float(eps))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _dropout_via_vjp(x, rate, seed):
+    return _fused_dropout(x, rate, seed)
+
+
+def _dropout_fwd(x, rate, seed):
+    return _fused_dropout(x, rate, seed), None
+
+
+def _dropout_bwd(rate, seed, _, gy):
+    # the PRNG is deterministic per (seed, shape): regenerate the scaled
+    # mask exactly instead of saving it (saves an HBM buffer)
+    scaled_keep = _fused_dropout(jnp.ones(gy.shape, gy.dtype), rate, seed)
+    return (gy * scaled_keep,)
+
+
+_dropout_via_vjp.defvjp(_dropout_fwd, _dropout_bwd)

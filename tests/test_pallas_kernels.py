@@ -106,6 +106,47 @@ class TestFusedNorm:
             atol=0.05, rtol=0.05)
 
 
+class TestFusedDropout:
+    def test_keep_fraction_and_determinism(self):
+        from paddle_tpu.ops.pallas.fused_norm import _fused_dropout
+        x = jnp.ones((128, 128), jnp.float32)
+        y = _fused_dropout(x, 0.3, seed=7)
+        kept = float((np.asarray(y) != 0).mean())
+        assert abs(kept - 0.7) < 0.05
+        np.testing.assert_array_equal(
+            np.asarray(y), np.asarray(_fused_dropout(x, 0.3, seed=7)))
+        assert not np.array_equal(
+            np.asarray(y), np.asarray(_fused_dropout(x, 0.3, seed=8)))
+
+    def test_norm_residual_dropout_grads(self):
+        from paddle_tpu.ops.pallas.fused_norm import (
+            fused_layer_norm_residual_dropout,
+            fused_rms_norm_residual_dropout)
+        x, r, w, b = (_rand(2, 8, 128), _rand(2, 8, 128), _rand(128),
+                      _rand(128))
+
+        def loss(x, r, w):
+            y, z = fused_rms_norm_residual_dropout(
+                x, r, w, dropout_rate=0.25, seed=3)
+            return (y ** 2).sum()
+        g = jax.grad(loss, argnums=(0, 1, 2))(x, r, w)
+        assert all(np.isfinite(np.asarray(gi)).all() for gi in g)
+        y, z = fused_layer_norm_residual_dropout(
+            x, r, w, b, dropout_rate=0.25, seed=3)
+        # z = dropout(x) + r: entries where dropout dropped equal r
+        dropped = np.isclose(np.asarray(z), np.asarray(r))
+        assert 0.1 < dropped.mean() < 0.4
+
+    def test_rate_zero_is_identity(self):
+        from paddle_tpu.ops.pallas.fused_norm import (
+            fused_rms_norm_residual, fused_rms_norm_residual_dropout)
+        x, r, w = _rand(2, 4, 128), _rand(2, 4, 128), _rand(128)
+        y0, _ = fused_rms_norm_residual(x, r, w)
+        y1, _ = fused_rms_norm_residual_dropout(x, r, w,
+                                                dropout_rate=0.0)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1))
+
+
 class TestFusedAdamW:
     def test_matches_reference_update(self):
         shape = (33, 77)  # ragged: exercises lane padding
