@@ -78,6 +78,22 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         ax = axis or "dp"
         mesh = mesh_mod.get_mesh()
         n = mesh.shape[ax]
+        if n == 1:
+            # size-1 group: reduce is the identity regardless of the
+            # rest of the mesh
+            return tensor
+        if any(v > 1 for k, v in mesh.shape.items() if k != ax):
+            # On a hybrid mesh the per-process addressable extent along
+            # `ax` is not local_device_count, and — worse — a group
+            # reduce over `ax` has a DIFFERENT result per coordinate of
+            # the other axes, which this single-global-value path cannot
+            # represent. Hybrid groups must reduce inside the jitted
+            # SPMD region instead.
+            raise NotImplementedError(
+                f"multi-controller eager all_reduce needs group axis "
+                f"{ax!r} to span the whole mesh (1-D world); on a hybrid "
+                f"mesh {dict(mesh.shape)} run the collective inside the "
+                f"jitted SPMD region (jax.lax.psum under shard_map/jit)")
         local_n = jax.local_device_count()
         a = _np.asarray(arr0)
         if op not in (ReduceOp.SUM, ReduceOp.AVG, ReduceOp.MAX,

@@ -178,10 +178,20 @@ class FusedMultiTransformer(nn.Layer):
             k = reshape(k, [b, l, self.num_heads, self.head_dim])
             v = reshape(v, [b, l, self.num_heads, self.head_dim])
             if caches is not None and time_step is not None:
-                # decode: append k/v at time_step into the static cache
+                # decode: append k/v at time_step into the static cache.
+                # time_step stays a TRACED scalar (dynamic_update_slice,
+                # the decode-kernel lens, and the mask below all accept
+                # traced indices) — no host sync, no per-step retrace,
+                # and forward can sit under jit with a traced time_step.
                 cache = caches[i]
-                t = int(time_step) if not isinstance(time_step, Tensor) \
-                    else int(time_step.numpy())
+                # python-int time_step keeps a static fast path (slice
+                # instead of full-cache mask); Tensor/traced time_step
+                # stays traced — no host sync, no per-step retrace
+                t_static = int(time_step) if isinstance(
+                    time_step, (int, np.integer)) else None
+                t = time_step.data if isinstance(time_step, Tensor) \
+                    else jnp.asarray(time_step, jnp.int32)
+                t = t.reshape(())
 
                 def upd(c, ka, va):
                     kc = jax.lax.dynamic_update_slice(
@@ -200,21 +210,37 @@ class FusedMultiTransformer(nn.Layer):
                     def dec(c, q_):
                         kc = jnp.swapaxes(c[0], 1, 2)  # [B, S, H, D]
                         vc = jnp.swapaxes(c[1], 1, 2)
-                        lens = jnp.full((q_.shape[0],), t + 1, jnp.int32)
+                        lens = jnp.zeros((q_.shape[0],), jnp.int32) \
+                            + (t + 1)
                         return decode_attention(q_[:, 0], kc, vc,
                                                 lens)[:, None]
                     attn = apply(dec, (cache, q),
                                  op_name="decode_attention")
-                else:
-                    k_full = transpose(cache[0], [0, 2, 1, 3])[:, :t + l]
-                    v_full = transpose(cache[1], [0, 2, 1, 3])[:, :t + l]
-                    # cross-length causal: query i sees cache pos <= t+i
+                elif t_static is not None:
+                    # static t: slice just the valid prefix (much
+                    # cheaper than attending over max_len when t << S)
+                    ts = t_static
+                    k_full = transpose(cache[0], [0, 2, 1, 3])[:, :ts + l]
+                    v_full = transpose(cache[1], [0, 2, 1, 3])[:, :ts + l]
                     mask = None
                     if l > 1:
-                        qpos = t + jnp.arange(l)[:, None]
-                        kpos = jnp.arange(t + l)[None, :]
+                        qpos = ts + jnp.arange(l)[:, None]
+                        kpos = jnp.arange(ts + l)[None, :]
                         mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
                                       .astype(jnp.float32))
+                    attn = F.scaled_dot_product_attention(
+                        q, k_full, v_full, attn_mask=mask)
+                else:
+                    # traced t: attend over the FULL static cache with a
+                    # validity mask (a [:t+l] slice would need static
+                    # t): query i sees cache pos <= t+i
+                    S = cache.shape[3]
+                    k_full = transpose(cache[0], [0, 2, 1, 3])
+                    v_full = transpose(cache[1], [0, 2, 1, 3])
+                    qpos = t + jnp.arange(l)[:, None]
+                    kpos = jnp.arange(S)[None, :]
+                    mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                                  .astype(jnp.float32))
                     attn = F.scaled_dot_product_attention(
                         q, k_full, v_full, attn_mask=mask)
             else:
@@ -256,8 +282,22 @@ class FusedMultiTransformerInt8(FusedMultiTransformer):
         self._quantized = False
 
     def quantize_weights(self, bits=8):
+        """Snapshot int8 weights and DROP the float linear weights:
+        quantization freezes the weights at this point (later float-side
+        mutation cannot silently desync from the int8 copies, and the
+        float tensors stop double-counting in parameters()). The int8
+        weights + scales are registered as persistable BUFFERS on each
+        linear, so state_dict()/set_state_dict round-trip the quantized
+        model (construct + quantize_weights() first, then load)."""
         import jax.numpy as _jnp
         from ...quantization.functional import quantize as _quantize
+        if self._quantized:
+            raise RuntimeError(
+                "already quantized: the float weights were dropped at "
+                "quantize time. To re-quantize at a different bit width, "
+                "rebuild via FusedMultiTransformerInt8.from_float(model, "
+                "bits=...) from the float model.")
+        self._bits = bits
         self._int8 = []
         for blk in self.layers:
             entry = {}
@@ -266,24 +306,41 @@ class FusedMultiTransformerInt8(FusedMultiTransformer):
                 w = lin.weight.data
                 # all-zero channels would give scale 0 -> NaN int8
                 scale = _jnp.maximum(_jnp.max(_jnp.abs(w), axis=0), 1e-8)
-                entry[name] = (
-                    _quantize(lin.weight, scale, bits=bits, axis=-1),
-                    scale, lin.bias)
+                wq = _quantize(lin.weight, scale, bits=bits, axis=-1)
+                wq = wq if isinstance(wq, Tensor) else Tensor(wq)
+                scale_t = Tensor(scale)
+                lin.weight = None  # Layer.__setattr__ drops the param
+                lin.register_buffer("weight_int8", wq)
+                lin.register_buffer("weight_scale", scale_t)
+                # entry aliases the SAME Tensor objects as the buffers:
+                # set_state_dict mutates them in place (set_value), so a
+                # reloaded checkpoint reaches _proj without re-wiring
+                entry[name] = (wq, scale_t, lin.bias)
             self._int8.append(entry)
         self._quantized = True
         return self
 
     @classmethod
-    def from_float(cls, model: "FusedMultiTransformer", bits=8):
+    def from_float(cls, model: "FusedMultiTransformer", bits: int = 8):
         m = cls(model.embed_dim, model.num_heads,
                 model.layers[0].ffn1.weight.shape[1],
                 activation=model._act_name,
                 num_layers=model.num_layers,
-                normalize_before=model.normalize_before)
+                normalize_before=model.normalize_before,
+                epsilon=model.layers[0].ln._epsilon)
+        # copy the float model's values into m's OWN Parameter objects
+        # (jnp arrays are immutable, so sharing the array data is safe;
+        # sharing the modules by reference is not — the source model
+        # would see its weights dropped by quantize_weights, and later
+        # source-side updates would silently desync from the int8 copies)
         for dst, srcb in zip(m.layers, model.layers):
             for name in ("ln", "qkv", "out_proj", "ffn_ln", "ffn1",
                          "ffn2"):
-                setattr(dst, name, getattr(srcb, name))
+                dmod, smod = getattr(dst, name), getattr(srcb, name)
+                for pname, p in smod._parameters.items():
+                    if p is not None and \
+                            dmod._parameters.get(pname) is not None:
+                        dmod._parameters[pname]._data = p.data
         return m.quantize_weights(bits=bits)
 
     def _proj(self, i, blk, name, x):
@@ -292,5 +349,6 @@ class FusedMultiTransformerInt8(FusedMultiTransformer):
                                "before forward")
         from ...quantization.functional import quantized_matmul
         wq, scale, bias = self._int8[i][name]
-        out = quantized_matmul(x, wq, scale)
+        # dequantize with the SAME bit width used at quantize time
+        out = quantized_matmul(x, wq, scale, bits=self._bits)
         return out + bias if bias is not None else out

@@ -65,7 +65,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     m_prev = m_scr[...]                          # [g, 1]
     m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
     corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(scores - m_new)                  # [g, block_s]
+    # mask the probabilities too: with length == 0 every score is
+    # NEG_INF, m_new stays NEG_INF, and exp(scores - m_new) would be a
+    # row of ones — the row must contribute nothing instead
+    p = jnp.exp(scores - m_new) * (pos < length)  # [g, block_s]
     l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
     acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
         p, v, (((1,), (0,)), ((), ())),
@@ -74,14 +77,18 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
     @pl.when(s_i == s_steps - 1)
     def _done():
-        o_ref[0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+        l = l_scr[...]
+        # length-0 rows have l == 0 and acc == 0: emit zeros, not NaN
+        o_ref[0] = (acc_scr[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, seq_lens, sm_scale=None,
                      block_s=128):
     """q: [B, nh, hd] (one decode step). k_cache/v_cache:
     [B, S, nkv, hd]. seq_lens: int32 [B] valid cache lengths (the entry
-    at seq_lens-1 is the newest token). Returns [B, nh, hd]."""
+    at seq_lens-1 is the newest token); rows with seq_lens == 0 return
+    zeros. Returns [B, nh, hd]."""
     B, nh, hd = q.shape
     S, nkv = k_cache.shape[1], k_cache.shape[2]
     g = nh // nkv
@@ -144,7 +151,9 @@ def decode_attention_reference(q, k_cache, v_cache, seq_lens,
     mask = jnp.arange(S)[None, None, None, :] < \
         jnp.asarray(seq_lens)[:, None, None, None]
     scores = jnp.where(mask, scores, NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1)
+    # mask again after softmax so all-masked (length 0) rows yield zeros
+    # rather than the uniform mean of the cache
+    p = jax.nn.softmax(scores, axis=-1) * mask
     out = jnp.einsum("bngs,bsnd->bngd", p,
                      v_cache.astype(jnp.float32))
     return out.reshape(B, nh, hd).astype(q.dtype)

@@ -226,6 +226,57 @@ class TestDecodeAttention:
             np.asarray(decode_attention_reference(q, kc, vc, lens)),
             atol=1e-5, rtol=1e-5)
 
+    def test_zero_length_rows_return_zeros(self):
+        """seq_lens == 0 must yield a zero row, not the uniform mean of
+        the whole (garbage) cache (advisor r2 finding)."""
+        B, S, nh, hd = 2, 32, 4, 16
+        q = _rand(B, nh, hd)
+        kc, vc = _rand(B, S, nh, hd), _rand(B, S, nh, hd)
+        lens = jnp.asarray([0, 7], jnp.int32)
+        out = np.asarray(decode_attention(q, kc, vc, lens, block_s=8))
+        ref = np.asarray(decode_attention_reference(q, kc, vc, lens))
+        assert np.all(out[0] == 0.0) and np.all(ref[0] == 0.0)
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out[1], ref[1], atol=1e-5, rtol=1e-5)
+
+    def test_traced_time_step_no_retrace(self):
+        """Decode forward keeps time_step traced: one jit trace serves
+        every decode position (advisor r2 finding — int(time_step)
+        forced a host sync + retrace per step)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.seed(0)
+        m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                                  dim_feedforward=64, num_layers=1)
+        caches = m.gen_cache(2, 16)
+        x0 = paddle.to_tensor(rng.standard_normal((2, 4, 32))
+                              .astype(np.float32))
+        _, caches = m(x0, caches=caches, time_step=0)
+        traces = 0
+
+        def fwd(tok, cache_data, t):
+            nonlocal traces
+            traces += 1
+            o, cs = m(Tensor(tok), caches=[Tensor(c) for c in cache_data],
+                      time_step=Tensor(t))
+            return o.data, [c.data for c in cs]
+
+        jf = jax.jit(fwd)
+        cd = [c.data for c in caches]
+        cd_eager = [c.data for c in caches]
+        for t in (4, 5, 6):
+            tok = jnp.asarray(rng.standard_normal((2, 1, 32)), jnp.float32)
+            o, cd = jf(tok, cd, jnp.asarray(t, jnp.int32))
+            # eager reference with a static python-int time_step
+            o_ref, cs = m(Tensor(tok),
+                          caches=[Tensor(c) for c in cd_eager],
+                          time_step=t)
+            cd_eager = [c.data for c in cs]
+            np.testing.assert_allclose(np.asarray(o), o_ref.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+        assert traces == 1
+
     def test_fused_transformer_decode_uses_cache_correctly(self):
         """End-to-end: FusedMultiTransformer decode equals the dense
         path (the kernel is TPU-gated; this exercises the jnp fallback +

@@ -228,6 +228,77 @@ def test_fused_multi_transformer_int8():
                                atol=1e-5)
 
 
+def test_fused_multi_transformer_int8_freezes_weights():
+    """from_float snapshots weights: mutating the float model afterwards
+    must not change the int8 model, and the dropped float weights must
+    not double-count in parameters() (advisor r2 finding)."""
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+    paddle.seed(1)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                              dim_feedforward=64, num_layers=1)
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal((2, 3, 32)).astype(np.float32))
+    qm = FusedMultiTransformerInt8.from_float(m)
+    before = qm(x).numpy()
+    m.layers[0].qkv.weight._data = m.layers[0].qkv.weight.data * 0.0
+    after = qm(x).numpy()
+    np.testing.assert_array_equal(before, after)
+    # float model keeps its weights; int8 model carries no float linears
+    assert m.layers[0].qkv.weight is not None
+    assert qm.layers[0].qkv.weight is None
+    n_lin_params = sum(1 for name, _ in qm.named_parameters()
+                       if "qkv" in name or "ffn1" in name)
+    assert n_lin_params == 2  # only the biases remain
+    # re-quantizing a frozen model must be a clear error, not a crash
+    with pytest.raises(RuntimeError, match="already quantized"):
+        qm.quantize_weights(bits=4)
+
+
+def test_fused_multi_transformer_int8_bits_and_epsilon():
+    """from_float must carry the LN epsilon and dequantize with the
+    same bit width it quantized with (4-bit weights scaled by qmax=7,
+    not 127)."""
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+    paddle.seed(3)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                              dim_feedforward=64, num_layers=1,
+                              epsilon=1e-3)
+    x = paddle.to_tensor(np.random.default_rng(3)
+                         .standard_normal((2, 4, 32)).astype(np.float32))
+    ref = m(x).numpy()
+    q4 = FusedMultiTransformerInt8.from_float(m, bits=4)
+    assert q4.layers[0].ln._epsilon == 1e-3
+    got = q4(x).numpy()
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.35, rel  # coarse 4-bit error, NOT the ~18x bits bug
+
+
+def test_fused_multi_transformer_int8_state_dict_roundtrip():
+    """Int8 weights/scales live in persistable buffers: state_dict of a
+    quantized model carries them, and a freshly-built quantized model
+    restores them with set_state_dict."""
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+    paddle.seed(2)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                              dim_feedforward=64, num_layers=2)
+    qm = FusedMultiTransformerInt8.from_float(m)
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((2, 3, 32)).astype(np.float32))
+    ref = qm(x).numpy()
+    sd = qm.state_dict()
+    assert any("weight_int8" in k for k in sd)
+    paddle.seed(99)  # different init
+    m2 = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                               dim_feedforward=64, num_layers=2)
+    qm2 = FusedMultiTransformerInt8.from_float(m2)
+    missing, unexpected = qm2.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_allclose(qm2(x).numpy(), ref, rtol=1e-6, atol=1e-6)
+
+
 def test_post_training_quantization_facade():
     from paddle_tpu.static.quantization import PostTrainingQuantization
     net = Net()
