@@ -66,7 +66,8 @@ class LlamaSpmdTrainer:
                  beta1=0.9, beta2=0.95, eps=1e-8, remat=True,
                  n_micro=None, seed=0, compute_dtype=jnp.bfloat16,
                  from_state_dict=None, remat_policy="full",
-                 n_virtual=1, remat_stage=False):
+                 n_virtual=1, remat_stage=False,
+                 moments_dtype=jnp.float32):
         self.config = config
         self.lr = lr
         self.wd = weight_decay
@@ -82,6 +83,12 @@ class LlamaSpmdTrainer:
                              f"got {remat_policy!r}")
         self.remat_policy = remat_policy
         self.compute_dtype = compute_dtype
+        # AdamW moment storage dtype. fp32 is the default (exact parity
+        # with the reference's Adam); bf16 halves optimizer-state HBM
+        # (the update math still runs in fp32 — only m/v storage is
+        # compressed, master weights stay fp32). The memory-efficient
+        # analog of the reference's multi_precision knob.
+        self.moments_dtype = moments_dtype
         mesh = mesh_mod.get_mesh()
         self.pp = mesh.shape.get("pp", 1)
         self.n_micro = n_micro or max(2 * self.pp, 1)
@@ -184,10 +191,10 @@ class LlamaSpmdTrainer:
             base = a.sharding.spec if isinstance(a.sharding,
                                                  NamedSharding) else ()
             spec = _zero_spec(shape, tuple(base))
+            mdt = self.moments_dtype
             def zeros():
                 # fresh buffer per accumulator (escape the constant cache)
-                return jnp.zeros(shape, jnp.float32) + jnp.zeros(
-                    (), jnp.float32)
+                return jnp.zeros(shape, mdt) + jnp.zeros((), mdt)
             return {
                 "m": mesh_mod.shard_tensor_data(zeros(), spec),
                 "v": mesh_mod.shard_tensor_data(zeros(), spec),
@@ -339,6 +346,12 @@ class LlamaSpmdTrainer:
 
     def forward(self, params, ids):
         """ids: [B, T] -> logits [B, T, V]."""
+        x = self.forward_hidden(params, ids)
+        logits = x @ params["head"]
+        return mesh_mod.constraint(logits, "dp", "sep", "mp")
+
+    def forward_hidden(self, params, ids):
+        """ids: [B, T] -> final-norm hidden states [B, T, H] (pre-head)."""
         x = jnp.take(params["embed"], ids, axis=0).astype(self.compute_dtype)
         x = mesh_mod.constraint(x, "dp", "sep", None)
         if self.pp > 1:
@@ -350,9 +363,6 @@ class LlamaSpmdTrainer:
             kw = dict(n_virtual=self.n_virtual,
                       remat_stage=self.remat_stage)
             if sep_n > 1:
-                # 'sep' must be manual inside the pipeline region (no
-                # nested manual axes in jax) — activations stay
-                # sequence-sharded on dim 2 throughout the schedule
                 kw.update(manual_axes={"sep"},
                           x_spec=P(None, None, "sep"))
             out = spmd_pipeline(self._stage_fn, params["blocks"], x_micro,
@@ -360,29 +370,72 @@ class LlamaSpmdTrainer:
                                 self.n_virtual > 1 else "logical", **kw)
             x = out.reshape((B,) + out.shape[2:])
         else:
-            # pp==1: chunks are logical-order, so fold them into one
-            # [chunks*layers_per_stage] stage and run a single stage_fn
             stage = jax.tree_util.tree_map(
                 lambda a: a.reshape((-1,) + a.shape[2:]), params["blocks"])
             x = self._stage_fn(stage, x)
         x32 = x.astype(jnp.float32)
         x32 = x32 * jax.lax.rsqrt(
             jnp.mean(x32 * x32, -1, keepdims=True) + self.config.rms_norm_eps)
-        x = (x32 * params["norm"].astype(jnp.float32)).astype(
+        return (x32 * params["norm"].astype(jnp.float32)).astype(
             self.compute_dtype)
-        logits = x @ params["head"]
-        return mesh_mod.constraint(logits, "dp", "sep", "mp")
 
     def loss_fn(self, params, ids, labels):
-        logits = self.forward(params, ids).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-        tgt = labels[:, 1:]
-        picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
-        return -picked.mean()
+        """Next-token cross entropy, computed CHUNKED over the sequence:
+        each lax.scan step projects one T-chunk through the vocab head
+        and reduces it to per-token CE (logsumexp - target logit) in
+        fp32, under jax.checkpoint so backward recomputes the chunk
+        logits instead of saving them. Peak loss memory drops from
+        2 full fp32 [B, T, V] buffers (logits + log_softmax) to one
+        [B, C, V] chunk — the difference between OOM and fitting a
+        bigger batch at vocab 32000 on one chip. Numerics are identical
+        to log_softmax + gather (same fp32 logsumexp)."""
+        if mesh_mod.mesh_axis_size("sep") > 1:
+            # sequence parallel: T is sep-sharded (chunking would fight
+            # GSPMD over the reshape) and the per-device logit slab is
+            # already T/sep small — use the plain log_softmax path
+            logits = self.forward(params, ids).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            tgt = labels[:, 1:]
+            picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            return -picked.mean()
+        x = self.forward_hidden(params, ids)          # [B, T, H]
+        B, T, H = x.shape
+        head = params["head"]
+        # position t predicts labels[t+1]; the final position has no
+        # target — give it a dummy and mask it out of the mean
+        tgt = jnp.concatenate([labels[:, 1:], labels[:, :1]], axis=1)
+        C = min(256, T)
+        while T % C:
+            C //= 2
+        nC = T // C
+        xs = jnp.moveaxis(x.reshape(B, nC, C, H), 1, 0)       # [nC,B,C,H]
+        ts = jnp.moveaxis(tgt.reshape(B, nC, C), 1, 0)        # [nC,B,C]
+
+        def chunk_ce(xc, tc):
+            logits = (xc @ head).astype(jnp.float32)          # [B, C, V]
+            logits = mesh_mod.constraint(logits, "dp", None, "mp")
+            lse = jax.nn.logsumexp(logits, axis=-1)           # [B, C]
+            picked = jnp.take_along_axis(
+                logits, tc[..., None], axis=-1)[..., 0]
+            return lse - picked                               # [B, C]
+
+        def body(total, xc_tc):
+            return total + chunk_ce(*xc_tc).sum(axis=-1), None
+
+        if nC > 1:
+            ce_rows, _ = jax.lax.scan(jax.checkpoint(body),
+                                      jnp.zeros((B,), jnp.float32),
+                                      (xs, ts))
+            # subtract the masked final position's dummy CE
+            ce_rows = ce_rows - chunk_ce(x[:, -1:], tgt[:, -1:])[:, 0]
+        else:
+            ce = chunk_ce(x, tgt)                             # [B, T]
+            ce_rows = ce[:, :-1].sum(axis=-1)
+        return ce_rows.sum() / (B * (T - 1))
 
     # -- optimizer ----------------------------------------------------------
     def _adamw(self, p, g, st, lr, step):
-        if self._pallas_fused:
+        if self._pallas_fused and self.moments_dtype == jnp.float32:
             # one fused pallas pass over p/g/m/v/master (the reference's
             # fused_adam multi-tensor kernel, fused_adam_kernel.cu)
             from ..ops.pallas.fused_adamw import fused_adamw_update
@@ -391,13 +444,17 @@ class LlamaSpmdTrainer:
                 self.b2, self.eps, self.wd, step)
             return new_p, {"m": m, "v": v, "master": master}
         g32 = g.astype(jnp.float32)
-        m = self.b1 * st["m"] + (1 - self.b1) * g32
-        v = self.b2 * st["v"] + (1 - self.b2) * g32 * g32
+        m = self.b1 * st["m"].astype(jnp.float32) + (1 - self.b1) * g32
+        v = (self.b2 * st["v"].astype(jnp.float32)
+             + (1 - self.b2) * g32 * g32)
         mh = m / (1 - self.b1 ** step)
         vh = v / (1 - self.b2 ** step)
         upd = mh / (jnp.sqrt(vh) + self.eps) + self.wd * st["master"]
         master = st["master"] - lr * upd
-        return master.astype(p.dtype), {"m": m, "v": v, "master": master}
+        mdt = self.moments_dtype
+        return master.astype(p.dtype), {"m": m.astype(mdt),
+                                        "v": v.astype(mdt),
+                                        "master": master}
 
     def _make_step(self):
         def step(params, opt_state, ids, labels, lr, stepno):
@@ -431,18 +488,26 @@ class LlamaSpmdTrainer:
 
     # -- analytics ----------------------------------------------------------
     def flops_per_token(self, seq_len=None):
-        """Training FLOPs/token: 6 * params-in-matmuls plus the causal
-        attention quadratic term (QK^T and PV are 2*H*T_eff fwd flops each
-        per token with T_eff = T/2 under causal masking; backward doubles
-        the forward, so train = 3x fwd = 6*H*T per layer per token).
-        Remat recompute is NOT counted (MFU convention: model FLOPs only).
+        """Training FLOPs/token, strict Megatron/PaLM convention:
+
+        - 6 * params-in-matmuls, where the vocab projection is counted
+          ONCE (the logit head V*H). The input-embedding forward is a
+          gather and its backward a scatter-add — no matmul FLOPs, so the
+          untied embedding table contributes nothing here even though the
+          hardware does real (uncounted) work for it.
+        - causal attention quadratic term: QK^T and PV are 2*H*T_eff fwd
+          flops each per token with T_eff = T/2 under causal masking;
+          backward doubles the forward, so train = 3x fwd = 6*H*T per
+          layer per token.
+        - Remat recompute is NOT counted (MFU convention: model FLOPs
+          only).
         """
         c = self.config
         H, F, V = c.hidden_size, c.intermediate_size, c.vocab_size
         T = seq_len or c.max_position_embeddings
         KV = c.num_key_value_heads * self.head_dim
         per_layer = 2 * H * H + 2 * H * KV + 3 * H * F
-        matmul_params = c.num_hidden_layers * per_layer + 2 * V * H
+        matmul_params = c.num_hidden_layers * per_layer + V * H
         attn = 6 * c.num_hidden_layers * H * T
         return 6 * matmul_params + attn
 
