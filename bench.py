@@ -1,8 +1,15 @@
 """Benchmark: Llama training throughput + MFU on one chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline (BASELINE.md): ≥45% MFU target for Llama-class hybrid training —
-vs_baseline = achieved_MFU / 0.45.
+Baseline (BASELINE.md): >=45% MFU target for Llama-class hybrid training
+— vs_baseline = achieved_MFU / 0.45.
+
+MFU accounting is the STRICT Megatron/PaLM convention: the vocab
+projection is counted once (the logit head matmul); the input-embedding
+gather, remat recompute, and the chunked-CE logit recompute are real
+work that is NOT counted (see LlamaSpmdTrainer.flops_per_token).
+Timing is windowed (sync at window boundaries only, never per step) and
+the three window throughputs + std are reported alongside the mean.
 """
 from __future__ import annotations
 
@@ -41,60 +48,72 @@ def main():
     mesh_mod.build_mesh(dp=1, devices=[dev])
 
     if on_tpu:
-        # Llama-2-7B layer dims (hidden 4096, inter 11008, 32 heads) with 2
-        # layers + 16k vocab so params+AdamW states fit one chip's HBM; bf16,
-        # selective remat (save_dots: keep matmul outputs, recompute only
-        # elementwise), seq 2048. MXU-saturating matmuls == honest 7B-class
-        # MFU; flops_per_token scales with the layer count.
-        cfg = LlamaConfig(vocab_size=16000, hidden_size=4096,
+        # Llama-2-7B layer dims (hidden 4096, inter 11008, 32 heads,
+        # TRUE 32000 vocab) with 2 layers so params + AdamW states fit
+        # one chip's HBM; bf16 compute, bf16 moment storage (update math
+        # fp32), selective remat (save_dots), chunked cross-entropy,
+        # seq 2048, tuned flash-attention block sizes. MXU-saturating
+        # matmuls == honest 7B-class MFU; flops_per_token scales with
+        # the layer count and counts the vocab matmul ONCE.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
                           intermediate_size=11008, num_hidden_layers=2,
                           num_attention_heads=32, num_key_value_heads=32,
                           max_position_embeddings=2048)
-        batch, seq, steps, warmup = 12, 2048, 10, 2
+        batch, seq, steps, windows, warmup = 16, 2048, 5, 3, 2
         dtype = jnp.bfloat16
+        moments = jnp.bfloat16
     else:
         cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=4,
                                kv_heads=4, inter=128, seq=128)
-        batch, seq, steps, warmup = 4, 128, 3, 1
+        batch, seq, steps, windows, warmup = 4, 128, 3, 1, 1
         dtype = jnp.float32
+        moments = jnp.float32
 
     trainer = LlamaSpmdTrainer(cfg, compute_dtype=dtype, remat=True,
                                remat_policy="save_dots" if on_tpu
-                               else "full")
+                               else "full",
+                               moments_dtype=moments, scan_unroll=2)
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq))
 
     for _ in range(warmup):
         float(trainer.train_step(ids))  # host sync
     jax.block_until_ready(trainer.params)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.train_step(ids)
-    loss_v = float(loss)  # host transfer: hard sync of the whole chain
-    jax.block_until_ready(trainer.params)
-    dt = time.perf_counter() - t0
+    win_tok_s = []
+    toks = batch * seq * steps
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.train_step(ids)
+        loss_v = float(loss)  # host transfer: hard sync of the chain
+        jax.block_until_ready(trainer.params)
+        win_tok_s.append(toks / (time.perf_counter() - t0))
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
-    # flops_per_token counts matmul params (6N) + causal attention term;
-    # remat recompute is excluded per MFU convention (model FLOPs only)
+    tok_s = float(np.mean(win_tok_s))
+    # strict-convention flops/token (vocab matmul counted once; no
+    # recompute, no embedding-gather flops)
     flops_tok = trainer.flops_per_token(seq)
     mfu = tok_s * flops_tok / _peak_flops(dev)
 
     try:
         from paddle_tpu.utils.op_coverage import coverage
         cov = coverage()
-        op_cov = cov["pct"] if cov["total"] else None
+        op_cov = cov["reachable_pct"] if cov["total"] else None
+        golden_cov = cov.get("golden_pct")
     except Exception:
-        op_cov = None
+        op_cov = golden_cov = None
 
     print(json.dumps({
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu * 100, 2),
-        "unit": "%MFU",
+        "unit": "%MFU_strict_megatron_convention",
         "vs_baseline": round(mfu / 0.45, 4),
         "tokens_per_sec_per_chip": round(tok_s, 1),
+        "tok_s_windows": [round(t, 1) for t in win_tok_s],
+        "tok_s_std": round(float(np.std(win_tok_s)), 1),
+        "flops_per_token_G": round(flops_tok / 1e9, 3),
         "params": trainer.param_count(),
-        "op_coverage_pct": op_cov,
+        "op_coverage_reachable_pct": op_cov,
+        "op_coverage_golden_pct": golden_cov,
         "device": str(dev),
     }))
 

@@ -42,6 +42,9 @@ _define("FLAGS_enable_pallas_kernels", True,
 _define("FLAGS_embedding_deterministic", False)
 _define("FLAGS_tpu_flash_impl", "jax",
         "flash attention kernel: jax (tuned pallas) | native (this repo)")
+_define("FLAGS_tpu_flash_attention", True,
+        "use the pallas flash-attention kernel in the llama trainer "
+        "(False falls back to the dense XLA attention path)")
 _define("FLAGS_tpu_fused_block", "xla",
         "llama block norm/optimizer fusion: xla (let XLA fuse — measured "
         "faster: pallas custom calls are fusion barriers in the training "

@@ -67,7 +67,8 @@ class LlamaSpmdTrainer:
                  n_micro=None, seed=0, compute_dtype=jnp.bfloat16,
                  from_state_dict=None, remat_policy="full",
                  n_virtual=1, remat_stage=False,
-                 moments_dtype=jnp.float32):
+                 moments_dtype=jnp.float32, ce_remat=True,
+                 scan_unroll=1):
         self.config = config
         self.lr = lr
         self.wd = weight_decay
@@ -89,6 +90,15 @@ class LlamaSpmdTrainer:
         # compressed, master weights stay fp32). The memory-efficient
         # analog of the reference's multi_precision knob.
         self.moments_dtype = moments_dtype
+        # ce_remat=True recomputes each CE chunk's logits in backward
+        # (min memory); False saves the bf16 chunk logits instead —
+        # one head-matmul less recompute when HBM allows
+        self.ce_remat = ce_remat
+        # unroll factor for the scan over a stage's layers: >1 removes
+        # the XLA while-loop (its double-buffered carries and per-layer
+        # weight dynamic-slices) at the cost of compile time — worth it
+        # for shallow stages
+        self.scan_unroll = int(scan_unroll)
         mesh = mesh_mod.get_mesh()
         self.pp = mesh.shape.get("pp", 1)
         self.n_micro = n_micro or max(2 * self.pp, 1)
@@ -268,8 +278,10 @@ class LlamaSpmdTrainer:
 
         scale = 1.0 / math.sqrt(hd)
         sep_n = mesh_mod.mesh_axis_size("sep")
+        from ..flags import get_flag
         use_flash = (_on_tpu() and hd % 64 == 0 and T % 128 == 0
-                     and sep_n == 1)
+                     and sep_n == 1
+                     and bool(get_flag("FLAGS_tpu_flash_attention", True)))
         if sep_n > 1:
             # sequence parallel: q/k/v all stay sep-sharded on T; ring
             # attention circulates K/V blocks over the sep axis — per-step
@@ -341,7 +353,8 @@ class LlamaSpmdTrainer:
         def body(carry, bp):
             return block(bp, carry), None
 
-        out, _ = jax.lax.scan(body, x, stage_params)
+        out, _ = jax.lax.scan(body, x, stage_params,
+                              unroll=max(1, self.scan_unroll))
         return out
 
     def forward(self, params, ids):
@@ -423,8 +436,8 @@ class LlamaSpmdTrainer:
             return total + chunk_ce(*xc_tc).sum(axis=-1), None
 
         if nC > 1:
-            ce_rows, _ = jax.lax.scan(jax.checkpoint(body),
-                                      jnp.zeros((B,), jnp.float32),
+            b = jax.checkpoint(body) if self.ce_remat else body
+            ce_rows, _ = jax.lax.scan(b, jnp.zeros((B,), jnp.float32),
                                       (xs, ts))
             # subtract the masked final position's dummy CE
             ce_rows = ce_rows - chunk_ce(x[:, -1:], tgt[:, -1:])[:, 0]

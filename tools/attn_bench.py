@@ -1,0 +1,128 @@
+"""Attention kernel shootout at the bench shape: jax flash w/ block-size
+variants, splash attention, native kernel, dense einsum."""
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, steps=5):
+    f = jax.jit(fn)
+    for _ in range(2):
+        out = f(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]
+                      .astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3
+
+
+def main():
+    B, T, NH, HD = 12, 2048, 32, 128
+    k = jax.random.PRNGKey(0)
+    qh = jax.random.normal(k, (B, NH, T, HD), jnp.bfloat16)  # [B,H,T,D]
+    scale = HD ** -0.5
+    fl_fwd = 4 * B * NH * (T * T // 2) * HD  # causal fwd flops
+    out = {}
+
+    def report(name, ms_fwd, ms_bwd=None):
+        out[name] = {
+            "fwd_ms": round(ms_fwd, 2),
+            "fwd_tflops": round(fl_fwd / ms_fwd / 1e9, 1),
+        }
+        if ms_bwd is not None:
+            out[name]["fwdbwd_ms"] = round(ms_bwd, 2)
+        print(json.dumps({name: out[name]}), flush=True)
+
+    # dense reference
+    def dense(q):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, q,
+                       preferred_element_type=jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, q)
+    try:
+        report("dense", timeit(dense, qh))
+    except Exception as e:
+        print(json.dumps({"dense": f"failed {type(e).__name__}"}),
+              flush=True)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention, BlockSizes)
+
+    def var(bq, bkM, bk, bb=1):
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bkM, block_k=bk, block_b=bb,
+            block_q_major_dkv=bq, block_k_major_dkv=bkM, block_k_dkv=bk,
+            block_q_dkv=bq, block_k_major_dq=bkM, block_k_dq=bk,
+            block_q_dq=bq)
+        def f(q):
+            return flash_attention(q, q, q, causal=True, sm_scale=scale,
+                                   block_sizes=bs)
+        def g(q):
+            return jax.grad(
+                lambda q_: f(q_).astype(jnp.float32).sum())(q)
+        return f, g
+
+    report_default_f = lambda q: flash_attention(q, q, q, causal=True,
+                                                 sm_scale=scale)
+    try:
+        ms = timeit(report_default_f, qh)
+        msb = timeit(jax.grad(lambda q: report_default_f(q)
+                              .astype(jnp.float32).sum()), qh)
+        report("jax_flash_default", ms, msb)
+    except Exception as e:
+        print(json.dumps({"jax_flash_default":
+                          f"failed {type(e).__name__}: {e}"}), flush=True)
+
+    for bq, bkM, bk in [(512, 512, 512), (1024, 512, 512),
+                        (2048, 512, 512), (512, 1024, 512),
+                        (256, 512, 256), (1024, 1024, 512)]:
+        name = f"jax_flash_q{bq}_kM{bkM}_k{bk}"
+        try:
+            f, g = var(bq, bkM, bk)
+            ms = timeit(f, qh)
+            msb = timeit(g, qh)
+            report(name, ms, msb)
+        except Exception as e:
+            print(json.dumps({name: f"failed {type(e).__name__}"}),
+                  flush=True)
+
+    # splash attention (newer kernel family)
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as sm)
+
+        mask = sm.MultiHeadMask(
+            [sm.CausalMask((T, T)) for _ in range(NH)])
+        kernel = sk.make_splash_mha(
+            mask=mask, head_shards=1, q_seq_shards=1)
+
+        def splash(q):
+            # splash wants [H, T, D] per batch; vmap over B, and takes
+            # q scaled externally
+            return jax.vmap(kernel)(q * scale, q, q)
+        ms = timeit(splash, qh)
+        msb = timeit(jax.grad(lambda q: splash(q).astype(jnp.float32)
+                              .sum()), qh)
+        report("splash", ms, msb)
+    except Exception as e:
+        print(json.dumps({"splash": f"failed {type(e).__name__}: {e}"}),
+              flush=True)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

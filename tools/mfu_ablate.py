@@ -1,0 +1,123 @@
+"""Step-time ablation for the champion MFU config — where do the ms go?
+
+Each variant runs in THIS process sequentially (fresh trainer per
+variant, same mesh). Run on the real chip:
+    python tools/mfu_ablate.py --layers 2 --vocab 32000 --batch 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _timeit(fn, sync, warmup=2, steps=3, windows=2):
+    for _ in range(warmup):
+        sync(fn())
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3  # ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+    from paddle_tpu import flags
+
+    dev = jax.devices()[0]
+    mesh_mod.build_mesh(dp=1, devices=[dev])
+    cfg = LlamaConfig(vocab_size=args.vocab, hidden_size=4096,
+                      intermediate_size=11008,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=32, num_key_value_heads=32,
+                      max_position_embeddings=args.seq)
+    ids = jnp.asarray(
+        np.random.randint(0, cfg.vocab_size, (args.batch, args.seq)))
+
+    def sync(x):
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        float(jnp.sum(leaf.astype(jnp.float32)))
+
+    def make(**kw):
+        return LlamaSpmdTrainer(cfg, compute_dtype=jnp.bfloat16,
+                                remat=True, remat_policy="save_dots",
+                                moments_dtype=jnp.bfloat16, **kw)
+
+    results = {}
+
+    def run(key, thunk):
+        try:
+            results[key] = _timeit(thunk, sync)
+        except Exception as e:
+            results[key] = f"failed: {type(e).__name__}"
+        print(json.dumps({key: results[key]}), flush=True)
+
+    # 1. full train step (champion)
+    tr = make()
+    run("full_step", lambda: tr.train_step(ids))
+
+    # 2. fwd only (loss)
+    f_fwd = jax.jit(tr.loss_fn)
+    run("fwd_loss", lambda: f_fwd(tr.params, ids, ids))
+
+    # 3. fwd + bwd (no optimizer)
+    f_vg = jax.jit(jax.value_and_grad(tr.loss_fn))
+    run("fwd_bwd", lambda: f_vg(tr.params, ids, ids)[0])
+
+    # 4/5. backbone only (no head/CE): dummy mean loss on hidden states
+    def dummy_loss(params, ids_, labels_):
+        return tr.forward_hidden(params, ids_).astype(jnp.float32).mean()
+    f_fwd_nh = jax.jit(dummy_loss)
+    run("fwd_backbone", lambda: f_fwd_nh(tr.params, ids, ids))
+    f_vg_nh = jax.jit(jax.value_and_grad(dummy_loss))
+    run("fwd_bwd_backbone", lambda: f_vg_nh(tr.params, ids, ids)[0])
+    del tr, f_fwd, f_vg, f_fwd_nh, f_vg_nh
+
+    # 6. CE without chunk remat (saves bf16 chunk logits instead)
+    tr2 = make(ce_remat=False)
+    run("full_step_ce_noremat", lambda: tr2.train_step(ids))
+    del tr2
+
+    # 7. no remat at all (XLA keeps everything; memory-permitting)
+    tr4 = LlamaSpmdTrainer(cfg, compute_dtype=jnp.bfloat16, remat=False,
+                           moments_dtype=jnp.bfloat16)
+    run("full_step_no_remat", lambda: tr4.train_step(ids))
+    del tr4
+
+    # 8. dense attention instead of flash kernel (known OOM at b>=8:
+    # the O(T^2) probs tensor; try smallest-batch evidence instead)
+    flags.set_flags({"FLAGS_tpu_flash_attention": False})
+    tr3 = make()
+    run("full_step_dense_attn", lambda: tr3.train_step(ids))
+    flags.set_flags({"FLAGS_tpu_flash_attention": True})
+    del tr3
+
+    toks = args.batch * args.seq
+    out = {"config": vars(args), "ms": results}
+    for k, v in results.items():
+        if isinstance(v, float):
+            out.setdefault("tok_s", {})[k] = round(toks / (v / 1e3), 1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

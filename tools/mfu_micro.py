@@ -1,0 +1,105 @@
+"""Microbenchmarks separating the step-time components on the real chip:
+raw MXU matmul ceiling, flash-attention kernel cost (fwd, fwd+bwd),
+elementwise/norm traffic, and the trainer's fwd with/without remat."""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, steps=5, warmup=2):
+    f = jax.jit(fn)
+    for _ in range(warmup):
+        out = f(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]
+                      .astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3
+
+
+def main():
+    B, T, H, NH, HD, F = 12, 2048, 4096, 32, 128, 11008
+    BT = B * T
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (BT, H), jnp.bfloat16)
+    w1 = jax.random.normal(k, (H, H), jnp.bfloat16)
+    w2 = jax.random.normal(k, (H, F), jnp.bfloat16)
+    w3 = jax.random.normal(k, (F, H), jnp.bfloat16)
+    out = {}
+
+    # raw MXU ceiling: the 7 matmuls of one decoder layer, chained
+    def layer_matmuls(x, w1, w2, w3):
+        h = x
+        for _ in range(4):              # qkv+o proxy: 4x [BT,H]@[H,H]
+            h = h @ w1
+        g = h @ w2                      # gate
+        u = h @ w2                      # up
+        return (g * u) @ w3             # down
+    ms = timeit(layer_matmuls, x, w1, w2, w3)
+    fl = 2 * BT * (4 * H * H + 3 * H * F)
+    out["layer_matmuls_ms"] = round(ms, 2)
+    out["layer_matmuls_tflops"] = round(fl / ms / 1e9, 1)
+
+    # flash attention fwd and fwd+bwd at bench shape
+    q = jax.random.normal(k, (B, T, NH, HD), jnp.bfloat16)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_blhd
+
+    def attn(q):
+        return flash_attention_blhd(q, q, q, causal=True)
+    out["attn_fwd_ms"] = round(timeit(attn, q), 2)
+
+    def attn_bwd(q):
+        return jax.grad(
+            lambda q_: attn(q_).astype(jnp.float32).sum())(q)
+    out["attn_fwdbwd_ms"] = round(timeit(attn_bwd, q), 2)
+    # ideal: causal fwd 2*2*BT*T/2*H = 2.06 TF -> ~10ms; bwd ~2.5x
+    afl = 4 * BT * (T // 2) * H
+    out["attn_fwd_tflops"] = round(afl / out["attn_fwd_ms"] / 1e9, 1)
+
+    # rmsnorm + rope elementwise cost for one layer's worth
+    w = jnp.ones((H,), jnp.bfloat16)
+
+    def norms(x, w):
+        h32 = x.astype(jnp.float32)
+        o = h32 * jax.lax.rsqrt(jnp.mean(h32 * h32, -1, keepdims=True)
+                                + 1e-6)
+        return (o * w.astype(jnp.float32)).astype(jnp.bfloat16)
+    out["rmsnorm_ms"] = round(timeit(norms, x, w), 2)
+
+    # trainer fwd loss with and without remat (isolates the remat tax
+    # XLA pays in the forward graph, if any)
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+    mesh_mod.build_mesh(dp=1, devices=[jax.devices()[0]])
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=H,
+                      intermediate_size=F, num_hidden_layers=2,
+                      num_attention_heads=NH, num_key_value_heads=NH,
+                      max_position_embeddings=T)
+    ids = np.random.randint(0, cfg.vocab_size, (B, T))
+    for remat in (True, False):
+        tr = LlamaSpmdTrainer(cfg, compute_dtype=jnp.bfloat16,
+                              remat=remat, remat_policy="save_dots",
+                              moments_dtype=jnp.bfloat16, scan_unroll=2)
+        try:
+            out[f"fwd_loss_remat_{remat}"] = round(
+                timeit(tr.loss_fn, tr.params, jnp.asarray(ids),
+                       jnp.asarray(ids)), 2)
+        except Exception as e:
+            out[f"fwd_loss_remat_{remat}"] = f"failed {type(e).__name__}"
+        del tr
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ def main():
     ap.add_argument("--moments", default="f32", choices=["f32", "bf16"])
     ap.add_argument("--remat", default="save_dots",
                     choices=["save_dots", "full"])
+    ap.add_argument("--unroll", type=int, default=1)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -47,7 +48,7 @@ def main():
                       max_position_embeddings=args.seq)
     trainer = LlamaSpmdTrainer(
         cfg, compute_dtype=jnp.bfloat16, remat=True,
-        remat_policy=args.remat,
+        remat_policy=args.remat, scan_unroll=args.unroll,
         moments_dtype=jnp.bfloat16 if args.moments == "bf16"
         else jnp.float32)
     ids = np.random.randint(0, cfg.vocab_size, (args.batch, args.seq))
@@ -72,11 +73,13 @@ def main():
     tok_s_w = [toks / t for t in win_times]
     tok_s = float(np.mean(tok_s_w))
     flops_tok = trainer.flops_per_token(args.seq)
-    peak = 197e12 if not args.cpu else 1e12
+    import bench
+    peak = bench._peak_flops(dev) if not args.cpu else 1e12
     mfu = tok_s * flops_tok / peak
     print(json.dumps({
         "layers": args.layers, "vocab": args.vocab, "batch": args.batch,
         "moments": args.moments, "remat": args.remat,
+        "unroll": args.unroll,
         "mfu_pct": round(mfu * 100, 2),
         "tok_s": round(tok_s, 1),
         "tok_s_windows": [round(t, 1) for t in tok_s_w],
