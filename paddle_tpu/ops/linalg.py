@@ -98,9 +98,11 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) with x = U @ diag(S) @ VH — VH is the
+    conjugate TRANSPOSE of V, matching the reference convention
+    (ref python/paddle/tensor/linalg.py:1920)."""
     def impl(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
     return op("svd", impl, x)
 
 

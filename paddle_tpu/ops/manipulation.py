@@ -282,8 +282,12 @@ def index_select(x, index, axis=0, name=None):
 
 
 def index_add(x, index, axis, value, name=None):
+    import builtins
+
     def impl(a, idx, v):
-        sl = [slice(None)] * a.ndim
+        # builtins.slice: this module defines the paddle `slice` op,
+        # which shadows the python builtin
+        sl = [builtins.slice(None)] * a.ndim
         sl[axis] = idx
         return a.at[tuple(sl)].add(v)
     return op("index_add", impl, x, index, value)
@@ -302,8 +306,7 @@ def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
     def impl(a, idx, v):
         v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
         if reduce == "assign":
-            return jnp.put_along_axis(a, idx, v, axis=axis) if hasattr(jnp, "put_along_axis") \
-                else _put_along(a, idx, v, axis, "set")
+            return _put_along(a, idx, v, axis, "set")
         if reduce in ("add", "sum"):
             return _put_along(a, idx, v, axis, "add")
         if reduce in ("mul", "multiply"):
@@ -544,7 +547,12 @@ def dstack(x, name=None):
 
 
 row_stack = vstack
-column_stack = hstack
+
+
+def column_stack(x, name=None):
+    # NOT hstack: 1-D inputs become columns (numpy column_stack)
+    return apply(lambda *xs: jnp.column_stack(xs), tuple(x),
+                 op_name="column_stack")
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

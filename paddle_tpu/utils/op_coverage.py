@@ -3,9 +3,17 @@
 Parses op names from the reference's YAML op registry
 (ref: /root/reference/paddle/phi/api/yaml/ops.yaml — 236 ops,
 legacy_ops.yaml — 120; these drive the reference's codegen, SURVEY.md §1)
-and reports which have a TPU-native implementation reachable from the
-public API (paddle.*, paddle.nn.functional.*, paddle.linalg/fft,
-Tensor methods, optimizers for the *_ infer-place update ops).
+and reports TWO numbers:
+
+- reachable_pct: ops with a TPU-native implementation reachable from
+  the public API (hasattr over paddle.*, paddle.nn.functional.*,
+  linalg/fft/..., Tensor methods; name-presence only)
+- golden_pct: ops covered by a golden OpSpec in tests/op/ (forward vs
+  numpy in dygraph + to_static + bf16, tape grad vs numeric diff) —
+  the correctness-backed number
+
+Ops with no meaningful TPU analog are listed in _DESCOPED with the
+reason and count as NOT implemented (they stay in the denominator).
 """
 from __future__ import annotations
 
@@ -18,10 +26,29 @@ REF_YAMLS = (
     "/root/reference/paddle/phi/api/yaml/legacy_ops.yaml",
 )
 
+# ops with no TPU-meaningful analog — counted as NOT implemented, with
+# the reason documented (the r2 verdict called the old charitable
+# aliases out: memcpy_h2d->to_tensor etc. overstated coverage)
+_DESCOPED = {
+    "memcpy_h2d": "explicit H2D staging — jax.device_put is implicit "
+                  "in every op; no user-facing analog",
+    "memcpy_d2h": "explicit D2H staging — .numpy() is the analog but "
+                  "not an op",
+    "coalesce_tensor": "fuses grad buffers for NCCL efficiency; XLA "
+                       "fuses buffers itself",
+    "npu_identity": "NPU-backend internal copy",
+    "merge_selected_rows": "SelectedRows (sparse-gradient rows) is a "
+                           "fluid-era storage class we do not carry",
+    "share_buffer": "buffer aliasing is XLA's donation, not an op",
+    "box_clip": "fluid-era detection-box clip; use paddle.clip on the "
+                "coordinate tensor",
+    "full_batch_size_like": "fluid-era shape-inference helper",
+    "trans_layout": "NCHW/NHWC layout swap — XLA picks layouts",
+}
+
 # ops whose public name differs from the yaml name
 _ALIASES = {
     "elementwise_pow": "pow",
-    "matmul": "matmul",
     "top_k": "topk",
     "reduce_sum": "sum",
     "reduce_mean": "mean",
@@ -38,10 +65,6 @@ _ALIASES = {
     "soft_shrink": "softshrink",
     "brelu": "relu6",
     "gaussian": "normal",
-    "uniform": "uniform",
-    "full": "full",
-    "memcpy_h2d": "to_tensor",
-    "memcpy_d2h": "to_tensor",
     # same semantics, different public name
     "bce_loss": "binary_cross_entropy",
     "kldiv_loss": "kl_div",
@@ -66,13 +89,10 @@ _ALIASES = {
     "frobenius_norm": "norm",
     "matrix_rank_tol": "matrix_rank",
     "remainder": "mod",
-    "share_buffer": "detach",
-    "slogdet": "slogdet",
     "softmax_": "softmax",
     "squared_l2_norm": "norm",
     "tril_triu": "tril",
     "truncated_gaussian_random": "normal",
-    "box_clip": "clip",
     "fused_softmax_mask_upper_triangle": "softmax",
     "fft_c2c": "fft",
     "fft_r2c": "rfft",
@@ -96,12 +116,7 @@ _ALIASES = {
     "repeat_interleave_with_tensor_index": "repeat_interleave",
     "fill_diagonal": "fill_diagonal_",
     "fill_diagonal_tensor": "diagonal_scatter",
-    "full_batch_size_like": "full_like",
     "memory_efficient_attention": "scaled_dot_product_attention",
-    "trans_layout": "transpose",
-    "npu_identity": "assign",
-    "merge_selected_rows": "assign",
-    "coalesce_tensor": "assign",
     # long-tail ops: public names of the new modules
     "multiclass_nms3": "multiclass_nms",
     "deformable_conv": "deform_conv2d",
@@ -110,10 +125,7 @@ _ALIASES = {
     "warprnnt": "rnnt_loss",
     "unpool": "max_unpool2d",
     "unpool3d": "max_unpool3d",
-    "segment_pool": "segment_pool",
     "spectral_norm": "spectral_norm_value",
-    "reindex_graph": "reindex_graph",
-    "weighted_sample_neighbors": "weighted_sample_neighbors",
 }
 
 # yaml ops with trailing underscore are in-place/param-update kernels; they
@@ -141,6 +153,8 @@ def _implemented(name: str) -> bool:
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
 
+    if name in _DESCOPED:
+        return False
     candidates = [name, _ALIASES.get(name, "")]
     base = name.rstrip("_")
     if base != name:
@@ -177,22 +191,69 @@ def _implemented(name: str) -> bool:
     return False
 
 
-def coverage() -> Dict[str, object]:
+def golden_op_names(repo_root=None) -> Set[str]:
+    """Yaml ops covered by a golden OpSpec (tests/op/test_*.py SPECS).
+
+    Loads the spec tables directly from the test files — specs are
+    executed by CI (pytest tests/op), so membership here means
+    'forward+grad golden-tested against numpy'."""
+    import glob
+    import importlib
+    import sys
+
+    here = globals().get("__file__") or os.path.join(
+        os.getcwd(), "paddle_tpu", "utils", "op_coverage.py")
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(here))))
+    opdir = os.path.join(root, "tests", "op")
+    if not os.path.isdir(opdir):
+        return set()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    covered: Set[str] = set()
+    for path in sorted(glob.glob(os.path.join(opdir, "test_*.py"))):
+        modname = "tests.op." + os.path.basename(path)[:-3]
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:
+            continue
+        for s in getattr(mod, "SPECS", []):
+            ops = tuple(getattr(s, "yaml_ops", ()) or ()) or (s.name,)
+            covered.update(ops)
+    return covered
+
+
+def coverage(with_golden=True) -> Dict[str, object]:
     names = ref_op_names()
     if not names:
-        return {"total": 0, "implemented": 0, "pct": 0.0, "missing": []}
+        return {"total": 0, "implemented": 0, "pct": 0.0,
+                "reachable_pct": 0.0, "golden_pct": 0.0, "missing": []}
     done = [n for n in names if _implemented(n)]
-    missing = [n for n in names if n not in set(done)]
-    return {
+    missing = [n for n in names if n not in set(done)
+               and n not in _DESCOPED]
+    reachable_pct = round(100.0 * len(done) / len(names), 1)
+    out = {
         "total": len(names),
         "implemented": len(done),
-        "pct": round(100.0 * len(done) / len(names), 1),
+        # pct stays the headline = reachable (backwards compat), with
+        # the two explicit numbers alongside
+        "pct": reachable_pct,
+        "reachable_pct": reachable_pct,
+        "descoped": len(_DESCOPED),
         "missing": missing,
     }
+    if with_golden:
+        golden = golden_op_names() & set(names)
+        out["golden"] = len(golden)
+        out["golden_pct"] = round(100.0 * len(golden) / len(names), 1)
+        out["ungolden"] = sorted(set(names) - golden - set(_DESCOPED))
+    return out
 
 
 if __name__ == "__main__":
     import json
     cov = coverage()
-    print(json.dumps({k: v for k, v in cov.items() if k != "missing"}))
+    print(json.dumps({k: v for k, v in cov.items()
+                      if k not in ("missing", "ungolden")}))
     print("missing:", " ".join(cov["missing"]))
+    print("ungolden:", " ".join(cov.get("ungolden", [])))

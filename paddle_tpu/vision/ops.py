@@ -97,7 +97,39 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                gaussian_sigma=2.0, background_label=0, normalized=True,
                return_index=False, return_rois_num=True, name=None):
     """Matrix NMS (SOLOv2; ref matrix_nms_kernel.cpp): soft decay by the
-    max IoU with any higher-scored box of the same class."""
+    max IoU with any higher-scored box of the same class.
+
+    Accepts the reference's batched layout bboxes [B, M, 4] /
+    scores [B, C, M] (results concatenated, rois_num per image) or a
+    single image [M, 4] / [C, M]."""
+    if np.asarray(_arr(bboxes)).ndim == 3:
+        b3 = np.asarray(_arr(bboxes))
+        s3 = np.asarray(_arr(scores))
+        parts = [matrix_nms(b3[i], s3[i], score_threshold,
+                            post_threshold, nms_top_k, keep_top_k,
+                            use_gaussian, gaussian_sigma,
+                            background_label, normalized,
+                            return_index=return_index,
+                            return_rois_num=False)
+                 for i in range(b3.shape[0])]
+        if return_index:
+            outs = [p[0] for p in parts]
+            # offset to global indices over the flattened batch (ref
+            # matrix_nms_kernel.cc: start = i * num_boxes)
+            n_boxes = b3.shape[1]
+            idxs = [_arr(p[1]) + i * n_boxes
+                    for i, p in enumerate(parts)]
+        else:
+            outs, idxs = list(parts), []
+        cat = Tensor(jnp.concatenate([_arr(o) for o in outs], 0))
+        res = [cat]
+        if return_index:
+            res.append(Tensor(jnp.concatenate(idxs, 0)))
+        if return_rois_num:
+            res.append(Tensor(jnp.asarray(
+                [int(_arr(o).shape[0]) for o in outs], jnp.int32)))
+        return tuple(res) if len(res) > 1 else res[0]
+
     def impl(b, s):
         C, N = s.shape
         out_scores = []
@@ -110,14 +142,19 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             bs = b[order]
             ss = sc[order]
             iou = _iou_matrix(bs)
-            upper = jnp.tril(iou, k=-1)  # IoU with higher-scored boxes
-            max_iou = upper.max(axis=1)
-            comp = upper.max(axis=0)
+            # SOLOv2 matrix NMS: decay_j = min over higher-scored i<j
+            # of f(iou_ij) / f(comp_i), comp_i = max_{k<i} iou_ki
+            hi = jnp.triu(jnp.ones((N, N), bool), k=1)   # i<j entries
+            iou_u = jnp.where(hi, iou, 0.0)
+            comp = iou_u.max(axis=0)                      # [N] per-i
             if use_gaussian:
-                decay = jnp.exp(-(max_iou ** 2 - comp ** 2)
-                                / gaussian_sigma)
+                dmat = jnp.exp(-(iou_u ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
             else:
-                decay = (1 - max_iou) / jnp.maximum(1 - comp, 1e-10)
+                dmat = (1 - iou_u) / jnp.maximum(
+                    1 - comp[:, None], 1e-10)
+            dmat = jnp.where(hi, dmat, jnp.inf)
+            decay = jnp.minimum(dmat.min(axis=0), 1.0)
             dec = ss * decay
             inv = jnp.argsort(order)
             out_scores.append(dec[inv] * (sc > score_threshold))
@@ -151,34 +188,49 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
                    keep_top_k=200, nms_threshold=0.3, normalized=True,
                    nms_eta=1.0, background_label=0, return_index=False,
                    return_rois_num=True, rois_num=None, name=None):
-    """Per-class hard NMS + global top-k (ref multiclass_nms3 op)."""
-    b = np.asarray(_arr(bboxes))
-    s = np.asarray(_arr(scores))
-    C, N = s.shape
-    results, indices = [], []
-    for c in range(C):
-        if c == background_label:
-            continue
-        mask = s[c] > score_threshold
-        if not mask.any():
-            continue
-        cand = np.nonzero(mask)[0]
-        keep = np.asarray(nms(b[cand], nms_threshold,
-                              s[c][cand]).numpy())
-        for i in keep:
-            gi = cand[i]
-            results.append([c, s[c, gi], *b[gi]])
-            indices.append(gi)
-    order = np.argsort([-r[1] for r in results])[:keep_top_k] \
-        if results else []
-    out = np.asarray([results[i] for i in order], np.float32
-                     ).reshape(-1, 6)
-    idx = np.asarray([indices[i] for i in order], np.int64)
+    """Per-class hard NMS + global top-k (ref multiclass_nms3 op).
+
+    Accepts the reference's batched layout bboxes [B, M, 4] /
+    scores [B, C, M] (outputs concatenated across images with
+    per-image rois_num), or a single image [M, 4] / [C, M]."""
+    b_all = np.asarray(_arr(bboxes))
+    s_all = np.asarray(_arr(scores))
+    batched = b_all.ndim == 3
+    if not batched:
+        b_all, s_all = b_all[None], s_all[None]
+    outs, idxs, nums = [], [], []
+    for img_i, (b, s) in enumerate(zip(b_all, s_all)):
+        C, N = s.shape
+        results, indices = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = s[c] > score_threshold
+            if not mask.any():
+                continue
+            cand = np.nonzero(mask)[0]
+            keep = np.asarray(nms(b[cand], nms_threshold,
+                                  s[c][cand]).numpy())
+            for i in keep:
+                gi = cand[i]
+                results.append([c, s[c, gi], *b[gi]])
+                indices.append(gi)
+        order = np.argsort([-r[1] for r in results])[:keep_top_k] \
+            if results else []
+        outs.append(np.asarray([results[i] for i in order], np.float32
+                               ).reshape(-1, 6))
+        # indices are GLOBAL over the flattened batch of boxes, like
+        # the reference (multiclass_nms3_kernel.cc: i * num_boxes + idx)
+        idxs.append(np.asarray([indices[i] for i in order], np.int64)
+                    + img_i * N)
+        nums.append(outs[-1].shape[0])
+    out = np.concatenate(outs, 0)
+    idx = np.concatenate(idxs, 0)
     res = [Tensor(jnp.asarray(out))]
     if return_index:
         res.append(Tensor(jnp.asarray(idx)))
     if return_rois_num:
-        res.append(Tensor(jnp.asarray([out.shape[0]], jnp.int32)))
+        res.append(Tensor(jnp.asarray(nums, jnp.int32)))
     return tuple(res) if len(res) > 1 else res[0]
 
 

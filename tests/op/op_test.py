@@ -38,6 +38,13 @@ class OpSpec:
     check_static: bool = True
     # numeric grad step
     fd_eps: float = 1e-3
+    # reference yaml registry op names this spec covers (op_coverage
+    # golden_pct is computed from the union of these). Defaults to
+    # (name,) when empty.
+    yaml_ops: Sequence[str] = ()
+    # random ops can't compare elementwise: check shape/dtype + moments
+    # (ref returns (mean, std) of the expected distribution instead)
+    stat_check: bool = False
 
 
 def _to_tensors(inputs, dtype=None, stop_gradient=True):
@@ -64,8 +71,28 @@ def _np(t):
 def check_output_dygraph(spec: OpSpec):
     ts = _to_tensors(spec.inputs)
     got = spec.fn(*ts.values(), **spec.kwargs)
+    if spec.stat_check:
+        _compare_stats(spec, got)
+        return
     want = spec.ref(*spec.inputs.values(), **spec.kwargs)
     _compare(spec.name + "/dygraph", got, want, spec.atol, spec.rtol)
+
+
+def _compare_stats(spec: OpSpec, got):
+    """Distribution check for random ops: ref gives (shape, mean, std);
+    the sample's moments must be within 5 sigma-of-the-mean."""
+    shape, mean, std = spec.ref(*spec.inputs.values(), **spec.kwargs)
+    a = _np(got).astype(np.float64)
+    assert tuple(a.shape) == tuple(shape), \
+        f"{spec.name}: shape {a.shape} != {shape}"
+    n = max(a.size, 1)
+    tol = 5.0 * (std / np.sqrt(n)) + 1e-6
+    assert abs(a.mean() - mean) < tol, \
+        f"{spec.name}: sample mean {a.mean():.4f} vs expected " \
+        f"{mean:.4f} (tol {tol:.4f})"
+    if std > 0 and n > 16:
+        assert abs(a.std() - std) < 10.0 * std / np.sqrt(n) + 0.05 * std, \
+            f"{spec.name}: sample std {a.std():.4f} vs expected {std:.4f}"
 
 
 def check_output_static(spec: OpSpec):
@@ -155,6 +182,8 @@ def _compare(label, got, want, atol, rtol):
 
 def run_spec(spec: OpSpec):
     check_output_dygraph(spec)
+    if spec.stat_check:
+        return
     if spec.check_static:
         check_output_static(spec)
     if spec.check_bf16:

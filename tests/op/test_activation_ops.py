@@ -1,0 +1,120 @@
+"""Golden op specs: activation family (ref yaml: ops.yaml activation
+entries; ref tests test_activation_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(7)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _erf(x):
+    import math
+    return np.vectorize(math.erf)(x).astype("float32")
+
+
+SPECS = [
+    OpSpec("relu", F.relu, lambda x: np.maximum(x, 0), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("relu6", F.relu6, lambda x: np.clip(x, 0, 6),
+           {"x": _f(3, 4) * 4}),
+    OpSpec("sigmoid", F.sigmoid, _sigmoid, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("silu", F.silu, lambda x: x * _sigmoid(x), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("gelu", F.gelu,
+           lambda x: 0.5 * x * (1 + _erf(x / np.sqrt(2.0))),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("gelu_tanh", lambda x: F.gelu(x, approximate=True),
+           lambda x: 0.5 * x * (1 + np.tanh(
+               np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3))),
+           {"x": _f(3, 4)}, yaml_ops=("gelu",)),
+    OpSpec("leaky_relu", F.leaky_relu,
+           lambda x: np.where(x > 0, x, 0.01 * x), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("elu", F.elu,
+           lambda x: np.where(x > 0, x, np.expm1(x)), {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("celu", F.celu,
+           lambda x: np.maximum(x, 0) + np.minimum(0, np.expm1(x)),
+           {"x": _f(3, 4)}),
+    OpSpec("selu", F.selu,
+           lambda x: 1.0507009873554805 * np.where(
+               x > 0, x, 1.6732632423543772 * np.expm1(x)),
+           {"x": _f(3, 4)}),
+    OpSpec("softplus", F.softplus, lambda x: np.log1p(np.exp(x)),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("softsign", F.softsign, lambda x: x / (1 + np.abs(x)),
+           {"x": _f(3, 4)}),
+    OpSpec("softshrink", lambda x: F.softshrink(x, threshold=0.3),
+           lambda x: np.where(x > 0.3, x - 0.3,
+                              np.where(x < -0.3, x + 0.3, 0.0)),
+           {"x": _f(3, 4)}, yaml_ops=("softshrink",)),
+    OpSpec("hardshrink", lambda x: F.hardshrink(x, threshold=0.3),
+           lambda x: np.where(np.abs(x) > 0.3, x, 0.0),
+           {"x": _f(3, 4)}, yaml_ops=("hardshrink",)),
+    OpSpec("hardsigmoid", F.hardsigmoid,
+           lambda x: np.clip(x / 6 + 0.5, 0, 1), {"x": _f(3, 4) * 4},
+           yaml_ops=("hardsigmoid",)),
+    OpSpec("hardswish", F.hardswish,
+           lambda x: x * np.clip(x + 3, 0, 6) / 6, {"x": _f(3, 4) * 3},
+           yaml_ops=("hardswish",)),
+    OpSpec("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1),
+           {"x": _f(3, 4) * 2}),
+    OpSpec("mish", F.mish,
+           lambda x: x * np.tanh(np.log1p(np.exp(x))), {"x": _f(3, 4)}),
+    OpSpec("swish", F.swish, lambda x: x * _sigmoid(x), {"x": _f(3, 4)}),
+    OpSpec("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x),
+           {"x": _f(3, 4)}, yaml_ops=("tanh_shrink",)),
+    OpSpec("logsigmoid", F.log_sigmoid,
+           lambda x: -np.log1p(np.exp(-x)), {"x": _f(3, 4)},
+           yaml_ops=("logsigmoid",), grad_inputs=("x",)),
+    OpSpec("log_softmax", lambda x: F.log_softmax(x, axis=-1),
+           lambda x: x - x.max(-1, keepdims=True) - np.log(
+               np.sum(np.exp(x - x.max(-1, keepdims=True)), -1,
+                      keepdims=True)),
+           {"x": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("softmax", lambda x: F.softmax(x, axis=-1),
+           lambda x: np.exp(x - x.max(-1, keepdims=True)) / np.sum(
+               np.exp(x - x.max(-1, keepdims=True)), -1, keepdims=True),
+           {"x": _f(3, 4)}, grad_inputs=("x",),
+           yaml_ops=("softmax", "softmax_")),
+    OpSpec("prelu", F.prelu,
+           lambda x, w: np.where(x > 0, x, w.reshape(1, -1, 1) * x),
+           {"x": _f(2, 3, 4), "w": np.abs(_f(3))}, grad_inputs=("x",)),
+    OpSpec("thresholded_relu",
+           lambda x: F.thresholded_relu(x, threshold=0.5),
+           lambda x: np.where(x > 0.5, x, 0.0), {"x": _f(3, 4)},
+           yaml_ops=("thresholded_relu",)),
+    OpSpec("stanh", lambda x: paddle.stanh(x, scale_a=0.67, scale_b=1.7),
+           lambda x: 1.7 * np.tanh(0.67 * x), {"x": _f(3, 4)},
+           yaml_ops=("stanh",)),
+    OpSpec("glu", lambda x: F.glu(x, axis=-1),
+           lambda x: x[..., :2] * _sigmoid(x[..., 2:]),
+           {"x": _f(3, 4)}),
+    OpSpec("maxout", lambda x: F.maxout(x, groups=2, axis=1),
+           lambda x: x.reshape(2, 2, 2, 3, 4).max(2).reshape(2, 2, 3, 4),
+           {"x": _f(2, 4, 3, 4)}),
+    # random sampling inside — check the deterministic property that
+    # every soft sample is a probability row (sums to one)
+    OpSpec("gumbel_softmax", lambda x: F.gumbel_softmax(x).sum(-1),
+           lambda x: np.ones(x.shape[0], "float32"), {"x": _f(16, 8)},
+           check_bf16=False, check_static=False,
+           yaml_ops=("gumbel_softmax",), atol=1e-4),
+]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

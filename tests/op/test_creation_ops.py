@@ -1,0 +1,125 @@
+"""Golden op specs: creation + random family (ref yaml ops.yaml; ref
+tests test_full_op.py, test_arange.py; random ops use the moment check
+— elementwise golden comparison is impossible for samplers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(19)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+SPECS = [
+    OpSpec("arange", lambda: paddle.arange(0, 10, 2),
+           lambda: np.arange(0, 10, 2), {}, check_bf16=False,
+           check_static=False),
+    OpSpec("linspace", lambda: paddle.linspace(0.0, 1.0, 5),
+           lambda: np.linspace(0, 1, 5, dtype="float32"), {},
+           check_bf16=False, check_static=False),
+    OpSpec("logspace", lambda: paddle.logspace(0.0, 2.0, 3),
+           lambda: np.logspace(0, 2, 3, dtype="float32"), {},
+           check_bf16=False, check_static=False, atol=1e-3),
+    OpSpec("eye", lambda: paddle.eye(3, 4),
+           lambda: np.eye(3, 4, dtype="float32"), {},
+           check_bf16=False, check_static=False),
+    OpSpec("full", lambda: paddle.full([2, 3], 1.5),
+           lambda: np.full((2, 3), 1.5, "float32"), {},
+           check_bf16=False, check_static=False,
+           yaml_ops=("full", "full_", "fill")),
+    OpSpec("full_like", lambda x: paddle.full_like(x, 2.0),
+           lambda x: np.full_like(x, 2.0), {"x": _f(2, 3)},
+           yaml_ops=("full_like", "fill_any_like")),
+    OpSpec("zeros", lambda: paddle.zeros([2, 3]),
+           lambda: np.zeros((2, 3), "float32"), {},
+           check_bf16=False, check_static=False),
+    OpSpec("ones", lambda: paddle.ones([2, 3]),
+           lambda: np.ones((2, 3), "float32"), {},
+           check_bf16=False, check_static=False),
+    OpSpec("zeros_like", paddle.zeros_like, np.zeros_like,
+           {"x": _f(2, 3)}),
+    OpSpec("ones_like", paddle.ones_like, np.ones_like, {"x": _f(2, 3)}),
+    OpSpec("empty_shape", lambda: paddle.empty([2, 3]) * 0.0,
+           lambda: np.zeros((2, 3), "float32"), {},
+           check_bf16=False, check_static=False,
+           yaml_ops=("empty", "empty_like")),
+    OpSpec("tril_indices", lambda: paddle.tril_indices(3, 3, 0),
+           lambda: np.stack(np.tril_indices(3, 0, 3)), {},
+           check_bf16=False, check_static=False),
+    OpSpec("triu_indices", lambda: paddle.triu_indices(3, 3, 0),
+           lambda: np.stack(np.triu_indices(3, 0, 3)), {},
+           check_bf16=False, check_static=False),
+    OpSpec("meshgrid", lambda a, b: paddle.meshgrid(a, b),
+           lambda a, b: np.meshgrid(a, b, indexing="ij"),
+           {"a": _f(3), "b": _f(4)}),
+    OpSpec("assign", paddle.assign, lambda x: x.copy(), {"x": _f(2, 3)},
+           yaml_ops=("assign", "assign_out_", "assign_value_")),
+    OpSpec("clone", lambda x: x.clone(), lambda x: x.copy(),
+           {"x": _f(2, 3)}),
+    OpSpec("numel", paddle.numel, lambda x: np.int64(x.size),
+           {"x": _f(2, 3)}, check_bf16=False),
+    OpSpec("shape_op", lambda x: paddle.shape(x),
+           lambda x: np.asarray(x.shape), {"x": _f(2, 3)},
+           yaml_ops=("shape",), check_bf16=False, check_static=False),
+    OpSpec("vander", lambda x: paddle.vander(x, 3),
+           lambda x: np.vander(x, 3, increasing=False), {"x": _f(4)},
+           check_bf16=False),
+    # ---- random samplers: moment checks ----
+    OpSpec("gaussian", lambda: paddle.normal(0.0, 1.0, [64, 64]),
+           lambda: ((64, 64), 0.0, 1.0), {}, stat_check=True,
+           yaml_ops=("gaussian",)),
+    OpSpec("truncated_gaussian",
+           lambda: paddle.framework.random_truncated_normal([64, 64])
+           if hasattr(paddle.framework, "random_truncated_normal")
+           else paddle.clip(paddle.standard_normal([64, 64]), -2.0, 2.0),
+           lambda: ((64, 64), 0.0, 0.88), {}, stat_check=True,
+           yaml_ops=("truncated_gaussian_random",)),
+    OpSpec("uniform", lambda: paddle.uniform([64, 64], min=0.0, max=1.0),
+           lambda: ((64, 64), 0.5, float(np.sqrt(1 / 12))), {},
+           stat_check=True, yaml_ops=("uniform", "uniform_inplace")),
+    OpSpec("randint", lambda: paddle.randint(0, 10, [64, 64])
+           .astype("float32"),
+           lambda: ((64, 64), 4.5, float(np.sqrt((100 - 1) / 12))), {},
+           stat_check=True),
+    OpSpec("bernoulli", lambda p: paddle.bernoulli(p),
+           lambda p: ((64, 64), 0.3, float(np.sqrt(0.3 * 0.7))),
+           {"p": np.full((64, 64), 0.3, "float32")}, stat_check=True),
+    OpSpec("poisson", lambda x: paddle.poisson(x),
+           lambda x: ((64, 64), 4.0, 2.0),
+           {"x": np.full((64, 64), 4.0, "float32")}, stat_check=True),
+    OpSpec("exponential", lambda x: x.exponential_(1.0),
+           lambda x: ((64, 64), 1.0, 1.0),
+           {"x": np.zeros((64, 64), "float32")}, stat_check=True,
+           yaml_ops=("exponential_",)),
+    OpSpec("multinomial",
+           lambda p: paddle.multinomial(p, num_samples=64,
+                                        replacement=True)
+           .astype("float32"),
+           lambda p: ((64,), 1.0, float(np.sqrt(0.6))),
+           {"p": np.array([0.2, 0.6, 0.2], "float32")},
+           stat_check=True),
+    OpSpec("randperm", lambda: paddle.randperm(64).astype("float32"),
+           lambda: ((64,), 31.5, float(np.sqrt((64 * 64 - 1) / 12.0))),
+           {}, stat_check=True),
+    OpSpec("standard_normal", lambda: paddle.standard_normal([64, 64]),
+           lambda: ((64, 64), 0.0, 1.0), {}, stat_check=True,
+           yaml_ops=("gaussian",)),
+    OpSpec("rand", lambda: paddle.rand([64, 64]),
+           lambda: ((64, 64), 0.5, float(np.sqrt(1 / 12))), {},
+           stat_check=True, yaml_ops=("uniform",)),
+    OpSpec("dirichlet",
+           lambda: paddle.distribution.Dirichlet(
+               paddle.to_tensor([2.0, 2.0])).sample([256]).sum(-1),
+           lambda: ((256,), 1.0, 0.0), {}, stat_check=True,
+           yaml_ops=("dirichlet",)),
+]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

@@ -1,0 +1,174 @@
+"""Golden op specs: linalg family (ref yaml ops.yaml/legacy_ops.yaml;
+ref tests test_cholesky_op.py, test_svd_op.py, ...). Decomposition
+outputs with sign/ordering freedom are checked via reconstruction
+properties instead of raw elementwise comparison."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(23)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _spd(n):
+    a = rng.standard_normal((n, n)).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+A = _spd(4)
+B4 = _f(4, 4)
+SYM = (B4 + B4.T) / 2
+
+
+SPECS = [
+    OpSpec("cholesky", paddle.linalg.cholesky, np.linalg.cholesky,
+           {"x": A}, check_bf16=False, atol=1e-4),
+    OpSpec("cholesky_solve",
+           lambda b, l: paddle.linalg.cholesky_solve(b, l, upper=False),
+           lambda b, l: np.linalg.solve(l @ l.T, b),
+           {"b": _f(4, 2), "l": np.linalg.cholesky(A).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("det", paddle.linalg.det, np.linalg.det, {"x": B4},
+           check_bf16=False, atol=1e-4),
+    # reference returns ONE stacked [2, ...] tensor [sign, logabsdet]
+    OpSpec("slogdet", paddle.linalg.slogdet,
+           lambda x: np.stack(np.linalg.slogdet(x)).astype("float32"),
+           {"x": B4}, check_bf16=False, atol=1e-4),
+    OpSpec("inverse", paddle.linalg.inv, np.linalg.inv, {"x": A},
+           check_bf16=False, atol=1e-4,
+           yaml_ops=("inverse",)),
+    OpSpec("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+           lambda x: np.linalg.matrix_power(x, 3), {"x": B4},
+           check_bf16=False, atol=1e-3),
+    OpSpec("matrix_rank", paddle.linalg.matrix_rank,
+           lambda x: np.linalg.matrix_rank(x),
+           {"x": np.array([[1., 0, 0], [0, 1, 0], [1, 1, 0]],
+                          "float32")},
+           check_bf16=False, check_static=False,
+           yaml_ops=("matrix_rank", "matrix_rank_tol")),
+    OpSpec("solve", paddle.linalg.solve, np.linalg.solve,
+           {"x": A, "y": _f(4, 2)}, check_bf16=False, atol=1e-4),
+    OpSpec("triangular_solve",
+           lambda a, b: paddle.linalg.triangular_solve(a, b,
+                                                       upper=False),
+           lambda a, b: np.linalg.solve(np.tril(a), b),
+           {"a": np.linalg.cholesky(A).astype("float32"),
+            "b": _f(4, 2)}, check_bf16=False, atol=1e-4),
+    OpSpec("lstsq",
+           lambda a, b: paddle.linalg.lstsq(a, b)[0],
+           lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+           {"a": _f(5, 3), "b": _f(5, 2)}, check_bf16=False,
+           check_static=False, atol=1e-3),
+    OpSpec("pinv", paddle.linalg.pinv, np.linalg.pinv, {"x": _f(4, 3)},
+           check_bf16=False, atol=1e-4),
+    OpSpec("mv", paddle.mv, lambda a, v: a @ v,
+           {"x": _f(3, 4), "vec": _f(4)}, grad_inputs=("x", "vec")),
+    OpSpec("multi_dot",
+           lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+           lambda a, b, c: a @ b @ c,
+           {"a": _f(3, 4), "b": _f(4, 2), "c": _f(2, 5)}, atol=1e-4),
+    OpSpec("cross", paddle.cross, lambda a, b: np.cross(a, b),
+           {"x": _f(4, 3), "y": _f(4, 3)}),
+    OpSpec("cov", paddle.linalg.cov, np.cov, {"x": _f(3, 8)},
+           check_bf16=False, atol=1e-4),
+    OpSpec("corrcoef", paddle.linalg.corrcoef, np.corrcoef,
+           {"x": _f(3, 8)}, check_bf16=False, atol=1e-4),
+    OpSpec("matrix_exp", paddle.linalg.matrix_exp,
+           lambda x: _expm_ref(x), {"x": B4 * 0.3}, check_bf16=False,
+           atol=1e-3),
+    OpSpec("householder_product", paddle.linalg.householder_product,
+           lambda a, tau: _householder_ref(a, tau),
+           {"a": _f(4, 3), "tau": np.zeros(3, "float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("cond", lambda x: paddle.linalg.cond(x),
+           lambda x: np.linalg.cond(x), {"x": A}, check_bf16=False,
+           check_static=False, rtol=1e-3, atol=1e-3),
+    OpSpec("norm_fro", lambda x: paddle.linalg.norm(x),
+           lambda x: np.linalg.norm(x), {"x": _f(3, 4)},
+           yaml_ops=("frobenius_norm", "norm")),
+    OpSpec("norm_inf", lambda x: paddle.linalg.norm(x, p=np.inf),
+           lambda x: np.abs(x).max(), {"x": _f(3, 4)},
+           yaml_ops=("p_norm",)),
+    # ---- decompositions: reconstruction-property checks ----
+    OpSpec("qr_reconstruct",
+           lambda x: _reconstruct_qr(x), lambda x: x, {"x": _f(4, 3)},
+           check_bf16=False, yaml_ops=("qr",), atol=1e-4),
+    OpSpec("svd_reconstruct",
+           lambda x: _reconstruct_svd(x), lambda x: x, {"x": _f(4, 3)},
+           check_bf16=False, yaml_ops=("svd",), atol=1e-4),
+    OpSpec("svdvals", lambda x: paddle.linalg.svdvals(x),
+           lambda x: np.linalg.svd(x, compute_uv=False), {"x": _f(4, 3)},
+           check_bf16=False, atol=1e-4, yaml_ops=("svd",)),
+    OpSpec("eigh_reconstruct",
+           lambda x: _reconstruct_eigh(x), lambda x: x, {"x": SYM},
+           check_bf16=False, yaml_ops=("eigh",), atol=1e-4),
+    OpSpec("eigvalsh", lambda x: paddle.linalg.eigvalsh(x),
+           lambda x: np.linalg.eigvalsh(x), {"x": SYM},
+           check_bf16=False, atol=1e-4, yaml_ops=("eigvalsh",)),
+    OpSpec("eigvals_sorted",
+           lambda x: paddle.sort(paddle.real(
+               paddle.linalg.eigvals(x))),
+           lambda x: np.sort(np.real(np.linalg.eigvals(x))), {"x": SYM},
+           check_bf16=False, check_static=False, atol=1e-3,
+           yaml_ops=("eigvals", "eig")),
+    OpSpec("lu_reconstruct",
+           lambda x: _reconstruct_lu(x), lambda x: x, {"x": B4},
+           check_bf16=False, check_static=False,
+           yaml_ops=("lu", "lu_unpack"), atol=1e-4),
+    OpSpec("eye_matmul_t", lambda x: paddle.matrix_transpose(x),
+           lambda x: np.swapaxes(x, -1, -2), {"x": _f(2, 3, 4)},
+           yaml_ops=("transpose",)),
+]
+
+
+def _expm_ref(x):
+    out = np.eye(x.shape[0])
+    term = np.eye(x.shape[0])
+    for i in range(1, 20):
+        term = term @ x / i
+        out = out + term
+    return out.astype("float32")
+
+
+def _householder_ref(a, tau):
+    m, n = a.shape
+    q = np.eye(m, dtype="float32")
+    for i in range(n):
+        v = np.zeros(m, "float32")
+        v[i] = 1.0
+        v[i + 1:] = a[i + 1:, i]
+        q = q @ (np.eye(m, dtype="float32")
+                 - tau[i] * np.outer(v, v))
+    return q[:, :n]
+
+
+def _reconstruct_qr(x):
+    q, r = paddle.linalg.qr(x)
+    return q @ r
+
+
+def _reconstruct_svd(x):
+    u, s, vh = paddle.linalg.svd(x, full_matrices=False)
+    return (u * s.unsqueeze(-2)) @ vh
+
+
+def _reconstruct_eigh(x):
+    w, v = paddle.linalg.eigh(x)
+    return (v * w.unsqueeze(-2)) @ v.t()
+
+
+def _reconstruct_lu(x):
+    lu, piv = paddle.linalg.lu(x)
+    p, l, u = paddle.linalg.lu_unpack(lu, piv)
+    return p @ l @ u
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

@@ -1,0 +1,242 @@
+"""Golden op specs: loss family (ref yaml ops.yaml loss entries; ref
+tests test_cross_entropy_op.py, test_bce_loss.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(29)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _p(*shape):
+    return rng.uniform(0.05, 0.95, shape).astype("float32")
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _log_softmax(x):
+    return x - x.max(-1, keepdims=True) - np.log(
+        np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
+
+
+LOGITS = _f(4, 5)
+LABELS = rng.integers(0, 5, (4,))
+
+
+SPECS = [
+    OpSpec("cross_entropy",
+           lambda x, t: F.cross_entropy(x, t),
+           lambda x, t: np.float32(
+               -_log_softmax(x)[np.arange(len(t)), t].mean()),
+           {"input": LOGITS, "label": LABELS}, check_bf16=False,
+           grad_inputs=("input",),
+           yaml_ops=("cross_entropy_with_softmax",
+                     "softmax_with_cross_entropy")),
+    OpSpec("nll_loss",
+           lambda x, t: F.nll_loss(x, t),
+           lambda x, t: np.float32(-x[np.arange(len(t)), t].mean()),
+           {"input": _log_softmax(LOGITS), "label": LABELS},
+           check_bf16=False, grad_inputs=("input",)),
+    OpSpec("binary_cross_entropy",
+           F.binary_cross_entropy,
+           lambda p, t: np.float32(
+               -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()),
+           {"input": _p(4, 3),
+            "label": rng.integers(0, 2, (4, 3)).astype("float32")},
+           grad_inputs=("input",), yaml_ops=("bce_loss",)),
+    OpSpec("bce_with_logits",
+           F.binary_cross_entropy_with_logits,
+           lambda x, t: np.float32(
+               (np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x))))
+               .mean()),
+           {"logit": _f(4, 3),
+            "label": rng.integers(0, 2, (4, 3)).astype("float32")},
+           grad_inputs=("logit",),
+           yaml_ops=("sigmoid_cross_entropy_with_logits",)),
+    OpSpec("mse_loss", F.mse_loss,
+           lambda x, y: np.float32(((x - y) ** 2).mean()),
+           {"input": _f(4, 3), "label": _f(4, 3)},
+           grad_inputs=("input",)),
+    OpSpec("l1_loss", F.l1_loss,
+           lambda x, y: np.float32(np.abs(x - y).mean()),
+           {"input": _f(4, 3), "label": _f(4, 3)}),
+    OpSpec("smooth_l1_loss", F.smooth_l1_loss,
+           lambda x, y: np.float32(np.where(
+               np.abs(x - y) < 1.0, 0.5 * (x - y) ** 2,
+               np.abs(x - y) - 0.5).mean()),
+           {"input": _f(4, 3) * 2, "label": _f(4, 3)},
+           yaml_ops=("huber_loss",)),
+    OpSpec("kl_div",
+           lambda x, t: F.kl_div(x, t, reduction="mean"),
+           lambda x, t: np.float32((t * (np.log(t) - x)).mean()),
+           {"input": _log_softmax(LOGITS), "label": _softmax(_f(4, 5))},
+           yaml_ops=("kldiv_loss",)),
+    OpSpec("margin_ranking_loss",
+           lambda a, b, t: F.margin_ranking_loss(a, b, t),
+           lambda a, b, t: np.float32(
+               np.maximum(0, -t * (a - b)).mean()),
+           {"input": _f(4), "other": _f(4),
+            "label": np.sign(_f(4)).astype("float32")},
+           check_bf16=False),
+    OpSpec("hinge_embedding_loss",
+           lambda x, t: F.hinge_embedding_loss(x, t),
+           lambda x, t: np.float32(np.where(
+               t == 1.0, x, np.maximum(0, 1.0 - x)).mean()),
+           {"input": _f(4, 3),
+            "label": np.sign(_f(4, 3)).astype("float32")},
+           check_bf16=False),
+    OpSpec("cosine_embedding_loss",
+           lambda a, b, t: F.cosine_embedding_loss(a, b, t),
+           lambda a, b, t: _cosine_embedding_ref2(a, b, t),
+           {"input1": _f(4, 3), "input2": _f(4, 3),
+            "label": np.sign(_f(4)).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("soft_margin_loss",
+           lambda x, t: F.soft_margin_loss(x, t),
+           lambda x, t: np.float32(np.log1p(np.exp(-t * x)).mean()),
+           {"input": _f(4, 3),
+            "label": np.sign(_f(4, 3)).astype("float32")},
+           check_bf16=False),
+    OpSpec("multi_label_soft_margin_loss",
+           lambda x, t: F.multi_label_soft_margin_loss(x, t),
+           lambda x, t: np.float32(
+               -(t * np.log(1 / (1 + np.exp(-x)))
+                 + (1 - t) * np.log(np.exp(-x) / (1 + np.exp(-x))))
+               .mean(-1).mean()),
+           {"input": _f(4, 3),
+            "label": rng.integers(0, 2, (4, 3)).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("triplet_margin_loss",
+           lambda a, p, n: F.triplet_margin_loss(a, p, n),
+           lambda a, p, n: np.float32(np.maximum(
+               np.sqrt(((a - p) ** 2).sum(-1) + 1e-6)
+               - np.sqrt(((a - n) ** 2).sum(-1) + 1e-6) + 1.0, 0).mean()),
+           {"input": _f(4, 3), "positive": _f(4, 3),
+            "negative": _f(4, 3)}, check_bf16=False, atol=1e-4),
+    OpSpec("poisson_nll_loss",
+           lambda x, t: F.poisson_nll_loss(x, t),
+           lambda x, t: np.float32((np.exp(x) - t * x).mean()),
+           {"input": _f(4, 3) * 0.5,
+            "label": rng.poisson(2.0, (4, 3)).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("gaussian_nll_loss",
+           lambda x, t, v: F.gaussian_nll_loss(x, t, v),
+           lambda x, t, v: np.float32(
+               0.5 * (np.log(np.maximum(v, 1e-6))
+                      + (x - t) ** 2 / np.maximum(v, 1e-6)).mean()),
+           {"input": _f(4, 3), "label": _f(4, 3),
+            "variance": _p(4, 3) + 0.5}, check_bf16=False, atol=1e-4),
+    OpSpec("log_loss", F.log_loss,
+           lambda p, t: -(t * np.log(p + 1e-4)
+                          + (1 - t) * np.log(1 - p + 1e-4)),
+           {"input": _p(4, 1),
+            "label": rng.integers(0, 2, (4, 1)).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("square_error_cost", F.square_error_cost,
+           lambda x, y: (x - y) ** 2,
+           {"input": _f(4, 3), "label": _f(4, 3)}),
+    OpSpec("sigmoid_focal_loss",
+           lambda x, t: F.sigmoid_focal_loss(x, t, reduction="mean"),
+           lambda x, t: _focal_ref(x, t),
+           {"logit": _f(4, 3),
+            "label": rng.integers(0, 2, (4, 3)).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("dice_loss",
+           lambda x, t: F.dice_loss(x, t),
+           lambda x, t: _dice_ref(x, t),
+           {"input": _softmax(_f(4, 3)).astype("float32"),
+            "label": rng.integers(0, 3, (4, 1))},
+           check_bf16=False, atol=1e-4),
+    OpSpec("label_smooth",
+           lambda x: F.label_smooth(x, epsilon=0.1),
+           lambda x: (1 - 0.1) * x + 0.1 / x.shape[-1],
+           {"label": np.eye(5, dtype="float32")[LABELS]}),
+    OpSpec("npair_loss",
+           lambda a, p, t: F.npair_loss(a, p, t, l2_reg=0.0),
+           lambda a, p, t: _npair_ref(a, p, t),
+           {"anchor": _f(3, 4), "positive": _f(3, 4),
+            "labels": np.arange(3).astype("float32")},
+           check_bf16=False, atol=1e-4),
+    OpSpec("ctc_loss",
+           lambda lp, la: F.ctc_loss(
+               lp, la, paddle.to_tensor(np.array([4], "int64")),
+               paddle.to_tensor(np.array([2], "int64")),
+               blank=0, reduction="sum"),
+           lambda lp, la: _ctc_ref(lp, la),
+           {"log_probs": np.log(_softmax(_f(4, 1, 3))),
+            "labels": np.array([[1, 2]], "int64")},
+           check_bf16=False, check_static=False, atol=1e-3,
+           yaml_ops=("warpctc",)),
+]
+
+
+def _cosine_embedding_ref2(a, b, t):
+    cos = (a * b).sum(-1) / (np.sqrt((a * a).sum(-1))
+                             * np.sqrt((b * b).sum(-1)) + 1e-12)
+    return np.float32(np.where(t == 1, 1 - cos,
+                               np.maximum(0, cos)).mean())
+
+
+def _focal_ref(x, t, gamma=2.0, alpha=0.25):
+    p = 1 / (1 + np.exp(-x))
+    ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+    pt = np.where(t == 1, p, 1 - p)
+    af = np.where(t == 1, alpha, 1 - alpha)
+    return np.float32((af * (1 - pt) ** gamma * ce).mean())
+
+
+def _dice_ref(x, label, eps=1e-5):
+    # paddle convention: per-sample dice over one-hot labels, union =
+    # sum(p) + sum(onehot) (no squares), mean over batch
+    t = np.eye(x.shape[-1], dtype="float32")[label[:, 0]]
+    inter = (x * t).sum(-1)
+    union = x.sum(-1) + t.sum(-1)
+    return np.float32((1 - (2 * inter + eps) / (union + eps)).mean())
+
+
+def _npair_ref(a, p, t):
+    # paddle convention: row-wise CE against the row-normalized
+    # same-label target (one-hot here: labels are distinct)
+    logits = a @ p.T
+    lab = t.astype("int64")
+    ls = _log_softmax(logits)
+    return np.float32(-ls[np.arange(len(lab)), lab].mean())
+
+
+def _ctc_ref(log_probs, labels):
+    # brute force over all alignments, T=4, L=2, blank=0
+    T = log_probs.shape[0]
+    lab = labels[0]
+    ext = [0]
+    for s in lab:
+        ext += [int(s), 0]
+    import itertools
+    total = 0.0
+    for path in itertools.product(range(log_probs.shape[-1]), repeat=T):
+        # collapse
+        col = []
+        prev = None
+        for s in path:
+            if s != prev:
+                col.append(s)
+            prev = s
+        col = [c for c in col if c != 0]
+        if col == list(lab):
+            total += np.exp(sum(log_probs[t, 0, path[t]]
+                                for t in range(T)))
+    return np.float32(-np.log(total))
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

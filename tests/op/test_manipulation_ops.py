@@ -1,0 +1,270 @@
+"""Golden op specs: manipulation / indexing family
+(ref yaml ops.yaml; ref tests test_gather_nd_op.py, test_scatter_op.py,
+test_pad_op.py ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(17)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _scatter_ref(x, index, updates):
+    out = x.copy()
+    out[index] = updates[: len(index)]
+    return out
+
+
+def _scatter_nd_add_ref(x, index, updates):
+    out = x.copy()
+    for i, idx in enumerate(index):
+        out[tuple(idx)] += updates[i]
+    return out
+
+
+def _put_along_axis_ref(x, idx, value):
+    out = x.copy()
+    np.put_along_axis(out, idx, value, axis=1)
+    return out
+
+
+SPECS = [
+    OpSpec("chunk", lambda x: paddle.chunk(x, 2, axis=1),
+           lambda x: np.split(x, 2, 1), {"x": _f(3, 4)},
+           yaml_ops=("split_with_num",)),
+    OpSpec("unbind", lambda x: paddle.unbind(x, axis=0),
+           lambda x: [x[0], x[1]], {"x": _f(2, 3)},
+           yaml_ops=("unbind",)),
+    OpSpec("unstack", lambda x: paddle.unstack(x, axis=0),
+           lambda x: [x[0], x[1]], {"x": _f(2, 3)},
+           yaml_ops=("unstack",)),
+    OpSpec("broadcast_to", lambda x: paddle.broadcast_to(x, [3, 2, 4]),
+           lambda x: np.broadcast_to(x, (3, 2, 4)), {"x": _f(2, 4)},
+           yaml_ops=("expand",)),
+    OpSpec("expand_as", paddle.expand_as,
+           lambda x, y: np.broadcast_to(x, y.shape),
+           {"x": _f(1, 4), "y": _f(3, 4)}),
+    OpSpec("broadcast_tensors",
+           lambda a, b: paddle.broadcast_tensors([a, b]),
+           lambda a, b: list(np.broadcast_arrays(a, b)),
+           {"a": _f(1, 4), "b": _f(3, 1)}),
+    OpSpec("gather_nd", paddle.gather_nd,
+           lambda x, idx: x[tuple(idx.T)],
+           {"x": _f(4, 5), "index": np.array([[0, 1], [2, 3]])},
+           check_bf16=False),
+    OpSpec("scatter", paddle.scatter, _scatter_ref,
+           {"x": _f(5, 3), "index": np.array([1, 3]),
+            "updates": _f(2, 3)}, check_bf16=False,
+           grad_inputs=("x", "updates")),
+    OpSpec("scatter_nd_add", paddle.scatter_nd_add, _scatter_nd_add_ref,
+           {"x": _f(4, 3), "index": np.array([[1], [3], [1]]),
+            "updates": _f(3, 3)}, check_bf16=False),
+    OpSpec("put_along_axis",
+           lambda x, idx: paddle.put_along_axis(
+               x, idx, 9.0, axis=1),
+           lambda x, idx: _put_along_axis_ref(x, idx, 9.0),
+           {"x": _f(3, 4), "index": rng.integers(0, 4, (3, 1))},
+           check_bf16=False),
+    OpSpec("take_along_axis",
+           lambda x, idx: paddle.take_along_axis(x, idx, axis=1),
+           lambda x, idx: np.take_along_axis(x, idx, 1),
+           {"x": _f(3, 4), "index": rng.integers(0, 4, (3, 2))},
+           check_bf16=False),
+    OpSpec("index_add",
+           lambda x, idx, v: paddle.index_add(x, idx, 0, v),
+           lambda x, idx, v: _index_add_ref(x, idx, v),
+           {"x": _f(5, 3), "index": np.array([1, 3]),
+            "value": _f(2, 3)}, check_bf16=False),
+    OpSpec("index_put",
+           lambda x, idx, v: paddle.index_put(x, (idx,), v),
+           lambda x, idx, v: _index_put_ref(x, idx, v),
+           {"x": _f(5, 3), "index": np.array([1, 3]), "value": _f(2, 3)},
+           check_bf16=False),
+    OpSpec("index_sample", paddle.index_sample,
+           lambda x, idx: np.take_along_axis(x, idx, 1),
+           {"x": _f(3, 5), "index": rng.integers(0, 5, (3, 2))},
+           check_bf16=False),
+    OpSpec("masked_fill",
+           lambda x, m: paddle.masked_fill(x, m, 2.5),
+           lambda x, m: np.where(m, 2.5, x),
+           {"x": _f(3, 4), "mask": _f(3, 4) > 0}, check_bf16=False),
+    OpSpec("moveaxis", lambda x: paddle.moveaxis(x, 0, 2),
+           lambda x: np.moveaxis(x, 0, 2), {"x": _f(2, 3, 4)}),
+    OpSpec("rot90", lambda x: paddle.rot90(x, k=1, axes=[0, 1]),
+           lambda x: np.rot90(x, 1, (0, 1)), {"x": _f(3, 4)}),
+    OpSpec("diag", paddle.diag, np.diag, {"x": _f(4)}),
+    OpSpec("diagflat", paddle.diagflat, np.diagflat, {"x": _f(2, 3)}),
+    OpSpec("diagonal", paddle.diagonal,
+           lambda x: np.diagonal(x, 0, 0, 1), {"x": _f(3, 4)}),
+    OpSpec("diag_embed", paddle.diag_embed,
+           lambda x: np.stack([np.diag(r) for r in x]), {"x": _f(2, 3)}),
+    OpSpec("kron", paddle.kron, np.kron, {"x": _f(2, 2), "y": _f(2, 3)}),
+    OpSpec("repeat_interleave",
+           lambda x: paddle.repeat_interleave(x, 2, axis=0),
+           lambda x: np.repeat(x, 2, 0), {"x": _f(2, 3)},
+           yaml_ops=("repeat_interleave",
+                     "repeat_interleave_with_tensor_index")),
+    OpSpec("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
+           lambda x, y: np.tensordot(x, y, 1),
+           {"x": _f(3, 4), "y": _f(4, 5)}),
+    OpSpec("pad_2d", lambda x: paddle.nn.functional.pad(
+        x, [1, 2], mode="constant", value=0.0),
+           lambda x: np.pad(x, ((0, 0), (1, 2))), {"x": _f(3, 4)},
+           yaml_ops=("pad",)),
+    OpSpec("pad_reflect", lambda x: paddle.nn.functional.pad(
+        x, [1, 1, 1, 1], mode="reflect", data_format="NCHW"),
+           lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                            mode="reflect"),
+           {"x": _f(1, 2, 4, 4)}, yaml_ops=("pad3d",)),
+    OpSpec("crop", lambda x: paddle.crop(x, shape=[2, 2],
+                                         offsets=[0, 1]),
+           lambda x: x[0:2, 1:3], {"x": _f(3, 4)}),
+    OpSpec("slice_op", lambda x: x[1:3, :2],
+           lambda x: x[1:3, :2], {"x": _f(4, 5)},
+           yaml_ops=("slice",), grad_inputs=("x",)),
+    OpSpec("strided_slice", lambda x: paddle.strided_slice(
+        x, axes=[0, 1], starts=[0, 0], ends=[4, 5], strides=[2, 2]),
+           lambda x: x[0:4:2, 0:5:2], {"x": _f(4, 5)}),
+    OpSpec("one_hot", lambda x: paddle.nn.functional.one_hot(x, 5),
+           lambda x: np.eye(5, dtype="float32")[x],
+           {"x": rng.integers(0, 5, (6,))}, check_bf16=False),
+    OpSpec("shard_index",
+           lambda x: paddle.shard_index(x, index_num=10, nshards=2,
+                                        shard_id=0),
+           lambda x: np.where(x < 5, x, -1),
+           {"x": rng.integers(0, 10, (6, 1))}, check_bf16=False),
+    OpSpec("unfold_im2col",
+           lambda x: paddle.nn.functional.unfold(x, 2, strides=1),
+           lambda x: _im2col_ref(x, 2, 1),
+           {"x": _f(1, 2, 3, 3)}, yaml_ops=("unfold",)),
+    OpSpec("signal_frame",
+           lambda x: paddle.signal.frame(x, frame_length=2, hop_length=1,
+                                         axis=-1),
+           lambda x: np.stack([x[..., 0:2], x[..., 1:3], x[..., 2:4]],
+                              -1),
+           {"x": _f(3, 4)}, yaml_ops=("frame",)),
+    OpSpec("flatten_range",
+           lambda x: paddle.flatten(x, start_axis=1, stop_axis=2),
+           lambda x: x.reshape(2, 12), {"x": _f(2, 3, 4)},
+           yaml_ops=("flatten",), grad_inputs=("x",)),
+    OpSpec("renorm", lambda x: paddle.renorm(x, p=2.0, axis=0,
+                                             max_norm=1.0),
+           lambda x: _renorm_ref(x), {"x": _f(3, 4)}),
+    OpSpec("multi_head_view", lambda x: paddle.view(x, [3, 2, 2]),
+           lambda x: x.reshape(3, 2, 2), {"x": _f(3, 4)},
+           yaml_ops=("reshape",)),
+    OpSpec("as_strided", lambda x: paddle.as_strided(x, [2, 3], [4, 1]),
+           lambda x: np.lib.stride_tricks.as_strided(
+               x, (2, 3), (16, 4)), {"x": _f(3, 4)},
+           check_bf16=False, check_static=False),
+    OpSpec("select_scatter",
+           lambda x, v: paddle.select_scatter(x, v, axis=0, index=1),
+           lambda x, v: _select_scatter_ref(x, v),
+           {"x": _f(3, 4), "value": _f(4)}, check_bf16=False),
+    OpSpec("slice_scatter",
+           lambda x, v: paddle.slice_scatter(x, v, axes=[0], starts=[1],
+                                             ends=[2], strides=[1]),
+           lambda x, v: _slice_scatter_ref(x, v),
+           {"x": _f(3, 4), "value": _f(1, 4)}, check_bf16=False),
+    OpSpec("diagonal_scatter",
+           lambda x, v: paddle.diagonal_scatter(x, v),
+           lambda x, v: _diagonal_scatter_ref(x, v),
+           {"x": _f(3, 3), "value": _f(3)},
+           yaml_ops=("fill_diagonal_tensor",), check_bf16=False),
+    OpSpec("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]),
+           lambda x: x.reshape(3, 2, 2), {"x": _f(3, 4)}),
+    OpSpec("vsplit", lambda x: paddle.vsplit(x, 2),
+           lambda x: np.split(x, 2, 0), {"x": _f(4, 3)}),
+    OpSpec("hstack", lambda a, b: paddle.hstack([a, b]),
+           lambda a, b: np.hstack([a, b]),
+           {"a": _f(3, 2), "b": _f(3, 4)}),
+    OpSpec("vstack", lambda a, b: paddle.vstack([a, b]),
+           lambda a, b: np.vstack([a, b]),
+           {"a": _f(2, 3), "b": _f(1, 3)}),
+    OpSpec("column_stack", lambda a, b: paddle.column_stack([a, b]),
+           lambda a, b: np.column_stack([a, b]),
+           {"a": _f(3), "b": _f(3)}),
+    OpSpec("atleast_2d", lambda x: paddle.atleast_2d(x),
+           lambda x: np.atleast_2d(x), {"x": _f(4)}),
+    OpSpec("gather_axis1", lambda x, idx: paddle.gather(x, idx, axis=1),
+           lambda x, idx: x[:, idx],
+           {"x": _f(3, 5), "index": np.array([0, 2])},
+           yaml_ops=("gather",), check_bf16=False),
+    OpSpec("take", lambda x, idx: paddle.take(x, idx),
+           lambda x, idx: np.take(x, idx),
+           {"x": _f(3, 4), "index": np.array([0, 5, 11])},
+           check_bf16=False),
+    OpSpec("index_fill",
+           lambda x, idx: paddle.masked_fill(
+               x, paddle.nn.functional.one_hot(
+                   idx, x.shape[0]).sum(0).astype("bool").unsqueeze(-1)
+               .expand([x.shape[0], x.shape[1]]), 0.5),
+           lambda x, idx: _index_fill_ref(x, idx, 0.5),
+           {"x": _f(4, 3), "index": np.array([1, 3])},
+           yaml_ops=(), check_bf16=False),
+]
+
+
+def _index_add_ref(x, idx, v):
+    out = x.copy()
+    for i, j in enumerate(idx):
+        out[j] += v[i]
+    return out
+
+
+def _index_put_ref(x, idx, v):
+    out = x.copy()
+    out[idx] = v
+    return out
+
+
+def _renorm_ref(x):
+    norms = np.sqrt((x ** 2).sum(axis=(1,), keepdims=True))
+    factor = np.minimum(1.0, 1.0 / (norms + 1e-7))
+    return x * factor
+
+
+def _select_scatter_ref(x, v):
+    out = x.copy()
+    out[1] = v
+    return out
+
+
+def _slice_scatter_ref(x, v):
+    out = x.copy()
+    out[1:2] = v
+    return out
+
+
+def _diagonal_scatter_ref(x, v):
+    out = x.copy()
+    np.fill_diagonal(out, v)
+    return out
+
+
+def _index_fill_ref(x, idx, val):
+    out = x.copy()
+    out[idx] = val
+    return out
+
+
+def _im2col_ref(x, k, s):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    cols = np.zeros((n, c * k * k, oh * ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * s:i * s + k, j * s:j * s + k]
+            cols[:, :, i * ow + j] = patch.reshape(n, -1)
+    return cols
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

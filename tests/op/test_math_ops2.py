@@ -1,0 +1,224 @@
+"""Golden op specs: trig / special / pointwise-math tail
+(ref yaml ops.yaml unary entries; ref tests test_activation_op.py,
+test_math_op_patch.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(11)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _pos(*shape):
+    return (np.abs(rng.standard_normal(shape)) + 0.5).astype("float32")
+
+
+def _unit(*shape):
+    return (rng.uniform(-0.9, 0.9, shape)).astype("float32")
+
+
+SPECS = [
+    OpSpec("acos", paddle.acos, np.arccos, {"x": _unit(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("asin", paddle.asin, np.arcsin, {"x": _unit(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("atan", paddle.atan, np.arctan, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("atan2", paddle.atan2, np.arctan2,
+           {"x": _f(3, 4), "y": _pos(3, 4)}, grad_inputs=("x", "y")),
+    OpSpec("sinh", paddle.sinh, np.sinh, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("cosh", paddle.cosh, np.cosh, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("asinh", paddle.asinh, np.arcsinh, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("acosh", paddle.acosh, np.arccosh, {"x": _pos(3, 4) + 1.0},
+           grad_inputs=("x",)),
+    OpSpec("atanh", paddle.atanh, np.arctanh, {"x": _unit(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("log2", paddle.log2, np.log2, {"x": _pos(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("log10", paddle.log10, np.log10, {"x": _pos(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("logit", paddle.logit,
+           lambda x: np.log(x / (1 - x)),
+           {"x": rng.uniform(0.1, 0.9, (3, 4)).astype("float32")},
+           grad_inputs=("x",)),
+    OpSpec("logaddexp", paddle.logaddexp, np.logaddexp,
+           {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("digamma", paddle.digamma,
+           lambda x: np.vectorize(
+               lambda v: _psi(v))(x).astype("float32"),
+           {"x": _pos(3, 4) + 1.0}, bf16_rtol=5e-2),
+    OpSpec("lgamma", paddle.lgamma,
+           lambda x: np.vectorize(math.lgamma)(x).astype("float32"),
+           {"x": _pos(3, 4) + 0.5}),
+    OpSpec("erfinv", paddle.erfinv,
+           lambda x: np.vectorize(_erfinv_ref)(x).astype("float32"),
+           {"x": _unit(3, 4) * 0.8}, atol=1e-4),
+    OpSpec("i0", paddle.i0,
+           lambda x: np.vectorize(_i0_ref)(x).astype("float32"),
+           {"x": _f(3, 4)}, atol=1e-4),
+    OpSpec("i0e", paddle.i0e,
+           lambda x: np.vectorize(
+               lambda v: _i0_ref(v) * math.exp(-abs(v)))(x)
+           .astype("float32"), {"x": _f(3, 4)}, atol=1e-4),
+    OpSpec("trunc", paddle.trunc, np.trunc, {"x": _f(3, 4) * 3},
+           check_bf16=False),
+    OpSpec("frac", paddle.frac, lambda x: x - np.trunc(x),
+           {"x": _f(3, 4) * 3}, check_bf16=False),
+    OpSpec("heaviside", paddle.heaviside,
+           lambda x, y: np.heaviside(x, y),
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("fmax", paddle.fmax, np.fmax, {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("fmin", paddle.fmin, np.fmin, {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("remainder", paddle.remainder, np.mod,
+           {"x": _f(3, 4) * 5, "y": _pos(3, 4)}),
+    OpSpec("gcd", paddle.gcd, np.gcd,
+           {"x": rng.integers(1, 40, (3, 4)),
+            "y": rng.integers(1, 40, (3, 4))}, check_bf16=False),
+    OpSpec("lcm", paddle.lcm, np.lcm,
+           {"x": rng.integers(1, 12, (3, 4)),
+            "y": rng.integers(1, 12, (3, 4))}, check_bf16=False),
+    OpSpec("lerp", paddle.lerp,
+           lambda x, y, weight: x + weight * (y - x),
+           {"x": _f(3, 4), "y": _f(3, 4)}, kwargs={"weight": 0.3},
+           grad_inputs=("x", "y")),
+    OpSpec("ldexp", paddle.ldexp, lambda x, y: np.ldexp(x, y),
+           {"x": _f(3, 4), "y": rng.integers(-3, 4, (3, 4))},
+           check_bf16=False),
+    OpSpec("hypot", paddle.hypot, np.hypot,
+           {"x": _f(3, 4), "y": _f(3, 4)}),
+    OpSpec("nextafter", paddle.nextafter, np.nextafter,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("copysign", paddle.copysign, np.copysign,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("nan_to_num", paddle.nan_to_num, np.nan_to_num,
+           {"x": np.array([[1.0, np.nan], [np.inf, -np.inf]],
+                          "float32")}, check_bf16=False),
+    OpSpec("rad2deg", paddle.rad2deg, np.rad2deg, {"x": _f(3, 4)}),
+    OpSpec("deg2rad", paddle.deg2rad, np.deg2rad, {"x": _f(3, 4) * 90}),
+    OpSpec("diff", paddle.diff, lambda x: np.diff(x, axis=-1),
+           {"x": _f(3, 5)}),
+    OpSpec("trapezoid", paddle.trapezoid,
+           lambda y: np.trapz(y, axis=-1), {"y": _f(3, 5)}),
+    OpSpec("sinc", paddle.sinc, np.sinc, {"x": _f(3, 4)}, atol=1e-4),
+    OpSpec("angle", paddle.angle, np.angle,
+           {"x": (_f(3, 4) + 1j * _f(3, 4)).astype("complex64")},
+           check_bf16=False, check_static=False),
+    OpSpec("conj", paddle.conj, np.conj,
+           {"x": (_f(3, 4) + 1j * _f(3, 4)).astype("complex64")},
+           check_bf16=False, check_static=False),
+    OpSpec("real", paddle.real, np.real,
+           {"x": (_f(3, 4) + 1j * _f(3, 4)).astype("complex64")},
+           check_bf16=False, check_static=False),
+    OpSpec("imag", paddle.imag, np.imag,
+           {"x": (_f(3, 4) + 1j * _f(3, 4)).astype("complex64")},
+           check_bf16=False, check_static=False),
+    OpSpec("as_complex", paddle.as_complex,
+           lambda x: x[..., 0] + 1j * x[..., 1], {"x": _f(3, 4, 2)},
+           check_bf16=False, check_static=False),
+    OpSpec("as_real", paddle.as_real,
+           lambda x: np.stack([x.real, x.imag], -1),
+           {"x": (_f(3, 4) + 1j * _f(3, 4)).astype("complex64")},
+           check_bf16=False, check_static=False),
+    OpSpec("complex", paddle.complex, lambda re, im: re + 1j * im,
+           {"real": _f(3, 4), "imag": _f(3, 4)},
+           check_bf16=False, check_static=False),
+    OpSpec("square_scale", lambda x: paddle.scale(x, scale=2.5, bias=1.0),
+           lambda x: 2.5 * x + 1.0, {"x": _f(3, 4)},
+           yaml_ops=("scale",), grad_inputs=("x",)),
+    OpSpec("increment", paddle.increment, lambda x: x + 1.0,
+           {"x": _f(1)}, check_bf16=False),
+    OpSpec("sgn", paddle.sgn, np.sign, {"x": _f(3, 4)},
+           check_bf16=False),
+    OpSpec("neg", paddle.neg, np.negative, {"x": _f(3, 4)},
+           grad_inputs=("x",)),
+    OpSpec("signbit", paddle.signbit, np.signbit, {"x": _f(3, 4)},
+           check_bf16=False),
+    OpSpec("isfinite", paddle.isfinite, np.isfinite,
+           {"x": np.array([1.0, np.inf, np.nan], "float32")},
+           check_bf16=False),
+    OpSpec("allclose", paddle.allclose,
+           lambda a, b: np.allclose(a, b),
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("isclose", paddle.isclose, np.isclose,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("equal_all", paddle.equal_all,
+           lambda a, b: np.array_equal(a, b),
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("multiplex", lambda a, b, idx: paddle.multiplex([a, b], idx),
+           lambda a, b, idx: np.stack([a, b])[idx[:, 0],
+                                              np.arange(a.shape[0])],
+           {"a": _f(3, 4), "b": _f(3, 4),
+            "idx": rng.integers(0, 2, (3, 1))}, check_bf16=False),
+    OpSpec("polygamma", lambda x: paddle.polygamma(x, 1),
+           lambda x: np.vectorize(_trigamma_ref)(x).astype("float32"),
+           {"x": _pos(3, 4) + 1.0}, atol=1e-3, check_bf16=False),
+    OpSpec("bitwise_and", paddle.bitwise_and, np.bitwise_and,
+           {"x": rng.integers(0, 16, (3, 4)),
+            "y": rng.integers(0, 16, (3, 4))}, check_bf16=False),
+    OpSpec("bitwise_or", paddle.bitwise_or, np.bitwise_or,
+           {"x": rng.integers(0, 16, (3, 4)),
+            "y": rng.integers(0, 16, (3, 4))}, check_bf16=False),
+    OpSpec("bitwise_xor", paddle.bitwise_xor, np.bitwise_xor,
+           {"x": rng.integers(0, 16, (3, 4)),
+            "y": rng.integers(0, 16, (3, 4))}, check_bf16=False),
+    OpSpec("bitwise_not", paddle.bitwise_not, np.bitwise_not,
+           {"x": rng.integers(0, 16, (3, 4))}, check_bf16=False),
+    OpSpec("logical_or", paddle.logical_or, np.logical_or,
+           {"x": _f(3, 4) > 0, "y": _f(3, 4) > 0}, check_bf16=False),
+    OpSpec("logical_xor", paddle.logical_xor, np.logical_xor,
+           {"x": _f(3, 4) > 0, "y": _f(3, 4) > 0}, check_bf16=False),
+    OpSpec("logical_not", paddle.logical_not, np.logical_not,
+           {"x": _f(3, 4) > 0}, check_bf16=False),
+    OpSpec("greater_equal", paddle.greater_equal, lambda a, b: a >= b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("less_equal", paddle.less_equal, lambda a, b: a <= b,
+           {"x": _f(3, 4), "y": _f(3, 4)}, check_bf16=False),
+    OpSpec("not_equal", paddle.not_equal, lambda a, b: a != b,
+           {"x": rng.integers(0, 3, (3, 4)),
+            "y": rng.integers(0, 3, (3, 4))}, check_bf16=False),
+    OpSpec("cast", lambda x: paddle.cast(x, "int32"),
+           lambda x: x.astype("int32"), {"x": _f(3, 4) * 3},
+           check_bf16=False),
+]
+
+
+def _psi(v, eps=1e-6):
+    return (math.lgamma(v + eps) - math.lgamma(v - eps)) / (2 * eps)
+
+
+def _erfinv_ref(y, lo=-6.0, hi=6.0):
+    for _ in range(80):
+        mid = (lo + hi) / 2
+        if math.erf(mid) < y:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
+
+
+def _i0_ref(v):
+    total, term = 1.0, 1.0
+    for k in range(1, 30):
+        term *= (v * v / 4.0) / (k * k)
+        total += term
+    return total
+
+
+def _trigamma_ref(v, eps=1e-4):
+    return (_psi(v + eps) - _psi(v - eps)) / (2 * eps)
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

@@ -1,0 +1,438 @@
+"""Golden op specs: conv / pool / norm / vision-functional family
+(ref yaml ops.yaml; ref tests test_conv2d_op.py, test_pool2d_op.py,
+test_layer_norm_op.py ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(31)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _conv2d_ref(x, w, stride=1, pad=0):
+    n, cin, h, ww = x.shape
+    cout, _, kh, kw = w.shape
+    if pad:
+        x = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (x.shape[2] - kh) // stride + 1
+    ow = (x.shape[3] - kw) // stride + 1
+    out = np.zeros((n, cout, oh, ow), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def _conv1d_ref(x, w):
+    n, cin, l = x.shape
+    cout, _, k = w.shape
+    ol = l - k + 1
+    out = np.zeros((n, cout, ol), "float32")
+    for i in range(ol):
+        out[:, :, i] = np.einsum("ncl,ocl->no", x[:, :, i:i + k], w)
+    return out
+
+
+def _conv3d_ref(x, w):
+    n, cin, d, h, ww = x.shape
+    cout, _, kd, kh, kw = w.shape
+    od, oh, ow = d - kd + 1, h - kh + 1, ww - kw + 1
+    out = np.zeros((n, cout, od, oh, ow), "float32")
+    for a in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[:, :, a:a + kd, i:i + kh, j:j + kw]
+                out[:, :, a, i, j] = np.einsum("ncdhw,ocdhw->no",
+                                               patch, w)
+    return out
+
+
+def _maxpool_ref(x, k, s):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    out = np.zeros((n, c, oh, ow), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].max((2, 3))
+    return out
+
+
+def _avgpool_ref(x, k, s):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    out = np.zeros((n, c, oh, ow), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].mean((2, 3))
+    return out
+
+
+def _layer_norm_ref(x, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps)
+
+
+SPECS = [
+    OpSpec("conv2d", lambda x, w: F.conv2d(x, w),
+           lambda x, w: _conv2d_ref(x, w),
+           {"x": _f(2, 3, 6, 6), "weight": _f(4, 3, 3, 3)},
+           atol=1e-4, grad_inputs=("x", "weight"), grad_atol=2e-2,
+           grad_rtol=2e-2),
+    OpSpec("conv2d_stride_pad",
+           lambda x, w: F.conv2d(x, w, stride=2, padding=1),
+           lambda x, w: _conv2d_ref(x, w, stride=2, pad=1),
+           {"x": _f(2, 3, 6, 6), "weight": _f(4, 3, 3, 3)}, atol=1e-4,
+           yaml_ops=("conv2d",)),
+    OpSpec("depthwise_conv2d",
+           lambda x, w: F.conv2d(x, w, groups=3),
+           lambda x, w: np.concatenate(
+               [_conv2d_ref(x[:, i:i + 1], w[i:i + 1, :1])
+                for i in range(3)], 1),
+           {"x": _f(2, 3, 5, 5), "weight": _f(3, 1, 3, 3)}, atol=1e-4,
+           yaml_ops=("depthwise_conv2d",)),
+    OpSpec("conv1d", lambda x, w: F.conv1d(x, w),
+           lambda x, w: _conv1d_ref(x, w),
+           {"x": _f(2, 3, 8), "weight": _f(4, 3, 3)}, atol=1e-4),
+    OpSpec("conv3d", lambda x, w: F.conv3d(x, w),
+           lambda x, w: _conv3d_ref(x, w),
+           {"x": _f(1, 2, 4, 4, 4), "weight": _f(3, 2, 2, 2, 2)},
+           atol=1e-4),
+    OpSpec("conv2d_transpose",
+           lambda x, w: F.conv2d_transpose(x, w),
+           lambda x, w: _convT_ref(x, w),
+           {"x": _f(1, 3, 4, 4), "weight": _f(3, 2, 3, 3)}, atol=1e-4,
+           yaml_ops=("conv2d_transpose",
+                     "depthwise_conv2d_transpose")),
+    OpSpec("conv3d_transpose",
+           lambda x, w: F.conv1d_transpose(x, w),
+           lambda x, w: _conv1dT_ref(x, w),
+           {"x": _f(1, 2, 5), "weight": _f(2, 3, 3)}, atol=1e-4,
+           yaml_ops=("conv3d_transpose",)),
+    OpSpec("max_pool2d", lambda x: F.max_pool2d(x, 2, stride=2),
+           lambda x: _maxpool_ref(x, 2, 2), {"x": _f(2, 3, 6, 6)},
+           yaml_ops=("pool2d", "max_pool2d_with_index")),
+    OpSpec("avg_pool2d", lambda x: F.avg_pool2d(x, 2, stride=2),
+           lambda x: _avgpool_ref(x, 2, 2), {"x": _f(2, 3, 6, 6)}),
+    OpSpec("max_pool1d", lambda x: F.max_pool1d(x, 2, stride=2),
+           lambda x: x.reshape(2, 3, 3, 2).max(-1),
+           {"x": _f(2, 3, 6)}),
+    OpSpec("avg_pool1d", lambda x: F.avg_pool1d(x, 2, stride=2),
+           lambda x: x.reshape(2, 3, 3, 2).mean(-1), {"x": _f(2, 3, 6)}),
+    OpSpec("max_pool3d", lambda x: F.max_pool3d(x, 2, stride=2),
+           lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2)
+           .max((3, 5, 7)), {"x": _f(1, 2, 4, 4, 4)},
+           yaml_ops=("pool3d", "max_pool3d_with_index")),
+    OpSpec("adaptive_avg_pool2d",
+           lambda x: F.adaptive_avg_pool2d(x, 2),
+           lambda x: x.reshape(2, 3, 2, 3, 2, 3).mean((3, 5)),
+           {"x": _f(2, 3, 6, 6)}),
+    OpSpec("adaptive_max_pool2d",
+           lambda x: F.adaptive_max_pool2d(x, 2),
+           lambda x: x.reshape(2, 3, 2, 3, 2, 3).max((3, 5)),
+           {"x": _f(2, 3, 6, 6)}),
+    OpSpec("lp_pool_proxy_unpool",
+           lambda x, idx: F.max_unpool2d(x, idx, 2),
+           lambda x, idx: _unpool_ref(x, idx),
+           {"x": _f(1, 1, 2, 2),
+            "indices": np.array([[[[0, 3], [8, 11]]]], "int64")},
+           yaml_ops=("unpool", "unpool3d"), check_bf16=False),
+    OpSpec("layer_norm", lambda x: F.layer_norm(x, [4]),
+           lambda x: _layer_norm_ref(x), {"x": _f(3, 4)}, atol=1e-4,
+           grad_inputs=("x",)),
+    OpSpec("group_norm",
+           lambda x: F.group_norm(x, num_groups=2),
+           lambda x: _group_norm_ref(x, 2), {"x": _f(2, 4, 3, 3)},
+           atol=1e-4),
+    OpSpec("instance_norm", lambda x: F.instance_norm(x),
+           lambda x: _instance_norm_ref(x), {"x": _f(2, 3, 4, 4)},
+           atol=1e-4),
+    OpSpec("batch_norm_eval",
+           lambda x, m, v: F.batch_norm(x, m, v, training=False),
+           lambda x, m, v: (x - m[None, :, None, None])
+           / np.sqrt(v[None, :, None, None] + 1e-5),
+           {"x": _f(2, 3, 4, 4), "running_mean": _f(3) * 0.1,
+            "running_var": np.abs(_f(3)) + 0.5},
+           atol=1e-4, yaml_ops=("batch_norm", "sync_batch_norm_")),
+    OpSpec("local_response_norm",
+           lambda x: F.local_response_norm(x, size=3),
+           lambda x: _lrn_ref(x, 3), {"x": _f(2, 4, 3, 3)}, atol=1e-4),
+    OpSpec("normalize", lambda x: F.normalize(x, axis=-1),
+           lambda x: x / np.maximum(
+               np.sqrt((x * x).sum(-1, keepdims=True)), 1e-12),
+           {"x": _f(3, 4)}, atol=1e-4),
+    OpSpec("rms_norm_f", lambda x, w: F.rms_norm(x, w),
+           lambda x, w: x / np.sqrt((x * x).mean(-1, keepdims=True)
+                                    + 1e-6) * w,
+           {"x": _f(3, 4), "w": np.abs(_f(4)) + 0.5}, atol=1e-4,
+           yaml_ops=()),
+    OpSpec("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+           lambda x: _pixel_shuffle_ref(x, 2), {"x": _f(1, 4, 2, 2)}),
+    OpSpec("pixel_unshuffle", lambda x: F.pixel_unshuffle(x, 2),
+           lambda x: _pixel_unshuffle_ref(x, 2), {"x": _f(1, 1, 4, 4)}),
+    OpSpec("channel_shuffle", lambda x: F.channel_shuffle(x, 2),
+           lambda x: x.reshape(1, 2, 2, 3, 3).transpose(0, 2, 1, 3, 4)
+           .reshape(1, 4, 3, 3), {"x": _f(1, 4, 3, 3)}),
+    OpSpec("interpolate_nearest",
+           lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+           lambda x: x.repeat(2, 2).repeat(2, 3), {"x": _f(1, 2, 3, 3)},
+           yaml_ops=("nearest_interp",)),
+    OpSpec("interpolate_bilinear",
+           lambda x: F.interpolate(x, size=[4, 4], mode="bilinear",
+                                   align_corners=True),
+           lambda x: _bilinear_ref(x, 4), {"x": _f(1, 1, 2, 2)},
+           atol=1e-4,
+           yaml_ops=("bilinear_interp", "linear_interp",
+                     "bicubic_interp", "trilinear_interp")),
+    OpSpec("grid_sample",
+           lambda x, g: F.grid_sample(x, g, align_corners=True),
+           lambda x, g: _grid_sample_ref(x, g),
+           {"x": _f(1, 1, 3, 3),
+            "grid": rng.uniform(-1, 1, (1, 2, 2, 2))
+            .astype("float32")}, atol=1e-4),
+    OpSpec("affine_grid",
+           lambda t: F.affine_grid(t, [1, 1, 2, 2],
+                                   align_corners=True),
+           lambda t: _affine_grid_ref(t),
+           {"theta": np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32")},
+           atol=1e-4),
+    OpSpec("cosine_similarity",
+           lambda a, b: F.cosine_similarity(a, b, axis=-1),
+           lambda a, b: (a * b).sum(-1)
+           / (np.sqrt((a * a).sum(-1)) * np.sqrt((b * b).sum(-1))),
+           {"x1": _f(3, 4), "x2": _f(3, 4)}, atol=1e-4),
+    OpSpec("pairwise_distance_cdist",
+           lambda a, b: paddle.cdist(a, b),
+           lambda a, b: np.sqrt(
+               ((a[:, None] - b[None]) ** 2).sum(-1)),
+           {"a": _f(3, 4), "b": _f(2, 4)}, atol=1e-4),
+    OpSpec("embedding", lambda idx, w: F.embedding(idx, w),
+           lambda idx, w: w[idx],
+           {"x": rng.integers(0, 6, (2, 3)), "weight": _f(6, 4)},
+           check_bf16=False, yaml_ops=("embedding", "lookup_table_v2")),
+    OpSpec("linear", lambda x, w, b: F.linear(x, w, b),
+           lambda x, w, b: x @ w + b,
+           {"x": _f(3, 4), "weight": _f(4, 5), "bias": _f(5)},
+           grad_inputs=("x", "weight")),
+    OpSpec("bilinear_fn", lambda a, b, w: F.bilinear(a, b, w),
+           lambda a, b, w: np.einsum("bi,oij,bj->bo", a, w, b),
+           {"x1": _f(3, 4), "x2": _f(3, 5), "weight": _f(2, 4, 5)},
+           atol=1e-4),
+    OpSpec("dropout_eval", lambda x: F.dropout(x, p=0.5, training=False),
+           lambda x: x, {"x": _f(3, 4)}, yaml_ops=("dropout",)),
+    OpSpec("zeropad2d", lambda x: F.zeropad2d(x, [1, 1, 1, 1]),
+           lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1))),
+           {"x": _f(1, 2, 3, 3)}),
+    OpSpec("fold",
+           lambda x: F.fold(x, output_sizes=[4, 4], kernel_sizes=2,
+                            strides=2),
+           lambda x: _fold_ref(x), {"x": _f(1, 8, 4)},
+           check_bf16=False),
+    OpSpec("temporal_shift",
+           lambda x: F.temporal_shift(x, seg_num=2, shift_ratio=0.25),
+           lambda x: _temporal_shift_ref(x, 2, 0.25),
+           {"x": _f(4, 4, 2, 2)}, check_bf16=False),
+    OpSpec("softmax2d_proxy_log_softmax_axis0",
+           lambda x: F.log_softmax(x, axis=0),
+           lambda x: x - x.max(0) - np.log(
+               np.exp(x - x.max(0)).sum(0)), {"x": _f(3, 4)},
+           yaml_ops=("log_softmax",)),
+    OpSpec("scaled_dot_product_attention",
+           lambda q, k, v: F.scaled_dot_product_attention(q, k, v),
+           lambda q, k, v: _sdpa_ref(q, k, v),
+           {"q": _f(1, 3, 2, 4), "k": _f(1, 3, 2, 4),
+            "v": _f(1, 3, 2, 4)}, atol=1e-4,
+           yaml_ops=("memory_efficient_attention", "flash_attn",
+                     "flash_attn_unpadded")),
+    OpSpec("gather_tree", paddle.nn.functional.gather_tree,
+           lambda ids, parents: _gather_tree_ref(ids, parents),
+           {"ids": np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                             [[0, 1], [9, 0]]], "int64"),
+            "parents": np.array([[[0, 0], [1, 1]], [[1, 0], [0, 0]],
+                                 [[0, 0], [0, 1]]], "int64")},
+           check_bf16=False),
+]
+
+
+def _convT_ref(x, w):
+    n, cin, h, ww = x.shape
+    _, cout, kh, kw = w.shape
+    out = np.zeros((n, cout, h + kh - 1, ww + kw - 1), "float32")
+    for i in range(h):
+        for j in range(ww):
+            out[:, :, i:i + kh, j:j + kw] += np.einsum(
+                "nc,cokl->nokl", x[:, :, i, j], w)
+    return out
+
+
+def _conv1dT_ref(x, w):
+    n, cin, l = x.shape
+    _, cout, k = w.shape
+    out = np.zeros((n, cout, l + k - 1), "float32")
+    for i in range(l):
+        out[:, :, i:i + k] += np.einsum("nc,cok->nok", x[:, :, i], w)
+    return out
+
+
+def _unpool_ref(x, idx):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h * 2, w * 2), "float32")
+    flat = out.reshape(n, c, -1)
+    for ni in range(n):
+        for ci in range(c):
+            flat[ni, ci, idx[ni, ci].reshape(-1)] = \
+                x[ni, ci].reshape(-1)
+    return flat.reshape(n, c, h * 2, w * 2)
+
+
+def _group_norm_ref(x, g, eps=1e-5):
+    n, c, h, w = x.shape
+    xg = x.reshape(n, g, c // g, h, w)
+    mu = xg.mean((2, 3, 4), keepdims=True)
+    var = xg.var((2, 3, 4), keepdims=True)
+    return ((xg - mu) / np.sqrt(var + eps)).reshape(n, c, h, w)
+
+
+def _instance_norm_ref(x, eps=1e-5):
+    mu = x.mean((2, 3), keepdims=True)
+    var = x.var((2, 3), keepdims=True)
+    return (x - mu) / np.sqrt(var + eps)
+
+
+def _lrn_ref(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    n, c, h, w = x.shape
+    sq = x ** 2
+    acc = np.zeros_like(x)
+    half = size // 2
+    for ci in range(c):
+        lo, hi = max(0, ci - half), min(c, ci + half + 1)
+        acc[:, ci] = sq[:, lo:hi].sum(1)
+    return x / (k + alpha * acc) ** beta
+
+
+def _pixel_shuffle_ref(x, r):
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // r // r, r, r, h, w)
+    return out.transpose(0, 1, 4, 2, 5, 3).reshape(
+        n, c // r // r, h * r, w * r)
+
+
+def _pixel_unshuffle_ref(x, r):
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    return out.transpose(0, 1, 3, 5, 2, 4).reshape(
+        n, c * r * r, h // r, w // r)
+
+
+def _bilinear_ref(x, size):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, size, size), "float32")
+    for i in range(size):
+        for j in range(size):
+            yi = i * (h - 1) / (size - 1)
+            xj = j * (w - 1) / (size - 1)
+            y0, x0 = int(np.floor(yi)), int(np.floor(xj))
+            y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+            dy, dx = yi - y0, xj - x0
+            out[:, :, i, j] = (
+                x[:, :, y0, x0] * (1 - dy) * (1 - dx)
+                + x[:, :, y1, x0] * dy * (1 - dx)
+                + x[:, :, y0, x1] * (1 - dy) * dx
+                + x[:, :, y1, x1] * dy * dx)
+    return out
+
+
+def _grid_sample_ref(x, grid):
+    n, c, h, w = x.shape
+    gh, gw = grid.shape[1], grid.shape[2]
+    out = np.zeros((n, c, gh, gw), "float32")
+    for i in range(gh):
+        for j in range(gw):
+            gx = (grid[:, i, j, 0] + 1) * (w - 1) / 2
+            gy = (grid[:, i, j, 1] + 1) * (h - 1) / 2
+            for ni in range(n):
+                x0, y0 = int(np.floor(gx[ni])), int(np.floor(gy[ni]))
+                x1, y1 = min(x0 + 1, w - 1), min(y0 + 1, h - 1)
+                dx, dy = gx[ni] - x0, gy[ni] - y0
+                out[ni, :, i, j] = (
+                    x[ni, :, y0, x0] * (1 - dy) * (1 - dx)
+                    + x[ni, :, y1, x0] * dy * (1 - dx)
+                    + x[ni, :, y0, x1] * (1 - dy) * dx
+                    + x[ni, :, y1, x1] * dy * dx)
+    return out
+
+
+def _affine_grid_ref(theta):
+    ys, xs = np.meshgrid([-1.0, 1.0], [-1.0, 1.0], indexing="ij")
+    base = np.stack([xs, ys, np.ones_like(xs)], -1)  # [2,2,3]
+    out = base @ theta[0].T  # [2,2,2]
+    return out[None].astype("float32")
+
+
+def _fold_ref(x):
+    n = 1
+    out = np.zeros((n, 2, 4, 4), "float32")
+    cols = x.reshape(n, 2, 2, 2, 4)
+    li = 0
+    for i in range(2):
+        for j in range(2):
+            out[:, :, i * 2:i * 2 + 2, j * 2:j * 2 + 2] += \
+                cols[:, :, :, :, li].reshape(n, 2, 2, 2)
+            li += 1
+    return out
+
+
+def _temporal_shift_ref(x, seg, ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    fold = int(c * ratio)
+    out = np.zeros_like(xr)
+    out[:, :-1, :fold] = xr[:, 1:, :fold]              # shift left
+    out[:, 1:, fold:2 * fold] = xr[:, :-1, fold:2 * fold]  # shift right
+    out[:, :, 2 * fold:] = xr[:, :, 2 * fold:]
+    return out.reshape(nt, c, h, w)
+
+
+def _sdpa_ref(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    s = qh @ kh.transpose(0, 1, 3, 2) * scale
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return (p @ vh).transpose(0, 2, 1, 3)
+
+
+def _gather_tree_ref(ids, parents):
+    T, B, W = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for w in range(W):
+            k = w
+            for t in range(T - 1, -1, -1):
+                out[t, b, w] = ids[t, b, k]
+                k = parents[t, b, k]
+    return out
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)
+
+
+for _s in SPECS:
+    if _s.name == "bilinear_fn":
+        _s.yaml_ops = ("bilinear",)

@@ -1,0 +1,209 @@
+"""Golden op specs: optimizer update kernels (ref yaml legacy_ops.yaml
+sgd_/momentum_/adam_ ... entries; ref tests test_sgd_op.py,
+test_adam_op.py). Each spec runs ONE optimizer step through the public
+paddle.optimizer API on a tiny param and compares the updated values
+against the reference update math in numpy. (to_static/bf16 legs are
+disabled — optimizers mutate state; the dygraph leg IS the op.)"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(37)
+
+P0 = rng.standard_normal((4, 3)).astype("float32")
+G0 = rng.standard_normal((4, 3)).astype("float32")
+LR = 0.1
+
+
+def _step(opt_factory, steps=1):
+    """Run `steps` optimizer steps with constant grad G0 on param P0."""
+    def fn(p_init, g):
+        p_np = np.asarray(p_init.numpy() if hasattr(p_init, "numpy")
+                          else p_init)
+        param = paddle.to_tensor(p_np.copy())
+        param.stop_gradient = False
+        opt = opt_factory([param])
+        for _ in range(steps):
+            param.clear_gradient()
+            loss = (param * g).sum()
+            loss.backward()
+            opt.step()
+        return param
+    return fn
+
+
+def _sgd_ref(p, g):
+    return p - LR * g
+
+
+def _momentum_ref(p, g, mu=0.9, steps=2):
+    v = np.zeros_like(p)
+    for _ in range(steps):
+        v = mu * v + g
+        p = p - LR * v
+    return p
+
+
+def _adam_ref(p, g, b1=0.9, b2=0.999, eps=1e-8, steps=2):
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        p = p - LR * mh / (np.sqrt(vh) + eps)
+    return p
+
+
+def _adamw_ref(p, g, b1=0.9, b2=0.999, eps=1e-8, wd=0.01, steps=2):
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        p = p * (1 - LR * wd)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        p = p - LR * mh / (np.sqrt(vh) + eps)
+    return p
+
+
+def _adagrad_ref(p, g, eps=1e-6, steps=2):
+    acc = np.zeros_like(p)
+    for _ in range(steps):
+        acc = acc + g * g
+        p = p - LR * g / (np.sqrt(acc) + eps)
+    return p
+
+
+def _adamax_ref(p, g, b1=0.9, b2=0.999, eps=1e-8, steps=2):
+    m = np.zeros_like(p)
+    u = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        p = p - (LR / (1 - b1 ** t)) * m / (u + eps)
+    return p
+
+
+def _adadelta_ref(p, g, rho=0.95, eps=1e-6, steps=2):
+    ga = np.zeros_like(p)
+    xa = np.zeros_like(p)
+    for _ in range(steps):
+        ga = rho * ga + (1 - rho) * g * g
+        upd = np.sqrt(xa + eps) / np.sqrt(ga + eps) * g
+        xa = rho * xa + (1 - rho) * upd * upd
+        p = p - LR * upd
+    return p
+
+
+def _rmsprop_ref(p, g, rho=0.95, eps=1e-6, steps=2):
+    acc = np.zeros_like(p)
+    for _ in range(steps):
+        acc = rho * acc + (1 - rho) * g * g
+        p = p - LR * g / np.sqrt(acc + eps)
+    return p
+
+
+def _lamb_ref(p, g, b1=0.9, b2=0.999, eps=1e-6, wd=0.01, steps=2):
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        r = mh / (np.sqrt(vh) + eps) + wd * p
+        w_norm = np.linalg.norm(p)
+        r_norm = np.linalg.norm(r)
+        ratio = np.where((w_norm > 0) & (r_norm > 0),
+                         w_norm / r_norm, 1.0)
+        p = p - LR * ratio * r
+    return p
+
+
+SPECS = [
+    OpSpec("sgd_step", _step(lambda ps: paddle.optimizer.SGD(
+        learning_rate=LR, parameters=ps), steps=1),
+        _sgd_ref, {"p": P0, "g": G0}, check_bf16=False,
+        check_static=False, yaml_ops=("sgd_",), atol=1e-5),
+    OpSpec("momentum_step", _step(lambda ps: paddle.optimizer.Momentum(
+        learning_rate=LR, momentum=0.9, parameters=ps), steps=2),
+        lambda p, g: _momentum_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False,
+        yaml_ops=("momentum_", "merged_momentum_"), atol=1e-5),
+    OpSpec("adam_step", _step(lambda ps: paddle.optimizer.Adam(
+        learning_rate=LR, parameters=ps), steps=2),
+        lambda p, g: _adam_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False,
+        yaml_ops=("adam_", "merged_adam_", "fused_adam_"), atol=1e-5),
+    OpSpec("adamw_step", _step(lambda ps: paddle.optimizer.AdamW(
+        learning_rate=LR, weight_decay=0.01, parameters=ps), steps=2),
+        lambda p, g: _adamw_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("adamw_",),
+        atol=1e-5),
+    OpSpec("adagrad_step", _step(lambda ps: paddle.optimizer.Adagrad(
+        learning_rate=LR, parameters=ps), steps=2),
+        lambda p, g: _adagrad_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("adagrad_",),
+        atol=1e-4),
+    OpSpec("adamax_step", _step(lambda ps: paddle.optimizer.Adamax(
+        learning_rate=LR, parameters=ps), steps=2),
+        lambda p, g: _adamax_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("adamax_",),
+        atol=1e-5),
+    OpSpec("adadelta_step", _step(lambda ps: paddle.optimizer.Adadelta(
+        learning_rate=LR, parameters=ps), steps=2),
+        lambda p, g: _adadelta_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("adadelta_",),
+        atol=1e-5),
+    OpSpec("rmsprop_step", _step(lambda ps: paddle.optimizer.RMSProp(
+        learning_rate=LR, rho=0.95, parameters=ps), steps=2),
+        lambda p, g: _rmsprop_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("rmsprop_",),
+        atol=1e-5),
+    OpSpec("lamb_step", _step(lambda ps: paddle.optimizer.Lamb(
+        learning_rate=LR, lamb_weight_decay=0.01, parameters=ps),
+        steps=2),
+        lambda p, g: _lamb_ref(p, g), {"p": P0, "g": G0},
+        check_bf16=False, check_static=False, yaml_ops=("lamb_",),
+        atol=1e-4),
+    # ASGD averaging covers average_accumulates_
+    OpSpec("asgd_step", _step(lambda ps: paddle.optimizer.ASGD(
+        learning_rate=LR, parameters=ps), steps=1),
+        _sgd_ref, {"p": P0, "g": G0}, check_bf16=False,
+        check_static=False, yaml_ops=("average_accumulates_",),
+        atol=1e-4),
+    # amp update ops: GradScaler found-inf handling
+    OpSpec("grad_scaler_inf_skip",
+           lambda p, g: _scaler_step(p, g),
+           lambda p, g: p,  # inf grad => update skipped, param kept
+           {"p": P0, "g": np.full_like(G0, np.inf)},
+           check_bf16=False, check_static=False,
+           yaml_ops=("check_finite_and_unscale_",
+                     "update_loss_scaling_")),
+]
+
+
+def _scaler_step(p_init, g):
+    p_np = np.asarray(p_init.numpy() if hasattr(p_init, "numpy")
+                      else p_init)
+    param = paddle.to_tensor(p_np.copy())
+    param.stop_gradient = False
+    opt = paddle.optimizer.SGD(learning_rate=LR, parameters=[param])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    loss = (param * g).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    return param
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

@@ -1,0 +1,152 @@
+"""Golden op specs: reductions / search / sort family
+(ref yaml ops.yaml; ref tests test_reduce_op.py, test_kthvalue_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(13)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+SPECS = [
+    OpSpec("amax", lambda x: paddle.amax(x, axis=-1),
+           lambda x: np.max(x, -1), {"x": _f(3, 5)}),
+    OpSpec("amin", lambda x: paddle.amin(x, axis=-1),
+           lambda x: np.min(x, -1), {"x": _f(3, 5)}),
+    OpSpec("all", lambda x: paddle.all(x, axis=-1),
+           lambda x: np.all(x, -1), {"x": _f(3, 5) > 0},
+           check_bf16=False),
+    OpSpec("any", lambda x: paddle.any(x, axis=-1),
+           lambda x: np.any(x, -1), {"x": _f(3, 5) > 0},
+           check_bf16=False),
+    OpSpec("count_nonzero", lambda x: paddle.count_nonzero(x, axis=-1),
+           lambda x: np.count_nonzero(x, -1),
+           {"x": (np.abs(_f(3, 5)) > 0.7).astype("float32")},
+           check_bf16=False),
+    OpSpec("std", paddle.std,
+           lambda x: np.std(x, ddof=1), {"x": _f(4, 5)},
+           grad_inputs=("x",)),
+    OpSpec("var", paddle.var,
+           lambda x: np.var(x, ddof=1), {"x": _f(4, 5)},
+           grad_inputs=("x",)),
+    OpSpec("median", lambda x: paddle.median(x, axis=-1),
+           lambda x: np.median(x, -1), {"x": _f(3, 5)},
+           check_bf16=False),
+    OpSpec("nanmedian", lambda x: paddle.nanmedian(x, axis=-1),
+           lambda x: np.nanmedian(x, -1),
+           {"x": np.where(_f(3, 5) > 1.0, np.nan, _f(3, 5))
+            .astype("float32")}, check_bf16=False),
+    OpSpec("nanmean", lambda x: paddle.nanmean(x, axis=-1),
+           lambda x: np.nanmean(x, -1),
+           {"x": np.where(_f(3, 5) > 1.0, np.nan, _f(3, 5))
+            .astype("float32")}, check_bf16=False),
+    OpSpec("nansum", lambda x: paddle.nansum(x, axis=-1),
+           lambda x: np.nansum(x, -1),
+           {"x": np.where(_f(3, 5) > 1.0, np.nan, _f(3, 5))
+            .astype("float32")}, check_bf16=False),
+    OpSpec("quantile", lambda x: paddle.quantile(x, 0.5, axis=-1),
+           lambda x: np.quantile(x, 0.5, axis=-1), {"x": _f(3, 5)},
+           check_bf16=False),
+    OpSpec("kthvalue", lambda x: paddle.kthvalue(x, k=2, axis=-1),
+           lambda x: (np.sort(x, -1)[..., 1],
+                      np.argsort(x, -1, kind="stable")[..., 1]),
+           {"x": _f(3, 5)}, check_bf16=False),
+    OpSpec("mode", lambda x: paddle.mode(x, axis=-1),
+           lambda x: _mode_ref(x),
+           {"x": rng.integers(0, 3, (3, 5)).astype("float32")},
+           check_bf16=False, check_static=False),
+    OpSpec("cumprod", lambda x: paddle.cumprod(x, dim=1),
+           lambda x: np.cumprod(x, 1), {"x": _f(3, 4)},
+           grad_inputs=("x",), grad_atol=2e-2, grad_rtol=2e-2),
+    OpSpec("cummax", lambda x: paddle.cummax(x, axis=1)[0],
+           lambda x: np.maximum.accumulate(x, 1), {"x": _f(3, 4)}),
+    OpSpec("cummin", lambda x: paddle.cummin(x, axis=1)[0],
+           lambda x: np.minimum.accumulate(x, 1), {"x": _f(3, 4)}),
+    OpSpec("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+           lambda x: np.log(np.cumsum(np.exp(x), 1)), {"x": _f(3, 4)},
+           atol=1e-4),
+    OpSpec("argsort", lambda x: paddle.argsort(x, axis=-1),
+           lambda x: np.argsort(x, -1, kind="stable"), {"x": _f(3, 5)},
+           check_bf16=False),
+    OpSpec("nonzero", paddle.nonzero,
+           lambda x: np.stack(np.nonzero(x), -1),
+           {"x": (np.abs(_f(3, 4)) > 0.7).astype("float32")},
+           check_bf16=False, check_static=False),
+    OpSpec("masked_select", paddle.masked_select,
+           lambda x, m: x[m],
+           {"x": _f(3, 4), "mask": _f(3, 4) > 0},
+           check_bf16=False, check_static=False),
+    OpSpec("searchsorted", paddle.searchsorted,
+           lambda s, v: np.searchsorted(s, v),
+           {"sorted_sequence": np.sort(_f(8)), "values": _f(5)},
+           check_bf16=False),
+    OpSpec("bucketize", paddle.bucketize,
+           lambda x, s: np.searchsorted(s, x),
+           {"x": _f(5), "sorted_sequence": np.sort(_f(8))},
+           check_bf16=False),
+    OpSpec("bincount", paddle.bincount,
+           lambda x: np.bincount(x),
+           {"x": rng.integers(0, 6, (20,))},
+           # output length is data-dependent (max(x)+1): not traceable
+           check_bf16=False, check_static=False),
+    OpSpec("histogram", lambda x: paddle.histogram(x, bins=5,
+                                                   min=-2.0, max=2.0),
+           lambda x: np.histogram(x, bins=5, range=(-2, 2))[0],
+           {"x": _f(30)}, check_bf16=False),
+    OpSpec("unique", lambda x: paddle.unique(x),
+           lambda x: np.unique(x),
+           {"x": rng.integers(0, 5, (12,))},
+           check_bf16=False, check_static=False),
+    OpSpec("unique_consecutive", lambda x: paddle.unique_consecutive(x),
+           lambda x: x[np.concatenate([[True], x[1:] != x[:-1]])],
+           {"x": np.array([1, 1, 2, 2, 2, 3, 1, 1])},
+           check_bf16=False, check_static=False),
+    OpSpec("is_empty", paddle.is_empty, lambda x: x.size == 0,
+           {"x": _f(3, 4)}, check_bf16=False),
+    OpSpec("trace", paddle.trace, np.trace, {"x": _f(4, 4)},
+           grad_inputs=("x",)),
+    OpSpec("dist", lambda x, y: paddle.dist(x, y, p=2),
+           lambda x, y: np.sqrt(np.sum((x - y) ** 2)),
+           {"x": _f(3, 4), "y": _f(3, 4)}, grad_inputs=("x",)),
+    OpSpec("squared_l2_norm", lambda x: (paddle.norm(x, p=2) ** 2),
+           lambda x: np.sum(x * x), {"x": _f(3, 4)},
+           yaml_ops=("squared_l2_norm", "frobenius_norm", "p_norm",
+                     "norm")),
+    OpSpec("logsumexp_axis", lambda x: paddle.logsumexp(x, axis=-1),
+           lambda x: np.log(np.sum(np.exp(x), -1)), {"x": _f(3, 5)},
+           yaml_ops=("logsumexp",), grad_inputs=("x",)),
+    OpSpec("max_axis", lambda x: paddle.max(x, axis=0),
+           lambda x: np.max(x, 0), {"x": _f(3, 5)}, yaml_ops=("max",),
+           grad_inputs=("x",)),
+    OpSpec("min_axis", lambda x: paddle.min(x, axis=0),
+           lambda x: np.min(x, 0), {"x": _f(3, 5)}, yaml_ops=("min",)),
+    OpSpec("mean_axis", lambda x: paddle.mean(x, axis=1, keepdim=True),
+           lambda x: np.mean(x, 1, keepdims=True), {"x": _f(3, 5)},
+           yaml_ops=("mean", "mean_all", "reduce_mean")),
+    OpSpec("sum_axis", lambda x: paddle.sum(x, axis=1),
+           lambda x: np.sum(x, 1), {"x": _f(3, 5)},
+           yaml_ops=("sum", "reduce_sum", "add_n")),
+]
+
+
+def _mode_ref(x):
+    vals = np.zeros(x.shape[0], x.dtype)
+    idxs = np.zeros(x.shape[0], "int64")
+    for i, row in enumerate(x):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        # paddle semantics: the LAST index of the most-frequent value
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    return vals, idxs
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)

