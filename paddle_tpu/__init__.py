@@ -58,6 +58,7 @@ from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import parallel  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
@@ -66,6 +67,8 @@ from . import models  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402

@@ -1,0 +1,9 @@
+"""paddle.audio (ref: /root/reference/python/paddle/audio/__init__.py):
+features (Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC), functional
+(mel/window/dB math), backends (wav I/O), datasets (ESC50/TESS,
+local-disk)."""
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["backends", "datasets", "features", "functional", "info",
+           "load", "save"]

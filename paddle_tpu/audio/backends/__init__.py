@@ -1,0 +1,9 @@
+"""paddle.audio.backends (ref: /root/reference/python/paddle/audio/
+backends/__init__.py)."""
+from .backend import AudioInfo  # noqa: F401
+from .init_backend import (get_current_backend,  # noqa: F401
+                           list_available_backends, set_backend)
+from .wave_backend import info, load, save  # noqa: F401
+
+__all__ = ["AudioInfo", "get_current_backend", "list_available_backends",
+           "set_backend", "info", "load", "save"]

@@ -1,0 +1,20 @@
+"""ref: /root/reference/python/paddle/audio/backends/backend.py:21."""
+
+
+class AudioInfo:
+    """Audio metadata, return type of the backend info() function."""
+
+    def __init__(self, sample_rate: int, num_samples: int,
+                 num_channels: int, bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")

@@ -1,0 +1,31 @@
+"""Backend registry (ref: /root/reference/python/paddle/audio/backends/
+init_backend.py — list_available_backends:37, get_current_backend:93,
+set_backend:135). Only the dependency-free 'wave_backend' ships; the
+reference additionally discovers paddleaudio's soundfile backend when the
+package is installed."""
+from __future__ import annotations
+
+from typing import List
+
+_CURRENT = "wave_backend"
+
+
+def list_available_backends() -> List[str]:
+    """ref init_backend.py:37."""
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    """ref init_backend.py:93."""
+    return _CURRENT
+
+
+def set_backend(backend_name: str):
+    """ref init_backend.py:135."""
+    global _CURRENT
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} is not available; this build ships "
+            f"the stdlib 'wave_backend' only (install-time backends like "
+            f"paddleaudio/soundfile are out of scope)")
+    _CURRENT = backend_name

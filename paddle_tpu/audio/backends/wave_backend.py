@@ -1,0 +1,65 @@
+"""WAV I/O over the stdlib `wave` module (ref: /root/reference/python/
+paddle/audio/backends/wave_backend.py — info:37, load:89, save:168).
+Host-side I/O by design: audio decode feeds the input pipeline, not the
+device graph."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from .backend import AudioInfo
+
+
+def info(filepath: str) -> AudioInfo:
+    """ref wave_backend.py:37."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_S")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """ref wave_backend.py:89. Returns (Tensor, sample_rate); float32 in
+    [-1, 1] when normalize else raw int16; [C, T] when channels_first."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        width = f.getsampwidth()
+        n_ch = f.getnchannels()
+        if width != 2:
+            raise ValueError(
+                f"the wave backend reads 16-bit PCM only, got "
+                f"{width * 8}-bit {filepath!r}")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype=np.int16).reshape(-1, n_ch)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """ref wave_backend.py:168. src: float Tensor in [-1,1] or int16."""
+    if bits_per_sample != 16:
+        raise ValueError("the wave backend writes 16-bit PCM only")
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.dtype != np.int16:
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

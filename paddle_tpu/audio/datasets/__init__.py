@@ -1,0 +1,7 @@
+"""paddle.audio.datasets (ref: /root/reference/python/paddle/audio/
+datasets/__init__.py)."""
+from .dataset import AudioClassificationDataset  # noqa: F401
+from .esc50 import ESC50  # noqa: F401
+from .tess import TESS  # noqa: F401
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]

@@ -1,0 +1,62 @@
+"""Audio dataset base (ref: /root/reference/python/paddle/audio/datasets/
+dataset.py:29 AudioClassificationDataset). Same local-disk stance as the
+vision datasets: no network download — datasets read a user-provided
+directory of wav files."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+_FEAT_TYPES = ["raw", "melspectrogram", "mfcc", "logmelspectrogram",
+               "spectrogram"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Base class: (waveform-or-feature, label) pairs from wav files."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw",
+                 sample_rate: Optional[int] = None, **kwargs):
+        super().__init__()
+        if feat_type not in _FEAT_TYPES:
+            raise ValueError(
+                f"feat_type {feat_type!r} not in {_FEAT_TYPES}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_kwargs = kwargs
+        self._extractor = None
+
+    def _feature_layer(self, sr: int):
+        if self._extractor is None and self.feat_type != "raw":
+            from .. import features
+            name = {"melspectrogram": "MelSpectrogram",
+                    "logmelspectrogram": "LogMelSpectrogram",
+                    "mfcc": "MFCC",
+                    "spectrogram": "Spectrogram"}[self.feat_type]
+            kw = dict(self._feat_kwargs)
+            if name != "Spectrogram":
+                kw.setdefault("sr", sr)
+            self._extractor = getattr(features, name)(**kw)
+        return self._extractor
+
+    def __getitem__(self, idx):
+        from ..backends import load
+        waveform, sr = load(self.files[idx])
+        if self.sample_rate is not None and sr != self.sample_rate:
+            raise ValueError(
+                f"{self.files[idx]!r} has sample rate {sr}, expected "
+                f"{self.sample_rate} (resampling is out of scope for the "
+                f"wave backend)")
+        label = np.int64(self.labels[idx])
+        if self.feat_type == "raw":
+            return waveform, label
+        feat = self._feature_layer(sr)(waveform)
+        return feat, label
+
+    def __len__(self):
+        return len(self.files)

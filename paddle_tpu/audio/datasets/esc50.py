@@ -1,0 +1,49 @@
+"""ESC-50 (ref: /root/reference/python/paddle/audio/datasets/esc50.py:26).
+Local-disk variant: point `root` at an extracted ESC-50 directory
+(audio/*.wav named <fold>-<src>-<take>-<target>.wav, like the upstream
+archive). The reference downloads the archive; this build never fetches."""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from .dataset import AudioClassificationDataset
+
+
+class ESC50(AudioClassificationDataset):
+    archive_hint = ("https://github.com/karoldvl/ESC-50/archive/master.zip "
+                    "(extract locally and pass root=)")
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", root: str = None, **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
+        if root is None or not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"ESC50 needs a local dataset directory: pass "
+                f"root=<path to extracted ESC-50> containing audio/*.wav "
+                f"(zero-egress build; fetch {self.archive_hint})")
+        files, labels = self._get_data(root, mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    @staticmethod
+    def _get_data(root, mode, split) -> Tuple[List[str], List[int]]:
+        audio_dir = os.path.join(root, "audio")
+        if not os.path.isdir(audio_dir):
+            audio_dir = root  # allow pointing straight at the wav dir
+        files, labels = [], []
+        for name in sorted(os.listdir(audio_dir)):
+            if not name.endswith(".wav"):
+                continue
+            parts = name[:-4].split("-")
+            if len(parts) != 4:
+                continue
+            fold, target = int(parts[0]), int(parts[3])
+            if (mode == "train") == (fold != split):
+                files.append(os.path.join(audio_dir, name))
+                labels.append(target)
+        if not files:
+            raise FileNotFoundError(
+                f"no ESC-50 wav files found under {audio_dir!r}")
+        return files, labels

@@ -1,0 +1,49 @@
+"""TESS (ref: /root/reference/python/paddle/audio/datasets/tess.py).
+Local-disk variant: point `root` at the extracted TESS directory of
+<speaker>_<word>_<emotion>.wav files. Never fetches (zero-egress)."""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from .dataset import AudioClassificationDataset
+
+
+class TESS(AudioClassificationDataset):
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", root: str = None,
+                 **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        if root is None or not os.path.isdir(root):
+            raise FileNotFoundError(
+                "TESS needs a local dataset directory: pass root=<path to "
+                "extracted TESS wavs> (zero-egress build)")
+        files, labels = self._get_data(root, mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, root, mode, n_folds,
+                  split) -> Tuple[List[str], List[int]]:
+        wavs = []
+        for dirpath, _, names in os.walk(root):
+            for name in sorted(names):
+                if name.endswith(".wav"):
+                    wavs.append(os.path.join(dirpath, name))
+        files, labels = [], []
+        for i, path in enumerate(sorted(wavs)):
+            emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+            if emotion not in self.emotions:
+                continue
+            fold = i % n_folds + 1
+            if (mode == "train") == (fold != split):
+                files.append(path)
+                labels.append(self.emotions.index(emotion))
+        if not files:
+            raise FileNotFoundError(f"no TESS wav files under {root!r}")
+        return files, labels

@@ -1,0 +1,6 @@
+"""paddle.audio.features (ref: /root/reference/python/paddle/audio/
+features/__init__.py)."""
+from .layers import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
+                     Spectrogram)
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

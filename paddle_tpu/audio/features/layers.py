@@ -1,0 +1,140 @@
+"""Audio feature layers (ref: /root/reference/python/paddle/audio/features/
+layers.py — Spectrogram:24, MelSpectrogram:106, LogMelSpectrogram:206,
+MFCC:309).
+
+Each layer is a thin composition over paddle_tpu.signal.stft + static
+host-built filter matrices (windows, mel fbank, DCT) registered as
+buffers — the device graph is frame→window→rFFT→|.|^p→(fbank matmul)→
+(log)→(DCT matmul), which XLA fuses around the batched FFT; the matmuls
+hit the MXU."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import nn, signal
+from ...framework.tensor import Tensor
+from ..functional import (compute_fbank_matrix, create_dct, get_window,
+                          power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    """ref layers.py:24 — |STFT|^power, output [N, n_fft//2+1, frames]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 1.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("Power of spectrogram must be > 0.")
+        self.power = power
+        if win_length is None:
+            win_length = n_fft
+        self._n_fft = n_fft
+        self._hop_length = hop_length
+        self._win_length = win_length
+        self._center = center
+        self._pad_mode = pad_mode
+        self.register_buffer(
+            "fft_window", get_window(window, win_length, fftbins=True,
+                                     dtype=dtype))
+
+    def forward(self, x: Tensor) -> Tensor:
+        stft = signal.stft(x, n_fft=self._n_fft,
+                           hop_length=self._hop_length,
+                           win_length=self._win_length,
+                           window=self.fft_window, center=self._center,
+                           pad_mode=self._pad_mode)
+        from ...ops.math import abs as _abs, pow as _pow
+        mag = _abs(stft)
+        if self.power == 1.0:
+            return mag
+        if self.power == 2.0:
+            return mag * mag
+        return _pow(mag, self.power)
+
+
+class MelSpectrogram(nn.Layer):
+    """ref layers.py:106 — fbank @ spectrogram, [N, n_mels, frames]."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.register_buffer(
+            "fbank_matrix",
+            compute_fbank_matrix(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                 f_min=f_min, f_max=f_max, htk=htk,
+                                 norm=norm, dtype=dtype))
+
+    def forward(self, x: Tensor) -> Tensor:
+        from ...ops.linalg import matmul
+        spect = self._spectrogram(x)  # [N, F, T]
+        return matmul(self.fbank_matrix, spect)  # [n_mels,F]@[N,F,T]
+
+
+class LogMelSpectrogram(nn.Layer):
+    """ref layers.py:206 — power_to_db of the mel spectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(nn.Layer):
+    """ref layers.py:309 — DCT of the log-mel spectrogram,
+    [N, n_mfcc, frames]."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot be larger than n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                        dtype=dtype))
+
+    def forward(self, x: Tensor) -> Tensor:
+        from ...ops.linalg import matmul
+        from ...ops.manipulation import transpose
+        log_mel = self._log_melspectrogram(x)  # [N, n_mels, T]
+        # [n_mfcc, n_mels] @ [N, n_mels, T] -> [N, n_mfcc, T]
+        return matmul(transpose(self.dct_matrix, [1, 0]), log_mel)

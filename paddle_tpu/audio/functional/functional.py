@@ -1,0 +1,143 @@
+"""Audio feature math (ref: /root/reference/python/paddle/audio/functional/
+functional.py — hz_to_mel:22, mel_to_hz:78, mel_frequencies:123,
+fft_frequencies:163, compute_fbank_matrix:186, power_to_db:259,
+create_dct:303).
+
+Filter banks and DCT matrices are static coefficients → built host-side
+with numpy and wrapped as Tensors; the per-frame math (power_to_db) runs
+as a device op so it fuses into the surrounding graph.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import apply
+from ...framework.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct"]
+
+_F_SP = 200.0 / 3
+_MIN_LOG_HZ = 1000.0
+_MIN_LOG_MEL = _MIN_LOG_HZ / _F_SP
+_LOGSTEP = math.log(6.4) / 27.0
+
+
+def hz_to_mel(freq: Union[Tensor, float], htk: bool = False):
+    """ref functional.py:22 — slaney scale by default, htk optional."""
+    if isinstance(freq, Tensor):
+        def impl(f):
+            if htk:
+                return 2595.0 * jnp.log10(1.0 + f / 700.0)
+            mels = f / _F_SP
+            target = _MIN_LOG_MEL + jnp.log(f / _MIN_LOG_HZ + 1e-10) \
+                / _LOGSTEP
+            return jnp.where(f > _MIN_LOG_HZ, target, mels)
+        return apply(impl, (freq,), op_name="hz_to_mel")
+    if htk:
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    mels = freq / _F_SP
+    if freq >= _MIN_LOG_HZ:
+        mels = _MIN_LOG_MEL + math.log(freq / _MIN_LOG_HZ + 1e-10) \
+            / _LOGSTEP
+    return mels
+
+
+def mel_to_hz(mel: Union[Tensor, float], htk: bool = False):
+    """ref functional.py:78."""
+    if isinstance(mel, Tensor):
+        def impl(m):
+            if htk:
+                return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+            freqs = _F_SP * m
+            target = _MIN_LOG_HZ * jnp.exp(_LOGSTEP * (m - _MIN_LOG_MEL))
+            return jnp.where(m > _MIN_LOG_MEL, target, freqs)
+        return apply(impl, (mel,), op_name="mel_to_hz")
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    freqs = _F_SP * mel
+    if mel >= _MIN_LOG_MEL:
+        freqs = _MIN_LOG_HZ * math.exp(_LOGSTEP * (mel - _MIN_LOG_MEL))
+    return freqs
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32") -> Tensor:
+    """ref functional.py:123 — n_mels frequencies evenly spaced in mel."""
+    mels = np.linspace(hz_to_mel(float(f_min), htk),
+                       hz_to_mel(float(f_max), htk), n_mels)
+    hz = np.array([mel_to_hz(float(m), htk) for m in mels])
+    return Tensor(hz.astype(np.dtype(dtype)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    """ref functional.py:163."""
+    return Tensor(np.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+                  .astype(np.dtype(dtype)))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False,
+                         norm: Union[str, float] = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """ref functional.py:186 — [n_mels, 1 + n_fft//2] triangular filters."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = np.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk,
+                                       "float64").numpy())
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        wnorm = np.sum(np.abs(weights) ** norm, axis=1,
+                       keepdims=True) ** (1.0 / norm)
+        weights = weights / np.maximum(wnorm, 1e-10)
+    return Tensor(weights.astype(np.dtype(dtype)))
+
+
+def power_to_db(spect: Tensor, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0) -> Tensor:
+    """ref functional.py:259 — 10*log10(max(amin, x)/ref), floored at
+    max - top_db. Runs as one device op (fuses into the mel pipeline)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+
+    def impl(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            if top_db < 0:
+                raise ValueError("top_db must be non-negative")
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return apply(impl, (spect,), op_name="power_to_db")
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """ref functional.py:303 — [n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct *= 2.0
+    else:
+        if norm != "ortho":
+            raise ValueError(f"norm must be 'ortho' or None, got {norm!r}")
+        dct[:, 0] *= 1.0 / math.sqrt(n_mels)
+        dct[:, 1:] *= math.sqrt(2.0 / n_mels)
+    return Tensor(dct.astype(np.dtype(dtype)))

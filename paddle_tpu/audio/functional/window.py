@@ -1,0 +1,220 @@
+"""Window functions (ref: /root/reference/python/paddle/audio/functional/
+window.py — get_window:335 and the per-window builders).
+
+TPU-first design: windows are STATIC filter coefficients, so they are
+computed once on the host with numpy at layer-construction time and live
+as buffers; only the windowed FFT runs on the device. (The reference
+builds them with tensor ops eagerly — same effect, more dispatches.)
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple, Union
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+__all__ = ["get_window"]
+
+
+def _len_guards(M: int) -> bool:
+    if int(M) != M or M < 0:
+        raise ValueError("Window length M must be a non-negative integer")
+    return M <= 1
+
+
+def _extend(M: int, sym: bool):
+    return (M + 1, True) if not sym else (M, False)
+
+
+def _truncate(w: np.ndarray, needs_trunc: bool) -> np.ndarray:
+    return w[:-1] if needs_trunc else w
+
+
+def _general_cosine(M, a, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    fac = np.linspace(-np.pi, np.pi, M)
+    w = np.zeros(M)
+    for k, coef in enumerate(a):
+        w += coef * np.cos(k * fac)
+    return _truncate(w, needs_trunc)
+
+
+def _general_hamming(M, alpha, sym=True):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+def _hann(M, sym=True):
+    return _general_hamming(M, 0.5, sym)
+
+
+def _hamming(M, sym=True):
+    return _general_hamming(M, 0.54, sym)
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _cosine(M, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    w = np.sin(np.pi / M * (np.arange(0, M) + 0.5))
+    return _truncate(w, needs_trunc)
+
+
+def _triang(M, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    return _truncate(w, needs_trunc)
+
+
+def _bohman(M, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    fac = np.abs(np.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * np.cos(np.pi * fac) + 1.0 / np.pi * np.sin(np.pi * fac)
+    w = np.concatenate([[0.0], w, [0.0]])
+    return _truncate(w, needs_trunc)
+
+
+def _tukey(M, alpha=0.5, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    if alpha <= 0:
+        return np.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym=sym)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M)
+    width = int(np.floor(alpha * (M - 1) / 2.0))
+    n1, n2, n3 = n[: width + 1], n[width + 1: M - width - 1], \
+        n[M - width - 1:]
+    w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = np.ones(n2.shape[0])
+    w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1
+                                    + 2.0 * n3 / alpha / (M - 1))))
+    return _truncate(np.concatenate([w1, w2, w3]), needs_trunc)
+
+
+def _gaussian(M, std=7, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M) - (M - 1.0) / 2.0
+    w = np.exp(-(n ** 2) / (2 * std * std))
+    return _truncate(w, needs_trunc)
+
+
+def _general_gaussian(M, p=1, sig=7, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M) - (M - 1.0) / 2.0
+    w = np.exp(-0.5 * np.abs(n / sig) ** (2 * p))
+    return _truncate(w, needs_trunc)
+
+
+def _exponential(M, center=None, tau=1.0, sym=True):
+    if sym and center is not None:
+        raise ValueError("If sym==True, center must be None.")
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = np.arange(0, M)
+    w = np.exp(-np.abs(n - center) / tau)
+    return _truncate(w, needs_trunc)
+
+
+def _kaiser(M, beta=12.0, sym=True):
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M)
+    alpha = (M - 1) / 2.0
+    w = np.i0(beta * np.sqrt(1 - ((n - alpha) / alpha) ** 2)) / np.i0(beta)
+    return _truncate(w, needs_trunc)
+
+
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True):
+    """Taylor window (SAR sidelobe control; scipy-compatible formula)."""
+    if _len_guards(M):
+        return np.ones(M)
+    M, needs_trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = math.acosh(B) / np.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.zeros(nbar - 1)
+    signs = np.empty_like(ma)
+    signs[::2] = 1
+    signs[1::2] = -1
+    m2 = ma * ma
+    for mi, _ in enumerate(ma):
+        numer = signs[mi] * np.prod(
+            1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+            1 - m2[mi] / m2[mi + 1:])
+        Fm[mi] = numer / denom
+
+    def W(n):
+        return 1 + 2 * np.dot(
+            Fm, np.cos(2 * np.pi * ma[:, None]
+                       * (n - M / 2.0 + 0.5) / M))
+
+    w = W(np.arange(0, M))
+    if norm:
+        scale = 1.0 / W((M - 1) / 2)
+        w *= scale
+    return _truncate(w, needs_trunc)
+
+
+_WINDOWS = {
+    "hann": _hann, "hamming": _hamming, "blackman": _blackman,
+    "cosine": _cosine, "triang": _triang, "bohman": _bohman,
+    "tukey": _tukey, "gaussian": _gaussian,
+    "general_gaussian": _general_gaussian, "exponential": _exponential,
+    "kaiser": _kaiser, "taylor": _taylor,
+}
+
+
+def get_window(window: Union[str, Tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64") -> Tensor:
+    """ref: audio/functional/window.py:335 — returns a window Tensor.
+    `window` is a name or a (name, *params) tuple (e.g. ('gaussian', 7),
+    ('kaiser', 12.0), ('tukey', 0.5), ('taylor', 4, 30))."""
+    sym = not fftbins
+    args: tuple = ()
+    if isinstance(window, tuple):
+        winstr = window[0]
+        if len(window) > 1:
+            args = window[1:]
+    elif isinstance(window, str):
+        if window in ("kaiser", "gaussian", "exponential", "tukey",
+                      "general_gaussian"):
+            # these take defaults here (scipy requires explicit params
+            # for kaiser/gaussian; the reference relaxes to defaults)
+            pass
+        winstr = window
+    else:
+        raise ValueError(f"The window type {type(window)} is not supported")
+    if winstr not in _WINDOWS:
+        raise ValueError(f"Unknown window type: {winstr!r}; supported: "
+                         f"{sorted(_WINDOWS)}")
+    w = _WINDOWS[winstr](win_length, *args, sym=sym)
+    return Tensor(np.asarray(w, dtype=np.dtype(dtype)))

@@ -17,6 +17,7 @@ from .auto_parallel import (ProcessMesh, Replicate, Shard, dtensor_from_fn,  # n
                             reshard, shard_tensor)
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
 
 
 def is_initialized():

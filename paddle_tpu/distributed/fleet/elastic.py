@@ -95,3 +95,101 @@ def scale_np(np_new):
     """ref: distributed/elastic.py:21-43 — request a new world size."""
     os.environ["PADDLE_ELASTIC_NP"] = str(np_new)
     return np_new
+
+
+class ElasticSupervisor:
+    """The relaunch half of the reference ElasticManager (manager.py:124
+    watch loop + :220 relaunch): spawns the worker processes, watches
+    process liveness + heartbeat files, and relaunches the whole pod when
+    membership drops (the reference also restarts every trainer — state
+    continuity comes from checkpoint/resume).
+
+    Used by the launcher under --elastic_level >= 1 and directly by the
+    elastic e2e test."""
+
+    def __init__(self, cmds, envs=None, heartbeat_dir=None, interval=0.5,
+                 max_restarts=3, heartbeat_timeout=None, log=print):
+        self.cmds = list(cmds)
+        self.envs = list(envs) if envs is not None \
+            else [dict(os.environ)] * len(self.cmds)
+        self.dir = heartbeat_dir or os.environ.get(
+            "PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic")
+        self.interval = interval
+        self.max_restarts = max_restarts
+        # hang detection: a rank that HAS written heartbeats (workers
+        # opt in by running ElasticManager.start()) and then goes silent
+        # longer than this is treated as dead even though its process is
+        # alive (deadlocked collective). None -> 20x poll interval.
+        self.heartbeat_timeout = heartbeat_timeout or 20 * interval
+        self.restarts = 0
+        self._procs = []
+        self._log = log
+
+    def _spawn(self):
+        import subprocess
+        os.makedirs(self.dir, exist_ok=True)
+        # stale beats from the previous incarnation must not mask a death
+        for name in os.listdir(self.dir):
+            if name.endswith(".beat"):
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        self._procs = [subprocess.Popen(cmd, env=env)
+                       for cmd, env in zip(self.cmds, self.envs)]
+
+    def _kill_all(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                p.kill()
+
+    def _stale_ranks(self):
+        """Ranks whose heartbeat file exists but went silent for longer
+        than heartbeat_timeout — alive-but-hung workers."""
+        import json
+        stale = []
+        now = time.time()
+        for rank in range(len(self._procs)):
+            path = os.path.join(self.dir, f"rank_{rank}.beat")
+            if not os.path.exists(path):
+                continue  # this worker never opted into heartbeats
+            try:
+                with open(path) as f:
+                    beat = json.load(f)
+                if now - beat["ts"] > self.heartbeat_timeout:
+                    stale.append(rank)
+            except (json.JSONDecodeError, OSError, KeyError):
+                pass  # mid-write; next poll decides
+        return stale
+
+    def run(self) -> int:
+        """Supervise until every worker exits 0 (returns 0) or
+        max_restarts is exhausted (returns the first failed worker's
+        exit code, or 1 when giving up on a hang)."""
+        self._spawn()
+        while True:
+            time.sleep(self.interval)
+            codes = [p.poll() for p in self._procs]
+            if all(c == 0 for c in codes):
+                return 0
+            dead = [i for i, c in enumerate(codes)
+                    if c is not None and c != 0]
+            hung = [] if dead else self._stale_ranks()
+            if dead or hung:
+                if self.restarts >= self.max_restarts:
+                    self._kill_all()
+                    self._log(f"ELASTIC giving up after "
+                              f"{self.restarts} restarts "
+                              f"(dead={dead}, hung={hung})")
+                    return codes[dead[0]] if dead else 1
+                self.restarts += 1
+                self._log(f"ELASTIC worker(s) dead={dead} hung={hung} "
+                          f"(codes={codes}); relaunch #{self.restarts}")
+                self._kill_all()
+                self._spawn()

@@ -64,27 +64,95 @@ def shard_parameters(layer, axis="sharding"):
 class DygraphShardingOptimizer:
     """Stage-1 wrapper (ref: dygraph_sharding_optimizer.py:29): optimizer
     states sharded over the sharding axis; step() delegates to the inner
-    optimizer whose jitted update runs distributed under GSPMD."""
+    optimizer whose jitted update runs distributed under GSPMD.
 
-    def __init__(self, optimizer, hcg=None, **kwargs):
+    offload=True pins optimizer states (and fp32 master weights) in HOST
+    memory (ref: group_sharded_stage3.py:84-96): each step streams one
+    parameter's states H2D, updates on the accelerator, and streams the
+    new states D2H — peak accelerator memory for optimizer state is the
+    largest single parameter, not the sum."""
+
+    def __init__(self, optimizer, hcg=None, offload=False, **kwargs):
         self._inner_opt = optimizer
         self._hcg = hcg
+        self._offload = bool(offload)
         orig_init = optimizer._init_state
 
-        def sharded_init(p):
-            st = orig_init(p)
-            for k, v in st.items():
-                spec = _shard_spec(v.shape)
-                if spec is not None:
-                    st[k] = mesh_mod.shard_tensor_data(v, spec)
-            return st
-        optimizer._init_state = sharded_init
+        if self._offload:
+            if mesh_mod.get_mesh().size > 1:
+                raise NotImplementedError(
+                    "offload=True with a multi-device mesh is not "
+                    "supported yet: host-pinned sharded state needs the "
+                    "TPU memory-kind API end to end. Use offload on "
+                    "single-device ranks, or sharding without offload "
+                    "(states are already partitioned over the sharding "
+                    "axis).")
+            self._host = jax.devices("cpu")[0]
+
+            def offload_init(p):
+                st = orig_init(p)
+                return {k: jax.device_put(v, self._host)
+                        for k, v in st.items()}
+            optimizer._init_state = offload_init
+        else:
+            def sharded_init(p):
+                st = orig_init(p)
+                for k, v in st.items():
+                    spec = _shard_spec(v.shape)
+                    if spec is not None:
+                        st[k] = mesh_mod.shard_tensor_data(v, spec)
+                return st
+            optimizer._init_state = sharded_init
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
     def step(self):
+        if self._offload:
+            return self._offload_step()
         self._inner_opt.step()
+
+    def _offload_step(self):
+        """Per-parameter streamed update with host-resident states.
+        Clip/lr/wd semantics come from the inner optimizer's own
+        _prepare_step/_param_meta — no duplicated update plumbing."""
+        from ....framework import autograd
+        opt = self._inner_opt
+        with autograd.no_grad():
+            prepared = opt._prepare_step()
+            if prepared is None:
+                return
+            params_grads, lr, step = prepared
+            compute_dev = params_grads[0][0].data.devices().pop()
+
+            for p, g in params_grads:
+                st, master, meta = opt._param_meta(p)
+                if master is not None and \
+                        self._host not in master.devices():
+                    master = jax.device_put(master, self._host)
+                p_arr = master if master is not None else p.data
+                key = ("offload", tuple(p_arr.shape), str(p_arr.dtype),
+                       meta, opt._extra_cache_key())
+                fn = opt._jit_cache.get(key)
+                if fn is None:
+                    fn = jax.jit(opt._make_fused([meta]))
+                    opt._jit_cache[key] = fn
+                # H2D stream: this parameter's states only
+                st_dev = {k: jax.device_put(v, compute_dev)
+                          for k, v in st.items()}
+                p_dev = jax.device_put(p_arr, compute_dev)
+                new_ps, new_sts = fn([p_dev], [g.data], [st_dev], lr, step)
+                new_p, new_st = new_ps[0], new_sts[0]
+                if master is not None:
+                    opt._master_weights[p.name] = jax.device_put(
+                        new_p, self._host)
+                    p._data = new_p.astype(p.dtype)
+                else:
+                    p._data = new_p
+                # D2H: states go back to host memory
+                opt._accumulators[p.name] = {
+                    k: jax.device_put(v, self._host)
+                    for k, v in new_st.items()}
 
     def minimize(self, *a, **kw):
         return self._inner_opt.minimize(*a, **kw)
@@ -96,7 +164,7 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     already reduce-scatters when the consumer (the update) is sharded."""
 
     def __init__(self, params, optim, group=None, offload=False, **kw):
-        super().__init__(optim)
+        super().__init__(optim, offload=offload)
         self._params = params
 
 
@@ -123,9 +191,14 @@ class GroupShardedStage3:
                  device="tpu", segment_size=2**20, pertrain_sync_models=True,
                  offload=False, **kw):
         self._layer = shard_parameters(layer)
-        self._opt = optimizer
-        if optimizer is not None:
-            shard_accumulators(optimizer)
+        if offload and optimizer is not None:
+            # host-resident states, streamed per-param (see stage-1 wrapper)
+            optimizer = DygraphShardingOptimizer(optimizer, offload=True)
+            self._opt = optimizer
+        else:
+            self._opt = optimizer
+            if optimizer is not None:
+                shard_accumulators(optimizer)
 
     def __call__(self, *args, **kwargs):
         return self._layer(*args, **kwargs)
@@ -148,15 +221,17 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            exclude_layer=None):
     """ref: python/paddle/distributed/sharding/group_sharded.py."""
     if level == "os":
-        opt = DygraphShardingOptimizer(optimizer)
+        opt = DygraphShardingOptimizer(optimizer, offload=offload)
         return model, opt, scaler
     if level == "os_g":
-        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          offload=offload)
         wrapped = GroupShardedStage2(model, opt)
         return wrapped, opt, scaler
     if level == "p_g_os":
-        wrapped = GroupShardedStage3(model, optimizer)
-        return wrapped, optimizer, scaler
+        wrapped = GroupShardedStage3(model, optimizer, offload=offload)
+        stage3_opt = wrapped._opt if offload else optimizer
+        return wrapped, stage3_opt, scaler
     raise ValueError(f"unknown group_sharded level {level}")
 
 

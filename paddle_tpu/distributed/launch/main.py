@@ -56,6 +56,20 @@ def launch():
         os.environ.setdefault("MASTER_ADDR", host)
         os.environ.setdefault("MASTER_PORT", port)
 
+    if args.elastic_level >= 1 and (args.nproc_per_node or 1) > 1:
+        # supervisor mode (ref ElasticManager relaunch, manager.py:220):
+        # spawn nproc workers, relaunch the pod when one dies
+        from ..fleet.elastic import ElasticSupervisor
+        nproc = args.nproc_per_node
+        cmds, envs = [], []
+        for r in range(nproc):
+            env = dict(os.environ)
+            env["PADDLE_TRAINER_ID"] = str(r)
+            env["PADDLE_TRAINERS_NUM"] = str(nproc)
+            cmds.append([sys.executable, args.script] + args.script_args)
+            envs.append(env)
+        sys.exit(ElasticSupervisor(cmds, envs).run())
+
     if args.master and nnodes > 1:
         import jax
         jax.distributed.initialize(args.master, num_processes=nnodes,

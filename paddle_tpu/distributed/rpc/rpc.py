@@ -1,0 +1,284 @@
+"""RPC between training workers (ref: /root/reference/python/paddle/
+distributed/rpc/rpc.py — init_rpc:73, rpc_sync:141, rpc_async:179,
+barrier/store plumbing :38-71).
+
+The reference builds this on brpc + a TCPStore master. TPU-native rebuild:
+the control plane stays entirely on the host (RPC never touches the
+device graph), so this is a pure-Python implementation over
+multiprocessing.connection — a rank-0 registry Listener plays the
+reference's master store, and each worker serves calls on its own
+Listener in a daemon thread. Works same-host and cross-host (TCP), authenticated with a
+shared authkey derived from the master endpoint.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, List, Optional
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+
+_state: Dict[str, Any] = {
+    "inited": False, "name": None, "rank": None, "world_size": None,
+    "workers": {}, "service": None, "master": None, "authkey": None,
+    "pool": None,
+}
+
+
+def _auth(master_endpoint: str) -> bytes:
+    return ("paddle_tpu_rpc:" + master_endpoint).encode()
+
+
+class _MasterRegistry(threading.Thread):
+    """Rank-0 registry: collects WorkerInfos, hands the full table to each
+    worker once all ranks registered (the reference's barrier store)."""
+
+    def __init__(self, endpoint, world_size, authkey):
+        super().__init__(daemon=True)
+        ip, port = endpoint.rsplit(":", 1)
+        self._listener = Listener((ip, int(port)), authkey=authkey)
+        self._world = world_size
+        self._infos: Dict[int, WorkerInfo] = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._barrier_count = 0
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            msg = conn.recv()
+            if msg[0] == "register":
+                info = WorkerInfo(*msg[1])
+                with self._cv:
+                    self._infos[info.rank] = info
+                    self._cv.notify_all()
+                    self._cv.wait_for(
+                        lambda: len(self._infos) >= self._world
+                        or self._stop)
+                conn.send(sorted(self._infos.values(),
+                                 key=lambda w: w.rank))
+            elif msg[0] == "barrier":
+                # shutdown barrier (the reference's barrier store): no
+                # worker tears its service down before every worker is
+                # done issuing RPCs
+                with self._cv:
+                    self._barrier_count += 1
+                    self._cv.notify_all()
+                    self._cv.wait_for(
+                        lambda: self._barrier_count >= self._world
+                        or self._stop)
+                conn.send("go")
+            elif msg[0] == "stop":
+                self.stop()
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class _Service(threading.Thread):
+    """Per-worker request server: recv (fn, args, kwargs) → run → reply."""
+
+    def __init__(self, authkey, bind_ip="127.0.0.1"):
+        super().__init__(daemon=True)
+        self._listener = Listener((bind_ip, 0), authkey=authkey)
+        self.port = self._listener.address[1]
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            fn, args, kwargs = conn.recv()
+            try:
+                result = fn(*args, **kwargs)
+                conn.send(("ok", result))
+            except Exception as e:  # ship the failure to the caller
+                conn.send(("err", e))
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """ref rpc.py:73 — start this worker's service and rendezvous through
+    the master registry. rank/world_size/master_endpoint fall back to
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER_ENDPOINT."""
+    if _state["inited"]:
+        raise RuntimeError("init_rpc called twice")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", -1)) \
+        if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", -1)) \
+        if world_size is None else world_size
+    master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT",
+                                     master_endpoint) \
+        if master_endpoint is None else master_endpoint
+    if rank < 0 or world_size <= 0 or not master_endpoint:
+        raise ValueError("init_rpc needs name, rank, world_size and "
+                         "master_endpoint (args or PADDLE_* env)")
+    authkey = _auth(master_endpoint)
+
+    master = None
+    if rank == 0:
+        master = _MasterRegistry(master_endpoint, world_size, authkey)
+        master.start()
+
+    # cross-host: when the master is not loopback, advertise the IP this
+    # host uses to reach it (overridable with PADDLE_LOCAL_IP) and bind
+    # the service on all interfaces so peers can dial in
+    mhost = master_endpoint.rsplit(":", 1)[0]
+    loopback = mhost in ("127.0.0.1", "localhost", "::1")
+    my_ip = os.environ.get("PADDLE_LOCAL_IP")
+    if my_ip is None:
+        if loopback:
+            my_ip = "127.0.0.1"
+        else:
+            import socket
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((mhost, 1))
+                my_ip = s.getsockname()[0]
+    service = _Service(authkey,
+                       bind_ip="127.0.0.1" if loopback else "0.0.0.0")
+    service.start()
+    info = (name, rank, my_ip, service.port)
+
+    # register with the master (retry while rank 0 comes up)
+    mhost, mport = master_endpoint.rsplit(":", 1)
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    workers: List[WorkerInfo] = []
+    while True:
+        try:
+            conn = Client((mhost, int(mport)), authkey=authkey)
+            conn.send(("register", info))
+            workers = conn.recv()
+            conn.close()
+            break
+        except (ConnectionError, OSError):
+            if time.time() > deadline:
+                service.stop()
+                raise TimeoutError(
+                    f"init_rpc: cannot reach master {master_endpoint}")
+            time.sleep(0.05)
+
+    _state.update(inited=True, name=name, rank=rank,
+                  world_size=world_size, service=service, master=master,
+                  authkey=authkey, master_endpoint=master_endpoint,
+                  workers={w.name: w for w in workers},
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def _require_init():
+    if not _state["inited"]:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout=_DEFAULT_RPC_TIMEOUT):
+    """ref rpc.py:141 — run fn(*args, **kwargs) on worker `to`, return the
+    result (raises the remote exception locally)."""
+    _require_init()
+    w = _state["workers"].get(to)
+    if w is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_state['workers'])}")
+    conn = Client((w.ip, w.port), authkey=_state["authkey"])
+    try:
+        conn.send((fn, tuple(args or ()), dict(kwargs or {})))
+        if timeout and timeout > 0 and not conn.poll(timeout):
+            raise TimeoutError(f"rpc to {to!r} timed out after {timeout}s")
+        status, payload = conn.recv()
+    finally:
+        conn.close()
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_RPC_TIMEOUT):
+    """ref rpc.py:179 — returns a future with wait()/result()."""
+    _require_init()
+    fut = _state["pool"].submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle's FutureWrapper API
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _require_init()
+    return _state["workers"][name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    _require_init()
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _require_init()
+    return _state["workers"][_state["name"]]
+
+
+def shutdown():
+    """ref rpc.py shutdown — barrier across workers (nobody stops serving
+    while a peer may still call in), then stop the service (and the
+    registry on rank 0)."""
+    if not _state["inited"]:
+        return
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=True)
+    if _state["world_size"] > 1:
+        mhost, mport = _state["master_endpoint"].rsplit(":", 1)
+        try:
+            conn = Client((mhost, int(mport)), authkey=_state["authkey"])
+            conn.send(("barrier",))
+            conn.recv()
+            conn.close()
+        except (ConnectionError, OSError, EOFError):
+            pass  # master already gone: best effort
+    if _state["service"] is not None:
+        _state["service"].stop()
+    if _state["master"] is not None:
+        _state["master"].stop()
+    _state.update(inited=False, name=None, rank=None, world_size=None,
+                  workers={}, service=None, master=None, authkey=None,
+                  pool=None)

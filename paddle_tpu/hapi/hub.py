@@ -1,0 +1,101 @@
+"""Model hub (ref: /root/reference/python/paddle/hapi/hub.py — list:175,
+help:223, load:263 over a repo's hubconf.py entrypoint protocol).
+
+Zero-egress build: source='local' is fully supported (same hubconf.py
+contract as the reference); 'github'/'gitee' sources raise with download
+instructions instead of fetching.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _remote_error(source, repo):
+    return RuntimeError(
+        f"hub source {source!r} needs network access, which this "
+        f"zero-egress TPU build does not perform. Clone the repo "
+        f"locally (git clone https://github.com/{repo}) and call with "
+        f"source='local', repo_dir=<clone path>.")
+
+
+def _import_module(name, repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {MODULE_HUBCONF} found in {repo_dir!r} — a hub repo "
+            f"must define its entrypoints there (ref hub protocol)")
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = []
+        for pkg in deps:
+            if importlib.util.find_spec(pkg) is None:
+                missing.append(pkg)
+        if missing:
+            raise RuntimeError(
+                f"hubconf dependencies missing: {missing}")
+
+
+def _get_repo_dir(repo_dir, source, force_reload):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected 'local', 'github' or "
+            f"'gitee'")
+    if source != "local":
+        raise _remote_error(source, repo_dir)
+    if not os.path.isdir(repo_dir):
+        raise FileNotFoundError(f"local hub repo {repo_dir!r} not found")
+    return repo_dir
+
+
+def _load_entry_from_hubconf(m, name):
+    if not isinstance(name, str):
+        raise ValueError("model name must be a string of function name")
+    entry = getattr(m, name, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return entry
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """ref hub.py:175 — names of all entrypoints in the repo's
+    hubconf.py."""
+    repo_dir = _get_repo_dir(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """ref hub.py:223 — the entrypoint's docstring."""
+    repo_dir = _get_repo_dir(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    entry = _load_entry_from_hubconf(m, model)
+    return entry.__doc__
+
+
+def load(repo_dir, model, *args, source="github", force_reload=False,
+         **kwargs):
+    """ref hub.py:263 — call the entrypoint and return its model."""
+    repo_dir = _get_repo_dir(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    _check_dependencies(m)
+    entry = _load_entry_from_hubconf(m, model)
+    return entry(*args, **kwargs)

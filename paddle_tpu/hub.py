@@ -1,0 +1,4 @@
+"""paddle.hub (ref: /root/reference/python/paddle/hub.py)."""
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

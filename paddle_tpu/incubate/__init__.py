@@ -1,7 +1,10 @@
 """paddle.incubate (ref: /root/reference/python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from . import moe  # noqa: F401
+from . import autotune  # noqa: F401
+from . import optimizer  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 
 class distributed:

@@ -1,0 +1,68 @@
+"""paddle.incubate.autotune (ref: /root/reference/python/paddle/incubate/
+autotune.py:24 set_config).
+
+TPU mapping: the reference's exhaustive cuDNN-algorithm search and
+NCHW/NHWC layout tuning are jobs XLA already performs at compile time
+(Mosaic/XLA autotune convolutions and pick layouts during lowering), so
+'kernel' and 'layout' tuning are accepted and recorded but have no
+runtime switch to flip. 'dataloader' tuning maps to the DataLoader
+prefetch thread pool: when enabled, num_workers=0/None loaders pick a
+worker count from os.cpu_count().
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+__all__ = ["set_config"]
+
+_CONFIG = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False},
+}
+
+
+def get_config():
+    return {k: dict(v) for k, v in _CONFIG.items()}
+
+
+def suggested_num_workers():
+    """Dataloader tuning hook: paddle_tpu.io.DataLoader consults this when
+    autotuning is enabled and num_workers is unset."""
+    if not _CONFIG["dataloader"]["enable"]:
+        return None
+    return max(2, min(8, (os.cpu_count() or 2) // 2))
+
+
+def set_config(config=None):
+    """ref autotune.py:24 — accepts a dict, a json file path, or None
+    (None enables everything)."""
+    if config is None:
+        for section in _CONFIG.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config, "r") as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise ValueError(
+            f"config must be None, a dict, or a json file path; got "
+            f"{type(config)}")
+    for key, val in config.items():
+        if key not in _CONFIG:
+            warnings.warn(f"autotune config key {key!r} ignored "
+                          f"(supported: {sorted(_CONFIG)})")
+            continue
+        if not isinstance(val, dict):
+            raise ValueError(f"autotune config[{key!r}] must be a dict")
+        if "enable" in val:
+            if not isinstance(val["enable"], bool):
+                raise ValueError(f"config[{key!r}]['enable'] must be bool")
+            _CONFIG[key]["enable"] = val["enable"]
+        if key == "kernel" and "tuning_range" in val:
+            rng = list(val["tuning_range"])
+            if len(rng) != 2:
+                raise ValueError("tuning_range must be [start, end]")
+            _CONFIG["kernel"]["tuning_range"] = rng

@@ -1,0 +1,215 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage.
+
+ref: /root/reference/python/paddle/incubate/optimizer/lookahead.py:25 and
+modelaverage.py:27 (accumulation semantics from the phi kernel,
+/root/reference/paddle/phi/kernels/impl/average_accumulates_kernel_impl.h:104).
+
+Both wrap an inner training loop with extra per-parameter slow state;
+the update math is a handful of fused element-wise ops that XLA folds
+into the optimizer dispatch.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ...framework import autograd
+from ...framework.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+# the reference rotates sum_1 into sum_2 every 16384 accumulations to
+# bound fp error (average_accumulates_op.cc kMaxNumAccumulates)
+_MAX_NUM_ACCUMULATES = 16384
+
+
+class LookAhead(Optimizer):
+    """Lookahead (https://arxiv.org/abs/1907.08610, ref lookahead.py:25):
+    the inner optimizer updates fast params every step; every k steps the
+    slow params take an alpha-step toward the fast params and the fast
+    params reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not isinstance(k, int) or k <= 0:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self.type = "lookahead"
+        self._k_step = 0
+        self._slow: dict = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def _parameter_list_flat(self):
+        return self.inner_optimizer._parameter_list_flat()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, lr):
+        return self.inner_optimizer.set_lr(lr)
+
+    @autograd.no_grad()
+    def step(self):
+        if not self._slow:
+            # slow params start at the INITIAL fast params (paper §2)
+            for p in self.inner_optimizer._parameter_list_flat():
+                if not p.stop_gradient:
+                    self._slow[p.name] = p.data
+        self.inner_optimizer.step()
+        self._k_step += 1
+        if self._k_step % self.k != 0:
+            return
+        alpha = self.alpha
+        for p in self.inner_optimizer._parameter_list_flat():
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(p.name, p.data)
+            new_slow = slow + alpha * (p.data - slow)
+            self._slow[p.name] = new_slow
+            p._data = new_slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_slow"] = {k: Tensor(v) for k, v in self._slow.items()}
+        sd["lookahead_k_step"] = self._k_step
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        slow = sd.pop("lookahead_slow", {})
+        self._slow = {k: (v.data if isinstance(v, Tensor) else jnp.asarray(
+            v)) for k, v in slow.items()}
+        self._k_step = int(sd.pop("lookahead_k_step", 0))
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """ref modelaverage.py:27 + average_accumulates kernel: accumulate a
+    sliding-window sum of parameters during training; `apply()` swaps in
+    the window average for evaluation, `restore()` swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self.type = "average_accumulates"
+        self._acc: dict = {}
+        self._restore_vals: dict = {}
+
+    def _state_for(self, p):
+        st = self._acc.get(p.name)
+        if st is None:
+            z = jnp.zeros(p.data.shape, jnp.float32)
+            st = {"sum_1": z, "sum_2": z, "sum_3": z, "num_accumulates": 0,
+                  "old_num_accumulates": 0, "num_updates": 0}
+            self._acc[p.name] = st
+        return st
+
+    @autograd.no_grad()
+    def step(self):
+        """Accumulate current params (call alongside the inner optimizer's
+        step, ref modelaverage.py examples)."""
+        for p in self._parameter_list_flat():
+            if p.stop_gradient:
+                continue
+            st = self._state_for(p)
+            st["num_updates"] += 1
+            st["num_accumulates"] += 1
+            # accumulator state stays RAW jnp arrays (never Tensors):
+            # apply() would wrap, and a wrapped array assigned back into
+            # p._data at apply() time poisons every later op
+            st["sum_1"] = st["sum_1"] + p.data.astype(jnp.float32)
+            if st["num_updates"] % _MAX_NUM_ACCUMULATES == 0:
+                st["sum_2"] = st["sum_2"] + st["sum_1"]
+                st["sum_1"] = jnp.zeros_like(st["sum_1"])
+            if st["num_accumulates"] >= self.min_window and \
+                    st["num_accumulates"] >= min(
+                        self.max_window,
+                        st["num_updates"] * self.avg_rate):
+                st["sum_3"] = st["sum_1"] + st["sum_2"]
+                st["sum_1"] = jnp.zeros_like(st["sum_1"])
+                st["sum_2"] = jnp.zeros_like(st["sum_2"])
+                st["old_num_accumulates"] = st["num_accumulates"]
+                st["num_accumulates"] = 0
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return [], []
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap the window-averaged parameters in (context manager)."""
+        with autograd.no_grad():
+            for p in self._parameter_list_flat():
+                if p.stop_gradient or p.name not in self._acc:
+                    continue
+                st = self._acc[p.name]
+                total_n = st["num_accumulates"] + st["old_num_accumulates"]
+                if total_n == 0:
+                    continue
+                self._restore_vals[p.name] = p.data
+                avg = (jnp.asarray(st["sum_1"]) + jnp.asarray(st["sum_2"])
+                       + jnp.asarray(st["sum_3"])) / total_n
+                p._data = avg.astype(p.data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        """Swap the live training parameters back."""
+        with autograd.no_grad():
+            for p in self._parameter_list_flat():
+                if p.name in self._restore_vals:
+                    p._data = self._restore_vals.pop(p.name)
+
+    def state_dict(self):
+        out = {}
+        for name, st in self._acc.items():
+            for k, v in st.items():
+                out[f"{name}.{k}"] = v if isinstance(v, int) else Tensor(v)
+        return out
+
+    def set_state_dict(self, state):
+        """Restore accumulation state saved by state_dict (the base
+        Optimizer's loader cannot parse the '<param>.<field>' keys)."""
+        acc: dict = {}
+        for key, v in dict(state).items():
+            name, _, field = key.rpartition(".")
+            if not name:
+                continue
+            st = acc.setdefault(name, {})
+            if field in ("num_accumulates", "old_num_accumulates",
+                         "num_updates"):
+                st[field] = int(v.numpy()) if isinstance(v, Tensor) \
+                    else int(v)
+            else:
+                st[field] = v.data if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+        self._acc = acc

@@ -3,11 +3,28 @@ analysis_predictor.cc — AnalysisPredictor::Run:1071, ZeroCopyRun:2044;
 python surface python/paddle/inference/).
 
 The reference's deployment pipeline (analysis passes → IR fusions → TRT
-subgraphs → NaiveExecutor) maps to: load the saved program, jit it once,
-run — XLA is the analysis+fusion pipeline. The Config/Predictor/handle API
-is preserved."""
+subgraphs → NaiveExecutor) maps to: load the saved program, capture it
+under one jit (XLA is the analysis+fusion pipeline), run. Config knobs
+route to real behavior:
+
+  * switch_ir_optim(True)   → forward captured via jit.to_static (one
+                              fused XLA program). False = eager per-op
+                              dispatch (the reference's un-fused
+                              NaiveExecutor mode, useful for debugging).
+  * enable_tpu(precision)   → Bfloat16/Half casts parameters, buffers and
+                              float inputs to the serving dtype; Int8
+                              rewrites FusedMultiTransformer blocks to
+                              FusedMultiTransformerInt8 (weight-only MXU
+                              int8, ref fused_multi_transformer_int8_op).
+  * enable_memory_optim()   → host input staging buffers are dropped
+                              after each run and outputs are fetched
+                              straight to host (no device-side cache) —
+                              the reference's memory-optimize pass frees
+                              activation buffers the same way.
+"""
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -38,6 +55,9 @@ class Config:
         self._use_tpu = True
         self._precision = PrecisionType.Float32
         self._memory_pool_mb = 0
+        self._memory_optim = False
+        self._ir_optim = True
+        self._cpu_threads = None
 
     def set_prog_file(self, path):
         self.prog_file = path
@@ -57,17 +77,30 @@ class Config:
         pass
 
     def enable_memory_optim(self, flag=True):
-        pass
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self):
+        return self._memory_optim
 
     def switch_ir_optim(self, flag=True):
-        pass
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
 
     def enable_tensorrt_engine(self, *a, **kw):
-        # TensorRT is CUDA-only; XLA applies its own fusion. Accepted no-op.
-        pass
+        # TensorRT is CUDA-only; XLA applies its own fusion. Accepted
+        # no-op — precision still routes through enable_tpu/enable_use_gpu.
+        precision = kw.get("precision_mode", kw.get("precision"))
+        if precision is not None:
+            self._precision = precision
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        # XLA host thread pools are fixed at backend init; record the
+        # request so launchers can export it before process start.
+        self._cpu_threads = int(n)
+        import os
+        os.environ["PADDLE_TPU_HOST_THREADS"] = str(int(n))
 
 
 class _Handle:
@@ -89,6 +122,36 @@ class _Handle:
         self.copy_from_cpu(np.asarray(data))
 
 
+def _cast_layer_floats(layer, np_dtype):
+    """Serving-precision cast: parameters + float buffers."""
+    from ..framework import autograd
+    with autograd.no_grad():
+        for p in layer.parameters():
+            if np.issubdtype(np.dtype(str(p.data.dtype)), np.floating):
+                p._data = p.data.astype(np_dtype)
+        for b in layer.buffers():
+            if b is not None and hasattr(b, "data") and \
+                    np.issubdtype(np.dtype(str(b.data.dtype)),
+                                  np.floating):
+                b._data = b.data.astype(np_dtype)
+
+
+def _quantize_fused_blocks(layer):
+    """Int8 precision: rewrite FusedMultiTransformer children to the
+    weight-only int8 variant. Returns how many blocks were rewritten."""
+    from ..incubate.nn.fused_transformer import (FusedMultiTransformer,
+                                                 FusedMultiTransformerInt8)
+    count = 0
+    for owner in [layer] + [l for _, l in layer.named_sublayers()]:
+        for name, child in list(getattr(owner, "_sub_layers", {}).items()):
+            if isinstance(child, FusedMultiTransformer) and \
+                    not isinstance(child, FusedMultiTransformerInt8):
+                setattr(owner, name,
+                        FusedMultiTransformerInt8.from_float(child))
+                count += 1
+    return count
+
+
 class Predictor:
     """Runs a paddle_tpu.jit-saved model (ref AnalysisPredictor)."""
 
@@ -103,6 +166,30 @@ class Predictor:
         self._input_names = ["input_" + str(i) for i in range(8)]
         self._output_names: List[str] = []
         self._precision = config._precision
+        self._memory_optim = config._memory_optim
+        self._ir_optim = config._ir_optim
+        self._np_dtype = np.float32
+
+        inner = self._layer._inner
+        if self._precision == PrecisionType.Bfloat16:
+            import jax.numpy as jnp
+            self._np_dtype = jnp.bfloat16
+            _cast_layer_floats(inner, self._np_dtype)
+        elif self._precision == PrecisionType.Half:
+            self._np_dtype = np.float16
+            _cast_layer_floats(inner, self._np_dtype)
+        elif self._precision == PrecisionType.Int8:
+            n = _quantize_fused_blocks(inner)
+            if n == 0:
+                warnings.warn(
+                    "PrecisionType.Int8: no FusedMultiTransformer blocks "
+                    "found to quantize; running float (per-layer PTQ "
+                    "lives in paddle.quantization)")
+        if self._ir_optim:
+            # the analysis/fusion pipeline: one compiled XLA program
+            self._runner = jit.to_static(inner)
+        else:
+            self._runner = inner
 
     def get_input_names(self):
         return self._input_names
@@ -116,20 +203,30 @@ class Predictor:
     def get_output_handle(self, name):
         return _Handle(name, self, False)
 
+    def _wrap_input(self, a):
+        arr = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+        if self._precision in (PrecisionType.Bfloat16, PrecisionType.Half) \
+                and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(self._np_dtype)
+        return Tensor(arr)
+
     def run(self, inputs: Optional[List] = None):
         if inputs is not None:
-            args = [Tensor(np.asarray(
-                a.numpy() if hasattr(a, "numpy") else a)) for a in inputs]
+            args = [self._wrap_input(a) for a in inputs]
         else:
-            args = [Tensor(self._inputs[n]) for n in self._input_names
-                    if n in self._inputs]
+            args = [self._wrap_input(self._inputs[n])
+                    for n in self._input_names if n in self._inputs]
         from ..framework.autograd import no_grad
         with no_grad():
-            out = self._layer(*args)
+            out = self._runner(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._output_names = [f"output_{i}" for i in range(len(outs))]
-        self._outputs = {n: o.numpy() for n, o in zip(self._output_names,
-                                                      outs)}
+        self._outputs = {n: np.asarray(o.numpy())
+                         for n, o in zip(self._output_names, outs)}
+        if self._memory_optim:
+            # free the host staging copies; device buffers die with the
+            # last Tensor reference when `outs`/`args` go out of scope
+            self._inputs.clear()
         if inputs is not None:
             return [self._outputs[n] for n in self._output_names]
         return True

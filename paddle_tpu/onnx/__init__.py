@@ -1,0 +1,4 @@
+"""paddle.onnx (ref: /root/reference/python/paddle/onnx/export.py)."""
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

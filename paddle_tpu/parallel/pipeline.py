@@ -72,6 +72,24 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
     the 'sep' ring-attention axis must become manual HERE when sequence
     parallelism runs inside a pipeline stage). x_spec: PartitionSpec of
     x_micro over those manual axes.
+
+    Scope / constraints (design contract, not accidental limits):
+      * Every stage runs the SAME stage_fn on params slices with a
+        uniform activation shape/dtype — the homogeneous-decoder-stack
+        regime (Llama/GPT/BERT bodies). Embedding and head live OUTSIDE
+        the pipeline region (they shard over 'mp', not 'pp'), mirroring
+        the reference's SharedLayerDesc tied-embedding treatment
+        (pp_layers.py:76).
+      * Heterogeneous stages (encoder→decoder handoff, uneven layer
+        cuts, per-stage activation shapes) need one spmd_pipeline region
+        per homogeneous segment, glued by ordinary jnp ops: the compiled
+        collective-permute schedule requires a static, uniform carry.
+        This trades the reference's fully-general actor pipeline
+        (fleet_executor) for an XLA-schedulable one.
+      * Interleaved virtual stages require n_micro % n_stages == 0
+        (raised below) — same divisibility the reference's
+        PipelineParallelWithInterleave enforces
+        (pipeline_parallel.py:551).
     """
     mesh = mesh_mod.get_mesh()
     n_stages = mesh.shape[axis]

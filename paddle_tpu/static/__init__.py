@@ -138,9 +138,20 @@ def load_inference_model(path_prefix, executor, **kwargs):
     return [program, payload["feed_names"], payload["fetch_vars"]]
 
 
+from .control_flow import (cond, while_loop, case,  # noqa: F401,E402
+                           switch_case, Print)
+
+
 class nn:
     """Minimal paddle.static.nn facade — modern static code uses paddle.nn
-    layers directly; these exist for legacy-style scripts."""
+    layers directly; these exist for legacy-style scripts. Control flow
+    (cond/while_loop/case/switch_case) lives in control_flow.py and lowers
+    to XLA lax control flow under @to_static."""
+
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):

@@ -1,0 +1,320 @@
+"""Control-flow ops: cond / while_loop / case / switch_case.
+
+ref: /root/reference/python/paddle/static/nn/control_flow.py (cond:877,
+while_loop:405, case:568, switch_case:701). The reference lowers these to
+ConditionalBlock/While ops inside the ProgramDesc; the dy2static AST pass
+(program_translator.py:304) rewrites Python `if`/`while` on tensor values
+into them.
+
+TPU-native design — three execution modes, one API:
+  * eager (concrete pred): plain Python branch/loop; the autograd tape
+    records whichever branch ran, exactly like reference dygraph.
+  * traced (inside @to_static / jit — pred is a jax tracer):
+    `lax.cond` / `lax.while_loop` / `lax.switch`, XLA's native control
+    flow. Gradients flow because to_static differentiates the whole
+    captured program with jax.vjp.
+  * symbolic static-graph mode (pred is a SymbolicTensor): cond/case/
+    switch_case build BOTH branch subgraphs and select the result
+    (pure-op semantics; XLA dead-code-eliminates what the select
+    discards where possible). while_loop requires the traced path and
+    says so.
+
+There is deliberately no AST rewriting: raw Python `if float(x) > 0`
+under to_static raises Dy2StaticError (jit/__init__.py) naming this
+module as the fix.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.symbolic import SymbolicTensor
+from ..framework.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Print"]
+
+
+def _flatten(obj):
+    """Flatten nests of Tensor/SymbolicTensor; non-tensor leaves are
+    literals that must agree across branches."""
+    leaves: List[Any] = []
+
+    def walk(o):
+        if isinstance(o, (Tensor, SymbolicTensor)):
+            leaves.append(o)
+            return ("T", len(leaves) - 1)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [walk(v) for v in o])
+        if isinstance(o, dict):
+            return ("dict", {k: walk(v) for k, v in sorted(o.items())})
+        return ("L", o)
+
+    tree = walk(obj)
+    return leaves, tree
+
+
+def _unflatten(tree, leaves):
+    kind = tree[0]
+    if kind == "T":
+        return leaves[tree[1]]
+    if kind in ("list", "tuple"):
+        seq = [_unflatten(t, leaves) for t in tree[1]]
+        return seq if kind == "list" else tuple(seq)
+    if kind == "dict":
+        return {k: _unflatten(t, leaves) for k, t in tree[1].items()}
+    return tree[1]
+
+
+def _pred_array(pred):
+    if isinstance(pred, SymbolicTensor):  # subclass of Tensor: check first
+        return pred
+    if isinstance(pred, Tensor):
+        return pred.data
+    return pred  # python bool / numpy
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _branch_mismatch(name, t_tree, f_tree):
+    raise ValueError(
+        f"paddle.static.nn.{name}: true_fn and false_fn must return the "
+        f"same structure of tensors (ref control_flow.py cond() contract); "
+        f"got {t_tree!r} vs {f_tree!r}. Make both branches return "
+        f"matching nests — pad with paddle.zeros_like where a branch has "
+        f"no natural value.")
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None, return_names=None):
+    """ref: static/nn/control_flow.py:877. Runs true_fn() if pred else
+    false_fn(); both must return the same nest of tensors."""
+    arr = _pred_array(pred)
+
+    # --- symbolic static-graph mode: evaluate both, select -------------
+    if isinstance(arr, SymbolicTensor):
+        t_out = true_fn() if true_fn is not None else None
+        f_out = false_fn() if false_fn is not None else None
+        t_leaves, t_tree = _flatten(t_out)
+        f_leaves, f_tree = _flatten(f_out)
+        if repr(t_tree) != repr(f_tree):
+            _branch_mismatch("cond", t_tree, f_tree)
+        from ..framework.op import apply
+
+        def select(p, *arrays):
+            n = len(arrays) // 2
+            p = jnp.reshape(p, ()).astype(bool)
+            return tuple(jnp.where(p, a, b)
+                         for a, b in zip(arrays[:n], arrays[n:]))
+        out = apply(select, (pred, *t_leaves, *f_leaves), op_name="cond")
+        out = out if isinstance(out, tuple) else (out,)
+        return _unflatten(t_tree, list(out))
+
+    # --- eager: concrete pred ------------------------------------------
+    if not _is_traced(arr):
+        if bool(np.asarray(arr)):
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    # --- traced: lax.cond ----------------------------------------------
+    trees = {}
+
+    def make(fn, key):
+        def run(_):
+            out = fn() if fn is not None else None
+            leaves, tree = _flatten(out)
+            trees[key] = tree
+            return tuple(jnp.asarray(t.data if isinstance(t, Tensor)
+                                     else t) for t in leaves)
+        return run
+
+    p = jnp.reshape(arr, ()).astype(bool)
+    try:
+        res = jax.lax.cond(p, make(true_fn, "t"), make(false_fn, "f"),
+                           None)
+    except TypeError as e:
+        if "t" in trees and "f" in trees \
+                and repr(trees["t"]) != repr(trees["f"]):
+            _branch_mismatch("cond", trees["t"], trees["f"])
+        raise
+    if repr(trees["t"]) != repr(trees["f"]):
+        _branch_mismatch("cond", trees["t"], trees["f"])
+    return _unflatten(trees["t"], [Tensor(a) for a in res])
+
+
+def while_loop(cond_fn: Callable, body: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """ref: static/nn/control_flow.py:405. loop_vars is a list; body
+    returns the next loop_vars (same shapes/dtypes — XLA requirement,
+    same as the reference's While block contract)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("while_loop: loop_vars must be a non-empty "
+                         "list/tuple")
+    leaves, tree = _flatten(list(loop_vars))
+    if any(isinstance(l, SymbolicTensor) for l in leaves):
+        raise NotImplementedError(
+            "paddle.static.nn.while_loop under build-time static graph "
+            "mode is not supported on the TPU backend: data-dependent "
+            "loops need tracing. Run the enclosing function through "
+            "@paddle.jit.to_static (the dy2static path), which lowers "
+            "this loop to XLA lax.while_loop.")
+
+    first = cond_fn(*loop_vars)
+    if isinstance(first, SymbolicTensor):
+        raise NotImplementedError(
+            "while_loop condition depends on build-time static-graph "
+            "values; run the enclosing function through "
+            "@paddle.jit.to_static instead")
+    first_arr = first.data if isinstance(first, Tensor) else first
+    traced = _is_traced(first_arr) or any(
+        _is_traced(l.data) for l in leaves if isinstance(l, Tensor))
+
+    if not traced:
+        # eager Python loop (reference dygraph behavior)
+        vars_ = list(loop_vars)
+        keep = bool(np.asarray(first_arr))
+        while keep:
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+            if len(vars_) != len(loop_vars):
+                raise ValueError(
+                    f"while_loop: body returned {len(vars_)} vars, "
+                    f"expected {len(loop_vars)}")
+            keep = bool(cond_fn(*vars_))
+        return vars_
+
+    # traced: lax.while_loop over the flattened carry
+    def carry_of(vars_nest):
+        ls, _ = _flatten(list(vars_nest))
+        return tuple(jnp.asarray(l.data if isinstance(l, Tensor) else l)
+                     for l in ls)
+
+    def nest_of(carry):
+        return _unflatten(tree, [Tensor(a) for a in carry])
+
+    def cond_w(carry):
+        r = cond_fn(*nest_of(carry))
+        return jnp.reshape(r.data if isinstance(r, Tensor)
+                           else jnp.asarray(r), ()).astype(bool)
+
+    def body_w(carry):
+        out = body(*nest_of(carry))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(out) != len(loop_vars):
+            raise ValueError(
+                f"while_loop: body returned {len(out)} vars, expected "
+                f"{len(loop_vars)}")
+        new = carry_of(out)
+        for i, (a, b) in enumerate(zip(carry, new)):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise ValueError(
+                    f"while_loop: loop var {i} changed from "
+                    f"{a.shape}/{a.dtype} to {b.shape}/{b.dtype}; XLA "
+                    f"loop carries must keep shape and dtype (cast or "
+                    f"pad inside the body)")
+        return new
+
+    final = jax.lax.while_loop(cond_w, body_w, carry_of(loop_vars))
+    return nest_of(final)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Callable = None, name=None):
+    """ref: static/nn/control_flow.py:568 — first pred that's True wins,
+    else default. Built as a right-folded chain of cond()."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        # reference behavior: last fn becomes the default
+        _, default = pairs[-1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def fold(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, fold(i + 1))
+
+    return fold(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """ref: static/nn/control_flow.py:701. branch_fns: dict {int: fn} or
+    list of (int, fn) or list of fns (indices 0..n-1)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        fns = list(branch_fns)
+        if fns and not isinstance(fns[0], (tuple, list)):
+            items = list(enumerate(fns))
+        else:
+            items = sorted((int(k), v) for k, v in fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case: duplicate branch keys {keys}")
+
+    arr = branch_index.data if (isinstance(branch_index, Tensor)
+                                and not isinstance(branch_index,
+                                                   SymbolicTensor)) \
+        else branch_index
+    if isinstance(branch_index, SymbolicTensor):
+        raise NotImplementedError(
+            "switch_case under build-time static-graph mode: wrap the "
+            "function in @paddle.jit.to_static instead (lowers to XLA "
+            "lax.switch)")
+
+    if not _is_traced(arr):
+        k = int(np.asarray(arr))
+        if k in keys:
+            return fns[keys.index(k)]()
+        if default is not None:
+            return default()
+        return fns[-1]()  # reference: largest key is the fallback
+
+    # traced: translate arbitrary keys to dense positions for lax.switch
+    if default is None:
+        default = fns[-1]
+    trees = {}
+
+    def wrap(fn, tag):
+        def run(_):
+            out = fn()
+            leaves, tree = _flatten(out)
+            trees[tag] = tree
+            return tuple(jnp.asarray(t.data if isinstance(t, Tensor)
+                                     else t) for t in leaves)
+        return run
+
+    branches = [wrap(f, i) for i, f in enumerate(fns)] \
+        + [wrap(default, len(fns))]
+    idx = jnp.reshape(arr, ()).astype(jnp.int32)
+    pos = jnp.full((), len(fns), jnp.int32)  # default position
+    for p_i, k in enumerate(keys):
+        pos = jnp.where(idx == k, jnp.int32(p_i), pos)
+    res = jax.lax.switch(pos, branches, None)
+    ref_tree = trees[next(iter(trees))]
+    for tag, t in trees.items():
+        if repr(t) != repr(ref_tree):
+            _branch_mismatch("switch_case", ref_tree, t)
+    return _unflatten(ref_tree, [Tensor(a) for a in res])
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """ref: static/nn/control_flow.py Print — debug passthrough. Uses
+    jax.debug.print under trace so it fires at run time."""
+    arr = input.data if isinstance(input, Tensor) else input
+    msg = (message or "") + " {x}"
+    if _is_traced(arr):
+        jax.debug.print(msg, x=arr)
+    elif not isinstance(arr, SymbolicTensor):
+        print(msg.format(x=np.asarray(arr)))
+    return input

@@ -1,0 +1,212 @@
+"""paddle.audio: windows, mel math, feature layers, wav backend, datasets.
+Parity is checked against pure-numpy references (no librosa/scipy in the
+image). ref: /root/reference/python/paddle/audio/."""
+import math
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+# ---------------------------------------------------------------- windows
+def test_hann_window_matches_numpy():
+    w = audio.functional.get_window("hann", 16, fftbins=True).numpy()
+    # periodic hann: 0.5 - 0.5 cos(2 pi n / N)
+    n = np.arange(16)
+    ref = 0.5 - 0.5 * np.cos(2 * np.pi * n / 16)
+    np.testing.assert_allclose(w, ref, atol=1e-12)
+
+
+def test_hamming_symmetric_matches_numpy():
+    w = audio.functional.get_window("hamming", 17, fftbins=False).numpy()
+    np.testing.assert_allclose(w, np.hamming(17), atol=1e-12)
+
+
+def test_kaiser_and_gaussian_windows():
+    w = audio.functional.get_window(("kaiser", 8.6), 32,
+                                    fftbins=False).numpy()
+    np.testing.assert_allclose(w, np.kaiser(32, 8.6), atol=1e-12)
+    g = audio.functional.get_window(("gaussian", 7), 21,
+                                    fftbins=False).numpy()
+    n = np.arange(21) - 10.0
+    np.testing.assert_allclose(g, np.exp(-0.5 * (n / 7.0) ** 2),
+                               atol=1e-12)
+
+
+def test_all_named_windows_build():
+    for name in ["hann", "hamming", "blackman", "cosine", "triang",
+                 "bohman", "tukey", "gaussian", "exponential", "kaiser",
+                 "taylor"]:
+        if name == "exponential":
+            w = audio.functional.get_window((name, None, 10.0), 64)
+        else:
+            w = audio.functional.get_window(name, 64)
+        assert w.shape == [64]
+        assert np.all(np.isfinite(w.numpy()))
+
+
+# ---------------------------------------------------------------- mel math
+def test_hz_mel_roundtrip_scalar_and_tensor():
+    for hz in [60.0, 440.0, 4000.0]:
+        mel = audio.functional.hz_to_mel(hz)
+        back = audio.functional.mel_to_hz(mel)
+        assert abs(back - hz) < 1e-6 * max(hz, 1.0)
+    t = paddle.to_tensor(np.array([60.0, 440.0, 4000.0], np.float32))
+    mel = audio.functional.hz_to_mel(t)
+    back = audio.functional.mel_to_hz(mel)
+    np.testing.assert_allclose(back.numpy(), t.numpy(), rtol=1e-4)
+
+
+def test_hz_to_mel_htk():
+    hz = 1000.0
+    mel = audio.functional.hz_to_mel(hz, htk=True)
+    assert abs(mel - 2595.0 * math.log10(1 + 1000.0 / 700.0)) < 1e-9
+
+
+def test_fbank_matrix_shape_and_coverage():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=512,
+                                               n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every mel filter has some support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_power_to_db_basics():
+    x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+    db = audio.functional.power_to_db(x, top_db=None).numpy()
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+    db = audio.functional.power_to_db(x, top_db=15.0).numpy()
+    np.testing.assert_allclose(db, [5.0, 10.0, 20.0], atol=1e-5)
+
+
+def test_create_dct_ortho_is_orthonormal():
+    d = audio.functional.create_dct(13, 40).numpy()  # [40, 13]
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+# ---------------------------------------------------------------- features
+def _sine(sr=8000, secs=0.25, f=440.0):
+    t = np.arange(int(sr * secs)) / sr
+    return np.sin(2 * np.pi * f * t).astype(np.float32)
+
+
+def test_spectrogram_peak_at_tone_frequency():
+    sr, f = 8000, 1000.0
+    x = paddle.to_tensor(_sine(sr=sr, f=f)[None, :])
+    spec = audio.features.Spectrogram(n_fft=256, hop_length=128,
+                                      power=2.0)(x)
+    assert spec.shape[0] == 1 and spec.shape[1] == 129
+    mean_spec = spec.numpy()[0].mean(axis=1)
+    peak_bin = int(np.argmax(mean_spec))
+    expected = round(f * 256 / sr)
+    assert abs(peak_bin - expected) <= 1, (peak_bin, expected)
+
+
+def test_spectrogram_matches_numpy_stft():
+    sr = 8000
+    x_np = _sine(sr=sr)[None, :]
+    n_fft, hop = 128, 64
+    spec = audio.features.Spectrogram(n_fft=n_fft, hop_length=hop,
+                                      window="hann", power=1.0,
+                                      center=False)(
+        paddle.to_tensor(x_np)).numpy()[0]
+    # numpy reference: frame -> periodic hann -> rfft magnitude
+    w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+    frames = []
+    for start in range(0, x_np.shape[1] - n_fft + 1, hop):
+        frames.append(np.abs(np.fft.rfft(x_np[0, start:start + n_fft]
+                                         * w)))
+    ref = np.stack(frames, axis=1)
+    assert spec.shape == ref.shape
+    np.testing.assert_allclose(spec, ref, atol=1e-3)
+
+
+def test_mel_log_mfcc_shapes_and_finiteness():
+    sr = 8000
+    x = paddle.to_tensor(np.stack([_sine(sr=sr), _sine(sr=sr, f=880)]))
+    mel = audio.features.MelSpectrogram(sr=sr, n_fft=256, hop_length=128,
+                                        n_mels=32, f_min=0.0)(x)
+    assert mel.shape[:2] == [2, 32]
+    logmel = audio.features.LogMelSpectrogram(
+        sr=sr, n_fft=256, hop_length=128, n_mels=32, f_min=0.0)(x)
+    assert logmel.shape == mel.shape
+    mfcc = audio.features.MFCC(sr=sr, n_mfcc=13, n_fft=256,
+                               hop_length=128, n_mels=32, f_min=0.0)(x)
+    assert mfcc.shape[:2] == [2, 13]
+    for t in (mel, logmel, mfcc):
+        assert np.all(np.isfinite(t.numpy()))
+
+
+def test_mfcc_rejects_n_mfcc_over_n_mels():
+    with pytest.raises(ValueError, match="n_mfcc"):
+        audio.features.MFCC(n_mfcc=64, n_mels=32)
+
+
+# ---------------------------------------------------------------- backend
+def test_wave_backend_roundtrip(tmp_path):
+    sr = 8000
+    x = (_sine(sr=sr) * 0.5)[None, :]
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, paddle.to_tensor(x), sr)
+    meta = audio.info(path)
+    assert meta.sample_rate == sr
+    assert meta.num_channels == 1
+    assert meta.bits_per_sample == 16
+    wav, sr2 = audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(wav.numpy(), x, atol=1e-3)
+    raw, _ = audio.load(path, normalize=False)
+    assert raw.numpy().dtype == np.int16
+
+
+def test_backend_registry():
+    assert audio.backends.list_available_backends() == ["wave_backend"]
+    assert audio.backends.get_current_backend() == "wave_backend"
+    audio.backends.set_backend("wave_backend")
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+
+
+# ---------------------------------------------------------------- datasets
+def _write_esc50_tree(root):
+    audio_dir = os.path.join(root, "audio")
+    os.makedirs(audio_dir)
+    sr = 8000
+    for fold in (1, 2):
+        for target in (0, 7):
+            name = f"{fold}-1234-A-{target}.wav"
+            with wave.open(os.path.join(audio_dir, name), "wb") as f:
+                f.setnchannels(1)
+                f.setsampwidth(2)
+                f.setframerate(sr)
+                f.writeframes((np.zeros(400, np.int16)).tobytes())
+
+
+def test_esc50_local_split(tmp_path):
+    _write_esc50_tree(str(tmp_path))
+    train = audio.datasets.ESC50(mode="train", split=1,
+                                 root=str(tmp_path))
+    dev = audio.datasets.ESC50(mode="dev", split=1, root=str(tmp_path))
+    assert len(train) == 2 and len(dev) == 2
+    wav, label = train[0]
+    assert wav.shape[0] == 1 and int(label) in (0, 7)
+
+
+def test_esc50_feature_extraction(tmp_path):
+    _write_esc50_tree(str(tmp_path))
+    ds = audio.datasets.ESC50(mode="train", split=1, root=str(tmp_path),
+                              feat_type="mfcc", n_mfcc=13, n_fft=256,
+                              n_mels=32, f_min=0.0)
+    feat, _ = ds[0]
+    assert feat.shape[:2] == [1, 13]
+
+
+def test_esc50_without_root_raises():
+    with pytest.raises(FileNotFoundError, match="root="):
+        audio.datasets.ESC50(mode="train")

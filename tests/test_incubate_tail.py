@@ -1,0 +1,208 @@
+"""Incubate/distributed tail: LookAhead, ModelAverage, autotune config,
+distributed.rpc. ref: reference python/paddle/incubate/optimizer/
+lookahead.py:25, modelaverage.py:27, incubate/autotune.py:24,
+distributed/rpc/rpc.py:73."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _tiny_net(seed=0):
+    paddle.seed(seed)
+    return nn.Linear(4, 4)
+
+
+def test_lookahead_sync_every_k():
+    net = _tiny_net()
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w0 = net.weight.numpy().copy()
+
+    # step 1: inner update only (fast params move, no sync)
+    (net(x) ** 2).mean().backward()
+    opt.step()
+    opt.clear_grad()
+    w_fast1 = net.weight.numpy().copy()
+    assert not np.allclose(w_fast1, w0)
+
+    # step 2: sync — params = slow0 + 0.5*(fast - slow0), slow0 = w0
+    (net(x) ** 2).mean().backward()
+    g2 = net.weight.grad.numpy().copy()
+    w_fast2_expected = w_fast1 - 0.1 * g2
+    opt.step()
+    opt.clear_grad()
+    expected = w0 + 0.5 * (w_fast2_expected - w0)
+    np.testing.assert_allclose(net.weight.numpy(), expected, rtol=1e-5)
+
+
+def test_lookahead_converges():
+    net = _tiny_net(1)
+    inner = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.8, k=5)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((8, 4)).astype(np.float32))
+    losses = []
+    for _ in range(40):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_lookahead_validates_args():
+    inner = paddle.optimizer.SGD(0.1, parameters=_tiny_net().parameters())
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, alpha=1.5)
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, k=0)
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(None)
+
+
+def test_model_average_window_average():
+    net = _tiny_net(2)
+    ma = paddle.incubate.ModelAverage(1.0, parameters=net.parameters(),
+                                      min_average_window=2,
+                                      max_average_window=100)
+    seen = []
+    for i in range(4):
+        with paddle.framework.autograd.no_grad():
+            for p in net.parameters():
+                p._data = p.data + np.float32(1.0)
+        seen.append(net.weight.numpy().copy())
+        ma.step()
+    live = net.weight.numpy().copy()
+    with ma.apply():
+        avg = net.weight.numpy().copy()
+        # average over the accumulated window of the 4 snapshots
+        np.testing.assert_allclose(avg, np.mean(seen, axis=0), rtol=1e-5)
+    # restored after the context
+    np.testing.assert_allclose(net.weight.numpy(), live)
+
+
+def test_autotune_set_config_and_file(tmp_path):
+    from paddle_tpu.incubate import autotune
+    autotune.set_config({"kernel": {"enable": True,
+                                    "tuning_range": [1, 5]},
+                         "dataloader": {"enable": True}})
+    cfg = autotune.get_config()
+    assert cfg["kernel"]["tuning_range"] == [1, 5]
+    assert cfg["dataloader"]["enable"] is True
+    assert autotune.suggested_num_workers() >= 2
+    import json
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps({"dataloader": {"enable": False}}))
+    autotune.set_config(str(path))
+    assert autotune.get_config()["dataloader"]["enable"] is False
+    assert autotune.suggested_num_workers() is None
+    with pytest.raises(ValueError):
+        autotune.set_config({"kernel": {"enable": "yes"}})
+
+
+# ------------------------------------------------------------------- rpc
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    return 1 / 0
+
+
+def test_rpc_single_worker_roundtrip():
+    from paddle_tpu.distributed import rpc
+    port = _free_port()
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("worker0", _double, args=(5,))
+        assert fut.wait() == 10
+        info = rpc.get_worker_info("worker0")
+        assert info.rank == 0 and info.name == "worker0"
+        assert rpc.get_current_worker_info() == info
+        assert len(rpc.get_all_worker_infos()) == 1
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc.rpc_sync("nobody", _double, args=(1,))
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("worker0", _boom)
+    finally:
+        rpc.shutdown()
+
+
+_RPC_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.distributed import rpc
+
+    def fma(a, b, c):
+        return a * b + c
+
+    rpc.init_rpc({name!r}, rank={rank}, world_size=2,
+                 master_endpoint={ep!r})
+    if {rank} == 0:
+        # call INTO the other process
+        out = rpc.rpc_sync("w1", fma, args=(3, 4, 5))
+        assert out == 17, out
+        print("RPC_OK", out, flush=True)
+    else:
+        # keep serving until rank 0 finished: barrier via reverse call
+        out = rpc.rpc_sync("w0", fma, args=(2, 2, 0))
+        assert out == 4, out
+        print("RPC_OK", out, flush=True)
+    rpc.shutdown()
+""")
+
+
+def test_rpc_two_processes_cross_call():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ep = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _RPC_WORKER.format(repo=repo, name=f"w{r}", rank=r, ep=ep)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True) for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "RPC_OK" in out, out
+
+
+def test_model_average_state_dict_roundtrip():
+    net = _tiny_net(4)
+    ma = paddle.incubate.ModelAverage(1.0, parameters=net.parameters(),
+                                      min_average_window=2,
+                                      max_average_window=100)
+    for _ in range(3):
+        with paddle.framework.autograd.no_grad():
+            for p in net.parameters():
+                p._data = p.data + np.float32(1.0)
+        ma.step()
+    sd = ma.state_dict()
+    ma2 = paddle.incubate.ModelAverage(1.0,
+                                       parameters=net.parameters(),
+                                       min_average_window=2,
+                                       max_average_window=100)
+    ma2.set_state_dict(sd)
+    with ma.apply(need_restore=True):
+        avg1 = net.weight.numpy().copy()
+    with ma2.apply(need_restore=True):
+        avg2 = net.weight.numpy().copy()
+    np.testing.assert_allclose(avg1, avg2)

@@ -1,0 +1,221 @@
+"""Multi-controller hybrid-parallel + elastic e2e (round-2 verdict #5):
+
+1. The flagship SPMD trainer runs with mp=2 SPLIT ACROSS two OS
+   processes (1 CPU device each, jax.distributed over Gloo) and its loss
+   curve matches the single-process mp=2 run exactly.
+   Ref contract: test_dist_base.py:926 (spawn trainers, compare loss).
+2. Elastic e2e: the supervisor relaunches the pod when a worker is
+   killed. Ref: fleet/elastic/manager.py:124 watch + :220 relaunch.
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+_TRAINER_BODY = """
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+
+    mesh_mod.build_mesh(mp=2)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=4, inter=64, seq=16)
+    tr = LlamaSpmdTrainer(cfg, remat=False, compute_dtype=jnp_dtype,
+                          seed=3)
+    ids = np.random.default_rng(11).integers(0, 64, (2, 16))
+    losses = [float(tr.train_step(ids)) for _ in range(3)]
+    print("LOSSES " + " ".join(f"{l:.6f}" for l in losses), flush=True)
+"""
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()   # 2 processes x 1 local device
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 2
+    import jax.numpy as jnp
+    jnp_dtype = jnp.float32
+""") + textwrap.dedent(_TRAINER_BODY)
+
+_SINGLE = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import jax.numpy as jnp
+    jnp_dtype = jnp.float32
+    import numpy as np
+    from paddle_tpu.parallel import mesh as mesh_mod
+    devs = jax.devices()[:2]
+    mesh_mod.build_mesh(mp=2, devices=devs)
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_spmd import LlamaSpmdTrainer
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=4, inter=64, seq=16)
+    tr = LlamaSpmdTrainer(cfg, remat=False, compute_dtype=jnp_dtype,
+                          seed=3)
+    ids = np.random.default_rng(11).integers(0, 64, (2, 16))
+    losses = [float(tr.train_step(ids)) for _ in range(3)]
+    print("LOSSES " + " ".join(f"{l:.6f}" for l in losses), flush=True)
+""")
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _extract_losses(out):
+    for line in out.splitlines():
+        if line.startswith("LOSSES"):
+            return [float(v) for v in line.split()[1:]]
+    return None
+
+
+def test_two_process_mp2_matches_single_process():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = {**os.environ,
+               "JAX_PLATFORMS": "cpu",
+               "PADDLE_MASTER": f"127.0.0.1:{port}",
+               "PADDLE_TRAINERS_NUM": "2",
+               "PADDLE_TRAINER_ID": str(rank),
+               # one local device per process -> mp axis SPANS processes
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=500)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, out in enumerate(outs):
+        assert procs[rank].returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+    multi = [_extract_losses(o) for o in outs]
+    assert multi[0] and multi[0] == multi[1], multi
+
+    # single-process reference: same seed/mesh factoring on 2 local devs
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    r = subprocess.run([sys.executable, "-c", _SINGLE], env=env,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    single = _extract_losses(r.stdout)
+    assert single is not None
+    np.testing.assert_allclose(multi[0], single, rtol=2e-4), \
+        (multi[0], single)
+
+
+# ------------------------------------------------------------------ elastic
+_ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    marker = os.environ["ELASTIC_TEST_DIR"] + f"/started_rank{rank}"
+    # append-mode: count incarnations
+    with open(marker, "a") as f:
+        f.write(str(os.getpid()) + "\\n")
+    deadline = time.time() + float(os.environ.get("ELASTIC_RUN_SECS", "3"))
+    while time.time() < deadline:
+        time.sleep(0.1)
+""")
+
+
+def test_elastic_supervisor_relaunches_killed_worker(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = tmp_path / "worker.py"
+    script.write_text(_ELASTIC_WORKER)
+    marker_dir = str(tmp_path)
+    cmds, envs = [], []
+    for r in range(2):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                   ELASTIC_TEST_DIR=marker_dir, ELASTIC_RUN_SECS="4")
+        cmds.append([sys.executable, str(script)])
+        envs.append(env)
+    sup = ElasticSupervisor(cmds, envs,
+                            heartbeat_dir=str(tmp_path / "beats"),
+                            interval=0.2, max_restarts=2)
+
+    import threading
+    rc_box = {}
+
+    def run():
+        rc_box["rc"] = sup.run()
+
+    t = threading.Thread(target=run)
+    t.start()
+    # wait for first incarnation of both workers
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if all(os.path.exists(os.path.join(marker_dir,
+                                           f"started_rank{r}"))
+               for r in range(2)):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("workers never started")
+    # kill worker 1 mid-flight -> supervisor must relaunch the pod
+    sup._procs[1].send_signal(signal.SIGKILL)
+    t.join(timeout=60)
+    assert not t.is_alive(), "supervisor did not finish"
+    assert rc_box["rc"] == 0
+    assert sup.restarts >= 1
+    # rank 1 must have a SECOND incarnation (new pid recorded)
+    with open(os.path.join(marker_dir, "started_rank1")) as f:
+        pids = [l for l in f.read().splitlines() if l]
+    assert len(pids) >= 2, pids
+
+
+_HUNG_WORKER = textwrap.dedent("""
+    import json, os, time
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    beat_dir = os.environ["PADDLE_ELASTIC_DIR"]
+    os.makedirs(beat_dir, exist_ok=True)
+    with open(os.path.join(beat_dir, f"rank_{rank}.beat"), "w") as f:
+        json.dump({"ts": time.time(), "host": "127.0.0.1"}, f)
+    if rank == "1":
+        time.sleep(3600)   # deadlocked collective: alive but silent
+    time.sleep(1.0)
+""")
+
+
+def test_elastic_supervisor_detects_hung_worker(tmp_path):
+    """A worker that stops heartbeating without exiting must trigger a
+    relaunch (ref ElasticManager membership watch, manager.py:124)."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = tmp_path / "worker.py"
+    script.write_text(_HUNG_WORKER)
+    beats = str(tmp_path / "beats")
+    cmds, envs = [], []
+    for r in range(2):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                   PADDLE_ELASTIC_DIR=beats)
+        cmds.append([sys.executable, str(script)])
+        envs.append(env)
+    sup = ElasticSupervisor(cmds, envs, heartbeat_dir=beats,
+                            interval=0.2, heartbeat_timeout=1.5,
+                            max_restarts=1, log=lambda *a: None)
+    rc = sup.run()
+    assert sup.restarts == 1        # hang detected -> one relaunch
+    assert rc == 1                  # still hung -> gave up with code 1

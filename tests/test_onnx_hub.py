@@ -1,0 +1,96 @@
+"""paddle.onnx.export (StableHLO artifact path) + paddle.hub (local
+source). ref: reference python/paddle/onnx/export.py:22,
+python/paddle/hapi/hub.py:175,223,263."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_onnx_export_writes_stablehlo(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    spec = [paddle.static.InputSpec(shape=[3, 4], dtype="float32")]
+    path = str(tmp_path / "model")
+    with pytest.warns(UserWarning, match="StableHLO"):
+        artifacts = paddle.onnx.export(net, path, input_spec=spec)
+    mlir = open(artifacts["stablehlo_mlir"]).read()
+    assert "stablehlo" in mlir and "main" in mlir
+    assert os.path.getsize(artifacts["stablehlo_bin"]) > 0
+    import json
+    manifest = json.load(open(artifacts["manifest"]))
+    assert manifest["inputs"][0]["shape"] == [3, 4]
+    assert manifest["outputs"][0]["shape"] == [3, 2]
+
+
+def test_onnx_export_roundtrip_runs():
+    """The serialized artifact must actually execute and match."""
+    import jax
+    import tempfile
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    net.eval()
+    x = paddle.rand([2, 4])
+    ref = net(x).numpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        with pytest.warns(UserWarning):
+            arts = paddle.onnx.export(net, path, input_spec=[x])
+        blob = open(arts["stablehlo_bin"], "rb").read()
+        reloaded = jax.export.deserialize(blob)
+        (out,) = reloaded.call(x.data)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_onnx_export_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "m"))
+
+
+_HUBCONF = '''
+dependencies = ["numpy"]
+
+def tiny_linear(out_features=2, pretrained=False):
+    """Builds a tiny Linear model. Args: out_features."""
+    import paddle_tpu as paddle
+    return paddle.nn.Linear(4, out_features)
+
+def _private_helper():
+    pass
+'''
+
+
+def test_hub_local_list_help_load(tmp_path):
+    (tmp_path / "hubconf.py").write_text(_HUBCONF)
+    repo = str(tmp_path)
+    names = paddle.hub.list(repo, source="local")
+    assert "tiny_linear" in names
+    assert "_private_helper" not in names
+    doc = paddle.hub.help(repo, "tiny_linear", source="local")
+    assert "tiny Linear" in doc
+    model = paddle.hub.load(repo, "tiny_linear", 3, source="local")
+    assert isinstance(model, nn.Linear)
+    y = model(paddle.rand([2, 4]))
+    assert y.shape == [2, 3]
+
+
+def test_hub_github_raises_zero_egress(tmp_path):
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.hub.list("org/repo", source="github")
+    with pytest.raises(ValueError, match="unknown source"):
+        paddle.hub.list(str(tmp_path), source="ftp")
+
+
+def test_hub_missing_hubconf(tmp_path):
+    with pytest.raises(FileNotFoundError, match="hubconf"):
+        paddle.hub.list(str(tmp_path), source="local")
+
+
+def test_hub_unknown_entry(tmp_path):
+    (tmp_path / "hubconf.py").write_text(_HUBCONF)
+    with pytest.raises(RuntimeError, match="Cannot find callable"):
+        paddle.hub.load(str(tmp_path), "nope", source="local")
