@@ -1,0 +1,36 @@
+"""paddle._C_ops (ref: /root/reference/python/paddle/_C_ops.py — re-export
+of the pybind-generated `core.eager.ops` functions).
+
+There is no C++ op layer here: the "C ops" ARE the functional layer.
+Attribute access resolves through the same namespaces op_coverage scans,
+so `paddle._C_ops.matmul(x, y)` keeps working for code written against
+the reference's low-level entry point.
+"""
+from __future__ import annotations
+
+_NAMESPACES = None
+
+
+def _namespaces():
+    global _NAMESPACES
+    if _NAMESPACES is None:
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.ops import (creation, linalg, logic, manipulation,
+                                    math, search)
+        _NAMESPACES = [math, manipulation, creation, linalg, logic,
+                       search, nn.functional, paddle]
+    return _NAMESPACES
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    base = name[:-1] if name.endswith("_") else name  # inplace alias
+    for ns in _namespaces():
+        fn = getattr(ns, base, None)
+        if callable(fn):
+            return fn
+    raise AttributeError(
+        f"paddle._C_ops.{name}: no such op in the functional layer "
+        f"(see utils/op_coverage.py for the registry)")

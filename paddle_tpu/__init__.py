@@ -69,6 +69,15 @@ from . import fft  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import _C_ops  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402

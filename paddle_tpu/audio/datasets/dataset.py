@@ -29,10 +29,15 @@ class AudioClassificationDataset(Dataset):
         self.feat_type = feat_type
         self.sample_rate = sample_rate
         self._feat_kwargs = kwargs
-        self._extractor = None
+        # keyed by sample rate: a mixed-rate directory must not reuse a
+        # mel filter bank built for the first file's rate
+        self._extractors: dict = {}
 
     def _feature_layer(self, sr: int):
-        if self._extractor is None and self.feat_type != "raw":
+        if self.feat_type == "raw":
+            return None
+        ext = self._extractors.get(sr)
+        if ext is None:
             from .. import features
             name = {"melspectrogram": "MelSpectrogram",
                     "logmelspectrogram": "LogMelSpectrogram",
@@ -41,8 +46,9 @@ class AudioClassificationDataset(Dataset):
             kw = dict(self._feat_kwargs)
             if name != "Spectrogram":
                 kw.setdefault("sr", sr)
-            self._extractor = getattr(features, name)(**kw)
-        return self._extractor
+            ext = getattr(features, name)(**kw)
+            self._extractors[sr] = ext
+        return ext
 
     def __getitem__(self, idx):
         from ..backends import load

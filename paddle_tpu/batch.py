@@ -1,0 +1,25 @@
+"""paddle.batch (ref: /root/reference/python/paddle/batch.py) — legacy
+reader-decorator batching."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (list-of-samples
+    batches), the reference's pre-DataLoader input idiom."""
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer, "
+                         f"got {batch_size}")
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

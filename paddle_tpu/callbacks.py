@@ -1,0 +1,8 @@
+"""paddle.callbacks (ref: /root/reference/python/paddle/callbacks/) —
+re-export of the hapi callback set."""
+from .hapi.callbacks import (Callback, EarlyStopping,  # noqa: F401
+                             LRScheduler, ModelCheckpoint, ProgBarLogger,
+                             VisualDL)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "VisualDL"]

@@ -1,0 +1,24 @@
+"""paddle.dataset (ref: /root/reference/python/paddle/dataset/) — the
+legacy auto-downloading dataset helpers (mnist/imdb/uci_housing/…).
+
+Descoped in this zero-egress build the same way the PS stack is: each
+accessor raises with a pointer to the supported local-disk datasets
+(`paddle.vision.datasets`, `paddle.audio.datasets`, `paddle.text`)."""
+from __future__ import annotations
+
+_LEGACY = ["mnist", "cifar", "imdb", "imikolov", "movielens",
+           "uci_housing", "wmt14", "wmt16", "conll05", "flowers",
+           "voc2012", "image", "common"]
+
+__all__ = list(_LEGACY)
+
+
+def __getattr__(name):
+    if name in _LEGACY:
+        raise RuntimeError(
+            f"paddle.dataset.{name} is the reference's auto-downloading "
+            f"legacy loader; this zero-egress TPU build ships local-disk "
+            f"datasets instead — see paddle.vision.datasets (CIFAR/"
+            f"ImageFolder/...), paddle.audio.datasets (ESC50/TESS) and "
+            f"paddle.text.")
+    raise AttributeError(name)

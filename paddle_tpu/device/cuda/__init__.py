@@ -1,0 +1,136 @@
+"""paddle.device.cuda compatibility surface (ref: /root/reference/python/
+paddle/device/cuda/__init__.py). There is no CUDA here: XLA's dispatch is
+already stream-ordered per device, so Stream/Event are ordering tokens
+whose synchronize() is a device sync, and the memory introspection maps
+to jax device memory stats."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties"]
+
+
+def _dev(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    return device
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device is done."""
+    # a tiny computation forced to host is a full pipeline drain
+    float(jnp.zeros((), jnp.float32) + 0.0)
+    return None
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class Stream:
+    """XLA issues work in dispatch order on one logical stream per
+    device; Stream objects exist for API compatibility and ordering."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = _dev(device)
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+
+_current = None
+
+
+def current_stream(device=None):
+    global _current
+    if _current is None:
+        _current = Stream(device)
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def empty_cache():
+    pass  # XLA's allocator manages HBM; nothing to drop
+
+
+def _stats(device=None):
+    try:
+        return _dev(device).memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    s = _stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+
+    class _Props:
+        name = getattr(d, "device_kind", str(d))
+        major, minor = 0, 0
+        total_memory = int(_stats(d).get("bytes_limit", 0))
+        multi_processor_count = 1
+
+        def __repr__(self):
+            return (f"_gpuDeviceProperties(name='{self.name}', "
+                    f"total_memory={self.total_memory})")
+    return _Props()

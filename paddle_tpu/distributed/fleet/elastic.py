@@ -112,8 +112,12 @@ class ElasticSupervisor:
         self.cmds = list(cmds)
         self.envs = list(envs) if envs is not None \
             else [dict(os.environ)] * len(self.cmds)
-        self.dir = heartbeat_dir or os.environ.get(
-            "PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic")
+        # per-supervisor unique default: a shared dir would let two jobs
+        # on one host delete/misread each other's heartbeats
+        self.dir = heartbeat_dir or os.environ.get("PADDLE_ELASTIC_DIR") \
+            or f"/tmp/paddle_tpu_elastic_{os.getpid()}"
+        for env in self.envs:
+            env.setdefault("PADDLE_ELASTIC_DIR", self.dir)
         self.interval = interval
         self.max_restarts = max_restarts
         # hang detection: a rank that HAS written heartbeats (workers
@@ -150,12 +154,16 @@ class ElasticSupervisor:
                 p.kill()
 
     def _stale_ranks(self):
-        """Ranks whose heartbeat file exists but went silent for longer
-        than heartbeat_timeout — alive-but-hung workers."""
+        """Ranks whose process is still RUNNING but whose heartbeat went
+        silent for longer than heartbeat_timeout — alive-but-hung
+        workers. Ranks that already exited (cleanly or not) are the
+        exit-code path's business, not a hang."""
         import json
         stale = []
         now = time.time()
-        for rank in range(len(self._procs)):
+        for rank, proc in enumerate(self._procs):
+            if proc.poll() is not None:
+                continue  # exited: not hung
             path = os.path.join(self.dir, f"rank_{rank}.beat")
             if not os.path.exists(path):
                 continue  # this worker never opted into heartbeats

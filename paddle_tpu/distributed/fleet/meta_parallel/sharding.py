@@ -94,6 +94,12 @@ class DygraphShardingOptimizer:
                 return {k: jax.device_put(v, self._host)
                         for k, v in st.items()}
             optimizer._init_state = offload_init
+            # Route the INNER optimizer's own step() through the
+            # streamed path too: once states live on the host device,
+            # the stock fused step would feed CPU-committed states +
+            # TPU params into one jit ("incompatible devices"). A user
+            # holding the original optimizer object must still work.
+            optimizer.step = self._offload_step
         else:
             def sharded_init(p):
                 st = orig_init(p)

@@ -32,6 +32,21 @@ _state: Dict[str, Any] = {
 
 
 def _auth(master_endpoint: str) -> bytes:
+    """Connection authkey. Same-host (loopback) runs derive it from the
+    endpoint — processes that can already reach 127.0.0.1 are inside the
+    trust boundary. Cross-host mode EXECUTES PICKLED CALLABLES, so it
+    demands a real out-of-band secret: set PADDLE_RPC_AUTHKEY to the same
+    random value on every worker."""
+    secret = os.environ.get("PADDLE_RPC_AUTHKEY")
+    if secret:
+        return secret.encode()
+    host = master_endpoint.rsplit(":", 1)[0]
+    if host not in ("127.0.0.1", "localhost", "::1"):
+        raise RuntimeError(
+            "cross-host rpc needs PADDLE_RPC_AUTHKEY set (a shared "
+            "random secret): an endpoint-derived key would let any host "
+            "that can reach the service port execute code in the "
+            "trainer process")
     return ("paddle_tpu_rpc:" + master_endpoint).encode()
 
 

@@ -347,8 +347,11 @@ class FusedMultiTransformerInt8(FusedMultiTransformer):
         if not self._quantized:
             raise RuntimeError("call quantize_weights() (or from_float) "
                                "before forward")
+        import jax.numpy as jnp
         from ...quantization.functional import quantized_matmul
         wq, scale, bias = self._int8[i][name]
-        # dequantize with the SAME bit width used at quantize time
-        out = quantized_matmul(x, wq, scale, bits=self._bits)
+        # dequantize with the SAME bit width used at quantize time; the
+        # activation dtype (bf16 in serving) flows through unchanged
+        out = quantized_matmul(x, wq, scale, bits=self._bits,
+                               out_dtype=jnp.dtype(str(x.dtype)))
         return out + bias if bias is not None else out

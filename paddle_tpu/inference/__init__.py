@@ -137,11 +137,17 @@ def _cast_layer_floats(layer, np_dtype):
 
 
 def _quantize_fused_blocks(layer):
-    """Int8 precision: rewrite FusedMultiTransformer children to the
-    weight-only int8 variant. Returns how many blocks were rewritten."""
+    """Int8 precision: rewrite FusedMultiTransformer blocks to the
+    weight-only int8 variant. Returns (count, new_top) — new_top
+    replaces `layer` when the loaded model IS a bare
+    FusedMultiTransformer (no parent slot to assign into)."""
     from ..incubate.nn.fused_transformer import (FusedMultiTransformer,
                                                  FusedMultiTransformerInt8)
     count = 0
+    new_top = layer
+    if isinstance(layer, FusedMultiTransformer) and \
+            not isinstance(layer, FusedMultiTransformerInt8):
+        return 1, FusedMultiTransformerInt8.from_float(layer)
     for owner in [layer] + [l for _, l in layer.named_sublayers()]:
         for name, child in list(getattr(owner, "_sub_layers", {}).items()):
             if isinstance(child, FusedMultiTransformer) and \
@@ -149,7 +155,7 @@ def _quantize_fused_blocks(layer):
                 setattr(owner, name,
                         FusedMultiTransformerInt8.from_float(child))
                 count += 1
-    return count
+    return count, new_top
 
 
 class Predictor:
@@ -179,7 +185,8 @@ class Predictor:
             self._np_dtype = np.float16
             _cast_layer_floats(inner, self._np_dtype)
         elif self._precision == PrecisionType.Int8:
-            n = _quantize_fused_blocks(inner)
+            n, inner = _quantize_fused_blocks(inner)
+            self._layer._inner = inner
             if n == 0:
                 warnings.warn(
                     "PrecisionType.Int8: no FusedMultiTransformer blocks "

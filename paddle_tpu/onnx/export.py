@@ -33,11 +33,26 @@ from ..static.input_spec import InputSpec
 __all__ = ["export"]
 
 
+_dyn_counter = [0]
+
+
 def _aval_of(spec):
     if isinstance(spec, InputSpec):
-        shape = tuple(1 if s in (None, -1) else int(s)
-                      for s in spec.shape)
-        return jax.ShapeDtypeStruct(shape, np.dtype(spec.dtype))
+        if any(s in (None, -1) for s in spec.shape):
+            # dynamic dims become jax.export SYMBOLIC dimensions so the
+            # artifact stays shape-polymorphic (the reference's ONNX
+            # export keeps -1 dims dynamic the same way)
+            names = []
+            for s in spec.shape:
+                if s in (None, -1):
+                    _dyn_counter[0] += 1
+                    names.append(f"_d{_dyn_counter[0]}")
+                else:
+                    names.append(str(int(s)))
+            shape = jax.export.symbolic_shape(", ".join(names))
+            return jax.ShapeDtypeStruct(shape, np.dtype(spec.dtype))
+        return jax.ShapeDtypeStruct(tuple(int(s) for s in spec.shape),
+                                    np.dtype(spec.dtype))
     if isinstance(spec, Tensor):
         return jax.ShapeDtypeStruct(tuple(spec.shape),
                                     np.dtype(str(spec.data.dtype)))
@@ -78,9 +93,10 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
         f.write(exported.serialize())
     manifest = {
         "format": "stablehlo",
-        "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
-                   for a in avals],
-        "outputs": [{"shape": list(o.shape), "dtype": str(o.dtype)}
+        "inputs": [{"shape": [str(s) for s in a.shape],
+                    "dtype": str(a.dtype)} for a in avals],
+        "outputs": [{"shape": [str(s) for s in o.shape],
+                     "dtype": str(o.dtype)}
                     for o in exported.out_avals],
         "opset_version_requested": opset_version,
     }

@@ -74,8 +74,10 @@ def quantized_matmul(x, w_int8, w_scale, x_scale=None, bits=8,
 
     if x_scale is None:
         def impl(x_, w_, ws):
-            wf = w_.astype(out_dtype) * (ws / qmax)
-            return jnp.matmul(x_, wf)
+            # dequantize in f32 (scale precision), matmul in out_dtype
+            # so bf16 activations stay bf16 end-to-end
+            wf = (w_.astype(jnp.float32) * (ws / qmax)).astype(out_dtype)
+            return jnp.matmul(x_.astype(out_dtype), wf)
         return _op(impl, x, w_int8, w_scale, op_name="quantized_matmul")
 
     def impl(x_, w_, ws, xs):

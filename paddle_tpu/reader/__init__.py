@@ -1,0 +1,128 @@
+"""paddle.reader (ref: /root/reference/python/paddle/reader/decorator.py)
+— legacy reader decorators kept for script compatibility; new code uses
+paddle.io.DataLoader."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+    return cached
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.get("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*rs):
+                yield sum((make_tuple(i) for i in items if i is not None),
+                          ())
+    return composed
+
+
+def buffered(reader, size):
+    import queue
+    import threading
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+        end = object()
+
+        def fill():
+            for d in reader():
+                q.put(d)
+            q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over a reader with a thread pool (the reference uses
+    threads too — XLA releases the GIL during device work)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def xreader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            it = reader()
+            for out in pool.map(mapper, it):
+                yield out
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Multiple readers interleaved; thread-based here (sample decode is
+    IO-bound, and the device pipeline is jax's)."""
+    def mreader():
+        its = [r() for r in readers]
+        while its:
+            alive = []
+            for it in its:
+                try:
+                    yield next(it)
+                    alive.append(it)
+                except StopIteration:
+                    pass
+            its = alive
+    return mreader

@@ -1,0 +1,17 @@
+"""paddle.sysconfig (ref: /root/reference/python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the package headers (the reference returns
+    its C++ extension headers; here the package root — custom ops are
+    Pallas/ctypes, see utils/cpp_extension.py)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libs")

@@ -1,0 +1,17 @@
+"""paddle.tensor namespace (ref: /root/reference/python/paddle/tensor/) —
+the functional tensor-op surface. In this build the implementations live
+in `paddle_tpu.ops.*`; this module re-exports them under the reference's
+module layout (paddle.tensor.math, paddle.tensor.creation, …)."""
+from ..ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.search import *  # noqa: F401,F403
+
+# reference submodule aliases
+attribute = math
+random = creation
+stat = math
+einsum = linalg

@@ -1,0 +1,29 @@
+"""paddle.version (ref: reference python/paddle/version.py, generated at
+build time there)."""
+full_version = "2.5.0+tpu"
+major = "2"
+minor = "5"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show",
+           "cuda", "cudnn"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

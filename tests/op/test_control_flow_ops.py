@@ -1,0 +1,105 @@
+"""Golden OpTest specs for control-flow ops (round-2 verdict #4: static-leg
+coverage for conditional ops). The static leg traces through to_static, so
+these run through lax.cond / lax.switch / lax.while_loop; the dygraph leg
+runs the eager Python branches. ref: reference control_flow.py cond:877,
+while_loop:405, switch_case:701; conditional_block/select_input ops."""
+import numpy as np
+
+from paddle_tpu.static import case, cond, switch_case, while_loop
+
+from .op_test import OpSpec, run_spec
+
+
+def test_cond_true_branch():
+    run_spec(OpSpec(
+        name="cond",
+        fn=lambda x: cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0),
+        ref=lambda x: x * 2.0 if x.sum() > 0 else x - 1.0,
+        inputs={"x": np.random.default_rng(0)
+                .standard_normal((4, 5)).astype(np.float32) + 1.0},
+        grad_inputs=("x",),
+        yaml_ops=("conditional_block", "select_input"),
+    ))
+
+
+def test_cond_false_branch():
+    run_spec(OpSpec(
+        name="cond_false",
+        fn=lambda x: cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0),
+        ref=lambda x: x * 2.0 if x.sum() > 0 else x - 1.0,
+        inputs={"x": np.random.default_rng(1)
+                .standard_normal((4, 5)).astype(np.float32) - 1.0},
+        grad_inputs=("x",),
+        yaml_ops=(),
+    ))
+
+
+def test_case_chain():
+    def f(x):
+        return case([(x.mean() < -10.0, lambda: x * 0.0),
+                     (x.mean() < 10.0, lambda: x + 1.0)],
+                    default=lambda: x)
+
+    def ref(x):
+        if x.mean() < -10.0:
+            return x * 0.0
+        if x.mean() < 10.0:
+            return x + 1.0
+        return x
+
+    run_spec(OpSpec(
+        name="case", fn=f, ref=ref,
+        inputs={"x": np.random.default_rng(2)
+                .standard_normal((3, 4)).astype(np.float32)},
+        grad_inputs=("x",),
+        yaml_ops=(),
+    ))
+
+
+def test_switch_case_branches():
+    def f(idx, x):
+        return switch_case(idx, {0: lambda: x + 1.0, 2: lambda: x * 3.0},
+                           default=lambda: x * 0.0)
+
+    def ref(idx, x):
+        k = int(idx)
+        return {0: x + 1.0, 2: x * 3.0}.get(k, x * 0.0)
+
+    for k in (0, 2, 5):
+        run_spec(OpSpec(
+            name=f"switch_case_{k}", fn=f, ref=ref,
+            inputs={"idx": np.array(k, np.int32),
+                    "x": np.random.default_rng(3)
+                    .standard_normal((2, 3)).astype(np.float32)},
+            grad_inputs=("x",),
+            check_bf16=False,  # int branch index doesn't sweep dtypes
+            yaml_ops=("select_input",) if k == 0 else (),
+        ))
+
+
+def test_while_loop_fixed_count():
+    def f(x):
+        def cond_fn(i, v):
+            return i < 3
+
+        def body(i, v):
+            return [i + 1, v * 2.0]
+
+        import paddle_tpu as paddle
+        _, v = while_loop(cond_fn, body,
+                          [paddle.zeros([], dtype="int32"), x])
+        return v
+
+    run_spec(OpSpec(
+        name="while_loop",
+        fn=f,
+        ref=lambda x: x * 8.0,
+        inputs={"x": np.random.default_rng(4)
+                .standard_normal((3, 3)).astype(np.float32)},
+        # reverse-mode AD through lax.while_loop is unsupported by XLA's
+        # loop primitive (same as the reference's While grad restriction
+        # to static graphs); gradients are covered by the eager leg in
+        # tests/test_control_flow.py
+        grad_inputs=(),
+        yaml_ops=("while",),
+    ))

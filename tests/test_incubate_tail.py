@@ -206,3 +206,14 @@ def test_model_average_state_dict_roundtrip():
     with ma2.apply(need_restore=True):
         avg2 = net.weight.numpy().copy()
     np.testing.assert_allclose(avg1, avg2)
+
+
+def test_rpc_cross_host_requires_secret(monkeypatch):
+    from paddle_tpu.distributed.rpc import rpc as rpc_mod
+    monkeypatch.delenv("PADDLE_RPC_AUTHKEY", raising=False)
+    with pytest.raises(RuntimeError, match="PADDLE_RPC_AUTHKEY"):
+        rpc_mod._auth("10.0.0.5:8090")
+    monkeypatch.setenv("PADDLE_RPC_AUTHKEY", "s3cret")
+    assert rpc_mod._auth("10.0.0.5:8090") == b"s3cret"
+    monkeypatch.delenv("PADDLE_RPC_AUTHKEY")
+    assert rpc_mod._auth("127.0.0.1:8090")  # loopback: derived key ok

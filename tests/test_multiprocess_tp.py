@@ -219,3 +219,38 @@ def test_elastic_supervisor_detects_hung_worker(tmp_path):
     rc = sup.run()
     assert sup.restarts == 1        # hang detected -> one relaunch
     assert rc == 1                  # still hung -> gave up with code 1
+
+
+_EXIT0_WORKER = textwrap.dedent("""
+    import json, os, time
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    beat_dir = os.environ["PADDLE_ELASTIC_DIR"]
+    os.makedirs(beat_dir, exist_ok=True)
+    with open(os.path.join(beat_dir, f"rank_{rank}.beat"), "w") as f:
+        json.dump({"ts": time.time(), "host": "127.0.0.1"}, f)
+    if rank == "0":
+        raise SystemExit(0)   # done early, beats go stale
+    time.sleep(4.0)           # keeps training
+""")
+
+
+def test_elastic_exited_worker_not_flagged_hung(tmp_path):
+    """A rank that exits 0 with stale beats must NOT trigger a relaunch
+    of the still-healthy pod."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = tmp_path / "worker.py"
+    script.write_text(_EXIT0_WORKER)
+    beats = str(tmp_path / "beats")
+    cmds, envs = [], []
+    for r in range(2):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                   PADDLE_ELASTIC_DIR=beats)
+        cmds.append([sys.executable, str(script)])
+        envs.append(env)
+    sup = ElasticSupervisor(cmds, envs, heartbeat_dir=beats,
+                            interval=0.2, heartbeat_timeout=10.0,
+                            max_restarts=2, log=lambda *a: None)
+    rc = sup.run()
+    assert rc == 0
+    assert sup.restarts == 0

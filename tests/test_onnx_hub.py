@@ -23,8 +23,31 @@ def test_onnx_export_writes_stablehlo(tmp_path):
     assert os.path.getsize(artifacts["stablehlo_bin"]) > 0
     import json
     manifest = json.load(open(artifacts["manifest"]))
-    assert manifest["inputs"][0]["shape"] == [3, 4]
-    assert manifest["outputs"][0]["shape"] == [3, 2]
+    assert manifest["inputs"][0]["shape"] == ["3", "4"]
+    assert manifest["outputs"][0]["shape"] == ["3", "2"]
+
+
+def test_onnx_export_dynamic_batch(tmp_path):
+    """None dims export as SYMBOLIC dimensions: one artifact serves any
+    batch size (the reference keeps -1 dims dynamic in ONNX too)."""
+    import jax
+    paddle.seed(2)
+    net = nn.Linear(4, 2)
+    net.eval()
+    spec = [paddle.static.InputSpec(shape=[None, 4], dtype="float32")]
+    path = str(tmp_path / "dyn")
+    with pytest.warns(UserWarning):
+        arts = paddle.onnx.export(net, path, input_spec=spec)
+    reloaded = jax.export.deserialize(
+        open(arts["stablehlo_bin"], "rb").read())
+    for b in (1, 5):
+        x = paddle.rand([b, 4])
+        (out,) = reloaded.call(x.data)
+        np.testing.assert_allclose(np.asarray(out), net(x).numpy(),
+                                   rtol=1e-5)
+    import json
+    manifest = json.load(open(arts["manifest"]))
+    assert not manifest["inputs"][0]["shape"][0].isdigit()  # symbolic
 
 
 def test_onnx_export_roundtrip_runs():

@@ -1,0 +1,106 @@
+"""Top-level API surface parity: paddle.device/tensor/callbacks/batch/
+sysconfig/_C_ops/reader/version/dataset. ref: the same-named modules in
+reference python/paddle/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_device_module():
+    import jax
+    d = paddle.device.get_device()
+    assert isinstance(d, str) and ":" in d or d == "cpu"
+    assert paddle.device.device_count() == len(jax.devices())
+    assert "cpu" in paddle.device.get_all_device_type()
+    avail = paddle.device.get_available_device()
+    assert len(avail) == len(jax.devices())
+    assert paddle.device.XPUPlace is not None  # place classes exist
+
+
+def test_device_cuda_compat_surface():
+    cu = paddle.device.cuda
+    s = cu.current_stream()
+    ev = s.record_event()
+    assert ev.query() is True
+    s.synchronize()
+    cu.synchronize()
+    with cu.stream_guard(cu.Stream()):
+        pass
+    assert cu.device_count() >= 1
+    assert cu.memory_allocated() >= 0
+    props = cu.get_device_properties()
+    assert props.name
+
+
+def test_tensor_namespace():
+    x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32))
+    assert float(paddle.tensor.max(x)) == 3.0
+    out = paddle.tensor.sort(x)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+    assert hasattr(paddle.tensor, "math")
+    assert hasattr(paddle.tensor, "creation")
+
+
+def test_callbacks_reexport():
+    assert paddle.callbacks.EarlyStopping is not None
+    cb = paddle.callbacks.Callback()
+    assert hasattr(cb, "on_train_batch_end")
+
+
+def test_batch_reader():
+    def reader():
+        return iter(range(7))
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(reader, 3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(reader, 0)
+
+
+def test_c_ops_shim():
+    x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    y = paddle.to_tensor(np.array([[3.0], [4.0]], np.float32))
+    out = paddle._C_ops.matmul(x, y)
+    np.testing.assert_allclose(out.numpy(), [[11.0]])
+    # trailing-underscore inplace alias resolves to the base op
+    out = paddle._C_ops.relu_(paddle.to_tensor(
+        np.array([-1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                               [0.0, 2.0])
+    with pytest.raises(AttributeError):
+        paddle._C_ops.definitely_not_an_op
+
+
+def test_reader_decorators():
+    def r():
+        return iter(range(10))
+
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(paddle.reader.shuffle(r, 4)()) == list(range(10))
+    doubled = paddle.reader.map_readers(lambda a: a * 2, r)
+    assert list(doubled())[:3] == [0, 2, 4]
+    both = paddle.reader.chain(r, r)
+    assert len(list(both())) == 20
+    buf = paddle.reader.buffered(r, 2)
+    assert sorted(buf()) == list(range(10))
+    xm = paddle.reader.xmap_readers(lambda a: a + 1, r, 2, 4)
+    assert sorted(xm()) == list(range(1, 11))
+    cached = paddle.reader.cache(r)
+    assert list(cached()) == list(cached())
+
+
+def test_version_and_sysconfig():
+    assert paddle.version.full_version.startswith("2.5")
+    paddle.version.show()
+    assert paddle.sysconfig.get_include().endswith("include")
+    assert paddle.sysconfig.get_lib().endswith("libs")
+
+
+def test_dataset_legacy_raises_with_pointer():
+    with pytest.raises(RuntimeError, match="local-disk"):
+        paddle.dataset.mnist
+    with pytest.raises(AttributeError):
+        paddle.dataset.not_a_dataset

@@ -145,3 +145,27 @@ def test_group_sharded_parallel_offload_trains(single_device_mesh):
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_offload_direct_inner_step_streams(single_device_mesh):
+    """A user holding the ORIGINAL optimizer object after stage-3 offload
+    wrapping must still get the streamed host-state step (review finding:
+    the stock fused step would mix host states with device params)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import \
+        GroupShardedStage3
+
+    net = _make_net(9)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    wrapped = GroupShardedStage3(net, opt, offload=True)
+    x = paddle.to_tensor(np.random.default_rng(5)
+                         .standard_normal((4, 16)).astype(np.float32))
+    cpu = jax.devices("cpu")[0]
+    for _ in range(2):
+        (wrapped(x) ** 2).mean().backward()
+        opt.step()          # the ORIGINAL object, not the wrapper
+        opt.clear_grad()
+    assert opt._accumulators
+    for st in opt._accumulators.values():
+        for v in st.values():
+            assert cpu in v.devices()
