@@ -14,5 +14,4 @@ from .meta_parallel.sharding import (group_sharded_parallel,  # noqa: F401
 # submodule aliases matching the reference layout
 from . import fleet as _fleet_mod  # noqa: F401
 from .layers import mpu  # noqa: F401
-
-utils = None
+from . import utils  # noqa: F401

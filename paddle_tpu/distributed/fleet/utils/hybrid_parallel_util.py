@@ -1,0 +1,77 @@
+"""Hybrid-parallel gradient/parameter sync helpers (ref: /root/reference/
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+fused_allreduce_gradients:227, broadcast_mp_parameters:199,
+broadcast_dp_parameters:207, sharding_reduce_gradients:258).
+
+GSPMD note: inside the jitted SPMD step these syncs are XLA collectives
+inserted automatically; these helpers exist for the EAGER hybrid path
+(dygraph DP over jax.distributed / multi-controller), where gradients
+live per-process."""
+from __future__ import annotations
+
+from ....framework import autograd
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "sharding_reduce_gradients",
+           "broadcast_sharding_parameters"]
+
+
+def _group_size(hcg, kind):
+    if hcg is None:
+        from ... import get_world_size
+        return get_world_size()
+    getter = {"dp": "get_data_parallel_world_size",
+              "mp": "get_model_parallel_world_size",
+              "sharding": "get_sharding_parallel_world_size"}[kind]
+    try:
+        return getattr(hcg, getter)()
+    except AttributeError:
+        return 1
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """ref hybrid_parallel_util.py:227 — mean-allreduce every grad over
+    the data-parallel group."""
+    from ... import all_reduce
+    n = _group_size(hcg, "dp")
+    if n <= 1:
+        return
+    with autograd.no_grad():
+        for p in parameter_list:
+            mg = getattr(p, "main_grad", None)
+            g = mg if mg is not None else p.grad  # bool(Tensor) raises
+            if g is None:
+                continue
+            all_reduce(g)
+            g.set_value(g * (1.0 / n))
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    """ref :258 — same mean-reduce over the sharding group (the rank
+    keeps its shard's slice; under GSPMD the slice-keeping is the
+    optimizer state's PartitionSpec)."""
+    fused_allreduce_gradients(parameter_list, hcg)
+
+
+def _broadcast_params(model, src_rank=0):
+    from ... import broadcast
+    with autograd.no_grad():
+        for p in model.parameters():
+            broadcast(p, src_rank)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    """ref :199 — rank-0 weights win across the model-parallel group."""
+    if _group_size(hcg, "mp") > 1:
+        _broadcast_params(model)
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """ref :207."""
+    if _group_size(hcg, "dp") > 1:
+        _broadcast_params(model)
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    if _group_size(hcg, "sharding") > 1:
+        _broadcast_params(model)

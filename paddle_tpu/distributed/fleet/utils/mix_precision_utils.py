@@ -1,0 +1,84 @@
+"""fp32 main-grad accumulation for hybrid-parallel bf16 training
+(ref: /root/reference/python/paddle/distributed/fleet/utils/
+mix_precision_utils.py:30-45 MixPrecisionLayer / MixPrecisionOptimizer).
+
+The reference registers per-parameter grad hooks that accumulate the
+bf16 gradients into an fp32 `main_grad` buffer, and the wrapped
+optimizer updates from main_grad with fp32 master weights. Identical
+mechanism here over the tape's _accumulate_grad hook point."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]
+
+
+class MixPrecisionLayer(Layer):
+    """Wraps a layer whose params run in bf16/fp16: every backward
+    accumulates the gradient into fp32 `param.main_grad` (the hook
+    returns the grad unchanged, so `.grad` semantics stay intact)."""
+
+    def __init__(self, layers, dtype="bfloat16"):
+        super().__init__()
+        self._layers = layers
+        self._dtype = dtype
+        import numpy as np
+        for p in layers.parameters():
+            if np.issubdtype(np.dtype(str(p.data.dtype)), np.floating) \
+                    and str(p.data.dtype) != dtype:
+                p._data = p.data.astype(dtype)
+            p.main_grad = None
+
+            def _acc(grad, param=p):
+                g32 = grad.data.astype(jnp.float32)
+                if param.main_grad is None:
+                    param.main_grad = Tensor(g32)
+                else:
+                    param.main_grad._data = param.main_grad.data + g32
+                return grad
+            p.register_hook(_acc)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+
+class MixPrecisionOptimizer:
+    """Updates from fp32 main_grad with fp32 master weights (the
+    reference swaps param.grad for param.main_grad before the inner
+    step)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+        self._inner_opt._multi_precision = True
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        params = self._inner_opt._parameter_list_flat()
+        saved = []
+        for p in params:
+            if p.main_grad is not None:
+                saved.append((p, p._grad))
+                p._grad = p.main_grad
+        try:
+            self._inner_opt.step()
+        finally:
+            for p, g in saved:
+                p._grad = g
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+        for p in self._inner_opt._parameter_list_flat():
+            p.main_grad = None
+
+    clear_gradients = clear_grad

@@ -50,6 +50,7 @@ class SymbolicTensor(Tensor):
         self._hooks = []
         self.is_distributed = False
         self._dist_attr = None
+        self.main_grad = None
         self._node = node
         self._out_idx = out_idx
         self._aval = aval

@@ -24,7 +24,7 @@ _tensor_counter = [0]
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "name", "persistable",
                  "trainable", "_hooks", "is_distributed", "_dist_attr",
-                 "__weakref__")
+                 "main_grad", "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient=True, name=None):
         dtype = convert_dtype(dtype)
@@ -46,6 +46,9 @@ class Tensor:
         self._hooks = []
         self.is_distributed = False
         self._dist_attr = None
+        # fp32 gradient accumulator for hybrid-parallel bf16 training
+        # (ref fleet/utils/mix_precision_utils.py MixPrecisionLayer)
+        self.main_grad = None
 
     # -- core properties ---------------------------------------------------
     @property

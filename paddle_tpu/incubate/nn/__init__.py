@@ -6,6 +6,12 @@ fused_attention_op.cu, fused_feedforward_op.cu).
 On TPU "fused" means: written as one jnp chain so XLA fuses the elementwise
 work into the GEMMs, with the flash-attention pallas kernel on the score
 path. The classes keep the reference's weight-list API."""
+from . import functional  # noqa: F401
+from .memory_efficient_attention import (  # noqa: F401
+    memory_efficient_attention)
+from .layers import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                     FusedDropout, FusedDropoutAdd, FusedEcMoe,
+                     FusedLinear)
 from .fused_transformer import (FusedFeedForward, FusedMultiHeadAttention,  # noqa: F401
                                 FusedMultiTransformer,
                                 FusedMultiTransformerInt8,

@@ -1,0 +1,149 @@
+"""Incubate fused layers beyond the transformer stack (ref:
+/root/reference/python/paddle/incubate/nn/__init__.py — FusedLinear:19,
+FusedEcMoe:23, FusedDropoutAdd:24, FusedDropout:25,
+FusedBiasDropoutResidualLayerNorm from layer/fused_transformer.py).
+Thin Layer wrappers over incubate.nn.functional."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...framework.tensor import Tensor
+from . import functional as F
+
+__all__ = ["FusedLinear", "FusedEcMoe", "FusedDropoutAdd", "FusedDropout",
+           "FusedBiasDropoutResidualLayerNorm"]
+
+
+class FusedLinear(nn.Layer):
+    """ref layer/fused_linear.py:19 — Linear through the fused
+    matmul+bias epilogue."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        from ...nn import initializer as I
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedEcMoe(nn.Layer):
+    """ref layer/fused_ec_moe.py — dense expert mixture over a gate."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"act_type must be gelu/relu, got {act_type}")
+        self.act_type = act_type
+        from ...framework.tensor import Parameter
+        import jax
+        import jax.numpy as jnp
+        k = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        ks = jax.random.split(k, 2)
+        scale = 0.02
+        self.bmm0_weight = Parameter(
+            scale * jax.random.normal(
+                ks[0], (num_experts, hidden_size, inter_size),
+                jnp.float32))
+        self.bmm0_bias = Parameter(
+            jnp.zeros((num_experts, 1, inter_size), jnp.float32))
+        self.bmm1_weight = Parameter(
+            scale * jax.random.normal(
+                ks[1], (num_experts, inter_size, hidden_size),
+                jnp.float32))
+        self.bmm1_bias = Parameter(
+            jnp.zeros((num_experts, 1, hidden_size), jnp.float32))
+
+    def forward(self, x, gate):
+        return F.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                              self.bmm1_weight, self.bmm1_bias,
+                              self.act_type)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """ref layer/fused_dropout_add.py — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p,
+                                   training=self.training,
+                                   mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedDropout(nn.Layer):
+    """ref layer/fused_dropout_nd.py — dropout with optional axis (the
+    nd variant broadcasts one mask along the reduced axes)."""
+
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        from ...framework.op import apply
+        from ...framework import random as _random
+        import jax
+        import jax.numpy as jnp
+        if not self.training or self.p == 0.0:
+            return x
+        key = _random.next_key()
+        axis = self.axis
+
+        def impl(a, k):
+            keep = 1.0 - self.p
+            if axis is None:
+                shape = a.shape
+            else:
+                axes = (axis,) if isinstance(axis, int) else tuple(axis)
+                shape = tuple(s if i in axes else 1
+                              for i, s in enumerate(a.shape))
+            mask = jax.random.bernoulli(k, keep, shape)
+            return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+        return apply(impl, (x, key), op_name="fused_dropout")
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """ref layer/fused_transformer.py FusedBiasDropoutResidualLayerNorm —
+    layer_norm(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...framework.tensor import Parameter
+        import jax.numpy as jnp
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, seq_len=?, " \
+               f"dropout_rate={self.dropout_rate}"

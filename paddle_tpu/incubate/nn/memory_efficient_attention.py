@@ -1,0 +1,66 @@
+"""paddle.incubate.nn.memory_efficient_attention (ref: /root/reference/
+python/paddle/incubate/nn/memory_efficient_attention.py:70 — the cutlass
+memory-efficient attention binding).
+
+On TPU the memory-efficient algorithm IS flash attention: the call
+routes to the Pallas flash kernel (ops/pallas/flash_attention.py) via
+nn.functional, with the reference's attn_bias type surface mapped to
+mask/causal arguments."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ...framework.tensor import Tensor
+from ...nn import functional as F
+
+__all__ = ["memory_efficient_attention", "LowerTriangularMask",
+           "BlockDiagonalMask"]
+
+
+class LowerTriangularMask:
+    """ref attn_bias LowerTriangularMask — causal attention marker."""
+
+
+class BlockDiagonalMask:
+    """Simplified block-diagonal bias: materialize() gives the additive
+    mask (the reference builds this from seqlen lists)."""
+
+    def __init__(self, q_seqinfo, k_seqinfo=None):
+        self.q_seqinfo = q_seqinfo
+        self.k_seqinfo = k_seqinfo or q_seqinfo
+
+    def materialize(self):
+        import numpy as np
+        qs = list(self.q_seqinfo)
+        ks = list(self.k_seqinfo)
+        Lq, Lk = sum(qs), sum(ks)
+        mask = np.full((Lq, Lk), -1e30, np.float32)
+        q0 = k0 = 0
+        for lq, lk in zip(qs, ks):
+            mask[q0:q0 + lq, k0:k0 + lk] = 0.0
+            q0 += lq
+            k0 += lk
+        return Tensor(mask)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None,
+                               p: float = 0.0,
+                               scale: Optional[float] = None,
+                               training: bool = True):
+    """ref memory_efficient_attention.py:70. query/key/value
+    [B, L, H, D]; attn_bias: None | Tensor (additive) |
+    LowerTriangularMask (causal) | BlockDiagonalMask."""
+    causal = isinstance(attn_bias, LowerTriangularMask)
+    mask = None
+    if isinstance(attn_bias, BlockDiagonalMask):
+        mask = attn_bias.materialize()
+    elif isinstance(attn_bias, Tensor):
+        mask = attn_bias
+    dropout = p if training else 0.0
+    if scale is not None:
+        # sdpa scales by 1/sqrt(d) internally; fold a custom scale into q
+        query = query * (scale * math.sqrt(query.shape[-1]))
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=mask, dropout_p=dropout,
+        is_causal=causal)

@@ -248,12 +248,20 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _stack(arrays):
+    """np.stack with the parallel C++ collator on large batches (the
+    reference's C++ DataFeed batch assembly; see native_collate.cpp)."""
+    from .native import collate_stack
+    out = collate_stack(arrays)
+    return out if out is not None else np.stack(arrays)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
-        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+        return Tensor(_stack([np.asarray(s.numpy()) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return Tensor(_stack(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):

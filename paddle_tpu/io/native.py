@@ -1,0 +1,69 @@
+"""Native collation binding (see native_collate.cpp). Falls back to
+numpy silently when the host toolchain is unavailable — the pipeline is
+correct either way, just slower."""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+# below this many bytes a plain np.stack wins (thread spawn overhead)
+MIN_NATIVE_BYTES = 1 << 20
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            from ..utils.cpp_extension import load
+            src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "native_collate.cpp")
+            _lib = load("paddle_tpu_native_collate", [src],
+                        extra_ldflags=["-lpthread"])
+            _lib.collate_copy.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_long,
+                ctypes.c_long, ctypes.c_void_p, ctypes.c_int]
+            _lib.collate_copy.restype = None
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def collate_stack(arrays: List[np.ndarray],
+                  nthreads: int = 0) -> Optional[np.ndarray]:
+    """np.stack(arrays) through the parallel C++ collator. Returns None
+    when the native path does not apply (caller falls back)."""
+    lib = _load()
+    if lib is None or not arrays:
+        return None
+    first = arrays[0]
+    if first.dtype.hasobject:
+        return None  # raw memcpy of PyObject* would skip increfs
+    bytes_per = first.nbytes
+    if bytes_per * len(arrays) < MIN_NATIVE_BYTES:
+        return None
+    contig = []
+    for a in arrays:
+        if a.shape != first.shape or a.dtype != first.dtype:
+            return None  # ragged: numpy path handles the error/pad
+        contig.append(np.ascontiguousarray(a))
+    out = np.empty((len(contig),) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * len(contig))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in contig])
+    lib.collate_copy(ptrs, len(contig), bytes_per,
+                     out.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out

@@ -33,31 +33,44 @@ from ..static.input_spec import InputSpec
 __all__ = ["export"]
 
 
-_dyn_counter = [0]
-
-
-def _aval_of(spec):
-    if isinstance(spec, InputSpec):
-        if any(s in (None, -1) for s in spec.shape):
-            # dynamic dims become jax.export SYMBOLIC dimensions so the
-            # artifact stays shape-polymorphic (the reference's ONNX
-            # export keeps -1 dims dynamic the same way)
-            names = []
-            for s in spec.shape:
-                if s in (None, -1):
-                    _dyn_counter[0] += 1
-                    names.append(f"_d{_dyn_counter[0]}")
-                else:
-                    names.append(str(int(s)))
-            shape = jax.export.symbolic_shape(", ".join(names))
-            return jax.ShapeDtypeStruct(shape, np.dtype(spec.dtype))
-        return jax.ShapeDtypeStruct(tuple(int(s) for s in spec.shape),
-                                    np.dtype(spec.dtype))
-    if isinstance(spec, Tensor):
-        return jax.ShapeDtypeStruct(tuple(spec.shape),
-                                    np.dtype(str(spec.data.dtype)))
-    arr = np.asarray(spec)
-    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+def _avals_of(specs):
+    """Build the traced avals. Dynamic dims (None/-1 in an InputSpec)
+    become jax.export SYMBOLIC dimensions so the artifact stays
+    shape-polymorphic — all created in ONE symbolic scope (mixing scopes
+    across inputs is rejected by jax.export). The leading dynamic dim of
+    every input shares the `batch` symbol; other dynamic dims get their
+    own symbols."""
+    scope = jax.export.SymbolicScope()
+    counter = [0]
+    avals = []
+    for spec in specs:
+        if isinstance(spec, InputSpec):
+            if any(s in (None, -1) for s in spec.shape):
+                names = []
+                for i, s in enumerate(spec.shape):
+                    if s in (None, -1):
+                        if i == 0:
+                            names.append("batch")
+                        else:
+                            counter[0] += 1
+                            names.append(f"dyn{counter[0]}")
+                    else:
+                        names.append(str(int(s)))
+                shape = jax.export.symbolic_shape(", ".join(names),
+                                                  scope=scope)
+                avals.append(jax.ShapeDtypeStruct(shape,
+                                                  np.dtype(spec.dtype)))
+            else:
+                avals.append(jax.ShapeDtypeStruct(
+                    tuple(int(s) for s in spec.shape),
+                    np.dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            avals.append(jax.ShapeDtypeStruct(
+                tuple(spec.shape), np.dtype(str(spec.data.dtype))))
+        else:
+            arr = np.asarray(spec)
+            avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return avals
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
@@ -69,7 +82,7 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
             "paddle.onnx.export on the TPU backend requires input_spec "
             "(a list of paddle.static.InputSpec or example Tensors): jax "
             "traces by shape, there is no ProgramDesc to introspect")
-    avals = [_aval_of(s) for s in input_spec]
+    avals = _avals_of(input_spec)
 
     from ..framework import autograd
 

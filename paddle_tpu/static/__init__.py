@@ -140,6 +140,7 @@ def load_inference_model(path_prefix, executor, **kwargs):
 
 from .control_flow import (cond, while_loop, case,  # noqa: F401,E402
                            switch_case, Print)
+from . import amp  # noqa: F401,E402
 
 
 class nn:

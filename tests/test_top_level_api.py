@@ -104,3 +104,31 @@ def test_dataset_legacy_raises_with_pointer():
         paddle.dataset.mnist
     with pytest.raises(AttributeError):
         paddle.dataset.not_a_dataset
+
+
+def test_static_amp_surface():
+    import jax.numpy as jnp
+    from paddle_tpu import nn
+    from paddle_tpu.static import amp as samp
+
+    lists = samp.AutoMixedPrecisionLists(custom_white_list=["softmax"],
+                                         custom_black_list=["sum"])
+    assert "softmax" in lists.white_list
+    assert "sum" in lists.black_list
+
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 multi_precision=True)
+    dec = samp.decorate(opt, amp_lists=lists)
+    assert dec.get_loss_scaling() > 1
+    # decorated optimizer still steps
+    x = paddle.rand([2, 4])
+    (net(x) ** 2).mean().backward()
+    dec.step()
+    dec.clear_grad()
+
+    samp.cast_model_to_fp16(net)
+    assert net.weight.data.dtype == jnp.bfloat16
+    with samp.fp16_guard():
+        pass
+    assert samp.bf16.cast_model_to_bf16 is not None
