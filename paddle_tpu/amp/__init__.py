@@ -1,5 +1,6 @@
 """AMP: autocast + loss scaling (ref: /root/reference/python/paddle/amp/)."""
 from .auto_cast import auto_cast, amp_guard, decorate, amp_state  # noqa: F401
 from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
+from . import debugging  # noqa: F401
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]

@@ -1,0 +1,148 @@
+"""paddle.amp.debugging (ref: /root/reference/python/paddle/amp/
+debugging.py — TensorCheckerConfig:79, enable_tensor_checker:489,
+operator stats collection:314).
+
+TPU mapping: the per-op nan/inf scan already lives in framework.op
+behind FLAGS_check_nan_inf (the reference's same flag); the checker API
+toggles it. Operator stats ride the profiler's host-event hook — every
+op application is recorded with its name, so counting per-op calls is a
+dict fold over those events."""
+from __future__ import annotations
+
+from collections import Counter
+from contextlib import contextmanager
+from enum import Enum
+from typing import List, Optional
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "compare_accuracy"]
+
+
+class DebugMode(Enum):
+    """ref debugging.py:37."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    """ref debugging.py:79 — which ops to scan and what to do on hit."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """ref debugging.py:489 — turns the per-op nan/inf scan on."""
+    from ..flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": bool(checker_config.enable)})
+
+
+def disable_tensor_checker():
+    """ref debugging.py:530."""
+    from ..flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Immediate nan/inf scan of one tensor (the reference's
+    check_numerics op). Raises on hit, like CHECK_NAN_INF_AND_ABORT."""
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    a = tensor.data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(a.dtype, jnp.inexact):
+        return 0, 0
+    n_nan = int(jnp.isnan(a).sum())
+    n_inf = int(jnp.isinf(a).sum())
+    if n_nan or n_inf:
+        raise RuntimeError(
+            f"check_numerics: {op_type or 'tensor'} {var_name or ''} "
+            f"contains nan={n_nan} inf={n_inf} "
+            f"(shape={tuple(a.shape)}, dtype={a.dtype})")
+    return n_nan, n_inf
+
+
+# ---------------------------------------------------------------- op stats
+_stats_state = {"mark": 0, "prev_enabled": False}
+
+
+def enable_operator_stats_collection():
+    """ref debugging.py:314 — start counting op applications via the
+    profiler's host-event hook. Coexists with an active profiler run:
+    prior events and the enabled flag are preserved."""
+    from ..profiler import _host
+    _stats_state["prev_enabled"] = _host.enabled
+    _stats_state["mark"] = len(_host.events)
+    _host.enabled = True
+
+
+def disable_operator_stats_collection():
+    """ref debugging.py:351 — stop and print the per-op call counts
+    (only the ops recorded since enable); restores the profiler's own
+    recording state."""
+    from ..profiler import _host
+    mark = _stats_state["mark"]
+    counts = Counter(name for name, *_ in _host.events[mark:])
+    _host.enabled = _stats_state["prev_enabled"]
+    if not _host.enabled:
+        # events collected for stats only; don't leak into a later
+        # profiler report
+        del _host.events[mark:]
+    print("<------------------------------ op list "
+          "------------------------------->")
+    for name, n in counts.most_common():
+        print(f"  {name:<40} calls={n}")
+    print("<----------------------------------- done "
+          "----------------------------->")
+    return dict(counts)
+
+
+@contextmanager
+def collect_operator_stats():
+    """ref debugging.py:393."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """ref debugging.py:428 — offline comparison of two runs' tensor
+    dumps. The TPU workflow dumps arrays with numpy.save; this compares
+    matching files."""
+    import csv
+    import os
+    import numpy as np
+    rows = []
+    for name in sorted(os.listdir(dump_path)):
+        other = os.path.join(another_dump_path, name)
+        if not name.endswith(".npy") or not os.path.exists(other):
+            continue
+        a = np.load(os.path.join(dump_path, name))
+        b = np.load(other)
+        if a.shape != b.shape:
+            rows.append((name, f"shape-mismatch {a.shape}->{b.shape}",
+                         "", ""))
+            continue
+        diff = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        rows.append((name, float(diff.max()), float(diff.mean()),
+                     bool(np.isnan(a).any() or np.isnan(b).any())))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "max_diff", "mean_diff", "has_nan"])
+        w.writerows(rows)
+    return rows

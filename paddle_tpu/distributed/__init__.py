@@ -18,6 +18,10 @@ from .auto_parallel import (ProcessMesh, Replicate, Shard, dtensor_from_fn,  # n
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from . import passes  # noqa: F401
+from . import utils  # noqa: F401
+from . import io  # noqa: F401
+from .utils import global_gather, global_scatter  # noqa: F401
 
 
 def is_initialized():

@@ -29,11 +29,8 @@ def _group_size(hcg, kind):
         return 1
 
 
-def fused_allreduce_gradients(parameter_list, hcg=None):
-    """ref hybrid_parallel_util.py:227 — mean-allreduce every grad over
-    the data-parallel group."""
+def _mean_reduce(parameter_list, n):
     from ... import all_reduce
-    n = _group_size(hcg, "dp")
     if n <= 1:
         return
     with autograd.no_grad():
@@ -46,11 +43,17 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
             g.set_value(g * (1.0 / n))
 
 
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """ref hybrid_parallel_util.py:227 — mean-allreduce every grad over
+    the data-parallel group."""
+    _mean_reduce(parameter_list, _group_size(hcg, "dp"))
+
+
 def sharding_reduce_gradients(parameter_list, hcg=None):
-    """ref :258 — same mean-reduce over the sharding group (the rank
-    keeps its shard's slice; under GSPMD the slice-keeping is the
-    optimizer state's PartitionSpec)."""
-    fused_allreduce_gradients(parameter_list, hcg)
+    """ref :258 — mean-reduce over the SHARDING group (the rank keeps
+    its shard's slice; under GSPMD the slice-keeping is the optimizer
+    state's PartitionSpec)."""
+    _mean_reduce(parameter_list, _group_size(hcg, "sharding"))
 
 
 def _broadcast_params(model, src_rank=0):

@@ -1,0 +1,37 @@
+"""paddle.distributed.io (ref: /root/reference/python/paddle/
+distributed/io.py — save/load_persistables + distributed
+save_inference_model). Single-controller GSPMD: every process holds the
+global (sharded) arrays, so the distributed save IS the sharded
+checkpoint writer in distributed.checkpoint; these wrappers keep the
+reference entry points."""
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import save
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    if main_program is None:
+        from ..framework.symbolic import default_main_program
+        main_program = default_main_program()
+    state = {}
+    for t in getattr(main_program, "_state_updates", []):
+        target = t[0]
+        if is_persistable(target):
+            state[target.name] = target
+    save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+    return state
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+    import os
+    return load(os.path.join(dirname,
+                             filename or "persistables.pdparams"))

@@ -1,0 +1,167 @@
+"""paddle.distributed.passes (ref: /root/reference/python/paddle/
+distributed/passes/pass_base.py — PassContext:20, new_pass:133,
+PassManager:353; the auto_parallel_* passes rewrite per-rank
+ProgramDescs).
+
+TPU mapping: program rewriting is XLA's job. The pass OBJECTS exist with
+the reference's registry/apply API so strategy code ports unchanged, and
+each pass records what GSPMD/XLA mechanism supersedes it; passes with a
+live equivalent route to it (sharding → optimizer-state PartitionSpecs).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "register_pass"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name):
+    def wrap(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+    return wrap
+
+
+class PassContext:
+    """ref pass_base.py:20."""
+
+    def __init__(self):
+        self._attrs = {}
+        self._applied_passes = []
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    """ref pass_base.py PassBase — check_enabled + apply contract."""
+
+    name = "base"
+    # what replaces this pass on the TPU backend (shown in repr/logs)
+    tpu_equivalent = "handled by XLA/GSPMD compilation"
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def check_enabled(self):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Default: the transformation is performed by the compiler; the
+        pass records itself in the context and leaves the program
+        untouched (programs here are traced jax computations — there is
+        no per-op IR to edit)."""
+        if context is not None:
+            context._applied_passes.append(self.name)
+        return main_programs
+
+    def __repr__(self):
+        return f"<Pass {self.name!r} (tpu: {self.tpu_equivalent})>"
+
+
+@register_pass("auto_parallel_amp")
+class _AmpPass(PassBase):
+    tpu_equivalent = "amp.auto_cast policy + bf16-native compute"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        from ...amp.auto_cast import amp_state
+        st = amp_state()
+        if self.get_attr("custom_white_list"):
+            st.white = set(st.white) | set(self.get_attr(
+                "custom_white_list"))
+        if self.get_attr("custom_black_list"):
+            st.black = set(st.black) | set(self.get_attr(
+                "custom_black_list"))
+        return super().apply(main_programs, startup_programs, context)
+
+
+@register_pass("auto_parallel_sharding")
+class _ShardingPass(PassBase):
+    tpu_equivalent = ("optimizer-state PartitionSpecs over the "
+                      "'sharding' mesh axis")
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        opt = self.get_attr("optimizer")
+        if opt is not None:
+            from ..fleet.meta_parallel.sharding import shard_accumulators
+            shard_accumulators(opt)
+        return super().apply(main_programs, startup_programs, context)
+
+
+@register_pass("auto_parallel_recompute")
+class _RecomputePass(PassBase):
+    tpu_equivalent = "jax.checkpoint on the marked segments"
+
+
+@register_pass("auto_parallel_gradient_merge_pass")
+class _GradientMergePass(PassBase):
+    tpu_equivalent = "fleet.meta_optimizers GradientMergeOptimizer"
+
+
+@register_pass("auto_parallel_fp16")
+class _Fp16Pass(_AmpPass):
+    tpu_equivalent = "bf16 compute dtype (fp16 maps to bf16 on TPU)"
+
+
+@register_pass("fuse_optimizer")
+class _FuseOptimizerPass(PassBase):
+    tpu_equivalent = "the optimizer's fused jitted update (_make_fused)"
+
+
+@register_pass("fused_attention")
+class _FusedAttentionPass(PassBase):
+    tpu_equivalent = "pallas flash attention via nn.functional"
+
+
+@register_pass("fused_feedforward")
+class _FusedFeedforwardPass(PassBase):
+    tpu_equivalent = "XLA elementwise-into-GEMM fusion"
+
+
+def new_pass(name, pass_attrs: Optional[dict] = None):
+    """ref pass_base.py:133."""
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        # unknown passes still construct (the reference registry is
+        # open-ended); they apply as compiler-handled no-ops
+        cls = type(f"_GenericPass_{name}", (PassBase,), {"name": name})
+    p = cls()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """ref pass_base.py:353 — ordered pass application."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = list(passes)
+        self._context = PassContext()
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self._passes:
+            if p.check_enabled():
+                p.apply(main_programs, startup_programs, self._context)
+        return main_programs

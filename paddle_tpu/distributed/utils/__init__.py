@@ -1,0 +1,6 @@
+"""paddle.distributed.utils (ref: /root/reference/python/paddle/
+distributed/utils/__init__.py)."""
+from .log_utils import get_logger  # noqa: F401
+from .moe_utils import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["get_logger", "global_scatter", "global_gather"]

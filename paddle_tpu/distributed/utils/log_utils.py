@@ -1,0 +1,17 @@
+"""ref: /root/reference/python/paddle/distributed/utils/log_utils.py."""
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(log_level="INFO", name="paddle_tpu.distributed"):
+    logger = logging.getLogger(name)
+    if isinstance(log_level, str):
+        log_level = getattr(logging, log_level.upper())
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s-%(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger

@@ -1,0 +1,80 @@
+"""MoE token dispatch (ref: /root/reference/python/paddle/distributed/
+utils/moe_utils.py — global_scatter:20 / global_gather:146, the
+variable-count all-to-all under the reference MoELayer).
+
+TPU design note: XLA requires STATIC shapes, so the production dispatch
+path is the capacity-padded all-to-all in `incubate.moe` (GShard) — the
+exact design the GShard/Switch papers use on TPU. These functions keep
+the reference's count-based API for porting:
+
+  * single-process (no jax.distributed world): exact semantics via
+    repeat/gather on the host-traced counts — counts define a
+    permutation, no communication needed.
+  * multi-process: raises, pointing at incubate.moe's static-shape
+    dispatch (variable-count send/recv cannot compile to one XLA
+    program).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.op import apply
+from ...framework.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _world_size():
+    import jax
+    return jax.process_count()
+
+
+def _require_single_process(op):
+    if _world_size() > 1:
+        raise NotImplementedError(
+            f"{op} with variable per-expert counts cannot compile to a "
+            f"static-shape XLA program across processes; use the "
+            f"capacity-padded dispatch in paddle.incubate.moe (MoELayer/"
+            f"GShard all-to-all), which is the TPU-native equivalent")
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """ref moe_utils.py:20. Single-process: rows of x are taken in
+    expert order — local_count[i] rows go to expert (i % n_expert) —
+    which equals receiving global_count in the same order."""
+    _require_single_process("global_scatter")
+    lc = np.asarray(local_count.numpy()
+                    if isinstance(local_count, Tensor) else local_count)
+    # expert-major concatenation of the count-segmented rows of x
+    starts = np.concatenate([[0], np.cumsum(lc)[:-1]])
+    order = []
+    n = lc.shape[0]
+    for i in range(n):
+        order.extend(range(int(starts[i]), int(starts[i] + lc[i])))
+    idx = np.asarray(order, np.int32)
+
+    def impl(a):
+        return a[idx] if idx.size else a[:0]
+    return apply(impl, (x,), op_name="global_scatter")
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """ref moe_utils.py:146 — the inverse permutation of
+    global_scatter."""
+    _require_single_process("global_gather")
+    lc = np.asarray(local_count.numpy()
+                    if isinstance(local_count, Tensor) else local_count)
+    starts = np.concatenate([[0], np.cumsum(lc)[:-1]])
+    order = []
+    n = lc.shape[0]
+    for i in range(n):
+        order.extend(range(int(starts[i]), int(starts[i] + lc[i])))
+    idx = np.asarray(order, np.int32)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(idx.size, dtype=np.int32)
+
+    def impl(a):
+        return a[inv] if inv.size else a[:0]
+    return apply(impl, (x,), op_name="global_gather")

@@ -28,12 +28,19 @@ __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_dropout_add", "fused_gate_attention"]
 
 
-def _dropout(a, rate, training, key):
-    if not training or rate == 0.0:
+def _dropout(a, rate, training, key, mode="upscale_in_train"):
+    """Both reference modes (nn.functional dropout semantics):
+    upscale_in_train — train: kept/keep, infer: identity;
+    downscale_in_infer — train: kept unscaled, infer: a*(1-p)."""
+    if rate == 0.0:
         return a
     keep = 1.0 - rate
+    if not training:
+        return a if mode == "upscale_in_train" \
+            else (a * keep).astype(a.dtype)
     mask = jax.random.bernoulli(key, keep, a.shape)
-    return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+    kept = a / keep if mode == "upscale_in_train" else a
+    return jnp.where(mask, kept, 0.0).astype(a.dtype)
 
 
 def _ln(a, scale, bias, eps):
@@ -74,7 +81,7 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
     key = _random.next_key()
 
     def impl(x_, y_, k):
-        return _dropout(x_, p, training, k) + y_
+        return _dropout(x_, p, training, k, mode) + y_
     return apply(impl, (x, y, key), op_name="fused_dropout_add")
 
 
@@ -94,7 +101,7 @@ def fused_bias_dropout_residual_layer_norm(
         s = next(it) if has[1] else None
         lb = next(it) if has[2] else None
         h = x_ + b if b is not None else x_
-        h = res + _dropout(h, dropout_rate, training, k)
+        h = res + _dropout(h, dropout_rate, training, k, mode)
         return _ln(h, s, lb, ln_epsilon)
     return apply(impl, (x, residual, key, *opt),
                  op_name="fused_bias_dropout_residual_layer_norm")
@@ -126,11 +133,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight,
         h = jnp.matmul(h, w1)
         if "l1b" in d:
             h = h + d["l1b"]
-        h = _dropout(act(h), dropout1_rate, training, ka)
+        h = _dropout(act(h), dropout1_rate, training, ka, mode)
         h = jnp.matmul(h, w2)
         if "l2b" in d:
             h = h + d["l2b"]
-        h = _dropout(h, dropout2_rate, training, kb)
+        h = _dropout(h, dropout2_rate, training, kb, mode)
         if add_residual:
             h = residual + h
         if not pre_layer_norm:
@@ -151,6 +158,12 @@ def fused_multi_head_attention(
     """ref fused_transformer.py:464 (fused_attention_op.cu). qkv_weight:
     [3, n_heads, head_dim, embed_dim] (or [embed_dim, 3*embed_dim] with
     transpose_qkv_wb=True, then num_heads is required)."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention(cache_kv=...) is not wired in "
+            "the functional entry; use incubate.nn.FusedMultiTransformer "
+            "(caches/time_step decode path) — silently ignoring the "
+            "cache would corrupt autoregressive decode")
     k1, k2 = _random.next_key(), _random.next_key()
     opt = {"pls": pre_ln_scale, "plb": pre_ln_bias, "ls": ln_scale,
            "lb": ln_bias, "qb": qkv_bias, "ob": linear_bias,
@@ -182,12 +195,12 @@ def fused_multi_head_attention(
         if "mask" in d:
             scores = scores + d["mask"]
         probs = jax.nn.softmax(scores, axis=-1)
-        probs = _dropout(probs, attn_dropout_rate, training, ka)
+        probs = _dropout(probs, attn_dropout_rate, training, ka, mode)
         ctx = jnp.einsum("bnlm,bmnh->blnh", probs, v).reshape(B, L, -1)
         out = jnp.matmul(ctx, ow)
         if "ob" in d:
             out = out + d["ob"]
-        out = _dropout(out, dropout_rate, training, kb)
+        out = _dropout(out, dropout_rate, training, kb, mode)
         if add_residual:
             out = residual + out
         if not pre_layer_norm:
@@ -211,6 +224,21 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     """ref fused_transformer.py:872 — the functional decoder-stack entry.
     Delegates to the FusedMultiTransformer layer math (incubate/nn/
     fused_transformer.py), wiring the per-layer weight lists in."""
+    unsupported = {"seq_lens": seq_lens, "pre_caches": pre_caches,
+                   "rotary_embs": rotary_embs}
+    bad = [k for k, v in unsupported.items() if v is not None]
+    if bad:
+        raise NotImplementedError(
+            f"fused_multi_transformer: {bad} are not wired in the "
+            f"functional entry (the layer path has no rotary/varlen "
+            f"support); silently dropping them would produce wrong "
+            f"outputs. Use models.llama_spmd for rotary decoding or "
+            f"ops.pallas.decode_attention for variable seq_lens.")
+    if dropout_rate:
+        raise NotImplementedError(
+            "fused_multi_transformer functional entry supports "
+            "dropout_rate=0 only (inference schedule, matching the "
+            "reference's training=False default)")
     from ..fused_transformer import FusedMultiTransformer
     num_layers = len(qkv_weights)
     embed_dim = x.shape[-1]
@@ -220,16 +248,20 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     # every weight is overwritten below anyway (array rebinding is free)
     cache_key = (embed_dim, nh, int(ffn1_weights[0].shape[-1]),
                  activation, pre_layer_norm, float(epsilon), num_layers)
-    blk = _FMT_CACHE.get(cache_key)
-    if blk is None:
-        blk = FusedMultiTransformer(
-            embed_dim, num_heads=nh,
-            dim_feedforward=ffn1_weights[0].shape[-1],
-            activation=activation, normalize_before=pre_layer_norm,
-            epsilon=epsilon, num_layers=num_layers)
-        _FMT_CACHE[cache_key] = blk
     from ....framework import autograd
-    with autograd.no_grad():
+    # the lock spans weight rebinding AND the forward: the cached
+    # block's parameters are shared mutable state across callers
+    with _FMT_LOCK, autograd.no_grad():
+        blk = _FMT_CACHE.get(cache_key)
+        if blk is None:
+            blk = FusedMultiTransformer(
+                embed_dim, num_heads=nh,
+                dim_feedforward=ffn1_weights[0].shape[-1],
+                activation=activation, normalize_before=pre_layer_norm,
+                epsilon=epsilon, num_layers=num_layers)
+            if len(_FMT_CACHE) >= 4:  # bound the pinned stacks
+                _FMT_CACHE.pop(next(iter(_FMT_CACHE)))
+            _FMT_CACHE[cache_key] = blk
         for i, b in enumerate(blk.layers):
             wd = _arr(qkv_weights[i])
             # ref layouts: trans_qkvw=True -> [3, nh, hd, E];
@@ -255,12 +287,17 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             b.ffn2.weight._data = _arr(ffn2_weights[i])
             if ffn2_biases and ffn2_biases[i] is not None:
                 b.ffn2.bias._data = _arr(ffn2_biases[i])
-    out = blk(x, attn_mask=attn_mask, caches=cache_kvs,
-              time_step=time_step)
+        out = blk(x, attn_mask=attn_mask, caches=cache_kvs,
+                  time_step=time_step)
     return out
 
 
+import threading
+
 _FMT_CACHE: dict = {}
+# weight rebinding + forward must not interleave across threads: the
+# cached block's parameters are shared mutable state
+_FMT_LOCK = threading.Lock()
 
 
 def _arr(t):

@@ -46,24 +46,19 @@ class FusedEcMoe(nn.Layer):
         if act_type not in ("gelu", "relu"):
             raise ValueError(f"act_type must be gelu/relu, got {act_type}")
         self.act_type = act_type
-        from ...framework.tensor import Parameter
-        import jax
-        import jax.numpy as jnp
-        k = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
-        ks = jax.random.split(k, 2)
-        scale = 0.02
-        self.bmm0_weight = Parameter(
-            scale * jax.random.normal(
-                ks[0], (num_experts, hidden_size, inter_size),
-                jnp.float32))
-        self.bmm0_bias = Parameter(
-            jnp.zeros((num_experts, 1, inter_size), jnp.float32))
-        self.bmm1_weight = Parameter(
-            scale * jax.random.normal(
-                ks[1], (num_experts, inter_size, hidden_size),
-                jnp.float32))
-        self.bmm1_bias = Parameter(
-            jnp.zeros((num_experts, 1, hidden_size), jnp.float32))
+        # create_parameter: honors paddle.seed reproducibility and the
+        # weight_attr/bias_attr contract like every other layer
+        from ...nn import initializer as I
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr,
+            default_initializer=I.Normal(std=0.02))
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr,
+            default_initializer=I.Normal(std=0.02))
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
 
     def forward(self, x, gate):
         return F.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
@@ -104,10 +99,15 @@ class FusedDropout(nn.Layer):
         from ...framework import random as _random
         import jax
         import jax.numpy as jnp
-        if not self.training or self.p == 0.0:
+        if self.p == 0.0:
+            return x
+        if not self.training:
+            if self.mode == "downscale_in_infer":
+                return x * (1.0 - self.p)
             return x
         key = _random.next_key()
         axis = self.axis
+        mode = self.mode
 
         def impl(a, k):
             keep = 1.0 - self.p
@@ -118,7 +118,8 @@ class FusedDropout(nn.Layer):
                 shape = tuple(s if i in axes else 1
                               for i, s in enumerate(a.shape))
             mask = jax.random.bernoulli(k, keep, shape)
-            return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+            kept = a / keep if mode == "upscale_in_train" else a
+            return jnp.where(mask, kept, 0.0).astype(a.dtype)
         return apply(impl, (x, key), op_name="fused_dropout")
 
 

@@ -281,6 +281,14 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        if not num_workers:
+            # dataloader autotuning (ref incubate/autotune.py): pick a
+            # prefetch worker count when the user left it unset
+            try:
+                from ..incubate.autotune import suggested_num_workers
+                num_workers = suggested_num_workers() or num_workers
+            except ImportError:  # pragma: no cover
+                pass
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)

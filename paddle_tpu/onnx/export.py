@@ -33,13 +33,19 @@ from ..static.input_spec import InputSpec
 __all__ = ["export"]
 
 
-def _avals_of(specs):
+def _avals_of(specs, share_batch=True):
     """Build the traced avals. Dynamic dims (None/-1 in an InputSpec)
     become jax.export SYMBOLIC dimensions so the artifact stays
     shape-polymorphic — all created in ONE symbolic scope (mixing scopes
-    across inputs is rejected by jax.export). The leading dynamic dim of
-    every input shares the `batch` symbol; other dynamic dims get their
-    own symbols."""
+    across inputs is rejected by jax.export).
+
+    share_batch=True (default): every input's LEADING dynamic dim shares
+    one `batch` symbol — required when the traced model combines inputs
+    elementwise (ids + mask), since equality of independent symbols is
+    unprovable at trace time. share_batch=False gives each dynamic dim
+    its own symbol, for inputs with genuinely independent sizes (query
+    set vs candidate set); pass share_batch_dim=False through export's
+    **configs to select it."""
     scope = jax.export.SymbolicScope()
     counter = [0]
     avals = []
@@ -49,7 +55,7 @@ def _avals_of(specs):
                 names = []
                 for i, s in enumerate(spec.shape):
                     if s in (None, -1):
-                        if i == 0:
+                        if i == 0 and share_batch:
                             names.append("batch")
                         else:
                             counter[0] += 1
@@ -82,7 +88,8 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
             "paddle.onnx.export on the TPU backend requires input_spec "
             "(a list of paddle.static.InputSpec or example Tensors): jax "
             "traces by shape, there is no ProgramDesc to introspect")
-    avals = _avals_of(input_spec)
+    avals = _avals_of(input_spec,
+                      share_batch=configs.get("share_batch_dim", True))
 
     from ..framework import autograd
 

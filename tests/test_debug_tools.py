@@ -46,3 +46,66 @@ def test_flag_off_no_raise():
     x = paddle.to_tensor(np.array([np.nan], np.float32))
     out = paddle.add(x, x)  # no error when the flag is off
     assert np.isnan(out.numpy()).all()
+
+
+def test_amp_debugging_tensor_checker():
+    from paddle_tpu.amp import debugging as dbg
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import get_flag
+
+    cfg = dbg.TensorCheckerConfig(
+        enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        assert get_flag("FLAGS_check_nan_inf")
+        bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            _ = bad + 1.0
+    finally:
+        dbg.disable_tensor_checker()
+    assert not get_flag("FLAGS_check_nan_inf")
+    # immediate single-tensor scan
+    with pytest.raises(RuntimeError, match="check_numerics"):
+        dbg.check_numerics(np.array([np.inf], np.float32), "add", "x")
+    assert dbg.check_numerics(np.ones(3, np.float32)) == (0, 0)
+
+
+def test_amp_debugging_operator_stats(capsys):
+    from paddle_tpu.amp import debugging as dbg
+    import paddle_tpu as paddle
+    with dbg.collect_operator_stats():
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        for _ in range(3):
+            x = x * 2.0
+    out = capsys.readouterr().out
+    assert "op list" in out
+    assert "multiply" in out or "mul" in out
+
+
+def test_amp_debugging_compare_accuracy(tmp_path):
+    from paddle_tpu.amp import debugging as dbg
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(); d2.mkdir()
+    np.save(d1 / "w.npy", np.ones((2, 2), np.float32))
+    np.save(d2 / "w.npy", np.ones((2, 2), np.float32) * 1.5)
+    rows = dbg.compare_accuracy(str(d1), str(d2),
+                                str(tmp_path / "cmp.csv"))
+    assert rows and rows[0][1] == 0.5
+    assert (tmp_path / "cmp.csv").exists()
+
+
+def test_operator_stats_preserves_profiler_events():
+    from paddle_tpu.amp import debugging as dbg
+    from paddle_tpu.profiler import _host
+    import paddle_tpu as paddle
+    # simulate an active profiler session with prior events
+    _host.enabled = True
+    _host.events.append(("pre_existing", 0, 1))
+    try:
+        with dbg.collect_operator_stats():
+            _ = paddle.to_tensor(np.ones(2, np.float32)) * 2.0
+        assert _host.enabled  # profiler still recording
+        assert ("pre_existing", 0, 1) in _host.events
+    finally:
+        _host.enabled = False
+        _host.events.clear()

@@ -295,3 +295,44 @@ def test_fused_gate_attention_cross_attention_uses_key():
     ctx = np.einsum("bnqk,bknh->bqnh", p, vv)
     ref = np.einsum("bqnh,nhd->bqd", ctx, ow)
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_dropout_modes():
+    x = rng.standard_normal((512,)).astype(np.float32)
+    y = np.zeros(512, np.float32)
+    # downscale_in_infer, eval: x*(1-p) + y
+    out = IF.fused_dropout_add(_t(x), _t(y), p=0.4, training=False,
+                               mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), x * 0.6, rtol=1e-5)
+    # downscale_in_infer, train: kept values NOT upscaled
+    out = IF.fused_dropout_add(_t(x), _t(y), p=0.4, training=True,
+                               mode="downscale_in_infer").numpy()
+    kept = out[out != 0.0]
+    orig = x[out != 0.0]
+    np.testing.assert_allclose(kept, orig, rtol=1e-6)
+
+
+def test_fused_mha_cache_kv_raises():
+    x = _t(rng.standard_normal((1, 2, 8)))
+    w = _t(rng.standard_normal((3, 2, 4, 8)))
+    ow = _t(rng.standard_normal((8, 8)))
+    with pytest.raises(NotImplementedError, match="cache_kv"):
+        IF.fused_multi_head_attention(x, w, ow, cache_kv=x)
+
+
+def test_fused_mt_rotary_raises():
+    with pytest.raises(NotImplementedError, match="rotary_embs"):
+        IF.fused_multi_transformer(
+            _t(rng.standard_normal((1, 2, 8))),
+            [], [], [], [], [], [], [], [], [], [], [], [],
+            rotary_embs=_t(np.ones(2, np.float32)))
+
+
+def test_fused_ec_moe_layer_reproducible():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+    paddle.seed(11)
+    m1 = FusedEcMoe(8, 16, num_experts=2)
+    paddle.seed(11)
+    m2 = FusedEcMoe(8, 16, num_experts=2)
+    np.testing.assert_array_equal(m1.bmm0_weight.numpy(),
+                                  m2.bmm0_weight.numpy())

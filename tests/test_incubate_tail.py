@@ -217,3 +217,27 @@ def test_rpc_cross_host_requires_secret(monkeypatch):
     assert rpc_mod._auth("10.0.0.5:8090") == b"s3cret"
     monkeypatch.delenv("PADDLE_RPC_AUTHKEY")
     assert rpc_mod._auth("127.0.0.1:8090")  # loopback: derived key ok
+
+
+def test_autotune_dataloader_hook_wired():
+    """set_config dataloader tuning must actually change DataLoader's
+    worker count (the hook was documented but unconsulted before)."""
+    from paddle_tpu.incubate import autotune
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 8
+
+    autotune.set_config({"dataloader": {"enable": True}})
+    try:
+        loader = paddle.io.DataLoader(DS(), batch_size=4)
+        assert loader.num_workers >= 2
+        vals = sorted(float(b[i]) for b in loader for i in range(4))
+        assert vals == [float(i) for i in range(8)]
+    finally:
+        autotune.set_config({"dataloader": {"enable": False}})
+    loader = paddle.io.DataLoader(DS(), batch_size=4)
+    assert loader.num_workers == 0

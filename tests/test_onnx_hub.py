@@ -150,3 +150,39 @@ def test_onnx_export_two_dynamic_inputs_share_scope():
         (out,) = reloaded.call(a.data, b.data)
         np.testing.assert_allclose(np.asarray(out), net(a, b).numpy(),
                                    rtol=1e-5)
+
+
+def test_onnx_export_independent_dynamic_dims():
+    """share_batch_dim=False: inputs with genuinely independent sizes
+    (query set vs candidate set) export without a false equality
+    constraint."""
+    import jax
+    import tempfile
+
+    class Scorer(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, q, c):
+            # [Nq, 4] x [Nc, 4] -> [Nq, Nc] similarity
+            from paddle_tpu.ops.linalg import matmul
+            return matmul(self.fc(q), self.fc(c), transpose_y=True)
+
+    paddle.seed(5)
+    net = Scorer()
+    net.eval()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "scorer")
+        with pytest.warns(UserWarning):
+            arts = paddle.onnx.export(
+                net, path,
+                input_spec=[paddle.static.InputSpec([None, 4], "float32"),
+                            paddle.static.InputSpec([None, 4], "float32")],
+                share_batch_dim=False)
+        reloaded = jax.export.deserialize(
+            open(arts["stablehlo_bin"], "rb").read())
+        q = paddle.rand([3, 4])
+        c = paddle.rand([7, 4])  # different size: must be accepted
+        (out,) = reloaded.call(q.data, c.data)
+        assert out.shape == (3, 7)
