@@ -120,14 +120,15 @@ def bench_resnet50():
             sopt.minimize(loss)
         exe = paddle.static.Executor()
         exe.run(startup)
-        feed = {"x": x.numpy(), "y": y.numpy()}
+        feed = {"x": x, "y": y}  # device-resident, like the dygraph leg
         out_box = [None]
 
         def sstep():
-            out_box[0] = exe.run(main, feed=feed, fetch_list=[loss])
+            out_box[0] = exe.run(main, feed=feed, fetch_list=[loss],
+                                 return_numpy=False)
 
         def ssync():
-            np.asarray(out_box[0][0])
+            float(out_box[0][0])
 
         static_s, static_std = _timeit(sstep, ssync, warmup=3,
                                        steps=10 if tpu else 2)
@@ -185,18 +186,21 @@ def bench_bert_static():
         mask = rng.random((batch, seq)) > 0.15
         feed["mlm_labels"][mask] = -100
 
+        feed = {k: paddle.to_tensor(v) for k, v in feed.items()}
         out_box = [None]
 
         def step():
-            out_box[0] = exe.run(main, feed=feed, fetch_list=[loss])
+            out_box[0] = exe.run(main, feed=feed, fetch_list=[loss],
+                                 return_numpy=False)
 
         def sync():
-            np.asarray(out_box[0][0])
+            float(out_box[0][0])
 
         step_s, std = _timeit(step, sync, warmup=3,
                               steps=10 if tpu else 2)
 
-        # AMP O2 leg: bf16 weights + fp32 master in AdamW
+        # AMP O2 leg: bf16 weights + O2 autocast policy at trace time
+        # (bf16 into MXU ops, fp32 LN/softmax/CE) + fp32 masters in AdamW
         # (multi_precision), same one-XLA-program step
         import jax.numpy as jnp
         main2 = paddle.static.Program()
@@ -211,8 +215,9 @@ def bench_bert_static():
             ids2 = paddle.static.data("input_ids", [batch, seq], "int64")
             mlm2 = paddle.static.data("mlm_labels", [batch, seq], "int64")
             nsp2 = paddle.static.data("nsp_labels", [batch], "int64")
-            loss2, _ = model2(ids2, masked_lm_labels=mlm2,
-                              next_sentence_label=nsp2)
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss2, _ = model2(ids2, masked_lm_labels=mlm2,
+                                  next_sentence_label=nsp2)
             opt2 = paddle.optimizer.AdamW(1e-4,
                                           parameters=model2.parameters(),
                                           multi_precision=True)
@@ -221,7 +226,8 @@ def bench_bert_static():
         exe2.run(startup2)
 
         def step2():
-            out_box[0] = exe2.run(main2, feed=feed, fetch_list=[loss2])
+            out_box[0] = exe2.run(main2, feed=feed, fetch_list=[loss2],
+                                  return_numpy=False)
 
         amp_s, amp_std = _timeit(step2, sync, warmup=3,
                                  steps=10 if tpu else 2)

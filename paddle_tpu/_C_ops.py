@@ -23,14 +23,49 @@ def _namespaces():
     return _NAMESPACES
 
 
+def _inplace_wrap(fn, name):
+    """'_'-suffixed C ops mutate their first Tensor argument in the
+    reference (eager inplace kernels); rebind the result into it so
+    `_C_ops.relu_(x); x.numpy()` observes the update."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        from paddle_tpu.framework.symbolic import SymbolicTensor
+        from paddle_tpu.framework.tensor import Tensor
+        first = out[0] if isinstance(out, (tuple, list)) else out
+        for a in args:
+            if isinstance(a, Tensor):
+                if isinstance(a, SymbolicTensor) or \
+                        isinstance(first, SymbolicTensor):
+                    raise NotImplementedError(
+                        f"paddle._C_ops.{name}: inplace C-ops cannot "
+                        "mutate a static-graph variable (the DAG has no "
+                        "SSA renaming); use the out-of-place form "
+                        f"`{name.rstrip('_')}` and rebind the Python "
+                        "variable instead")
+                if isinstance(first, Tensor):
+                    # concrete: rebind data (shape may change — reshape_)
+                    a._data = first.data
+                break
+        return out
+    wrapped.__name__ = name
+    return wrapped
+
+
 def __getattr__(name):
     if name.startswith("__"):
         raise AttributeError(name)
-    base = name[:-1] if name.endswith("_") else name  # inplace alias
+    inplace = name.endswith("_") and not name.endswith("__")
+    base = name[:-1] if inplace else name  # inplace alias
     for ns in _namespaces():
+        fn = getattr(ns, name, None)
+        if callable(fn):            # a real inplace impl exists: use it
+            return fn
         fn = getattr(ns, base, None)
         if callable(fn):
-            return fn
+            return _inplace_wrap(fn, name) if inplace else fn
     raise AttributeError(
         f"paddle._C_ops.{name}: no such op in the functional layer "
         f"(see utils/op_coverage.py for the registry)")

@@ -90,31 +90,42 @@ class auto_cast:
 amp_guard = auto_cast  # legacy alias (python/paddle/fluid/dygraph/amp)
 
 
-def _cast_tensor(t, dtype):
+def _cast_tensor(t, dtype, symbolic=False):
     from ..framework.tensor import Tensor
     if not isinstance(t, Tensor):
         return t
     if not is_floating(t.dtype) or t.dtype == np.dtype(dtype):
         return t
+    if symbolic:
+        from ..framework.symbolic import SymbolicTensor, build_node
+        if not isinstance(t, SymbolicTensor):
+            # Static trace: the cast must be a graph NODE over the live
+            # parameter leaf, not an eager copy — an eager cast would turn
+            # the weight into a frozen constant (no gradient, no update).
+            return build_node(lambda x, _d=np.dtype(dtype): x.astype(_d),
+                              [t], {})
     return t.astype(dtype)
 
 
-def maybe_cast_inputs(op_name, tensor_args):
+def maybe_cast_inputs(op_name, tensor_args, symbolic=False):
     """Called from framework.op.apply for every op application."""
     if not _state.enabled or op_name is None or op_name == "cast":
         return tensor_args
     if _state.level in ("O1", "OD"):
         if op_name in _state.white:
-            return [_cast_tensor(t, _state.dtype) for t in tensor_args]
+            return [_cast_tensor(t, _state.dtype, symbolic)
+                    for t in tensor_args]
         if op_name in _state.black:
             import jax.numpy as jnp
-            return [_cast_tensor(t, jnp.float32) for t in tensor_args]
+            return [_cast_tensor(t, jnp.float32, symbolic)
+                    for t in tensor_args]
         return tensor_args
     # O2: everything to amp dtype except black list
     if op_name in _state.black:
         import jax.numpy as jnp
-        return [_cast_tensor(t, jnp.float32) for t in tensor_args]
-    return [_cast_tensor(t, _state.dtype) for t in tensor_args]
+        return [_cast_tensor(t, jnp.float32, symbolic)
+                for t in tensor_args]
+    return [_cast_tensor(t, _state.dtype, symbolic) for t in tensor_args]
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",

@@ -31,12 +31,14 @@ _state: Dict[str, Any] = {
 }
 
 
-def _auth(master_endpoint: str) -> bytes:
-    """Connection authkey. Same-host (loopback) runs derive it from the
-    endpoint — processes that can already reach 127.0.0.1 are inside the
-    trust boundary. Cross-host mode EXECUTES PICKLED CALLABLES, so it
-    demands a real out-of-band secret: set PADDLE_RPC_AUTHKEY to the same
-    random value on every worker."""
+def _auth(master_endpoint: str, rank: int = 0) -> bytes:
+    """Connection authkey. The service EXECUTES PICKLED CALLABLES, so the
+    key must never be derivable from the (public) endpoint — on a shared
+    host any local user can reach 127.0.0.1:<port>. Cross-host: set
+    PADDLE_RPC_AUTHKEY to the same random value on every worker (the
+    launcher does this for spawned jobs). Loopback without the env var:
+    rank 0 generates a random secret and shares it through a user-only
+    (0600) keyfile — same user, same trust boundary."""
     secret = os.environ.get("PADDLE_RPC_AUTHKEY")
     if secret:
         return secret.encode()
@@ -47,7 +49,50 @@ def _auth(master_endpoint: str) -> bytes:
             "random secret): an endpoint-derived key would let any host "
             "that can reach the service port execute code in the "
             "trainer process")
-    return ("paddle_tpu_rpc:" + master_endpoint).encode()
+    import hashlib
+    import secrets
+    import tempfile
+    tag = hashlib.sha256(master_endpoint.encode()).hexdigest()[:16]
+    path = os.path.join(tempfile.gettempdir(),
+                        f"paddle_tpu_rpc_{os.getuid()}_{tag}.key")
+    if rank == 0:
+        key = secrets.token_bytes(32)
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        except PermissionError:
+            raise RuntimeError(
+                f"rpc keyfile {path} exists and belongs to another user — "
+                "refusing the shared-tempdir key; set PADDLE_RPC_AUTHKEY")
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        except FileExistsError:
+            raise RuntimeError(
+                f"rpc keyfile {path} reappeared (another process owns "
+                "it); set PADDLE_RPC_AUTHKEY for this job")
+        with os.fdopen(fd, "wb") as f:
+            f.write(key)
+        _state["keyfile"] = path
+        return key
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    while True:
+        try:
+            st = os.stat(path)
+            if st.st_uid != os.getuid():
+                raise RuntimeError(
+                    f"rpc keyfile {path} owned by another user — refusing "
+                    "the shared-tempdir key; set PADDLE_RPC_AUTHKEY")
+            with open(path, "rb") as f:
+                key = f.read()
+            if len(key) == 32:
+                return key
+        except FileNotFoundError:
+            pass
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"init_rpc: rank 0 never published the rpc keyfile {path}")
+        time.sleep(0.05)
 
 
 class _MasterRegistry(threading.Thread):
@@ -65,9 +110,15 @@ class _MasterRegistry(threading.Thread):
         self._barrier_count = 0
 
     def run(self):
+        from multiprocessing import AuthenticationError
         while not self._stop:
             try:
                 conn = self._listener.accept()
+            except AuthenticationError:
+                # a peer dialed in with a wrong/stale key — drop THAT
+                # connection, keep serving (the peer re-reads the keyfile
+                # and retries; dying here would hang every rank)
+                continue
             except (OSError, EOFError):
                 break
             threading.Thread(target=self._serve, args=(conn,),
@@ -124,9 +175,15 @@ class _Service(threading.Thread):
         self._stop = False
 
     def run(self):
+        from multiprocessing import AuthenticationError
         while not self._stop:
             try:
                 conn = self._listener.accept()
+            except AuthenticationError:
+                # a peer dialed in with a wrong/stale key — drop THAT
+                # connection, keep serving (the peer re-reads the keyfile
+                # and retries; dying here would hang every rank)
+                continue
             except (OSError, EOFError):
                 break
             threading.Thread(target=self._serve, args=(conn,),
@@ -171,7 +228,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
     if rank < 0 or world_size <= 0 or not master_endpoint:
         raise ValueError("init_rpc needs name, rank, world_size and "
                          "master_endpoint (args or PADDLE_* env)")
-    authkey = _auth(master_endpoint)
+    authkey = _auth(master_endpoint, rank)
 
     master = None
     if rank == 0:
@@ -198,6 +255,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
     info = (name, rank, my_ip, service.port)
 
     # register with the master (retry while rank 0 comes up)
+    from multiprocessing import AuthenticationError
     mhost, mport = master_endpoint.rsplit(":", 1)
     deadline = time.time() + _DEFAULT_RPC_TIMEOUT
     workers: List[WorkerInfo] = []
@@ -208,6 +266,24 @@ def init_rpc(name: str, rank: Optional[int] = None,
             workers = conn.recv()
             conn.close()
             break
+        except AuthenticationError:
+            # a stale keyfile from a previous job: rank 0 republishes on
+            # startup, so re-read the key and restart our service with it
+            if time.time() > deadline:
+                service.stop()
+                raise TimeoutError(
+                    f"init_rpc: authentication with master "
+                    f"{master_endpoint} kept failing (stale key?)")
+            time.sleep(0.1)
+            new_key = _auth(master_endpoint, rank)
+            if new_key != authkey:
+                authkey = new_key
+                service.stop()
+                service = _Service(
+                    authkey,
+                    bind_ip="127.0.0.1" if loopback else "0.0.0.0")
+                service.start()
+                info = (name, rank, my_ip, service.port)
         except (ConnectionError, OSError):
             if time.time() > deadline:
                 service.stop()
@@ -294,6 +370,12 @@ def shutdown():
         _state["service"].stop()
     if _state["master"] is not None:
         _state["master"].stop()
+        keyfile = _state.get("keyfile")
+        if keyfile:                     # rank 0: retire the job's keyfile
+            try:
+                os.remove(keyfile)
+            except OSError:
+                pass
     _state.update(inited=False, name=None, rank=None, world_size=None,
                   workers={}, service=None, master=None, authkey=None,
                   pool=None)

@@ -123,6 +123,10 @@ _define("FLAGS_enable_host_event_recorder_hook", False,
         "host events are always recorded via paddle_tpu.profiler instead")
 _define("FLAGS_max_body_size", 2147483647)
 _define("FLAGS_rpc_retry_times", 3)
+_define("FLAGS_static_executor_donate", True,
+        "Static Executor donates param/optimizer-state buffers to XLA "
+        "(in-place updates, halved peak HBM). Set False when holding "
+        "detach()/raw-array aliases of params across exe.run steps.")
 _define("FLAGS_apply_pass_to_program", False)
 _define("FLAGS_save_static_runtime_data", False)
 _define("FLAGS_static_runtime_data_save_path", "./")

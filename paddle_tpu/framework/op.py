@@ -69,10 +69,10 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
     from ..amp.auto_cast import maybe_cast_inputs
 
     kwargs = kwargs or {}
-    tensor_args = maybe_cast_inputs(op_name, tensor_args)
-
     from .symbolic import SymbolicTensor, build_node
-    if any(isinstance(a, SymbolicTensor) for a in tensor_args):
+    symbolic = any(isinstance(a, SymbolicTensor) for a in tensor_args)
+    tensor_args = maybe_cast_inputs(op_name, tensor_args, symbolic=symbolic)
+    if symbolic:
         return build_node(impl, tensor_args, kwargs)
 
     arrays = tuple(unwrap(a) for a in tensor_args)

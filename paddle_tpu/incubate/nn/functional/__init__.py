@@ -126,7 +126,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight,
 
     def impl(x_, w1, w2, ka, kb, *rest):
         d = dict(zip(names, rest))
-        act = getattr(jax.nn, activation, None) or getattr(jnp, activation)
+        # exact-gelu default, matching nn.functional.gelu / the reference
+        # (jax.nn.gelu would silently use the tanh approximation)
+        act = (lambda a: jax.nn.gelu(a, approximate=False)) \
+            if activation == "gelu" else \
+            (getattr(jax.nn, activation, None) or getattr(jnp, activation))
         residual = x_
         h = _ln(x_, d.get("s1"), d.get("b1"), ln1_epsilon) \
             if pre_layer_norm else x_
@@ -327,7 +331,10 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
         raise ValueError(f"act_type must be gelu/relu, got {act_type!r}")
 
     def impl(x_, g, w0, b0, w1, b1):
-        act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+        # exact gelu: the reference (and this repo's nn.functional.gelu)
+        # default to erf-gelu; jax.nn.gelu defaults to the tanh approx
+        act = (lambda h: jax.nn.gelu(h, approximate=False)) \
+            if act_type == "gelu" else jax.nn.relu
         probs = jax.nn.softmax(g, axis=-1)          # [B,S,E]
         h = jnp.einsum("bsd,edf->bsef", x_, w0) + b0[None, None, :, 0]
         h = act(h)

@@ -276,17 +276,19 @@ class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
-                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 num_workers=None, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        if not num_workers:
+        if num_workers is None:
             # dataloader autotuning (ref incubate/autotune.py): pick a
-            # prefetch worker count when the user left it unset
+            # prefetch worker count ONLY when the user left it unset —
+            # an explicit num_workers=0 means deliberate sync loading
+            num_workers = 0
             try:
                 from ..incubate.autotune import suggested_num_workers
-                num_workers = suggested_num_workers() or num_workers
+                num_workers = suggested_num_workers() or 0
             except ImportError:  # pragma: no cover
                 pass
         self.num_workers = num_workers

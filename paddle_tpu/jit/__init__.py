@@ -169,13 +169,17 @@ class StaticFunction:
             repr(arg_tree),
             self._layer.training if self._layer is not None else None,
             autograd.tape_enabled(),
+            # param/buffer dtypes: casting the layer (e.g. bf16 serving
+            # cast) must hit a fresh entry — each trace's treedef/buffer
+            # boxes belong to that trace's backward
+            tuple(str(p.dtype) for p in params),
+            tuple(str(b.dtype) for b in buffers),
         )
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._build(arg_tree, len(arg_leaves), len(params),
                                 len(buffers))
             self._cache[sig] = entry
-        impl, fwd_res, bwd_fn, n_out_buffers_box, out_tree_box = entry
 
         key = _random.next_key()
         tensor_args = tuple(arg_leaves) + tuple(params) + tuple(buffers) \
@@ -186,13 +190,42 @@ class StaticFunction:
         # cached) — a per-call jax.vjp closure would run the transpose
         # of the whole captured program op-by-op on the host (measured
         # ~15x the forward on ResNet-50).
-        from ..framework.op import _check_nan_inf, unwrap
+        from ..framework.op import unwrap
         input_tensors = [a if isinstance(a, Tensor) else None
                          for a in tensor_args]
         arrays = tuple(unwrap(a) for a in tensor_args)
         needs_grad = (autograd.tape_enabled()
                       and any(t is not None and not t.stop_gradient
                               for t in input_tensors))
+        try:
+            return self._run_compiled(entry, arrays, input_tensors,
+                                      needs_grad, buffers)
+        except Dy2StaticError as first_err:
+            # lazy dy2static: translate raw `if`/`while`/`for` on tensor
+            # values (ref program_translator.py:304) and retry once
+            if getattr(self, "_tried_translate", False):
+                raise
+            self._tried_translate = True
+            from .dy2static import translate_function
+            translated = translate_function(self._function)
+            if translated is None:
+                raise
+            original, self._function = self._function, translated
+            self._cache.clear()
+            try:
+                return self.__call__(*args, **kwargs)
+            except Dy2StaticError:
+                # translation didn't help (e.g. return inside the branch):
+                # restore and surface the ORIGINAL error — its traceback
+                # names the real user source line
+                self._function = original
+                self._cache.clear()
+                raise first_err
+
+    def _run_compiled(self, entry, arrays, input_tensors, needs_grad,
+                      buffers):
+        impl, fwd_res, bwd_fn, n_out_buffers_box, out_tree_box = entry
+        from ..framework.op import _check_nan_inf
         try:
             if needs_grad:
                 flat_raw, res_leaves = fwd_res(*arrays)

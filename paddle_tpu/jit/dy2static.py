@@ -1,0 +1,457 @@
+"""dy2static AST translation — raw Python control flow on tensor values.
+
+ref: /root/reference/python/paddle/jit/dy2static/program_translator.py:304
+(DygraphToStaticAst) + convert_operators.py (convert_ifelse:40,
+convert_while_loop:126). The reference rewrites EVERY ``if``/``while``/
+``for`` into ``convert_*`` calls whose runtime helpers branch on "is the
+predicate a graph variable".
+
+TPU-first design: same two-phase shape, much smaller surface. The AST pass
+rewrites the two dominant patterns —
+
+    if <pred>:  ... else: ...        ->  _pt_ifelse(pred, t_fn, f_fn, vars)
+    while <pred>: ...                ->  _pt_while(cond_fn, body_fn, vars)
+    for i in range(<n>): ...         ->  while-form, then _pt_while
+
+— into runtime helpers that dispatch exactly like static/control_flow.py's
+``cond``/``while_loop``: concrete predicate -> plain Python; traced
+predicate (inside @to_static's jax.jit) -> ``lax.cond``/``lax.while_loop``.
+Anything the pass cannot prove safe (return/break/continue inside the
+block, no source available) is left untouched, so untranslatable code
+still raises the instructive Dy2StaticError.
+
+The pass runs LAZILY: StaticFunction first traces the original function
+(zero overhead for code that already traces); only when tracing hits a
+data-dependent branch does it translate and retry.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Undefined:
+    """Sentinel for an out-var with no binding before the branch (the
+    reference's UndefinedVar)."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined before branch>"
+
+
+_PT_UNDEF = _Undefined()
+
+
+def _pt_get(name: str, loc: dict):
+    """Read a (possibly unbound) local for the branch-capture tuple."""
+    if name in loc:
+        return loc[name]
+    return _PT_UNDEF
+
+
+def _tensorize(v):
+    """Python numerics become arrays so they can ride a lax carry."""
+    from ..framework.tensor import Tensor
+    if isinstance(v, (bool, int, float)) or isinstance(v, np.number):
+        return Tensor(jnp.asarray(v))
+    return v
+
+
+def _is_traced_value(v) -> bool:
+    from ..framework.tensor import Tensor
+    arr = v.data if isinstance(v, Tensor) else v
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _pt_ifelse(pred, true_fn: Callable, false_fn: Callable, init: tuple):
+    """Runtime dispatch for a rewritten ``if`` (ref convert_ifelse:40)."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+    from ..static.control_flow import cond
+
+    arr = pred.data if (isinstance(pred, Tensor)
+                        and not isinstance(pred, SymbolicTensor)) else pred
+    traced = _is_traced_value(pred)
+    if not traced and not isinstance(arr, SymbolicTensor):
+        # concrete predicate: plain Python semantics, tape records the
+        # branch that ran (reference dygraph behavior)
+        return true_fn(init) if bool(np.asarray(arr)) else false_fn(init)
+    init2 = tuple(_tensorize(v) for v in init)
+    try:
+        out = cond(pred, lambda: true_fn(init2), lambda: false_fn(init2))
+    except (ValueError, TypeError):
+        if any(v is _PT_UNDEF for v in init2):
+            _check_no_undef([_PT_UNDEF], "if")
+        raise
+    _check_no_undef(out, "if")
+    return out
+
+
+def _pt_while(cond_fn: Callable, body_fn: Callable, init: tuple):
+    """Runtime dispatch for a rewritten ``while`` (ref
+    convert_while_loop:126)."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+    from ..static.control_flow import while_loop
+
+    pred = cond_fn(init)
+    arr = pred.data if (isinstance(pred, Tensor)
+                        and not isinstance(pred, SymbolicTensor)) else pred
+    traced = _is_traced_value(pred) or any(
+        _is_traced_value(v) for v in init)
+    if not traced and not isinstance(arr, SymbolicTensor):
+        vals = init
+        while bool(np.asarray(arr)):
+            vals = body_fn(vals)
+            pred = cond_fn(vals)
+            arr = pred.data if isinstance(pred, Tensor) else pred
+        return vals
+    init2 = tuple(_tensorize(v) for v in init)
+    _check_no_undef(init2, "while")
+    res = while_loop(lambda *vs: cond_fn(tuple(vs)),
+                     lambda *vs: tuple(body_fn(tuple(vs))),
+                     list(init2))
+    return tuple(res)
+
+
+def _pt_range_keep(i, stop, step):
+    """range-loop continuation predicate that works for tensor bounds and
+    either sign of step."""
+    from ..framework.tensor import Tensor
+    vals = [v.data if isinstance(v, Tensor) else v for v in (i, stop, step)]
+    i_, stop_, step_ = vals
+    if all(not isinstance(v, jax.core.Tracer)
+           and not hasattr(v, "_node") for v in vals):
+        return (i_ < stop_) if step_ > 0 else (i_ > stop_)
+    out = jnp.where(jnp.asarray(step_) > 0,
+                    jnp.asarray(i_) < jnp.asarray(stop_),
+                    jnp.asarray(i_) > jnp.asarray(stop_))
+    return Tensor(out)
+
+
+def _pt_cast(v, kind: str):
+    """float(x)/int(x)/bool(x) on a possibly-traced Tensor (the
+    reference's CastTransformer, convert_var_dtype)."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+    if isinstance(v, Tensor):
+        traced = isinstance(v, SymbolicTensor) or _is_traced_value(v)
+        if traced:
+            if kind == "bool":
+                return v.astype("bool")
+            return v.astype("float32" if kind == "float" else "int64")
+    return {"float": float, "int": int, "bool": bool}[kind](v)
+
+
+def _check_no_undef(out, kind: str):
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for v in leaves:
+        if v is _PT_UNDEF:
+            from . import Dy2StaticError
+            raise Dy2StaticError(
+                f"dy2static: a variable assigned inside a tensor-dependent "
+                f"`{kind}` has no value on the other path. Under XLA both "
+                f"paths must produce the same variables — initialize it "
+                f"before the `{kind}` (e.g. with paddle.zeros_like).")
+
+
+# ---------------------------------------------------------------------------
+# AST pass
+# ---------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (no descent into nested defs)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):  # do not descend
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        # comprehension targets live in their own scope (py3)
+        for f in ("iter", "ifs"):
+            v = getattr(node, f, None)
+            if v is None:
+                continue
+            for n in (v if isinstance(v, list) else [v]):
+                self.visit(n)
+
+
+def _assigned(stmts: Sequence[ast.stmt]) -> Set[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasDisallowed(ast.NodeVisitor):
+    """Return/Yield/Break/Continue/Global/Nonlocal anywhere in the block
+    (outside nested defs) make the block untranslatable."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _mark(self, node):
+        self.found = True
+
+    visit_Return = _mark
+    visit_Yield = _mark
+    visit_YieldFrom = _mark
+    visit_Break = _mark
+    visit_Continue = _mark
+    visit_Global = _mark
+    visit_Nonlocal = _mark
+
+
+def _has_disallowed(stmts: Sequence[ast.stmt]) -> bool:
+    v = _HasDisallowed()
+    for s in stmts:
+        v.visit(s)
+        if v.found:
+            return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _capture_tuple(names: List[str]) -> ast.expr:
+    """(_pt_get('a', locals()), _pt_get('b', locals()), ...)"""
+    elts = [
+        ast.Call(func=_name("_pt_get"),
+                 args=[ast.Constant(n),
+                       ast.Call(func=_name("locals"), args=[],
+                                keywords=[])],
+                 keywords=[])
+        for n in names]
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+def _unpack_stmt(names: List[str], src: str) -> ast.stmt:
+    """(a, b) = <src>"""
+    tgt = ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                    ctx=ast.Store())
+    return ast.Assign(targets=[tgt], value=_name(src))
+
+
+def _branch_funcdef(fname: str, names: List[str],
+                    body: List[ast.stmt]) -> ast.stmt:
+    """def <fname>(_pt_in): (a, b) = _pt_in; <body>; return (a, b)"""
+    stmts: List[ast.stmt] = []
+    if names:
+        stmts.append(_unpack_stmt(names, "_pt_in"))
+    stmts.extend(body)
+    stmts.append(ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load())))
+    args = ast.arguments(posonlyargs=[], args=[ast.arg(arg="_pt_in")],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+    return ast.FunctionDef(name=fname, args=args, body=stmts,
+                           decorator_list=[], returns=None)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- float(x) / int(x) / bool(x) ----------------------------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1 and not node.keywords):
+            self.changed = True
+            return ast.Call(func=_name("_pt_cast"),
+                            args=[node.args[0],
+                                  ast.Constant(node.func.id)],
+                            keywords=[])
+        return node
+
+    # -- if ----------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)          # bottom-up: inner blocks first
+        if _has_disallowed(node.body) or _has_disallowed(node.orelse):
+            return node
+        out = sorted(_assigned(node.body) | _assigned(node.orelse))
+        out = [n for n in out if not n.startswith("_pt_")]
+        if not out:
+            return node                   # side-effect-only branch
+        uid = self._uid()
+        t_name, f_name = f"_pt_true_{uid}", f"_pt_false_{uid}"
+        tmp = f"_pt_out_{uid}"
+        self.changed = True
+        new: List[ast.stmt] = [
+            _branch_funcdef(t_name, out, list(node.body)),
+            _branch_funcdef(f_name, out,
+                            list(node.orelse) or [ast.Pass()]),
+            ast.Assign(
+                targets=[_name(tmp, ast.Store())],
+                value=ast.Call(func=_name("_pt_ifelse"),
+                               args=[node.test, _name(t_name),
+                                     _name(f_name), _capture_tuple(out)],
+                               keywords=[])),
+            _unpack_stmt(out, tmp),
+        ]
+        return new
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_disallowed(node.body):
+            return node
+        out = sorted(_assigned(node.body))
+        out = [n for n in out if not n.startswith("_pt_")]
+        if not out:
+            return node
+        uid = self._uid()
+        c_name, b_name = f"_pt_cond_{uid}", f"_pt_body_{uid}"
+        tmp = f"_pt_out_{uid}"
+        cond_body: List[ast.stmt] = [_unpack_stmt(out, "_pt_in"),
+                                     ast.Return(value=node.test)]
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg="_pt_in")],
+                             vararg=None, kwonlyargs=[], kw_defaults=[],
+                             kwarg=None, defaults=[])
+        cond_def = ast.FunctionDef(name=c_name, args=args, body=cond_body,
+                                   decorator_list=[], returns=None)
+        self.changed = True
+        new: List[ast.stmt] = [
+            cond_def,
+            _branch_funcdef(b_name, out, list(node.body)),
+            ast.Assign(
+                targets=[_name(tmp, ast.Store())],
+                value=ast.Call(func=_name("_pt_while"),
+                               args=[_name(c_name), _name(b_name),
+                                     _capture_tuple(out)],
+                               keywords=[])),
+            _unpack_stmt(out, tmp),
+        ]
+        return new
+
+    # -- for i in range(...) ------------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse or _has_disallowed(node.body):
+            return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3
+                and isinstance(node.target, ast.Name)):
+            return node
+        uid = self._uid()
+        i_name = node.target.id
+        stop_v, step_v = f"_pt_stop_{uid}", f"_pt_step_{uid}"
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        else:
+            start, stop, step = rargs
+        init = [
+            ast.Assign(targets=[_name(i_name, ast.Store())], value=start),
+            ast.Assign(targets=[_name(stop_v, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(step_v, ast.Store())], value=step),
+        ]
+        test = ast.Call(func=_name("_pt_range_keep"),
+                        args=[_name(i_name), _name(stop_v), _name(step_v)],
+                        keywords=[])
+        incr = ast.Assign(
+            targets=[_name(i_name, ast.Store())],
+            value=ast.BinOp(left=_name(i_name), op=ast.Add(),
+                            right=_name(step_v)))
+        loop = ast.While(test=test, body=list(node.body) + [incr],
+                         orelse=[])
+        replaced = self.visit_While(loop)
+        if replaced is loop:              # body untranslatable: keep as-is
+            return node
+        self.changed = True
+        return init + (replaced if isinstance(replaced, list)
+                       else [replaced])
+
+
+def translate_function(fn: Callable) -> Optional[Callable]:
+    """AST-translate ``fn``; None when nothing applies (no source, no
+    rewritable control flow)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []              # strip @to_static etc.
+    tr = _ControlFlowTransformer()
+    tr.visit(fdef)
+    if not tr.changed:
+        return None
+    ast.fix_missing_locations(tree)
+
+    glb = dict(fn.__globals__)
+    # closure variables: bind current cell values (late-binding is lost —
+    # acceptable for model forward methods)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    glb.update(_pt_ifelse=_pt_ifelse, _pt_while=_pt_while,
+               _pt_get=_pt_get, _pt_range_keep=_pt_range_keep,
+               _pt_cast=_pt_cast, _PT_UNDEF=_PT_UNDEF)
+    code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn,
+                             assigned=("__name__", "__qualname__",
+                                       "__doc__", "__module__"))
+    new_fn.__pt_translated__ = True
+    return new_fn

@@ -225,15 +225,21 @@ class Optimizer:
 
     # -- checkpoint -----------------------------------------------------------
     def state_dict(self):
+        # copies, not live references: the static Executor DONATES the
+        # accumulator buffers to XLA each step, so a dict of live arrays
+        # held across an exe.run would point at deleted buffers
+        def _copy(v):
+            return Tensor(jnp.array(v, copy=True))
+
         out = {}
         for pname, st in self._accumulators.items():
             for k, v in st.items():
-                out[f"{pname}_{k}"] = Tensor(v)
+                out[f"{pname}_{k}"] = _copy(v)
         out["global_step"] = self._step_count
         if isinstance(self._lr, LRScheduler):
             out["LR_Scheduler"] = self._lr.state_dict()
         if self._master_weights:
-            out["master_weights"] = {k: Tensor(v) for k, v in
+            out["master_weights"] = {k: _copy(v) for k, v in
                                      self._master_weights.items()}
         return out
 

@@ -118,7 +118,8 @@ class Executor:
 
         sig = (id(program), len(program._nodes),
                tuple(sorted(feeds_map.keys())),
-               tuple((tuple(np.asarray(v).shape)) for v in feed.values()),
+               tuple(tuple(v.shape) if hasattr(v, "shape")
+                     else np.asarray(v).shape for v in feed.values()),
                tuple(id(t) if isinstance(t, SymbolicTensor) else None
                      for t in fetch_syms),
                tuple(id(o) for o, _ in program._optimize_ops))
@@ -128,29 +129,44 @@ class Executor:
                                fetch_syms, state_targets, opt_blobs)
             self._cache[sig] = fn
 
-        feed_arrays = {k: jnp.asarray(np.asarray(
-            v.numpy() if hasattr(v, "numpy") else v))
-            for k, v in feed.items()}
-        leaf_arrays = [t.data for t in leaf_objs]
+        def _feed_array(v):
+            # device-resident feeds (Tensor / jax array) pass straight
+            # through — no device→host→device round trip
+            if isinstance(v, Tensor):
+                return v.data
+            if isinstance(v, jax.Array):
+                return v
+            return jnp.asarray(np.asarray(v))
+
+        feed_arrays = {k: _feed_array(v) for k, v in feed.items()}
+        trainable_ids = {id(t) for t in trainable}
+        other_arrays = [t.data for t in leaf_objs
+                        if id(t) not in trainable_ids]
+        train_arrays = [t.data for t in trainable]
         opt_state_arrays = [
-            ([opt._get_state(p) for p in params], jnp.asarray(
-                opt.get_lr(), jnp.float32), jnp.asarray(
-                opt._step_count + 1, jnp.float32))
+            ([opt._get_state(p) for p in params],
+             [opt._master_weights.get(p.name) for p in params],
+             jnp.asarray(opt.get_lr(), jnp.float32),
+             jnp.asarray(opt._step_count + 1, jnp.float32))
             for opt, _, params, _, _ in opt_blobs]
 
-        fetches, state_arrays, new_leafs, new_opt_states = fn(
-            feed_arrays, leaf_arrays, opt_state_arrays)
+        fetches, state_arrays, new_train, new_opt_states = fn(
+            feed_arrays, other_arrays, train_arrays, opt_state_arrays)
 
-        # write back state updates and optimizer results
+        # write back state updates and optimizer results; the old param /
+        # optimizer-state buffers were donated to XLA, so reassign _data
+        # before anything can observe the stale arrays
         for (target, _), arr in zip(program._state_updates, state_arrays):
             target._data = arr
-        for t, arr in zip(leaf_objs, new_leafs):
-            if arr is not None:
-                t._data = arr
-        for (opt, _, params, _, _), sts in zip(opt_blobs, new_opt_states):
+        for t, arr in zip(trainable, new_train):
+            t._data = arr
+        for (opt, _, params, _, _), (sts, new_masters) in zip(
+                opt_blobs, new_opt_states):
             opt._step_count += 1
-            for p, st in zip(params, sts):
+            for p, st, m in zip(params, sts, new_masters):
                 opt._accumulators[p.name] = st
+                if m is not None:
+                    opt._master_weights[p.name] = m
 
         outs = []
         for f, arr in zip(fetch_syms, fetches):
@@ -159,43 +175,87 @@ class Executor:
 
     def _compile(self, program, nodes, leaf_ids, leaf_objs, fetch_syms,
                  state_targets, opt_blobs):
-        n_leaf = len(leaf_objs)
         trainable_idx = [i for i, t in enumerate(leaf_objs)
                          if isinstance(t, Parameter) and not t.stop_gradient]
+        other_idx = [i for i in range(len(leaf_objs))
+                     if i not in set(trainable_idx)]
+        sym_fetches = [t for t in fetch_syms if isinstance(t, SymbolicTensor)]
+        n_fetch = len(sym_fetches)
 
-        def run_fn(feed_arrays, leaf_arrays, opt_state_arrays):
+        def run_fn(feed_arrays, other_arrays, train_arrays, opt_state_arrays):
             env = {("feed", k): v for k, v in feed_arrays.items()}
-            for tid, arr, obj in zip(leaf_ids, leaf_arrays, leaf_objs):
-                env[("t", id(obj))] = arr
+            for i, arr in zip(other_idx, other_arrays):
+                env[("t", id(leaf_objs[i]))] = arr
 
-            sym_fetches = [t for t in fetch_syms
-                           if isinstance(t, SymbolicTensor)]
-            fetch_vals = _eval_graph(nodes, sym_fetches + state_targets, env)
-            fetches = fetch_vals[:len(sym_fetches)]
-            state_arrays = fetch_vals[len(sym_fetches):]
+            if not opt_blobs:
+                for i, arr in zip(trainable_idx, train_arrays):
+                    env[("t", id(leaf_objs[i]))] = arr
+                vals = _eval_graph(nodes, sym_fetches + state_targets, env)
+                return (vals[:n_fetch], vals[n_fetch:], list(train_arrays),
+                        [])
 
-            new_leafs = [None] * n_leaf
+            # Single evaluation: differentiate the first optimizer's loss
+            # with the fetches + state updates riding along as aux, so the
+            # forward runs once (ref interpretercore.cc:656 — one
+            # instruction stream, no re-execution for fetch vars).
+            def fwd(p_arrs):
+                env2 = dict(env)
+                for i, arr in zip(trainable_idx, p_arrs):
+                    env2[("t", id(leaf_objs[i]))] = arr
+                vals = _eval_graph(
+                    nodes, [opt_blobs[0][1]] + sym_fetches + state_targets,
+                    env2)
+                return vals[0], vals[1:]
+
+            (_, aux), grads0 = jax.value_and_grad(fwd, has_aux=True)(
+                list(train_arrays))
+            fetches = aux[:n_fetch]
+            state_arrays = aux[n_fetch:]
+
+            new_train = list(train_arrays)
             new_opt_states = []
-            for (opt, loss_sym, params, _, metas), (states, lr, step) in zip(
-                    opt_blobs, opt_state_arrays):
-                pidx = trainable_idx
-
-                def loss_of(p_arrs):
-                    env2 = dict(env)
-                    for i, arr in zip(pidx, p_arrs):
-                        env2[("t", id(leaf_objs[i]))] = arr
-                    return _eval_graph(nodes, [loss_sym], env2)[0]
-
-                p_arrs = [env[("t", id(leaf_objs[i]))] for i in pidx]
-                grads = jax.grad(loss_of)(p_arrs)
+            for bi, ((opt, loss_sym, params, _, metas),
+                     (states, masters, lr, step)) in enumerate(
+                    zip(opt_blobs, opt_state_arrays)):
+                if bi == 0:
+                    grads = grads0
+                else:
+                    def loss_of(p_arrs, _loss=loss_sym):
+                        env2 = dict(env)
+                        for i, arr in zip(trainable_idx, p_arrs):
+                            env2[("t", id(leaf_objs[i]))] = arr
+                        return _eval_graph(nodes, [_loss], env2)[0]
+                    grads = jax.grad(loss_of)(list(train_arrays))
+                # multi_precision: update the fp32 master, keep the low-
+                # precision param as a cast of it (ref adamw multi_precision)
+                p_in = [m if m is not None else a
+                        for m, a in zip(masters, train_arrays)]
                 fused = opt._make_fused(list(metas))
-                new_ps, new_sts = fused(p_arrs, grads, states, lr, step)
-                for i, np_ in zip(pidx, new_ps):
-                    new_leafs[i] = np_
-                new_opt_states.append(new_sts)
-            return fetches, state_arrays, new_leafs, new_opt_states
+                new_ps, new_sts = fused(p_in, grads, states, lr, step)
+                new_masters = []
+                for j, (np_, m) in enumerate(zip(new_ps, masters)):
+                    if m is not None:
+                        new_masters.append(np_)
+                        new_train[j] = np_.astype(train_arrays[j].dtype)
+                    else:
+                        new_masters.append(None)
+                        new_train[j] = np_
+                new_opt_states.append((new_sts, new_masters))
+            return fetches, state_arrays, new_train, new_opt_states
 
-        return jax.jit(run_fn)
+        # Donate the big per-step buffers — params and optimizer states —
+        # so XLA updates them in place instead of allocating fresh HBM
+        # every step (the reference InterpreterCore's buffer-reuse GC,
+        # interpretercore.cc:656). Consequence, same as the reference's
+        # static mode: buffers from BEFORE a run are invalid after it —
+        # don't hold detach()/raw-array aliases of params or accumulators
+        # across exe.run steps (Optimizer.state_dict() returns copies for
+        # this reason). FLAGS_static_executor_donate=False restores
+        # alias-safe, slower stepping. Feeds and non-trainable leaves are
+        # never donated.
+        from ..flags import get_flag
+        donate = (2, 3) if get_flag("FLAGS_static_executor_donate") else ()
+        return jax.jit(run_fn, donate_argnums=donate)
 
     def close(self):
         pass

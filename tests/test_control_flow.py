@@ -169,15 +169,17 @@ def test_raw_python_branch_raises_helpful_error():
     assert "float(x.sum())" in msg or "if float" in msg
 
 
-def test_raw_python_while_raises_helpful_error():
+def test_raw_python_while_now_translates():
+    # r3 behavior: raised Dy2StaticError. r4: the dy2static AST pass
+    # (jit/dy2static.py) rewrites the loop to lax.while_loop and it runs.
     @paddle.jit.to_static
     def f(x):
         while x.sum() < 10:  # __bool__ on a tracer
             x = x * 2
         return x
 
-    with pytest.raises(paddle.jit.Dy2StaticError, match="while_loop"):
-        f(paddle.to_tensor(np.array([1.0])))
+    out = f(paddle.to_tensor(np.array([1.0])))
+    np.testing.assert_allclose(np.asarray(out), [16.0])
 
 
 # -------------------------------------------------- symbolic static mode

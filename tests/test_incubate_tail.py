@@ -125,6 +125,30 @@ def _boom():
     return 1 / 0
 
 
+def test_rpc_registry_survives_bad_authkey():
+    # a stale-keyfile peer dials in with the wrong key: the registry must
+    # drop that connection and keep serving (not die with an uncaught
+    # AuthenticationError), or every rank would hang to TimeoutError
+    import time
+    from multiprocessing import AuthenticationError
+    from multiprocessing.connection import Client
+    from paddle_tpu.distributed.rpc import rpc as R
+
+    port = _free_port()
+    reg = R._MasterRegistry(f"127.0.0.1:{port}", 1, b"A" * 32)
+    reg.start()
+    time.sleep(0.2)
+    with pytest.raises((AuthenticationError, OSError, EOFError)):
+        Client(("127.0.0.1", port), authkey=b"B" * 32)
+    time.sleep(0.2)
+    assert reg.is_alive()
+    conn = Client(("127.0.0.1", port), authkey=b"A" * 32)
+    conn.send(("register", ("w0", 0, "127.0.0.1", 12345)))
+    assert len(conn.recv()) == 1
+    conn.close()
+    reg.stop()
+
+
 def test_rpc_single_worker_roundtrip():
     from paddle_tpu.distributed import rpc
     port = _free_port()
