@@ -102,6 +102,48 @@ def main():
     except Exception:
         op_cov = golden_cov = None
 
+    # step-time ablation: where the remaining non-MFU time lives
+    # (fwd / fwd+bwd / backbone-only legs; optimizer = step - fwd_bwd,
+    # head+CE = full - backbone). PT_BENCH_NO_ABLATE=1 skips.
+    ablation = None
+    import os
+    if on_tpu and not os.environ.get("PT_BENCH_NO_ABLATE"):
+        def _t(fn, n=3):
+            fn()
+            out = None
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn()
+            float(jnp.sum(jax.tree_util.tree_leaves(out)[0]
+                          .astype(jnp.float32)))
+            return round((time.perf_counter() - t0) / n * 1e3, 1)
+        # half batch: the standalone value_and_grad holds grads + params
+        # + optimizer states concurrently (no donation), which OOMs at
+        # the headline batch — legs are labeled with their own batch
+        ab_batch = max(1, batch // 2)
+        jids = jnp.asarray(ids[:ab_batch])
+        f_fwd = jax.jit(trainer.loss_fn)
+        f_vg = jax.jit(jax.value_and_grad(trainer.loss_fn))
+
+        def bb_loss(params, i, l):
+            return trainer.forward_hidden(params, i).astype(
+                jnp.float32).mean()
+        f_bb = jax.jit(jax.value_and_grad(bb_loss))
+        try:
+            ablation = {
+                "batch": ab_batch,
+                "fwd_loss_ms": _t(lambda: f_fwd(trainer.params, jids,
+                                                jids)),
+                "fwd_bwd_ms": _t(lambda: f_vg(trainer.params, jids,
+                                              jids)[0]),
+                "fwd_bwd_backbone_ms": _t(
+                    lambda: f_bb(trainer.params, jids, jids)[0]),
+                "full_step_ms_headline_batch": round(
+                    batch * seq / tok_s * 1e3, 1),
+            }
+        except Exception as e:
+            ablation = {"error": f"{type(e).__name__}"}
+
     print(json.dumps({
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu * 100, 2),
@@ -114,6 +156,7 @@ def main():
         "params": trainer.param_count(),
         "op_coverage_reachable_pct": op_cov,
         "op_coverage_golden_pct": golden_cov,
+        "ablation_ms": ablation,
         "device": str(dev),
     }))
 

@@ -22,6 +22,10 @@ from .framework.dtype import (  # noqa: F401
 )
 from .framework import random as _framework_random  # noqa: F401
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401
+from .framework.api_extras import (  # noqa: F401
+    LazyGuard, check_shape, dtype, finfo, get_cuda_rng_state, iinfo,
+    set_cuda_rng_state, set_printoptions,
+)
 
 # dtype aliases paddle exposes at top level
 bool = bool_  # noqa: A001
@@ -129,3 +133,9 @@ def load(path, **configs):
 def summary(net, input_size=None, dtypes=None, input=None):
     from .hapi.summary import summary as _summary
     return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)

@@ -21,13 +21,20 @@ WHITE_LIST = {
     "conv2d", "conv1d", "conv3d", "conv2d_transpose", "matmul", "matmul_v2",
     "mul", "bmm", "einsum", "linear", "fc", "attention", "flash_attention",
 }
+# ref static/amp/fp16_lists.py black_list + _extra_black_list, plus
+# batch/instance norm (the reference's keep_batch_norm_fp32=True default).
+# layer_norm / group_norm are NOT black: their impls accumulate in f32
+# internally (nn/functional/norm.py), so bf16 I/O is lossless and keeps
+# activations on the MXU-native dtype between matmuls.
 BLACK_LIST = {
-    "exp", "log", "log2", "log10", "square", "reciprocal", "rsqrt", "pow",
-    "softmax_with_cross_entropy", "cross_entropy", "c_softmax_with_cross_entropy",
-    "mean", "sum", "cumsum", "softmax", "log_softmax", "layer_norm", "norm",
-    "batch_norm", "group_norm", "instance_norm", "reduce_sum", "reduce_mean",
-    "sigmoid_cross_entropy_with_logits", "cos_sim", "erf", "expm1", "tan",
-    "sin", "cos", "linspace",
+    "exp", "square", "log", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "c_softmax_with_cross_entropy",
+    "cross_entropy", "cross_entropy2", "reduce_sum",
+    "batch_norm", "instance_norm",
+    "lookup_table", "lookup_table_v2", "scatter",
+    "linear_interp_v2", "nearest_interp_v2", "bilinear_interp_v2",
+    "bicubic_interp_v2", "trilinear_interp_v2",
 }
 
 

@@ -104,12 +104,83 @@ class _ShardingPass(PassBase):
 
 @register_pass("auto_parallel_recompute")
 class _RecomputePass(PassBase):
+    """Wraps the marked layers so their forward runs under jax.checkpoint
+    (ref auto_parallel_recompute.py — segment rewrite into
+    recompute blocks). attrs: model (Layer), optional segments (list of
+    sublayer names or Layer objects; default: the whole model)."""
+
     tpu_equivalent = "jax.checkpoint on the marked segments"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        model = self.get_attr("model")
+        if model is not None:
+            from ...nn.layer.layers import Layer
+            segments = self.get_attr("segments") \
+                or self.get_attr("checkpoints") or [model]
+            resolved = []
+            for s in segments:
+                if isinstance(s, str):
+                    sub = dict(model.named_sublayers()).get(s)
+                    if sub is None:
+                        raise ValueError(
+                            f"recompute pass: no sublayer named {s!r}")
+                    resolved.append(sub)
+                elif isinstance(s, Layer):
+                    resolved.append(s)
+            for lyr in resolved:
+                _wrap_layer_recompute(lyr)
+        return super().apply(main_programs, startup_programs, context)
+
+
+def _wrap_layer_recompute(lyr):
+    if getattr(lyr, "_recompute_wrapped", False):
+        return
+    from ...nn.layer.layers import Layer
+    from ..fleet.recompute import recompute
+
+    class _Seg(Layer):
+        """Parameter-carrying shim so recompute() traces the segment's
+        params as checkpoint inputs (gradients flow)."""
+
+        def __init__(self, inner, orig):
+            super().__init__()
+            self._inner = inner          # registers params via sublayer
+            self._orig = orig
+
+        def forward(self, *a, **kw):
+            return self._orig(*a, **kw)
+
+    seg = _Seg(lyr, lyr.forward)
+
+    def fwd(*a, **kw):
+        return recompute(seg, *a, **kw)
+
+    lyr.forward = fwd
+    lyr._recompute_wrapped = True
 
 
 @register_pass("auto_parallel_gradient_merge_pass")
 class _GradientMergePass(PassBase):
+    """Wraps attr 'optimizer' in GradientMergeOptimizer(k_steps, avg) and
+    publishes it as context attr 'optimizer' (ref
+    auto_parallel_gradient_merge.py — the reference rewrites the program
+    to accumulate grads k steps; here accumulation is the tape's native
+    behavior and merging = the wrapper's deferred step)."""
+
     tpu_equivalent = "fleet.meta_optimizers GradientMergeOptimizer"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        opt = self.get_attr("optimizer")
+        if opt is not None:
+            from ..fleet.meta_optimizers.gradient_merge import \
+                GradientMergeOptimizer
+            merged = GradientMergeOptimizer(
+                opt, k_steps=int(self.get_attr("k_steps", 2) or 2),
+                avg=bool(self.get_attr("avg", True)))
+            self.merged_optimizer = merged
+            if context is not None:
+                context.set_attr("optimizer", merged)
+        return super().apply(main_programs, startup_programs, context)
 
 
 @register_pass("auto_parallel_fp16")
@@ -119,17 +190,93 @@ class _Fp16Pass(_AmpPass):
 
 @register_pass("fuse_optimizer")
 class _FuseOptimizerPass(PassBase):
+    """Pre-compiles attr 'optimizer's fused jitted update for the current
+    parameter set (the mechanism the reference's fuse_optimizer pass
+    builds per-program; here the optimizer always steps through
+    _make_fused — this pass warms that compile)."""
+
     tpu_equivalent = "the optimizer's fused jitted update (_make_fused)"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        opt = self.get_attr("optimizer")
+        if opt is not None:
+            opt.prebuild_fused()
+        return super().apply(main_programs, startup_programs, context)
 
 
 @register_pass("fused_attention")
 class _FusedAttentionPass(PassBase):
+    """Forces the Pallas flash-attention route: turns the kernel flag on
+    and widens the AMP white list so attention matmuls take the MXU path
+    (ref fused_attention_pass.cc pattern-match; here routing is a flag
+    read by nn.functional.scaled_dot_product_attention)."""
+
     tpu_equivalent = "pallas flash attention via nn.functional"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        from ...flags import set_flags
+        set_flags({"FLAGS_enable_pallas_kernels": True})
+        from ...amp.auto_cast import amp_state
+        st = amp_state()
+        st.white = set(st.white) | {"flash_attention", "attention"}
+        return super().apply(main_programs, startup_programs, context)
 
 
 @register_pass("fused_feedforward")
 class _FusedFeedforwardPass(PassBase):
-    tpu_equivalent = "XLA elementwise-into-GEMM fusion"
+    """Routes every nn.TransformerEncoderLayer in attr 'model' through
+    incubate.nn.functional.fused_feedforward (one fused FFN expression:
+    (pre/post-)LN + linear + act + dropout + linear + dropout + residual
+    — ref fused_feedforward_op.cu schedule)."""
+
+    tpu_equivalent = "incubate fused_feedforward / XLA GEMM fusion"
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        model = self.get_attr("model")
+        if model is not None:
+            from ...nn.layer.transformer import TransformerEncoderLayer
+            targets = [model] if isinstance(
+                model, TransformerEncoderLayer) else [
+                s for _, s in model.named_sublayers()
+                if isinstance(s, TransformerEncoderLayer)]
+            for lyr in targets:
+                _wrap_layer_fused_ffn(lyr)
+        return super().apply(main_programs, startup_programs, context)
+
+
+def _wrap_layer_fused_ffn(lyr):
+    if getattr(lyr, "_fused_ffn", False):
+        return
+    act_name = getattr(lyr.activation, "__name__", "relu")
+
+    def fwd(src, src_mask=None, cache=None, _l=lyr):
+        from ...incubate.nn import functional as IF
+        residual = src
+        if _l.normalize_before:
+            src = _l.norm1(src)
+        if cache is None:
+            src = _l.self_attn(src, src, src, src_mask)
+        else:
+            src, cache = _l.self_attn(src, src, src, src_mask, cache)
+        src = residual + _l.dropout1(src)
+        if not _l.normalize_before:
+            src = _l.norm1(src)
+        src = IF.fused_feedforward(
+            src, _l.linear1.weight, _l.linear2.weight,
+            linear1_bias=_l.linear1.bias, linear2_bias=_l.linear2.bias,
+            ln1_scale=_l.norm2.weight if _l.normalize_before else None,
+            ln1_bias=_l.norm2.bias if _l.normalize_before else None,
+            ln2_scale=None if _l.normalize_before else _l.norm2.weight,
+            ln2_bias=None if _l.normalize_before else _l.norm2.bias,
+            dropout1_rate=_l.dropout.p, dropout2_rate=_l.dropout2.p,
+            activation=act_name,
+            ln1_epsilon=getattr(_l.norm2, "_epsilon", 1e-5),
+            ln2_epsilon=getattr(_l.norm2, "_epsilon", 1e-5),
+            pre_layer_norm=_l.normalize_before, training=_l.training)
+        return src if cache is None else (src, cache)
+
+    lyr.forward = fwd
+    lyr._fused_ffn = True
 
 
 def new_pass(name, pass_attrs: Optional[dict] = None):

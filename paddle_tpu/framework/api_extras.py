@@ -1,0 +1,128 @@
+"""Top-level API tail: dtype introspection, print options, lazy init.
+
+ref: python/paddle/framework/dtype.py (iinfo:24, finfo:66),
+python/paddle/tensor/to_string.py (set_printoptions:32),
+python/paddle/fluid/lazy_init.py (LazyGuard:91),
+python/paddle/utils/layers_utils.py (check_shape:463).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["iinfo", "finfo", "dtype", "set_printoptions", "LazyGuard",
+           "check_shape", "get_cuda_rng_state", "set_cuda_rng_state"]
+
+
+class _IInfo:
+    def __init__(self, d):
+        i = np.iinfo(np.dtype(d))
+        self.min, self.max, self.bits = int(i.min), int(i.max), int(i.bits)
+        self.dtype = str(np.dtype(d).name)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class _FInfo:
+    def __init__(self, d):
+        d = np.dtype(d)
+        f = jnp.finfo(d) if d == np.dtype(jnp.bfloat16) else np.finfo(d)
+        self.min, self.max = float(f.min), float(f.max)
+        self.eps = float(f.eps)
+        self.bits = int(f.bits)
+        self.tiny = float(getattr(f, "tiny", getattr(f, "smallest_normal",
+                                                     0.0)))
+        self.smallest_normal = self.tiny
+        self.resolution = float(getattr(f, "resolution", self.eps))
+        self.dtype = str(d.name)
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")
+
+
+def iinfo(d):
+    """ref framework/dtype.py:24 — integer dtype machine limits."""
+    from .dtype import convert_dtype
+    return _IInfo(convert_dtype(d))
+
+
+def finfo(d):
+    """ref framework/dtype.py:66 — float dtype machine limits."""
+    from .dtype import convert_dtype
+    return _FInfo(convert_dtype(d))
+
+
+# paddle.dtype: the dtype factory/type — paddle_tpu dtypes ARE numpy
+# dtypes, so np.dtype is both the constructor (paddle.dtype('float32'))
+# and the isinstance target.
+dtype = np.dtype
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref tensor/to_string.py:32 — Tensor repr goes through numpy, so
+    numpy's printoptions are the single source of truth."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """ref fluid/lazy_init.py:91 — delays parameter materialization on
+    the DEVICE. Obviated by construction here: layer parameters are
+    host-side (numpy-backed) until first device use, and jax only
+    materializes device buffers lazily at dispatch — so construction
+    under LazyGuard and normal construction behave identically. Kept for
+    source compatibility; `param.initialize()` is likewise a no-op
+    (params are always initialized host-side)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def check_shape(shape):
+    """ref utils/layers_utils.py:463 — validate a shape argument before
+    fill_constant-style ops."""
+    from .tensor import Tensor
+    if isinstance(shape, Tensor):
+        if np.dtype(shape.dtype) not in (np.dtype(np.int32),
+                                         np.dtype(np.int64)):
+            raise TypeError("shape tensor must be int32 or int64, got "
+                            f"{shape.dtype}")
+        return
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            continue
+        if not isinstance(ele, (int, np.integer)):
+            raise TypeError("All elements in ``shape`` must be integers "
+                            "when it's a list or tuple")
+        if ele < 0:
+            raise ValueError("All elements in ``shape`` must be positive "
+                             "when it's a list or tuple")
+
+
+def get_cuda_rng_state():
+    """CUDA-compat alias: the device RNG here is the jax key stream."""
+    from . import random as _random
+    return _random.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from . import random as _random
+    return _random.set_rng_state(state)

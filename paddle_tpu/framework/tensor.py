@@ -305,6 +305,12 @@ class Parameter(Tensor):
         self.need_clip = True
         self.is_dist_param = False
 
+    def initialize(self):
+        """LazyGuard compat (ref fluid/lazy_init.py): params here are
+        always initialized host-side at construction; device buffers
+        materialize lazily at first dispatch anyway."""
+        return self
+
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor (ref: python/paddle/tensor/creation.py)."""

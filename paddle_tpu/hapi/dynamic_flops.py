@@ -1,0 +1,118 @@
+"""FLOPs counter (ref: /root/reference/python/paddle/hapi/dynamic_flops.py
+— flops:28, register per-layer count hooks, run one forward, sum).
+
+Counts multiply-accumulates as the reference does (a Linear of [M,K]@[K,N]
+counts M*K*N FLOPs, not 2*M*K*N)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_linear(layer, inputs, output):
+    x = inputs[0]
+    return _numel(x.shape) * layer.weight.shape[-1]
+
+
+def _count_conv(layer, inputs, output):
+    w = layer.weight           # [out_c, in_c/groups, *k]
+    kernel_ops = _numel(w.shape[1:])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return _numel(output.shape) * (kernel_ops + bias_ops)
+
+
+def _count_norm(layer, inputs, output):
+    return 2 * _numel(inputs[0].shape)
+
+
+def _count_act(layer, inputs, output):
+    return _numel(inputs[0].shape)
+
+
+def _count_pool(layer, inputs, output):
+    return _numel(output.shape)
+
+
+def _count_embedding(layer, inputs, output):
+    return 0
+
+
+_DEFAULT_OPS = {
+    nn.Linear: _count_linear,
+    nn.Conv1D: _count_conv,
+    nn.Conv2D: _count_conv,
+    nn.Conv3D: _count_conv,
+    nn.Conv2DTranspose: _count_conv,
+    nn.BatchNorm1D: _count_norm,
+    nn.BatchNorm2D: _count_norm,
+    nn.BatchNorm3D: _count_norm,
+    nn.BatchNorm: _count_norm,
+    nn.LayerNorm: _count_norm,
+    nn.GroupNorm: _count_norm,
+    nn.ReLU: _count_act,
+    nn.GELU: _count_act,
+    nn.Sigmoid: _count_act,
+    nn.Tanh: _count_act,
+    nn.Softmax: _count_act,
+    nn.AvgPool2D: _count_pool,
+    nn.MaxPool2D: _count_pool,
+    nn.AdaptiveAvgPool2D: _count_pool,
+    nn.Embedding: _count_embedding,
+}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """ref hapi/dynamic_flops.py:28 — total FLOPs of one forward at
+    ``input_size`` (list like [1, 3, 224, 224]). ``custom_ops`` maps a
+    Layer class to ``fn(layer, inputs, output) -> flops``."""
+    table: Dict[type, object] = dict(_DEFAULT_OPS)
+    table.update(custom_ops or {})
+    counts = []
+    handles = []
+
+    def make_hook(fn, lyr):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            counts.append((type(layer).__name__,
+                           int(fn(layer, inputs, out))))
+        return hook
+
+    for _, lyr in net.named_sublayers(include_self=True):
+        fn = table.get(type(lyr))
+        if fn is not None:
+            handles.append(lyr.register_forward_post_hook(
+                make_hook(fn, lyr)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(np.zeros(tuple(int(s) for s in input_size), np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        if was_training:
+            net.train()
+
+    total = sum(c for _, c in counts)
+    if print_detail:
+        for name, c in counts:
+            print(f"  {name:<24s} {c:>14,d}")
+        print(f"Total Flops: {total}")
+    return total

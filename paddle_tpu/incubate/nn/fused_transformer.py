@@ -156,6 +156,12 @@ class FusedMultiTransformer(nn.Layer):
 
     def gen_cache(self, batch, max_len, dtype="float32"):
         import paddle_tpu as paddle
+        # round the cache length up to a lane multiple: the flash-decode
+        # kernel blocks the cache axis in 128-wide steps, and a max_len
+        # like 200 would otherwise force an 8-wide block (16x more grid
+        # steps for the same bytes)
+        if max_len > 128:
+            max_len = -(-max_len // 128) * 128
         return [paddle.zeros([2, batch, self.num_heads, max_len,
                               self.head_dim], dtype=dtype)
                 for _ in range(self.num_layers)]

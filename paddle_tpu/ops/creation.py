@@ -18,7 +18,7 @@ __all__ = [
     "triu_indices", "rand", "randn", "randint", "randint_like", "randperm",
     "uniform", "normal", "standard_normal", "bernoulli", "multinomial",
     "poisson", "assign", "clone", "one_hot", "complex", "numel", "diag_embed",
-    "uniform_", "normal_", "exponential_",
+    "uniform_", "normal_", "exponential_", "polar", "create_parameter",
 ]
 
 
@@ -277,3 +277,31 @@ def complex(real, imag, name=None):
 
 def numel(x, name=None):
     return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)), dtype=jnp.int64))
+
+
+def polar(abs, angle, name=None):
+    """ref: python/paddle/tensor/creation.py:2501 — complex from polar
+    coordinates: abs * (cos(angle) + i sin(angle))."""
+    def impl(r, t):
+        return jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t))
+    return apply(impl, (abs, angle), op_name="polar")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: python/paddle/tensor/creation.py:146 — low-level learnable
+    parameter factory (Xavier init, or zeros for biases)."""
+    from ..framework.tensor import Parameter
+    from .. import nn
+    shape = _shape(shape)
+    d = convert_dtype(dtype)
+    init = default_initializer
+    if init is None and attr is not None and \
+            getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = nn.initializer.Constant(0.0) if is_bias \
+            else nn.initializer.XavierNormal()
+    data = init(shape, d)
+    return Parameter(data, name=name or (getattr(attr, "name", None)
+                                         if attr is not None else None))

@@ -13,9 +13,9 @@ __all__ = [
     "cast", "reshape", "reshape_", "flatten", "squeeze", "squeeze_",
     "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk",
     "vsplit", "hsplit", "dsplit", "tile", "expand", "expand_as",
-    "broadcast_to", "broadcast_tensors", "transpose", "moveaxis", "flip",
+    "broadcast_to", "broadcast_tensors", "transpose", "moveaxis", "flip", "reverse", "tolist",
     "roll", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
-    "scatter_nd_add", "index_select", "index_add", "index_put",
+    "scatter_nd_add", "index_select", "index_add", "index_add_", "index_put", "index_put_",
     "put_along_axis", "take_along_axis", "slice", "strided_slice", "pad",
     "repeat_interleave", "unbind", "unique", "unique_consecutive",
     "masked_select", "masked_fill", "where", "nonzero", "unstack",
@@ -669,3 +669,39 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
             sls[int(ax)] = builtins.slice(int(s), int(e), int(st))
         return a.at[tuple(sls)].set(v)
     return op("slice_scatter", impl, x, value)
+
+
+def reverse(x, axis, name=None):
+    """ref: fluid layers reverse — flip along the given axes (legacy
+    top-level alias of flip)."""
+    return flip(x, axis)
+
+
+def tolist(x):
+    """ref: python/paddle/tensor/to_string.py tolist — nested Python list
+    of the tensor's values."""
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x).tolist()
+
+
+def index_add_(x, index, axis, value, name=None):
+    """Inplace index_add via apply_inplace so the autograd tape records
+    the rebinding (a raw ._data swap would silently disconnect grads)."""
+    import builtins
+    from ..framework.op import apply_inplace
+
+    def impl(a, idx, v):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+    return apply_inplace(x, impl, (x, index, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    from ..framework.op import apply_inplace
+    idx = tuple(unwrap(i) for i in indices)
+
+    def impl(a, v):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+    return apply_inplace(x, impl, (x, value))

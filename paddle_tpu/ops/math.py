@@ -27,7 +27,7 @@ __all__ = [
     "stanh", "add_n", "count_nonzero", "increment", "multiply_", "add_",
     "subtract_", "divide_", "clip_", "scale_", "exp_", "sqrt_", "rsqrt_",
     "reciprocal_", "round_", "ceil_", "floor_", "tanh_", "sigmoid_",
-    "quantile", "trapezoid", "cumulative_trapezoid", "rot90", "logit",
+    "quantile", "nanquantile", "frexp", "trapezoid", "cumulative_trapezoid", "rot90", "logit",
     "log_normalize", "renorm", "inverse", "digamma", "lgamma", "polygamma",
     "nextafter", "ldexp", "copysign", "signbit", "i0", "i0e", "i1",
     "i1e", "multiplex", "sinc", "take",
@@ -738,3 +738,22 @@ def multiplex(inputs, index, name=None):
         return stacked[ii, rows]
     return _ap(lambda idx, *xs: impl(idx, *xs),
                (index,) + tuple(inputs), op_name="multiplex")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    """ref: python/paddle/tensor/stat.py:662 — quantile ignoring NaNs."""
+    ax = normalize_axis(axis)
+    qq = unwrap(q) if isinstance(q, Tensor) else q
+    return op("nanquantile", lambda a: jnp.nanquantile(
+        a, jnp.asarray(qq), axis=ax, keepdims=keepdim,
+        method=interpolation), x)
+
+
+def frexp(x, name=None):
+    """ref: python/paddle/tensor/math.py:5239 — mantissa in [0.5, 1) and
+    integer exponent with x = mantissa * 2**exponent."""
+    def impl(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+    return apply(impl, (x,), op_name="frexp")

@@ -94,8 +94,16 @@ def decode_attention(q, k_cache, v_cache, seq_lens, sm_scale=None,
     g = nh // nkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
     block_s = min(block_s, S)
-    while S % block_s:
-        block_s //= 2
+    if S % block_s:
+        # zero-pad the cache axis up to a block multiple rather than
+        # shrinking the block (a 200-long cache would collapse to
+        # 8-wide blocks: 16x the grid steps for the same bytes). The
+        # in-kernel `pos < length` mask discards the padded zeros.
+        S_pad = -(-S // block_s) * block_s
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        k_cache = jnp.pad(k_cache, pad)
+        v_cache = jnp.pad(v_cache, pad)
+        S = S_pad
     s_steps = S // block_s
 
     qg = q.reshape(B, nkv, g, hd).reshape(B * nkv, g, hd)

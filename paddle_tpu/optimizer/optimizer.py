@@ -159,6 +159,21 @@ class Optimizer:
             states.append(st)
             metas.append(meta)
 
+        fn = self._get_or_build_fused(p_arrs, metas)
+        new_ps, new_states = fn(p_arrs, g_arrs, states, lr, step)
+
+        for (p, _), new_p, new_st in zip(params_grads, new_ps, new_states):
+            if p.name in self._master_weights:
+                self._master_weights[p.name] = new_p
+                p._data = new_p.astype(p.dtype)
+            else:
+                p._data = new_p
+            self._accumulators[p.name] = new_st
+
+    def _get_or_build_fused(self, p_arrs, metas):
+        """One cache-key construction shared by step() and
+        prebuild_fused() so the precompiled variant is exactly the one
+        the step hits."""
         cache_key = (tuple((a.shape, str(a.dtype)) for a in p_arrs),
                      tuple(metas), self._extra_cache_key())
         fn = self._jit_cache.get(cache_key)
@@ -170,15 +185,7 @@ class Optimizer:
             # trainer's fused train_step owns its buffers and donates there.
             fn = jax.jit(self._make_fused(metas))
             self._jit_cache[cache_key] = fn
-        new_ps, new_states = fn(p_arrs, g_arrs, states, lr, step)
-
-        for (p, _), new_p, new_st in zip(params_grads, new_ps, new_states):
-            if p.name in self._master_weights:
-                self._master_weights[p.name] = new_p
-                p._data = new_p.astype(p.dtype)
-            else:
-                p._data = new_p
-            self._accumulators[p.name] = new_st
+        return fn
 
     def _make_fused(self, metas):
         wd_mode = self._wd_mode()
@@ -196,6 +203,32 @@ class Optimizer:
                 new_sts.append(nst)
             return new_ps, new_sts
         return fused
+
+    def prebuild_fused(self):
+        """AOT-compile the fused update for the current parameter set
+        (all trainable params — step() hits this cache entry when every
+        param received a grad, the common case) so the first real step
+        pays no XLA compile. The fuse_optimizer pass routes here."""
+        params = [p for p in self._parameter_list_flat()
+                  if not p.stop_gradient]
+        if not params:
+            return None
+        p_arrs, states, metas = [], [], []
+        for p in params:
+            st, master, meta = self._param_meta(p)
+            p_arrs.append(master if master is not None else p.data)
+            states.append(st)
+            metas.append(meta)
+        fn = self._get_or_build_fused(p_arrs, metas)
+        # jax.jit is lazy: lower+compile NOW with the step-time avals
+        # (jit reuses the lowering cache on the real call)
+        s = jax.ShapeDtypeStruct
+        ps = [s(a.shape, a.dtype) for a in p_arrs]
+        gs = [s(p.data.shape, p.data.dtype) for p in params]
+        sts = jax.tree_util.tree_map(lambda a: s(a.shape, a.dtype), states)
+        scalar = s((), jnp.float32)
+        fn.lower(ps, gs, sts, scalar, scalar).compile()
+        return fn
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list_flat():

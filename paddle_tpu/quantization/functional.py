@@ -74,6 +74,22 @@ def quantized_matmul(x, w_int8, w_scale, x_scale=None, bits=8,
 
     if x_scale is None:
         def impl(x_, w_, ws):
+            # serving path: the Pallas w8a16 kernel streams int8 weight
+            # blocks (halved weight bytes — the point of int8 in the
+            # weight-bound decode regime); XLA fallback materializes the
+            # dequantized weight, tripling traffic
+            from ..flags import get_flag
+            if get_flag("FLAGS_enable_pallas_kernels", True) \
+                    and x_.ndim >= 2 and w_.ndim == 2:
+                from ..ops.pallas.int8_matmul import w8a16_matmul
+                lead = x_.shape[:-1]
+                x2 = x_.reshape(-1, x_.shape[-1])
+                if x2.shape[0] <= 256:       # serving-size M only
+                    acc = w8a16_matmul(x2, w_)
+                    if acc is not None:
+                        out = acc * (ws.astype(jnp.float32) / qmax)
+                        return out.astype(out_dtype).reshape(
+                            *lead, w_.shape[1])
             # dequantize in f32 (scale precision), matmul in out_dtype
             # so bf16 activations stay bf16 end-to-end
             wf = (w_.astype(jnp.float32) * (ws / qmax)).astype(out_dtype)

@@ -186,6 +186,223 @@ class nn:
             out = getattr(F, activation)(out)
         return out
 
+    # -- r4: the reference's remaining static.nn layer set (ref
+    # python/paddle/static/nn/__init__.py __all__). Legacy style: each
+    # call instantiates the paddle.nn layer inline and applies it.
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  dtype="float32", **kwargs):
+        from .. import nn as _nn
+        return _nn.Embedding(size[0], size[1],
+                             padding_idx=padding_idx)(input)
+
+    sparse_embedding = embedding
+
+    @staticmethod
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                   epsilon=1e-05, **kwargs):
+        from ..nn import functional as F
+        shape = list(input.shape[begin_norm_axis:])
+        from .. import nn as _nn
+        lyr = _nn.LayerNorm(shape, epsilon=epsilon)
+        if not scale:
+            lyr.weight = None
+        if not shift:
+            lyr.bias = None
+        return lyr(input)
+
+    @staticmethod
+    def group_norm(input, groups, epsilon=1e-05, data_layout="NCHW",
+                   **kwargs):
+        from .. import nn as _nn
+        return _nn.GroupNorm(groups, input.shape[
+            1 if data_layout == "NCHW" else -1], epsilon=epsilon)(input)
+
+    @staticmethod
+    def instance_norm(input, epsilon=1e-05, **kwargs):
+        from .. import nn as _nn
+        return _nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+    @staticmethod
+    def prelu(x, mode="all", param_attr=None, data_format="NCHW",
+              name=None):
+        from .. import nn as _nn
+        if mode == "element":
+            # per-element alpha of shape x.shape[1:] (the reference's
+            # element mode; PReLU's flat weight only broadcasts per
+            # channel)
+            import jax.numpy as jnp
+            from ..framework.op import apply as _apply
+            from ..framework.tensor import Parameter
+            alpha = Parameter(np.full(tuple(x.shape[1:]), 0.25,
+                                      np.float32))
+            return _apply(lambda a, al: jnp.where(a > 0, a, al * a),
+                          (x, alpha), op_name="prelu")
+        num = 1
+        if mode == "channel":
+            num = x.shape[1 if data_format == "NCHW" else -1]
+        return _nn.PReLU(num_parameters=num)(x)
+
+    @staticmethod
+    def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+        """Returns the spectrally-normalized WEIGHT (the reference op's
+        contract, distinct from nn.utils.spectral_norm's layer hook)."""
+        import jax.numpy as jnp
+        from ..framework.op import apply as _apply
+
+        def impl(w):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            u = jnp.ones((wm.shape[0],), w.dtype)
+            v = None
+            for _ in range(max(1, power_iters)):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (wm @ v)
+            return w / sigma
+        return _apply(impl, (weight,), op_name="spectral_norm")
+
+    @staticmethod
+    def conv2d_transpose(input, num_filters, output_size=None,
+                         filter_size=None, stride=1, padding=0,
+                         activation=None, **kwargs):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        lyr = _nn.Conv2DTranspose(input.shape[1], num_filters,
+                                  filter_size, stride, padding)
+        out = lyr(input)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+               activation=None, **kwargs):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        out = _nn.Conv3D(input.shape[1], num_filters, filter_size,
+                         stride, padding)(input)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def conv3d_transpose(input, num_filters, output_size=None,
+                         filter_size=None, stride=1, padding=0,
+                         activation=None, **kwargs):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        out = _nn.Conv3DTranspose(input.shape[1], num_filters,
+                                  filter_size, stride, padding)(input)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def bilinear_tensor_product(x, y, size, name=None, **kwargs):
+        from .. import nn as _nn
+        return _nn.Bilinear(x.shape[-1], y.shape[-1], size)(x, y)
+
+    @staticmethod
+    def data_norm(input, epsilon=1e-05, **kwargs):
+        """ref data_norm op — normalization by running batch statistics
+        WITHOUT scale/shift params; BatchNorm with affine disabled is the
+        direct analog."""
+        from .. import nn as _nn
+        lyr = _nn.BatchNorm2D(input.shape[1], epsilon=epsilon,
+                              weight_attr=False, bias_attr=False) \
+            if len(input.shape) == 4 else _nn.BatchNorm1D(
+                input.shape[1], epsilon=epsilon, weight_attr=False,
+                bias_attr=False)
+        return lyr(input)
+
+    @staticmethod
+    def row_conv(input, future_context_size, param_attr=None,
+                 act=None):
+        """ref row_conv op (lookahead conv for streaming ASR): causal
+        1-D depthwise conv over the time axis with a (context+1) window."""
+        import jax.numpy as jnp
+        import numpy as _np
+        from ..framework.op import apply as _apply
+        from ..framework.tensor import Parameter
+        d = input.shape[-1]
+        w = Parameter(_np.zeros((future_context_size + 1, d), _np.float32))
+
+        def impl(x, wt):
+            pads = [(0, 0), (0, future_context_size), (0, 0)]
+            xp = jnp.pad(x, pads)
+            out = jnp.zeros_like(x)
+            for t in range(future_context_size + 1):
+                out = out + xp[:, t:t + x.shape[1], :] * wt[t]
+            return out
+        out = _apply(impl, (input, w), op_name="row_conv")
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def nce(input, label, num_total_classes, sample_weight=None,
+            param_attr=None, bias_attr=None, num_neg_samples=None,
+            name=None, sampler="uniform", custom_dist=None, seed=0,
+            is_sparse=False):
+        """ref nce op — noise-contrastive estimation loss. TPU-native:
+        dense sampled-softmax formulation (uniform negative sampling,
+        static sample count) instead of the reference's per-row candidate
+        sampler kernel."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+        from ..framework.op import apply as _apply
+        from ..framework.random import next_key
+        from ..framework.tensor import Parameter
+        k = int(num_neg_samples or 10)
+        d = input.shape[-1]
+        w = Parameter(_np.random.RandomState(seed or 0).standard_normal(
+            (num_total_classes, d)).astype(_np.float32) * 0.01)
+        b = Parameter(_np.zeros((num_total_classes,), _np.float32))
+        key = next_key()
+
+        def impl(x, lbl, wt, bt):
+            lbl = lbl.reshape(-1)
+            neg = jax.random.randint(key, (x.shape[0], k), 0,
+                                     num_total_classes)
+            pos_logit = jnp.sum(x * wt[lbl], -1) + bt[lbl]
+            neg_logit = jnp.einsum("bd,bkd->bk", x, wt[neg]) + bt[neg]
+            # NCE with uniform noise: logit - log(k * q), q = 1/C
+            corr = jnp.log(k / num_total_classes)
+            pos_loss = jax.nn.softplus(-(pos_logit - corr))
+            neg_loss = jax.nn.softplus(neg_logit - corr).sum(-1)
+            return (pos_loss + neg_loss).reshape(-1, 1)
+        return _apply(impl, (input, label, w, b), op_name="nce")
+
+    @staticmethod
+    def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+        """ref py_func op — run a host Python function on tensor values.
+        Uses jax.pure_callback under trace so it works inside compiled
+        programs (the reference runs it on the executor thread)."""
+        import jax
+        import numpy as _np
+        from ..framework.op import apply as _apply
+        from ..framework.tensor import Tensor
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
+                  for o in outs]
+
+        def impl(*arrays):
+            def host(*np_arrays):
+                res = func(*[Tensor(_np.asarray(a)) for a in np_arrays])
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(_np.asarray(
+                    r.numpy() if hasattr(r, "numpy") else r) for r in res)
+            result = jax.pure_callback(
+                host, tuple(shapes), *arrays)
+            return result if len(shapes) > 1 else result[0]
+        return _apply(impl, tuple(xs), op_name="py_func",
+                      differentiable=False)
+
 
 def amp_guard(*a, **kw):
     from ..amp import auto_cast

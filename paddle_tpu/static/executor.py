@@ -74,10 +74,14 @@ def _eval_graph(nodes, targets, env):
     return [lookup(t) for t in targets]
 
 
+_DONATE_OVERRIDE = None
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, Any] = {}
+        self._lr_cache: Dict[Any, Any] = {}
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
@@ -143,15 +147,29 @@ class Executor:
         other_arrays = [t.data for t in leaf_objs
                         if id(t) not in trainable_ids]
         train_arrays = [t.data for t in trainable]
+        master_arrays = [
+            [opt._master_weights.get(p.name) for p in params]
+            for opt, _, params, _, _ in opt_blobs]
+        def _lr_array(opt):
+            # cache the device scalar: re-uploading an unchanged lr every
+            # step costs a host→device transfer on the tunnel backend
+            key = (id(opt), float(opt.get_lr()))
+            arr = self._lr_cache.get(key)
+            if arr is None:
+                if len(self._lr_cache) > 64:   # bound schedule churn
+                    self._lr_cache.clear()
+                arr = self._lr_cache[key] = jnp.asarray(key[1], jnp.float32)
+            return arr
+
         opt_state_arrays = [
             ([opt._get_state(p) for p in params],
-             [opt._master_weights.get(p.name) for p in params],
-             jnp.asarray(opt.get_lr(), jnp.float32),
+             _lr_array(opt),
              jnp.asarray(opt._step_count + 1, jnp.float32))
             for opt, _, params, _, _ in opt_blobs]
 
-        fetches, state_arrays, new_train, new_opt_states = fn(
-            feed_arrays, other_arrays, train_arrays, opt_state_arrays)
+        fetches, state_arrays, new_train, new_masters_all, new_opt_states \
+            = fn(feed_arrays, other_arrays, train_arrays, master_arrays,
+                 opt_state_arrays)
 
         # write back state updates and optimizer results; the old param /
         # optimizer-state buffers were donated to XLA, so reassign _data
@@ -160,8 +178,8 @@ class Executor:
             target._data = arr
         for t, arr in zip(trainable, new_train):
             t._data = arr
-        for (opt, _, params, _, _), (sts, new_masters) in zip(
-                opt_blobs, new_opt_states):
+        for (opt, _, params, _, _), sts, new_masters in zip(
+                opt_blobs, new_opt_states, new_masters_all):
             opt._step_count += 1
             for p, st, m in zip(params, sts, new_masters):
                 opt._accumulators[p.name] = st
@@ -182,7 +200,8 @@ class Executor:
         sym_fetches = [t for t in fetch_syms if isinstance(t, SymbolicTensor)]
         n_fetch = len(sym_fetches)
 
-        def run_fn(feed_arrays, other_arrays, train_arrays, opt_state_arrays):
+        def run_fn(feed_arrays, other_arrays, train_arrays, master_arrays,
+                   opt_state_arrays):
             env = {("feed", k): v for k, v in feed_arrays.items()}
             for i, arr in zip(other_idx, other_arrays):
                 env[("t", id(leaf_objs[i]))] = arr
@@ -192,31 +211,52 @@ class Executor:
                     env[("t", id(leaf_objs[i]))] = arr
                 vals = _eval_graph(nodes, sym_fetches + state_targets, env)
                 return (vals[:n_fetch], vals[n_fetch:], list(train_arrays),
-                        [])
+                        [], [])
 
             # Single evaluation: differentiate the first optimizer's loss
             # with the fetches + state updates riding along as aux, so the
             # forward runs once (ref interpretercore.cc:656 — one
-            # instruction stream, no re-execution for fetch vars).
+            # instruction stream, no re-execution for fetch vars). Targets
+            # are DEDUPED by graph node: returning the same value twice
+            # from the jitted step (e.g. fetching the loss that is also
+            # the differentiated output) trips an axon-backend
+            # InvalidArgument on Adam-family programs.
+            def _tkey(s):
+                return (id(s._node), s._out_idx) if s._node is not None \
+                    else ("feed", s._feed_name)
+
+            loss0 = opt_blobs[0][1]
+            aux_targets, aux_pos = [], {}
+            for s in [loss0] + sym_fetches + state_targets:
+                k = _tkey(s)
+                if k not in aux_pos:
+                    aux_pos[k] = len(aux_targets)
+                    aux_targets.append(s)
+
             def fwd(p_arrs):
                 env2 = dict(env)
                 for i, arr in zip(trainable_idx, p_arrs):
                     env2[("t", id(leaf_objs[i]))] = arr
-                vals = _eval_graph(
-                    nodes, [opt_blobs[0][1]] + sym_fetches + state_targets,
-                    env2)
-                return vals[0], vals[1:]
+                vals = _eval_graph(nodes, aux_targets, env2)
+                return vals[aux_pos[_tkey(loss0)]], vals
 
-            (_, aux), grads0 = jax.value_and_grad(fwd, has_aux=True)(
-                list(train_arrays))
-            fetches = aux[:n_fetch]
-            state_arrays = aux[n_fetch:]
+            # jax.grad (not value_and_grad): the fetches/states ride as
+            # aux and the loss is read from aux too — returning the
+            # differentiated primal from this program trips an
+            # axon-backend InvalidArgument on Adam-family updates
+            grads0, aux = jax.grad(fwd, has_aux=True)(list(train_arrays))
+
+            def _resolve(s):
+                return aux[aux_pos[_tkey(s)]]
+
+            fetches = [_resolve(s) for s in sym_fetches]
+            state_arrays = [_resolve(s) for s in state_targets]
 
             new_train = list(train_arrays)
-            new_opt_states = []
-            for bi, ((opt, loss_sym, params, _, metas),
-                     (states, masters, lr, step)) in enumerate(
-                    zip(opt_blobs, opt_state_arrays)):
+            new_masters_all, new_opt_states = [], []
+            for bi, ((opt, loss_sym, params, _, metas), masters,
+                     (states, lr, step)) in enumerate(
+                    zip(opt_blobs, master_arrays, opt_state_arrays)):
                 if bi == 0:
                     grads = grads0
                 else:
@@ -240,21 +280,28 @@ class Executor:
                     else:
                         new_masters.append(None)
                         new_train[j] = np_
-                new_opt_states.append((new_sts, new_masters))
-            return fetches, state_arrays, new_train, new_opt_states
+                new_masters_all.append(new_masters)
+                new_opt_states.append(new_sts)
+            return (fetches, state_arrays, new_train, new_masters_all,
+                    new_opt_states)
 
-        # Donate the big per-step buffers — params and optimizer states —
-        # so XLA updates them in place instead of allocating fresh HBM
-        # every step (the reference InterpreterCore's buffer-reuse GC,
-        # interpretercore.cc:656). Consequence, same as the reference's
-        # static mode: buffers from BEFORE a run are invalid after it —
-        # don't hold detach()/raw-array aliases of params or accumulators
-        # across exe.run steps (Optimizer.state_dict() returns copies for
-        # this reason). FLAGS_static_executor_donate=False restores
-        # alias-safe, slower stepping. Feeds and non-trainable leaves are
-        # never donated.
+        # Donate the params so XLA updates them in place instead of
+        # allocating fresh HBM every step (the reference InterpreterCore's
+        # buffer-reuse GC, interpretercore.cc:656). Optimizer accumulators
+        # and fp32 masters are deliberately NOT donated: donating buffers
+        # consumed by the optimizer-update subgraph trips an axon-backend
+        # InvalidArgument at execution time on Adam-family programs
+        # (empirically bisected — params-only donation is clean; see
+        # round-4 notes). Consequence, same as the reference's static
+        # mode: param buffers from BEFORE a run are invalid after it —
+        # don't hold detach()/raw-array aliases across exe.run steps
+        # (Optimizer.state_dict() returns copies for this reason).
+        # FLAGS_static_executor_donate=False restores alias-safe
+        # stepping. Feeds and non-trainable leaves are never donated.
         from ..flags import get_flag
-        donate = (2, 3) if get_flag("FLAGS_static_executor_donate") else ()
+        donate = (2,) if get_flag("FLAGS_static_executor_donate") else ()
+        if _DONATE_OVERRIDE is not None:    # debugging escape hatch
+            donate = _DONATE_OVERRIDE
         return jax.jit(run_fn, donate_argnums=donate)
 
     def close(self):

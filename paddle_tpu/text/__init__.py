@@ -7,8 +7,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .framework.op import apply as _apply
-from .framework.tensor import Tensor
+from ..framework.op import apply as _apply
+from ..framework.tensor import Tensor
 
 __all__ = ["viterbi_decode", "ViterbiDecoder"]
 
@@ -75,3 +75,6 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+from . import datasets  # noqa: E402,F401

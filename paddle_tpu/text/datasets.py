@@ -1,0 +1,558 @@
+"""paddle.text.datasets (ref: /root/reference/python/paddle/text/datasets/
+— imdb.py:31, imikolov.py, uci_housing.py, movielens.py, conll05.py:39,
+wmt14.py, wmt16.py).
+
+Zero-egress runtime: every dataset loads from a local ``data_file`` in the
+reference's on-disk format (the same archives the reference downloads);
+when ``data_file`` is not given the constructor raises with the expected
+format instead of attempting a download. Samples come back as numpy
+arrays with the reference's per-item layout.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import tarfile
+from typing import Dict, List
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05st",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+# decoder re-exports so `paddle.text.datasets` mirrors `paddle.text`
+from . import ViterbiDecoder, viterbi_decode  # noqa: E402,F401
+
+
+def _need_file(data_file, what, layout):
+    if data_file is None or not os.path.exists(data_file):
+        raise FileNotFoundError(
+            f"{what}: pass data_file pointing at a local copy "
+            f"({layout}); this runtime has no network egress so the "
+            "reference's auto-download is unavailable.")
+    return data_file
+
+
+class Imdb(Dataset):
+    """ref imdb.py:31 — aclImdb tar; items are (word-id doc, [label])
+    with label 0 = positive, 1 = negative."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise AssertionError(
+                f"mode should be 'train', 'test', but got {mode}")
+        self.mode = mode
+        self.data_file = _need_file(
+            data_file, "Imdb", "the aclImdb_v1 tar with "
+            "aclImdb/{train,test}/{pos,neg}/*.txt members")
+        self.word_idx = self._build_dict(cutoff)
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for polarity, label in (("pos", 0), ("neg", 1)):
+            pat = re.compile(
+                rf"aclImdb/{self.mode}/{polarity}/.*\.txt$")
+            for words in self._docs_matching(pat):
+                self.docs.append([self.word_idx.get(w, unk)
+                                  for w in words])
+                self.labels.append(label)
+
+    def _docs_matching(self, pattern):
+        punct = re.compile(r"[^a-z0-9\s]")
+        with tarfile.open(self.data_file) as tf:
+            for member in tf:
+                if not pattern.match(member.name):
+                    continue
+                raw = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                yield punct.sub(" ", raw).split()
+
+    def _build_dict(self, cutoff):
+        freq: Dict[str, int] = collections.defaultdict(int)
+        pat = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for words in self._docs_matching(pat):
+            for w in words:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx]), np.array([self.labels[idx]]))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """ref imikolov.py — PTB simple-examples tar; NGRAM windows or
+    (src, trg) SEQ pairs of word ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise AssertionError(
+                f"mode should be 'train', 'test', but got {mode}")
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise AssertionError("data_type must be NGRAM or SEQ")
+        self.mode = "train" if mode == "train" else "valid"
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _need_file(
+            data_file, "Imikolov", "the PTB simple-examples tar with "
+            "./simple-examples/data/ptb.{train,valid}.txt members")
+        self.word_idx = self._build_dict()
+        self._load()
+
+    def _counts(self, f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w.decode() if isinstance(w, bytes) else w] += 1
+        return freq
+
+    def _build_dict(self):
+        with tarfile.open(self.data_file) as tf:
+            freq: Dict[str, int] = collections.defaultdict(int)
+            self._counts(tf.extractfile(
+                "./simple-examples/data/ptb.train.txt"), freq)
+            self._counts(tf.extractfile(
+                "./simple-examples/data/ptb.valid.txt"), freq)
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > self.min_word_freq),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        self.data = []
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                words = line.decode().strip().split()
+                if self.data_type == "NGRAM":
+                    if self.window_size <= 0:
+                        raise AssertionError("Invalid gram length")
+                    seq = ["<s>"] + words + ["<e>"]
+                    if len(seq) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in seq]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx.get("<s>", unk)] + ids
+                    trg = ids + [self.word_idx.get("<e>", unk)]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """ref uci_housing.py — 14-column whitespace floats; features are
+    mean/range normalized; 80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise AssertionError(
+                f"mode should be 'train' or 'test', but got {mode}")
+        self.mode = mode
+        self.dtype = "float32"
+        self.data_file = _need_file(
+            data_file, "UCIHousing",
+            "the housing.data file: rows of 14 whitespace floats")
+        raw = np.fromfile(self.data_file, sep=" ")
+        raw = raw.reshape(raw.shape[0] // 14, 14)
+        maxs, mins = raw.max(axis=0), raw.min(axis=0)
+        avgs = raw.mean(axis=0)
+        for i in range(13):
+            raw[:, i] = (raw[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        split = int(raw.shape[0] * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(self.dtype), row[-1:].astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age
+        self.job_id = int(job_id)
+
+    def value(self, age_index):
+        return [[self.index], [0 if self.is_male else 1],
+                [age_index[self.age]], [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ref movielens.py — ml-1m archive ('::'-separated movies.dat,
+    users.dat, ratings.dat); items are
+    [user fields..., movie fields..., [rating]]."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise AssertionError(
+                f"mode should be 'train' or 'test', but got {mode}")
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self.data_file = _need_file(
+            data_file, "Movielens", "the ml-1m zip/tar with movies.dat, "
+            "users.dat and ratings.dat ('::'-separated)")
+        self._load()
+
+    def _member_lines(self, suffix):
+        name = self.data_file
+        if name.endswith(".zip"):
+            import zipfile
+            with zipfile.ZipFile(name) as zf:
+                for n in zf.namelist():
+                    if n.endswith(suffix):
+                        for line in zf.read(n).splitlines():
+                            yield line.decode("latin1")
+                        return
+        else:
+            with tarfile.open(name) as tf:
+                for m in tf:
+                    if m.name.endswith(suffix):
+                        for line in tf.extractfile(m).read().splitlines():
+                            yield line.decode("latin1")
+                        return
+        raise FileNotFoundError(f"{suffix} not found in {name}")
+
+    def _load(self):
+        self.movie_info: Dict[int, MovieInfo] = {}
+        categories: Dict[str, int] = {}
+        titles: Dict[str, int] = {}
+        for line in self._member_lines("movies.dat"):
+            mid, title, cats = line.strip().split("::")
+            cats = cats.split("|")
+            for c in cats:
+                categories.setdefault(c, len(categories))
+            title = re.sub(r"\(\d{4}\)$", "", title).strip()
+            for w in title.split():
+                titles.setdefault(w.lower(), len(titles))
+            self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+        self.categories_dict, self.movie_title_dict = categories, titles
+
+        self.user_info: Dict[int, UserInfo] = {}
+        ages = set()
+        for line in self._member_lines("users.dat"):
+            uid, gender, age, job, _ = line.strip().split("::")
+            ages.add(age)
+            self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+        age_index = {a: i for i, a in enumerate(sorted(ages, key=int))}
+
+        rng = np.random.RandomState(self.rand_seed)
+        self.data: List[list] = []
+        for line in self._member_lines("ratings.dat"):
+            uid, mid, rating, _ = line.strip().split("::")
+            uid, mid = int(uid), int(mid)
+            if uid not in self.user_info or mid not in self.movie_info:
+                continue
+            is_test = rng.rand() < self.test_ratio
+            if is_test != (self.mode == "test"):
+                continue
+            self.data.append(
+                self.user_info[uid].value(age_index)
+                + self.movie_info[mid].value(self.categories_dict,
+                                             self.movie_title_dict)
+                + [[float(rating)]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """ref conll05.py:39 — SRL test set: conll05st-tests tar
+    (words/props gz members) + word/verb/target dict files; items are
+    (sentence ids, predicate id, label ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.data_file = _need_file(
+            data_file, "Conll05st", "conll05st-tests.tar.gz with "
+            "conll05st-release/test.wsj/{words,props}/*.gz members")
+        self.word_dict_file = _need_file(
+            word_dict_file, "Conll05st", "wordDict.txt (one word/line)")
+        self.verb_dict_file = _need_file(
+            verb_dict_file, "Conll05st", "verbDict.txt (one verb/line)")
+        self.target_dict_file = _need_file(
+            target_dict_file, "Conll05st",
+            "targetDict.txt (B-/I- tag lines)")
+        self.emb_file = emb_file
+        self.word_dict = self._line_dict(self.word_dict_file)
+        self.predicate_dict = self._line_dict(self.verb_dict_file)
+        self.label_dict = self._label_dict(self.target_dict_file)
+        self._load()
+
+    @staticmethod
+    def _line_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d, i = {}, 0
+        for t in tags:
+            d["B-" + t], d["I-" + t] = i, i + 1
+            i += 2
+        d["O"] = i
+        return d
+
+    @staticmethod
+    def _expand_props(col):
+        """One predicate column of CoNLL bracket props -> BIO tags."""
+        out, cur, inside = [], "O", False
+        for tok in col:
+            if tok == "*":
+                out.append("I-" + cur if inside else "O")
+            elif tok == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append("B-" + cur)
+                inside = ")" not in tok
+            else:
+                raise RuntimeError(f"Unexpected label: {tok}")
+        return out
+
+    def _load(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, rows = [], []
+                for wline, pline in zip(words, props):
+                    w = wline.strip().decode()
+                    cols = pline.strip().decode().split()
+                    if not cols:            # sentence boundary
+                        if rows:
+                            verb_col = [r[0] for r in rows]
+                            verbs = [v for v in verb_col if v != "-"]
+                            n_pred = len(rows[0]) - 1
+                            for k in range(n_pred):
+                                tags = self._expand_props(
+                                    [r[k + 1] for r in rows])
+                                self.sentences.append(list(sent))
+                                self.predicates.append(verbs[k])
+                                self.labels.append(tags)
+                        sent, rows = [], []
+                    else:
+                        sent.append(w)
+                        rows.append(cols)
+
+    def __getitem__(self, idx):
+        unk_w = self.word_dict.get("<unk>", 0)
+        words = np.array([self.word_dict.get(w, unk_w)
+                          for w in self.sentences[idx]])
+        pred = np.array(
+            [self.predicate_dict.get(self.predicates[idx], 0)])
+        labels = np.array([self.label_dict[t] for t in self.labels[idx]])
+        return words, pred, labels
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
+
+
+_WMT14_UNK, _WMT14_START, _WMT14_END = "<unk>", "<s>", "<e>"
+
+
+class WMT14(Dataset):
+    """ref wmt14.py — tar with {mode}/{mode} tab-separated pairs and
+    src.dict/trg.dict members; items are (src_ids, trg_ids,
+    trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test", "gen"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'gen', but got {mode}")
+        self.mode = mode
+        if dict_size <= 0:
+            raise AssertionError("dict_size must be positive")
+        self.dict_size = dict_size
+        self.data_file = _need_file(
+            data_file, "WMT14", "the wmt14 tar with */src.dict, "
+            "*/trg.dict and {mode}/{mode} members")
+        self._load()
+
+    def _dict_member(self, tf, suffix):
+        names = [m.name for m in tf if m.name.endswith(suffix)]
+        assert len(names) == 1, f"need exactly one {suffix} member"
+        d = {}
+        for i, line in enumerate(tf.extractfile(names[0])):
+            if i >= self.dict_size:
+                break
+            d[line.strip().decode()] = i
+        return d
+
+    def _load(self):
+        unk = 2  # reference layout: <s>=0, <e>=1, <unk>=2
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            self.src_dict = self._dict_member(tf, "src.dict")
+            self.trg_dict = self._dict_member(tf, "trg.dict")
+            data_suffix = f"{self.mode}/{self.mode}"
+            names = [m.name for m in tf if m.name.endswith(data_suffix)]
+            for name in names:
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, unk) for w in
+                           [_WMT14_START] + parts[0].split()
+                           + [_WMT14_END]]
+                    trg = [self.trg_dict.get(w, unk)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(
+                        trg + [self.trg_dict[_WMT14_END]])
+                    self.trg_ids.append(
+                        [self.trg_dict[_WMT14_START]] + trg)
+                    self.src_ids.append(src)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """ref wmt16.py — tar with wmt16/{train,test,val} tab-separated
+    en\\tde lines; dictionaries are built from the train split (cached
+    next to the tar); items are (src_ids, trg_ids, trg_ids_next)."""
+
+    START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test", "val"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'val', but got {mode}")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise AssertionError("dict sizes must be positive")
+        self.mode = mode
+        self.lang = lang
+        self.data_file = _need_file(
+            data_file, "WMT16",
+            "the wmt16 tar with wmt16/{train,test,val} members")
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(
+            lang, src_dict_size)
+        self.trg_dict = self._build_dict(
+            "de" if lang == "en" else "en", trg_dict_size)
+        self._load()
+
+    def _build_dict(self, lang, dict_size):
+        col = 0 if lang == "en" else 1
+        freq: Dict[str, int] = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        words = [self.START_MARK, self.END_MARK, self.UNK_MARK] + [
+            w for w, _ in sorted(freq.items(), key=lambda x: -x[1])]
+        return {w: i for i, w in enumerate(words[:dict_size])}
+
+    def _load(self):
+        start = self.src_dict[self.START_MARK]
+        end = self.src_dict[self.END_MARK]
+        unk = self.src_dict[self.UNK_MARK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [start] + [self.src_dict.get(w, unk)
+                                 for w in parts[src_col].split()] + [end]
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([start] + trg)
+                self.trg_ids_next.append(trg + [end])
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

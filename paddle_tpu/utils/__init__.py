@@ -1,2 +1,26 @@
 from . import op_coverage  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
+from ..framework.api_extras import check_shape  # noqa: F401
+
+def try_import(name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(err_msg or str(e)) from e
+
+
+def run_check():
+    """paddle.utils.run_check (ref utils/install_check.py) — verify the
+    runtime can compile and run a matmul on the available device."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((8, 8))
+    y = jax.jit(lambda a: a @ a)(x)
+    dev = jax.devices()[0]
+    assert float(y[0, 0]) == 8.0
+    print(f"PaddlePaddle (paddle_tpu) works fine on {dev.device_kind} "
+          f"({dev.platform}).")

@@ -1,0 +1,24 @@
+"""paddle.utils.dlpack (ref: /root/reference/python/paddle/utils/dlpack.py
+— to_dlpack:27, from_dlpack:64). Zero-copy tensor exchange via the DLPack
+protocol; jax arrays speak it natively."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (shares memory with the device buffer)."""
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return jax.dlpack.to_dlpack(arr)
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule (or any __dlpack__ provider, e.g. a torch/numpy
+    array) -> Tensor."""
+    arr = jax.dlpack.from_dlpack(dlpack)
+    return Tensor(arr)

@@ -1,0 +1,76 @@
+"""paddle.utils.download (ref: /root/reference/python/paddle/utils/
+download.py — get_weights_path_from_url:73, get_path_from_url:119).
+
+This environment has zero network egress, so downloads resolve strictly
+from the local cache (~/.cache/paddle/hapi/weights by default, same layout
+as the reference); a missing file raises with the exact path to place it
+at, instead of silently hanging on a socket."""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DOWNLOAD_HOME = osp.expanduser("~/.cache/paddle")
+
+
+def is_url(path):
+    return path.startswith(("http://", "https://"))
+
+
+def _map_path(url, root_dir):
+    fname = osp.split(url)[-1]
+    return osp.join(root_dir, fname)
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname):
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            dst = osp.dirname(fname)
+            tf.extractall(path=dst)
+        return fname
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(osp.dirname(fname))
+        return fname
+    return fname
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True, method="get"):
+    """Resolve ``url`` from the local cache under ``root_dir``."""
+    if not is_url(url):
+        if osp.exists(url):
+            return url
+        raise FileNotFoundError(f"{url} is neither a URL nor a local file")
+    fullname = _map_path(url, root_dir)
+    if osp.exists(fullname) and check_exist and _md5check(fullname, md5sum):
+        if decompress and (tarfile.is_tarfile(fullname)
+                           or zipfile.is_zipfile(fullname)):
+            _decompress(fullname)
+        return fullname
+    raise RuntimeError(
+        f"cannot fetch {url}: this environment has no network egress. "
+        f"Place the file at {fullname} (the reference's cache layout) and "
+        "retry.")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """ref download.py:73 — weights path for a URL, cache-only here."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,48 @@
+"""paddle.utils.unique_name (ref: /root/reference/python/paddle/utils/
+unique_name.py — generate/switch/guard over a per-generator counter)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids: Dict[str, int] = {}
+        self.prefix = prefix
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return "_".join([self.prefix + key, str(n)]) if self.prefix \
+            else f"{key}_{n}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

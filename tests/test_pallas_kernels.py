@@ -226,6 +226,20 @@ class TestDecodeAttention:
             np.asarray(decode_attention_reference(q, kc, vc, lens)),
             atol=1e-5, rtol=1e-5)
 
+    def test_non_dividing_cache_length_pads(self):
+        """S that no power-of-two block divides (e.g. 200) must zero-pad
+        up to a block multiple instead of collapsing to tiny blocks
+        (16x grid blowup measured in the r3 decode bench)."""
+        B, S, nh, hd = 2, 50, 4, 16
+        q = _rand(B, nh, hd)
+        kc, vc = _rand(B, S, nh, hd), _rand(B, S, nh, hd)
+        lens = jnp.asarray([50, 13], jnp.int32)
+        out = decode_attention(q, kc, vc, lens, block_s=16)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(decode_attention_reference(q, kc, vc, lens)),
+            atol=1e-5, rtol=1e-5)
+
     def test_zero_length_rows_return_zeros(self):
         """seq_lens == 0 must yield a zero row, not the uniform mean of
         the whole (garbage) cache (advisor r2 finding)."""
@@ -350,3 +364,36 @@ class TestLlamaPallasFusedPath:
         for _ in range(4):
             last = float(tr.train_step(ids))
         assert last < first
+
+
+class TestW8A16Matmul:
+    def test_matches_float_matmul(self):
+        from paddle_tpu.ops.pallas.int8_matmul import w8a16_matmul
+        r = np.random.default_rng(0)
+        for M, K, N in [(1, 256, 128), (8, 512, 256), (5, 384, 128)]:
+            x = jnp.asarray(r.standard_normal((M, K)), jnp.bfloat16)
+            w = jnp.asarray(r.integers(-127, 128, (K, N)), jnp.int8)
+            out = w8a16_matmul(x, w)
+            assert out is not None and out.shape == (M, N)
+            ref = jnp.matmul(x.astype(jnp.float32), w.astype(jnp.float32))
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_returns_none_on_bad_tiling(self):
+        from paddle_tpu.ops.pallas.int8_matmul import w8a16_matmul
+        x = jnp.zeros((4, 100), jnp.bfloat16)   # K=100: no valid block
+        w = jnp.zeros((100, 128), jnp.int8)
+        assert w8a16_matmul(x, w) is None
+
+    def test_quantized_matmul_routes_and_matches(self):
+        from paddle_tpu.quantization.functional import (quantize,
+                                                        quantized_matmul)
+        r = np.random.default_rng(1)
+        w = jnp.asarray(r.standard_normal((256, 128)), jnp.float32)
+        scale = jnp.max(jnp.abs(w), axis=0)
+        wq = quantize(w, scale, bits=8, axis=-1)
+        x = jnp.asarray(r.standard_normal((4, 256)), jnp.float32)
+        out = quantized_matmul(x, wq, scale, out_dtype=jnp.float32)
+        ref = jnp.matmul(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-2, atol=3e-1)

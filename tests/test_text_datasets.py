@@ -1,0 +1,156 @@
+"""paddle.text.datasets — local-disk loaders for the reference's archive
+formats (ref python/paddle/text/datasets/*; zero-egress so each test
+synthesizes a tiny archive in the documented layout)."""
+import gzip
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (Conll05st, Imdb, Imikolov, Movielens,
+                                      UCIHousing, WMT14, WMT16)
+
+
+def _tar_add(tf, name, content: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(content)
+    tf.addfile(info, io.BytesIO(content))
+
+
+def test_imdb_parses_acl_layout(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for i in range(3):
+            _tar_add(tf, f"aclImdb/train/pos/{i}.txt",
+                     b"a fine movie truly fine")
+            _tar_add(tf, f"aclImdb/train/neg/{i}.txt",
+                     b"a bad movie truly bad")
+    ds = Imdb(data_file=str(path), mode="train", cutoff=1)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert doc.ndim == 1 and label.shape == (1,)
+    labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+    assert labels == [0, 0, 0, 1, 1, 1]
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    text = b"the cat sat on the mat\nthe dog sat on the log\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt", text)
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt", text)
+    ng = Imikolov(data_file=str(path), data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=0)
+    assert len(ng) > 0 and len(ng[0]) == 3
+    sq = Imikolov(data_file=str(path), data_type="SEQ", window_size=-1,
+                  mode="test", min_word_freq=0)
+    src, trg = sq[0]
+    assert len(src) == len(trg)
+
+
+def test_uci_housing_split_and_normalization(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = rng.uniform(1, 10, (20, 14))
+    path = tmp_path / "housing.data"
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(" ".join(f"{v:.4f}" for v in r) + "\n")
+    tr = UCIHousing(data_file=str(path), mode="train")
+    te = UCIHousing(data_file=str(path), mode="test")
+    assert len(tr) == 16 and len(te) == 4
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32
+
+
+def test_movielens_fields(tmp_path):
+    path = tmp_path / "ml-1m.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "ml-1m/movies.dat",
+                 b"1::Toy Story (1995)::Animation|Comedy\n"
+                 b"2::Jumanji (1995)::Adventure\n")
+        _tar_add(tf, "ml-1m/users.dat",
+                 b"1::F::1::10::48067\n2::M::56::16::70072\n")
+        _tar_add(tf, "ml-1m/ratings.dat",
+                 b"1::1::5::978300760\n2::2::3::978302109\n"
+                 b"1::2::4::978301968\n")
+    tr = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+    assert len(tr) == 3
+    sample = tr[0]
+    # user id, gender, age, job, movie id, categories, title, rating
+    assert len(sample) == 8
+    assert sample[-1].shape == (1,)
+
+
+def test_conll05_bracket_expansion(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    props = b"-  (A0*\n-  *)\nsat  (V*)\n\n"
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 wbuf.getvalue())
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 pbuf.getvalue())
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("the\ncat\nsat\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("sat\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    ds = Conll05st(data_file=str(path), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 1
+    sent, pred, labels = ds[0]
+    assert len(sent) == 3 and len(labels) == 3
+    w, p, lbl = ds.get_dict()
+    assert "O" in lbl
+
+
+def _wmt14_tar(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    d = b"<s>\n<e>\n<unk>\nhello\nworld\nbonjour\nmonde\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "data/src.dict", d)
+        _tar_add(tf, "data/trg.dict", d)
+        _tar_add(tf, "train/train",
+                 b"hello world\tbonjour monde\nworld hello\tmonde bonjour\n")
+    return path
+
+
+def test_wmt14_pairs(tmp_path):
+    ds = WMT14(data_file=str(_wmt14_tar(tmp_path)), mode="train",
+               dict_size=10)
+    assert len(ds) == 2
+    src, trg, nxt = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert nxt[-1] == ds.trg_dict["<e>"]
+
+
+def test_wmt16_builds_dicts_from_train(tmp_path):
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train",
+                 b"hello world\thallo welt\nworld\twelt\n")
+        _tar_add(tf, "wmt16/val", b"hello\thallo\n")
+        _tar_add(tf, "wmt16/test", b"world\twelt\n")
+    ds = WMT16(data_file=str(path), mode="val", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    assert len(ds) == 1
+    src, trg, nxt = ds[0]
+    assert ds.get_dict("en")["<s>"] == 0
+    rev = ds.get_dict("de", reverse=True)
+    assert rev[0] == "<s>"
+
+
+def test_missing_file_raises_with_layout_hint():
+    with pytest.raises(FileNotFoundError, match="data_file"):
+        Imdb(data_file=None)
+    with pytest.raises(FileNotFoundError, match="housing"):
+        UCIHousing(data_file="/nonexistent/housing.data")
