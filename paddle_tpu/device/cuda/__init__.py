@@ -26,23 +26,49 @@ def _dev(device=None):
 def synchronize(device=None):
     """Block until all queued work on the device is done."""
     # a tiny computation forced to host is a full pipeline drain
-    float(jnp.zeros((), jnp.float32) + 0.0)
+    import jax
+    d = _dev(device)
+    float(jax.device_put(jnp.zeros((), jnp.float32), d) + 0.0)
     return None
 
 
 class Event:
+    """Ordering + timing token. record() drains the dispatch queue and
+    stamps a host clock, so elapsed_time() between two events brackets
+    the device work issued between them — ported CUDA profiling code
+    (ev0.record(); work; ev1.record(); ev1.synchronize();
+    ev0.elapsed_time(ev1)) reports real milliseconds."""
+
     def __init__(self, enable_timing=False, blocking=False,
                  interprocess=False):
-        self._recorded = False
+        self._enable_timing = bool(enable_timing)
+        self._t = None
 
     def record(self, stream=None):
-        self._recorded = True
+        # ordering-only events (enable_timing=False) stay free: XLA
+        # dispatch is already stream-ordered, and draining the pipeline
+        # every iteration would serialize host dispatch with the device
+        if not self._enable_timing:
+            return
+        import time
+        dev = getattr(stream, "device", None) if stream is not None \
+            else None
+        synchronize(dev)         # stamp AFTER queued work completes
+        self._t = time.perf_counter()
 
     def query(self):
         return True
 
     def synchronize(self):
         synchronize()
+
+    def elapsed_time(self, end_event):
+        """Milliseconds between this event's record() and end_event's
+        (ref cuda Event.elapsed_time contract)."""
+        if self._t is None or getattr(end_event, "_t", None) is None:
+            raise RuntimeError(
+                "elapsed_time needs both events record()-ed first")
+        return (end_event._t - self._t) * 1e3
 
 
 class Stream:

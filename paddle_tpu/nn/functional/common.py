@@ -193,10 +193,18 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         out = jnp.take(w, idx, axis=0)
         return out
     if padding_idx is not None:
-        pi = padding_idx if padding_idx >= 0 else weight.shape[0] + padding_idx
+        n_rows = weight.shape[0]
+        if not -n_rows <= padding_idx < n_rows:
+            raise ValueError(
+                f"padding_idx must be within [-{n_rows}, {n_rows}), got "
+                f"{padding_idx}")
+        pi = padding_idx if padding_idx >= 0 else n_rows + padding_idx
         def impl(idx, w):  # noqa: F811
-            w = w.at[pi].set(jax.lax.stop_gradient(w[pi]))
-            return jnp.take(w, idx, axis=0)
+            # ref input.py embedding: ids equal to padding_idx produce
+            # all-zero OUTPUT rows (hence also zero gradient into w[pi])
+            out = jnp.take(w, idx, axis=0)
+            return jnp.where((idx == pi)[..., None], jnp.zeros((), w.dtype),
+                             out)
     return op("embedding", impl, x, weight)
 
 

@@ -1,0 +1,523 @@
+"""Multi-configuration sweeps for the highest-traffic ops (r4, verdict
+weak #5/#7): per-op shape/axis/dtype/broadcast/0-size cases, the way the
+reference's per-op unittest files carry many TestCase subclasses each
+(ref eager_op_test.py:375 + test_matmul_v2_op.py etc.).
+
+Also splits the optimizer alias claims: merged_/fused_ Adam variants get
+their own specs exercising the actual merged (multi-param) and fused
+(Pallas kernel) code paths instead of riding the plain adam spec.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from .op_test import OpSpec, run_spec
+
+rng = np.random.default_rng(7)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype("float32")
+
+
+def _pos(*shape):
+    return (np.abs(rng.standard_normal(shape)) + 0.5).astype("float32")
+
+
+SPECS = []
+
+
+def S(*a, **k):
+    SPECS.append(OpSpec(*a, **k))
+
+
+# ---------------------------------------------------------------- matmul
+def _mm_ref(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = np.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = np.swapaxes(y, -1, -2)
+    return np.matmul(x, y)
+
+
+for tag, sx, sy, kw in [
+    ("2d", (4, 5), (5, 6), {}),
+    ("batched", (2, 3, 4, 5), (2, 3, 5, 6), {}),
+    ("bcast_batch", (1, 3, 4, 5), (2, 1, 5, 6), {}),
+    ("tx", (5, 4), (5, 6), {"transpose_x": True}),
+    ("ty", (4, 5), (6, 5), {"transpose_y": True}),
+    ("txty", (5, 4), (6, 5), {"transpose_x": True, "transpose_y": True}),
+    ("vecvec", (5,), (5,), {}),
+    ("matvec", (3, 4, 5), (5,), {}),
+]:
+    S(f"matmul/{tag}", paddle.matmul, _mm_ref,
+      {"x": _f(*sx), "y": _f(*sy)}, kwargs=dict(kw),
+      grad_inputs=("x", "y") if tag in ("2d", "batched", "ty") else (),
+      yaml_ops=("matmul",), bf16_atol=5e-2, bf16_rtol=5e-2)
+
+# ------------------------------------------------------------- reductions
+for op_name, pfn, rfn in [
+    ("sum", paddle.sum, np.sum), ("mean", paddle.mean, np.mean),
+    ("max", paddle.max, np.max), ("min", paddle.min, np.min),
+    ("prod", paddle.prod, np.prod),
+]:
+    for tag, shape, kw in [
+        ("flat", (3, 4), {}),
+        ("axis0", (3, 4), {"axis": 0}),
+        ("axis-1", (3, 4, 5), {"axis": -1}),
+        ("axes_tuple", (2, 3, 4), {"axis": (0, 2)}),
+        ("keepdim", (3, 4), {"axis": 1, "keepdim": True}),
+        ("size1", (1, 4), {"axis": 0}),
+    ]:
+        if op_name in ("max", "min") and tag == "axes_tuple":
+            continue  # paddle max/min take a single axis
+        ref = (lambda rf: lambda x, axis=None, keepdim=False: rf(
+            x, axis=axis, keepdims=keepdim))(rfn)
+        S(f"{op_name}/{tag}", pfn, ref, {"x": _f(*shape)},
+          kwargs=dict(kw), yaml_ops=(op_name,),
+          grad_inputs=("x",) if op_name in ("sum", "mean")
+          and tag in ("flat", "axis0") else (),
+          check_bf16=op_name not in ("prod",))
+
+# 0-size reduction: reference OpTest includes zero-size cases
+S("sum/zero_size", paddle.sum,
+  lambda x, axis=None: np.sum(x, axis=axis),
+  {"x": np.zeros((0, 4), np.float32)}, kwargs={"axis": 0},
+  yaml_ops=("sum",), check_bf16=False, check_static=False)
+
+# ------------------------------------------------------------ elementwise
+def _bcast_cases():
+    return [
+        ("bcast_row", (3, 1), (1, 4)),
+        ("bcast_scalar", (3, 4), ()),
+        ("bcast_outer", (2, 1, 4), (3, 1)),
+        ("same3d", (2, 3, 4), (2, 3, 4)),
+    ]
+
+
+for op_name, pfn, rfn, pos_y in [
+    ("add", paddle.add, lambda a, b: a + b, False),
+    ("multiply", paddle.multiply, lambda a, b: a * b, False),
+    ("divide", paddle.divide, lambda a, b: a / b, True),
+    ("maximum", paddle.maximum, np.maximum, False),
+    ("minimum", paddle.minimum, np.minimum, False),
+]:
+    for tag, sx, sy in _bcast_cases():
+        y = _pos(*sy) if pos_y else _f(*sy)
+        S(f"{op_name}/{tag}", pfn, rfn, {"x": _f(*sx), "y": y},
+          yaml_ops=(op_name,),
+          grad_inputs=("x", "y") if tag == "bcast_row"
+          and op_name in ("add", "multiply") else ())
+
+# integer dtype legs (reference sweeps int32/int64 for arith ops)
+for dt in (np.int32, np.int64):
+    ix = rng.integers(-5, 5, (3, 4)).astype(dt)
+    iy = rng.integers(1, 5, (3, 4)).astype(dt)
+    S(f"add/int_{dt.__name__}", paddle.add, lambda a, b: a + b,
+      {"x": ix, "y": iy}, yaml_ops=("add",), check_bf16=False)
+    S(f"multiply/int_{dt.__name__}", paddle.multiply, lambda a, b: a * b,
+      {"x": ix, "y": iy}, yaml_ops=("multiply",), check_bf16=False)
+
+# --------------------------------------------------------------- softmax
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+
+def _softmax_ref(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+for tag, shape, ax in [("last", (3, 5), -1), ("axis0", (3, 5), 0),
+                       ("mid", (2, 3, 4), 1), ("size1", (3, 1), -1)]:
+    S(f"softmax/{tag}", F.softmax,
+      lambda x, axis=-1: _softmax_ref(x, axis),
+      {"x": _f(*shape)}, kwargs={"axis": ax}, yaml_ops=("softmax",),
+      grad_inputs=("x",) if tag == "last" else ())
+    S(f"log_softmax/{tag}", F.log_softmax,
+      lambda x, axis=-1: np.log(_softmax_ref(x, axis)),
+      {"x": _f(*shape)}, kwargs={"axis": ax}, yaml_ops=("log_softmax",))
+
+# ------------------------------------------------------------------ conv
+def _conv2d_ref(x, w, stride=1, padding=0, dilation=1, groups=1):
+    import jax
+    import jax.numpy as jnp
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    if isinstance(padding, int):
+        pad = [(padding, padding), (padding, padding)]
+    else:
+        pad = [(p, p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), st, pad, rhs_dilation=dl,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return np.asarray(out)
+
+
+for tag, kw, sx, sw in [
+    ("plain", {}, (2, 3, 8, 8), (4, 3, 3, 3)),
+    ("stride2", {"stride": 2}, (2, 3, 9, 9), (4, 3, 3, 3)),
+    ("pad1", {"padding": 1}, (2, 3, 8, 8), (4, 3, 3, 3)),
+    ("dilate2", {"dilation": 2}, (2, 3, 9, 9), (4, 3, 3, 3)),
+    ("groups", {"groups": 3}, (2, 6, 8, 8), (6, 2, 3, 3)),
+    ("k1", {}, (2, 3, 5, 5), (4, 3, 1, 1)),
+]:
+    S(f"conv2d/{tag}", F.conv2d, _conv2d_ref,
+      {"x": _f(*sx), "weight": _f(*sw) * 0.2}, kwargs=dict(kw),
+      yaml_ops=("conv2d",), bf16_atol=6e-2, bf16_rtol=6e-2,
+      grad_inputs=("x", "weight") if tag == "plain" else ())
+
+# ---------------------------------------------------------- manipulation
+S("concat/axis0", lambda x, y: paddle.concat([x, y]),
+  lambda x, y: np.concatenate([x, y], axis=0),
+  {"x": _f(2, 3), "y": _f(4, 3)}, yaml_ops=("concat",))
+S("concat/axis-1", lambda x, y: paddle.concat([x, y], axis=-1),
+  lambda x, y: np.concatenate([x, y], axis=-1),
+  {"x": _f(2, 3), "y": _f(2, 5)}, yaml_ops=("concat",))
+S("stack/axis1", lambda x, y: paddle.stack([x, y], axis=1),
+  lambda x, y: np.stack([x, y], axis=1),
+  {"x": _f(2, 3), "y": _f(2, 3)}, yaml_ops=("stack",))
+S("split/sections", lambda x: paddle.split(x, 3, axis=1),
+  lambda x: np.split(x, 3, axis=1), {"x": _f(2, 6)},
+  yaml_ops=("split",))
+S("transpose/perm", lambda x: paddle.transpose(x, [2, 0, 1]),
+  lambda x: np.transpose(x, (2, 0, 1)), {"x": _f(2, 3, 4)},
+  yaml_ops=("transpose",), grad_inputs=("x",))
+S("reshape/minus1", lambda x: paddle.reshape(x, [-1, 6]),
+  lambda x: x.reshape(-1, 6), {"x": _f(2, 3, 4)},
+  yaml_ops=("reshape",))
+S("squeeze/axis", lambda x: paddle.squeeze(x, axis=1),
+  lambda x: np.squeeze(x, 1), {"x": _f(3, 1, 4)}, yaml_ops=("squeeze",))
+S("unsqueeze/multi", lambda x: paddle.unsqueeze(x, [0, 2]),
+  lambda x: x[None, :, None, :], {"x": _f(3, 4)},
+  yaml_ops=("unsqueeze",))
+S("tile/reps", lambda x: paddle.tile(x, [2, 3]),
+  lambda x: np.tile(x, (2, 3)), {"x": _f(2, 3)}, yaml_ops=("tile",))
+S("pad/2d", lambda x: paddle.nn.functional.pad(x, [1, 2, 0, 1]),
+  # len(pad)==2*ndim: paddle pads first dim -> last dim
+  lambda x: np.pad(x, [(1, 2), (0, 1)]), {"x": _f(3, 4)},
+  yaml_ops=("pad",), check_static=False)
+
+# ---------------------------------------------------------------- indexing
+IDX = np.array([2, 0, 1], np.int64)
+S("gather/axis0", lambda x, i: paddle.gather(x, i, axis=0),
+  lambda x, i: x[i], {"x": _f(4, 3), "i": IDX}, yaml_ops=("gather",))
+S("gather/axis1", lambda x, i: paddle.gather(x, i, axis=1),
+  lambda x, i: x[:, i], {"x": _f(2, 4), "i": IDX},
+  yaml_ops=("gather",))
+S("index_select/axis1",
+  lambda x, i: paddle.index_select(x, i, axis=1),
+  lambda x, i: np.take(x, i, axis=1), {"x": _f(3, 4), "i": IDX},
+  yaml_ops=("index_select",))
+S("take_along_axis/axis1",
+  lambda x, i: paddle.take_along_axis(x, i, axis=1),
+  lambda x, i: np.take_along_axis(x, i, 1),
+  {"x": _f(3, 4), "i": rng.integers(0, 4, (3, 2)).astype(np.int64)},
+  yaml_ops=("take_along_axis",))
+S("slice/strided", lambda x: x[:, 1:4:2],
+  lambda x: x[:, 1:4:2], {"x": _f(3, 5)}, yaml_ops=("slice",))
+S("argmax/axis", lambda x: paddle.argmax(x, axis=1),
+  lambda x: np.argmax(x, 1), {"x": _f(3, 5)}, yaml_ops=("argmax",),
+  check_bf16=False)
+S("argmin/neg_axis", lambda x: paddle.argmin(x, axis=-1),
+  lambda x: np.argmin(x, -1), {"x": _f(3, 5)}, yaml_ops=("argmin",),
+  check_bf16=False)
+S("cumsum/axis0", lambda x: paddle.cumsum(x, axis=0),
+  lambda x: np.cumsum(x, 0), {"x": _f(3, 4)}, yaml_ops=("cumsum",),
+  grad_inputs=("x",))
+S("where/bcast", paddle.where,
+  lambda c, a, b: np.where(c, a, b),
+  {"c": rng.random((3, 4)) > 0.5, "x": _f(3, 4), "y": _f(1, 4)},
+  yaml_ops=("where",), check_bf16=False)
+
+# ------------------------------------------------- optimizer alias split
+LR = 0.05
+
+
+def _momentum_np(p, g, steps=2, mu=0.9):
+    v = np.zeros_like(p)
+    for _ in range(steps):
+        v = mu * v + g
+        p = p - LR * v
+    return p
+
+
+def _adam_np(p, g, steps=2, b1=0.9, b2=0.999, eps=1e-8):
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        p = p - LR * np.sqrt(1 - b2 ** t) / (1 - b1 ** t) \
+            * m / (np.sqrt(v) + eps)
+    return p
+
+
+def _merged_adam_step(p, g):
+    """TWO params through one optimizer: the merged (multi-tensor)
+    update path — every param updated by the same fused jitted call."""
+    pn = np.asarray(p.numpy() if hasattr(p, "numpy") else p)
+    t1 = paddle.to_tensor(pn.copy(), stop_gradient=False)
+    t2 = paddle.to_tensor(pn * 0.5, stop_gradient=False)
+    opt = paddle.optimizer.Adam(learning_rate=LR, parameters=[t1, t2])
+    gt = paddle.to_tensor(g)
+    for _ in range(2):
+        t1.grad = gt
+        t2.grad = gt
+        opt.step()
+        opt.clear_grad()
+    return t1
+
+
+P0, G0 = _f(4, 5), _f(4, 5) * 0.1
+
+S("merged_adam_step", _merged_adam_step, lambda p, g: _adam_np(p, g),
+  {"p": P0, "g": G0}, yaml_ops=("merged_adam_",), check_bf16=False,
+  check_static=False, atol=1e-5)
+
+
+def _fused_adamw_kernel_step(p, g):
+    """The Pallas fused AdamW kernel itself (ops/pallas/fused_adamw) —
+    the fused_adam_ yaml op's actual TPU implementation."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw_update
+    pj = jnp.asarray(p)
+    m = jnp.zeros_like(pj)
+    v = jnp.zeros_like(pj)
+    master = pj
+    for t in range(1, 3):
+        _, m, v, master = fused_adamw_update(
+            pj.astype(jnp.bfloat16), jnp.asarray(g), m, v, master,
+            LR, 0.9, 0.999, 1e-8, 0.0, float(t))
+        pj = master
+    return paddle.to_tensor(np.asarray(master))
+
+
+S("fused_adam_step", _fused_adamw_kernel_step,
+  lambda p, g: _adam_np(p, g), {"p": P0, "g": G0},
+  yaml_ops=("fused_adam_",), check_bf16=False, check_static=False,
+  atol=5e-3, rtol=5e-3)
+
+
+def _merged_momentum_step(p, g):
+    pn = np.asarray(p.numpy() if hasattr(p, "numpy") else p)
+    t1 = paddle.to_tensor(pn.copy(), stop_gradient=False)
+    t2 = paddle.to_tensor(pn + 1.0, stop_gradient=False)
+    opt = paddle.optimizer.Momentum(learning_rate=LR, momentum=0.9,
+                                    parameters=[t1, t2])
+    gt = paddle.to_tensor(g)
+    for _ in range(2):
+        t1.grad = gt
+        t2.grad = gt
+        opt.step()
+        opt.clear_grad()
+    return t1
+
+
+S("merged_momentum_step", _merged_momentum_step,
+  lambda p, g: _momentum_np(p, g), {"p": P0, "g": G0},
+  yaml_ops=("merged_momentum_",), check_bf16=False, check_static=False,
+  atol=1e-5)
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_op_sweep(spec):
+    run_spec(spec)
+
+
+# ------------------------------------------------------------ activations
+def _gelu_ref(x):
+    from scipy.special import erf as _erf  # scipy is unavailable: inline
+    raise RuntimeError
+try:
+    import scipy  # noqa: F401
+    HAVE_SCIPY = True
+except ImportError:
+    HAVE_SCIPY = False
+import math as _math
+
+
+def _erf_np(x):
+    from numpy import vectorize
+    return vectorize(_math.erf)(x).astype(np.float32)
+
+
+for tag, shape in [("1d", (7,)), ("3d", (2, 3, 4)), ("size1", (1, 1))]:
+    S(f"relu/{tag}", F.relu, lambda x: np.maximum(x, 0),
+      {"x": _f(*shape)}, yaml_ops=("relu",))
+    S(f"sigmoid/{tag}", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)),
+      {"x": _f(*shape)}, yaml_ops=("sigmoid",))
+    S(f"tanh/{tag}", paddle.tanh, np.tanh, {"x": _f(*shape)},
+      yaml_ops=("tanh",))
+    S(f"silu/{tag}", F.silu, lambda x: x / (1 + np.exp(-x)),
+      {"x": _f(*shape)}, yaml_ops=("silu",))
+    S(f"gelu/{tag}", F.gelu,
+      lambda x: 0.5 * x * (1.0 + _erf_np(x / np.sqrt(2.0))),
+      {"x": _f(*shape)}, yaml_ops=("gelu",), atol=1e-4, rtol=1e-4)
+S("leaky_relu/slope", F.leaky_relu,
+  lambda x, negative_slope=0.01: np.where(x > 0, x, 0.2 * x),
+  {"x": _f(3, 4)}, kwargs={"negative_slope": 0.2},
+  yaml_ops=("leaky_relu",))
+S("hardtanh/range", F.hardtanh,
+  lambda x, min=-1.0, max=1.0: np.clip(x, -0.5, 0.5),
+  {"x": _f(3, 4)}, kwargs={"min": -0.5, "max": 0.5},
+  yaml_ops=("hardtanh",))
+S("elu/alpha", F.elu,
+  lambda x, alpha=1.0: np.where(x > 0, x, 0.5 * (np.exp(x) - 1)),
+  {"x": _f(3, 4)}, kwargs={"alpha": 0.5}, yaml_ops=("elu",))
+
+# ----------------------------------------------------------------- norms
+def _ln_np(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b
+
+
+for tag, shape in [("2d", (4, 8)), ("3d", (2, 3, 8)), ("4d", (2, 2, 3, 8))]:
+    S(f"layer_norm/{tag}",
+      lambda x, w, b: F.layer_norm(x, 8, weight=w, bias=b),
+      _ln_np, {"x": _f(*shape), "w": _pos(8), "b": _f(8)},
+      yaml_ops=("layer_norm",),
+      grad_inputs=("x", "w", "b") if tag == "2d" else ())
+
+
+def _clip_cases():
+    S("clip/both", paddle.clip,
+      lambda x, min=None, max=None: np.clip(x, -0.5, 0.5),
+      {"x": _f(3, 4)}, kwargs={"min": -0.5, "max": 0.5},
+      yaml_ops=("clip",))
+    S("clip/min_only", paddle.clip,
+      lambda x, min=None, max=None: np.maximum(x, 0.0),
+      {"x": _f(3, 4)}, kwargs={"min": 0.0}, yaml_ops=("clip",))
+    S("clip/max_only", paddle.clip,
+      lambda x, min=None, max=None: np.minimum(x, 0.0),
+      {"x": _f(3, 4)}, kwargs={"max": 0.0}, yaml_ops=("clip",))
+
+
+_clip_cases()
+
+# ------------------------------------------------------------------ loss
+def _ce_np(logits, labels, ignore_index=-100):
+    m = logits.max(-1, keepdims=True)
+    lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    logp = logits - lse
+    n, c = logits.shape
+    mask = labels != ignore_index
+    safe = np.where(mask, labels, 0)
+    picked = logp[np.arange(n), safe]
+    return -(picked * mask).sum() / max(mask.sum(), 1)
+
+
+LBL = rng.integers(0, 5, (6,)).astype(np.int64)
+S("cross_entropy/plain",
+  lambda x, l: F.cross_entropy(x, l),
+  lambda x, l: _ce_np(x, l), {"x": _f(6, 5), "l": LBL},
+  yaml_ops=("cross_entropy",), check_bf16=False)
+LBL_IGN = LBL.copy()
+LBL_IGN[:2] = -100
+S("cross_entropy/ignore_index",
+  lambda x, l: F.cross_entropy(x, l, ignore_index=-100),
+  lambda x, l: _ce_np(x, l), {"x": _f(6, 5), "l": LBL_IGN},
+  yaml_ops=("cross_entropy",), check_bf16=False)
+for red, rf in [("mean", np.mean), ("sum", np.sum),
+                ("none", lambda v: v)]:
+    S(f"mse_loss/{red}",
+      lambda x, y, reduction=red: F.mse_loss(x, y, reduction=reduction),
+      (lambda rf_: lambda x, y, reduction=None: rf_((x - y) ** 2))(rf),
+      {"x": _f(3, 4), "y": _f(3, 4)}, yaml_ops=("mse_loss",))
+    S(f"l1_loss/{red}",
+      lambda x, y, reduction=red: F.l1_loss(x, y, reduction=reduction),
+      (lambda rf_: lambda x, y, reduction=None: rf_(np.abs(x - y)))(rf),
+      {"x": _f(3, 4), "y": _f(3, 4)}, yaml_ops=("l1_loss",))
+
+# ------------------------------------------------------------ comparisons
+CX, CY = _f(3, 4), _f(1, 4)
+for op_name, pfn, rfn in [
+    ("equal", paddle.equal, np.equal),
+    ("not_equal", paddle.not_equal, np.not_equal),
+    ("less_than", paddle.less_than, np.less),
+    ("greater_than", paddle.greater_than, np.greater),
+    ("less_equal", paddle.less_equal, np.less_equal),
+    ("greater_equal", paddle.greater_equal, np.greater_equal),
+]:
+    S(f"{op_name}/bcast", pfn, rfn, {"x": CX, "y": CY},
+      yaml_ops=(op_name,), check_bf16=False)
+
+BX = rng.random((3, 4)) > 0.5
+BY = rng.random((3, 4)) > 0.5
+for op_name, pfn, rfn in [
+    ("logical_and", paddle.logical_and, np.logical_and),
+    ("logical_or", paddle.logical_or, np.logical_or),
+    ("logical_xor", paddle.logical_xor, np.logical_xor),
+]:
+    S(f"{op_name}/bool", pfn, rfn, {"x": BX, "y": BY},
+      yaml_ops=(op_name,), check_bf16=False)
+
+# --------------------------------------------------------------- sorting
+S("topk/axis0", lambda x: paddle.topk(x, 2, axis=0),
+  lambda x: (np.sort(x, 0)[::-1][:2],
+             np.argsort(-x, 0, kind="stable")[:2]),
+  {"x": _f(5, 3)}, yaml_ops=("topk",), check_bf16=False)
+S("sort/desc", lambda x: paddle.sort(x, axis=-1, descending=True),
+  lambda x: -np.sort(-x, -1), {"x": _f(3, 5)}, yaml_ops=("sort",))
+S("argsort/axis0", lambda x: paddle.argsort(x, axis=0),
+  lambda x: np.argsort(x, 0, kind="stable"), {"x": _f(5, 3)},
+  yaml_ops=("argsort",), check_bf16=False)
+
+# ------------------------------------------------------------- embedding
+EMB_W = _f(10, 6)
+EMB_I = rng.integers(0, 10, (2, 4)).astype(np.int64)
+S("embedding/plain", lambda i, w: F.embedding(i, w),
+  lambda i, w: w[i], {"i": EMB_I, "w": EMB_W},
+  yaml_ops=("embedding",), check_bf16=False)
+
+
+def _emb_pad_ref(i, w):
+    out = w[i].copy()
+    out[i == 3] = 0.0
+    return out
+
+
+S("embedding/padding_idx",
+  lambda i, w: F.embedding(i, w, padding_idx=3),
+  _emb_pad_ref, {"i": EMB_I, "w": EMB_W}, yaml_ops=("embedding",),
+  check_bf16=False)
+
+# ---------------------------------------------------------------- pooling
+def _pool_ref(x, k, s, op):
+    n, c, h, wdt = x.shape
+    oh, ow = (h - k) // s + 1, (wdt - k) // s + 1
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = x[:, :, i * s:i * s + k, j * s:j * s + k]
+            out[:, :, i, j] = op(win, axis=(2, 3))
+    return out
+
+
+for k, s in [(2, 2), (3, 1)]:
+    S(f"max_pool2d/k{k}s{s}",
+      lambda x, k=k, s=s: F.max_pool2d(x, k, stride=s),
+      lambda x, k=k, s=s: _pool_ref(x, k, s, np.max),
+      {"x": _f(2, 3, 6, 6)}, yaml_ops=("max_pool2d",))
+    S(f"avg_pool2d/k{k}s{s}",
+      lambda x, k=k, s=s: F.avg_pool2d(x, k, stride=s),
+      lambda x, k=k, s=s: _pool_ref(x, k, s, np.mean),
+      {"x": _f(2, 3, 6, 6)}, yaml_ops=("avg_pool2d",))
+
+
+# a few more shape-rule cases
+S("expand/bcast", lambda x: paddle.expand(x, [3, 2, 4]),
+  lambda x: np.broadcast_to(x, (3, 2, 4)), {"x": _f(2, 4)},
+  yaml_ops=("expand",))
+S("flip/multi_axis", lambda x: paddle.flip(x, [0, 2]),
+  lambda x: x[::-1, :, ::-1], {"x": _f(2, 3, 4)}, yaml_ops=("flip",))
+S("roll/axis1", lambda x: paddle.roll(x, 2, axis=1),
+  lambda x: np.roll(x, 2, axis=1), {"x": _f(3, 5)}, yaml_ops=("roll",))
+S("diag/k1", lambda x: paddle.diag(x, offset=1),
+  lambda x: np.diag(x, k=1), {"x": _f(4, 4)}, yaml_ops=("diag",))
+S("tril/k-1", lambda x: paddle.tril(x, diagonal=-1),
+  lambda x: np.tril(x, -1), {"x": _f(4, 5)}, yaml_ops=("tril",))
