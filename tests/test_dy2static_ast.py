@@ -177,3 +177,52 @@ def test_translation_does_not_break_plain_functions():
     sf = paddle.jit.to_static(f)
     x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
     _allclose(sf(x), np.array([2.0, 4.0], np.float32))
+
+
+def test_nested_if_inside_while():
+    def f(x):
+        s = x.sum()
+        n = paddle.to_tensor(np.float32(0.0))
+        while s < 50.0:
+            if n.sum() > 2.0:     # nested tensor branch
+                s = s * 3.0
+            else:
+                s = s * 2.0
+            n = n + 1.0
+        return s
+
+    sf = paddle.jit.to_static(f)
+    expect_s, = [f(paddle.to_tensor(np.array([2.0], np.float32)))]
+    got = sf(paddle.to_tensor(np.array([2.0], np.float32)))
+    _allclose(got, expect_s)
+
+
+def test_for_with_break_falls_back_cleanly():
+    # break inside the loop: untranslatable — concrete bounds still run
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):          # python int n: plain loop
+            if i == 2:
+                break
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    _allclose(sf(x, 5), np.array([2.0, 2.0], np.float32))
+
+
+def test_augmented_assign_in_branch():
+    def f(x):
+        y = x * 1.0
+        if x.sum() > 0:
+            y += 2.0               # AugAssign target captured as out var
+        else:
+            y -= 2.0
+        return y
+
+    sf = paddle.jit.to_static(f)
+    _allclose(sf(paddle.to_tensor(np.array([1.0], np.float32))),
+              np.array([3.0], np.float32))
+    _allclose(sf(paddle.to_tensor(np.array([-1.0], np.float32))),
+              np.array([-3.0], np.float32))

@@ -175,3 +175,76 @@ def test_pylayer():
     y = Double.apply(x)
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_static_amp_o2_multi_precision_training():
+    """r4: static AMP-O2 — bf16 params + O2 autocast at trace time must
+    train THROUGH the cast nodes (an eager weight cast would freeze the
+    weights), with fp32 masters updated inside the compiled step."""
+    import jax.numpy as jnp
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(16, 32), nn.LayerNorm(32),
+                                nn.Linear(32, 4))
+            for p in net.parameters():
+                p._data = p.data.astype(jnp.bfloat16)
+            x = paddle.static.data("x", [8, 16], "float32")
+            y = paddle.static.data("y", [8], "int64")
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = F.cross_entropy(net(x), y)
+            opt = paddle.optimizer.AdamW(1e-2,
+                                         parameters=net.parameters(),
+                                         multi_precision=True)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((8, 16)).astype(np.float32),
+                "y": rng.integers(0, 4, (8,)).astype(np.int64)}
+        ln0 = np.asarray(net[1].weight.data.astype(jnp.float32))
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+        ln1 = np.asarray(net[1].weight.data.astype(jnp.float32))
+        assert losses[-1] < losses[0], losses
+        # LN weight (black-listed op, fp32 inputs) must still TRAIN
+        assert not np.allclose(ln0, ln1), "LayerNorm params frozen"
+        # masters exist for every float param, in fp32
+        assert len(opt._master_weights) == len(list(net.parameters()))
+        for m in opt._master_weights.values():
+            assert m.dtype == jnp.float32
+        # params stayed bf16 (master casts back each step)
+        assert net[0].weight.dtype == jnp.bfloat16
+    finally:
+        paddle.disable_static()
+
+
+def test_static_executor_donation_flag_preserves_aliases():
+    """FLAGS_static_executor_donate=False keeps detach() aliases valid
+    across exe.run (the alias-safe mode); default donation documents
+    buffer reuse like the reference InterpreterCore."""
+    paddle.set_flags({"FLAGS_static_executor_donate": False})
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            x = paddle.static.data("x", [2, 4], "float32")
+            loss = net(x).sum()
+            opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        snap = net.weight.detach()
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        assert snap.numpy().shape == (4, 4)  # alias still readable
+    finally:
+        paddle.disable_static()
+        paddle.set_flags({"FLAGS_static_executor_donate": True})
