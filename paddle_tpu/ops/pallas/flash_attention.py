@@ -31,10 +31,45 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 
+# murmur3-finalizer constants as wrapped int32 (jnp int32 arithmetic is
+# two's-complement wraparound under XLA, exactly what a u32 hash needs)
+def _hash_mix(x):
+    sr = jax.lax.shift_right_logical
+    x = x ^ sr(x, 16)
+    x = x * jnp.int32(-2048144789)      # 0x85ebca6b
+    x = x ^ sr(x, 13)
+    x = x * jnp.int32(-1028477387)      # 0xc2b2ae35
+    x = x ^ sr(x, 16)
+    return x
+
+
+def _keep_scale(row, col, bh, seed, rate):
+    """Deterministic per-POSITION dropout mask (independent of kernel
+    blocking, so the fwd and both bwd kernels regenerate the identical
+    mask from (position, seed) alone). Returns keep/(1-rate) as f32."""
+    h = (row * jnp.int32(-1640531527)
+         ^ col * jnp.int32(1013904223)
+         ^ bh * jnp.int32(374761393)) + seed
+    h = _hash_mix(h)
+    u = (h & jnp.int32(0xFFFFFF)).astype(jnp.float32) * (1.0 / (1 << 24))
+    return jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0)
+
+
+def _block_drop_scale(q_i, kv_i, block_q, block_k, seed_ref, rate):
+    """The [block_q, block_k] dropout scale for grid block (q_i, kv_i) —
+    ONE derivation shared by the fwd, dq and dkv kernels so their masks
+    can never desynchronize (which would silently corrupt gradients)."""
+    row = q_i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    col = kv_i * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return _keep_scale(row, col, pl.program_id(0), seed_ref[0, 0], rate)
+
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch,
                       l_scratch, acc_scratch, *, kv_steps, sm_scale, causal,
-                      block_q, block_k, t_k, causal_offset, mask_tail):
+                      block_q, block_k, t_k, causal_offset, mask_tail,
+                      dropout_rate=0.0, seed_ref=None):
     """Grid: (batch*heads, q_blocks, kv_blocks). Online softmax: running max
     (m), normalizer (l) and fp32 accumulator live in VMEM scratch across the
     kv_block grid dimension. `t_k` is the un-padded KV length (tail KV blocks
@@ -93,9 +128,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch,
             # running max is still NEG_INF (exp(NEG_INF - NEG_INF) == 1)
             p = jnp.where(pad_valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
+        # the normalizer l uses the UNDROPPED p (dropout applies to the
+        # normalized probabilities: out = (P∘M/(1-r)) V = acc_dropped / l)
         l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+        p_use = p
+        if dropout_rate > 0.0:
+            p_use = p * _block_drop_scale(q_i, kv_i, block_q, block_k,
+                                          seed_ref, dropout_rate)
         acc = acc_scratch[...] * alpha + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p_use.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
         m_scratch[...] = m_new
         l_scratch[...] = l_new
@@ -114,10 +155,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch,
 
 
 def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
-                      block_k=128, interpret=False, return_lse=False):
+                      block_k=128, interpret=False, return_lse=False,
+                      dropout_rate=0.0, seed=None):
     """q,k,v: [BH, T, D] -> o [BH, T, D] (and lse [BH, T] if return_lse).
     Handles sequence lengths that are not multiples of the block size by
-    padding + in-kernel masking."""
+    padding + in-kernel masking. dropout_rate > 0 drops attention
+    probabilities in-kernel using the position-hash mask (`seed` is a
+    traced int32 scalar; no probs tensor ever hits HBM)."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -136,28 +180,48 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
     base = functools.partial(
         _flash_fwd_kernel, kv_steps=grid[2], sm_scale=sm_scale,
         causal=causal, block_q=block_q, block_k=block_k, t_k=t_k,
-        causal_offset=t_k - t_q, mask_tail=(t_k_pad != t_k))
+        causal_offset=t_k - t_q, mask_tail=(t_k_pad != t_k),
+        dropout_rate=dropout_rate)
 
     out_shapes = [jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))]
-    if return_lse:
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    extra = ()
+    if dropout_rate > 0.0:
+        # the seed rides as an (8,128) VMEM tile (a (1,1) block would
+        # violate Mosaic tiling); kernels read [0, 0]
+        extra = (jnp.full((8, 128), jnp.asarray(seed, jnp.int32)),)
+        in_specs.append(pl.BlockSpec((8, 128), lambda b, qi, ki: (0, 0)))
+        if return_lse:
+            def kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+                       m_s, l_s, acc_s):
+                return base(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
+                            acc_s, seed_ref=seed_ref)
+        else:
+            def kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, m_s, l_s,
+                       acc_s):
+                return base(q_ref, k_ref, v_ref, o_ref, None, m_s, l_s,
+                            acc_s, seed_ref=seed_ref)
+    elif return_lse:
         kernel = base
-        out_shapes.append(
-            jax.ShapeDtypeStruct((bh, t_q_pad, 128), jnp.float32))
-        out_specs.append(
-            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)))
     else:
         def kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
             return base(q_ref, k_ref, v_ref, o_ref, None, m_s, l_s, acc_s)
 
+    if return_lse:
+        out_shapes.append(
+            jax.ShapeDtypeStruct((bh, t_q_pad, 128), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)))
+
     outs = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs if return_lse else out_specs[0],
         out_shape=out_shapes if return_lse else out_shapes[0],
         scratch_shapes=[
@@ -170,7 +234,7 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
             dimension_semantics=("parallel", "parallel", "arbitrary"))
             if (pltpu is not None and not interpret
                 and hasattr(pltpu, "CompilerParams")) else None),
-    )(q, k, v)
+    )(q, k, v, *extra)
     out, lse = outs if return_lse else (outs, None)
     if t_q_pad != t_q:
         out = out[:, :t_q]
@@ -180,7 +244,8 @@ def _flash_fwd_pallas(q, k, v, causal=False, sm_scale=None, block_q=128,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc, *, kv_steps, sm_scale, causal,
-                         block_q, block_k, t_k, causal_offset, mask_tail):
+                         block_q, block_k, t_k, causal_offset, mask_tail,
+                         dropout_rate=0.0, seed_ref=None):
     """Grid (bh, q_blocks, kv_blocks): accumulate dQ over KV blocks.
     dS = P * (dO V^T - delta); dQ = dS K * scale  (FlashAttention-2 bwd)."""
     kv_i = pl.program_id(2)
@@ -227,6 +292,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # same position-hash mask as the forward: dS = P∘(M̃∘dP - δ)
+            dp = dp * _block_drop_scale(q_i, kv_i, block_q, block_k,
+                                        seed_ref, dropout_rate)
         ds = p * (dp - delta) * sm_scale
         dq_acc[...] += jax.lax.dot(ds.astype(k.dtype), k,
                                    preferred_element_type=jnp.float32)
@@ -239,7 +308,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, q_steps,
                           sm_scale, causal, block_q, block_k, t_k,
-                          causal_offset, mask_tail):
+                          causal_offset, mask_tail, dropout_rate=0.0,
+                          seed_ref=None):
     """Grid (bh, kv_blocks, q_blocks): accumulate dK/dV over Q blocks.
     dV = P^T dO; dK = dS^T Q * scale."""
     q_i = pl.program_id(2)
@@ -285,12 +355,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
-        # dV += P^T dO
-        dv_acc[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        p_v = p
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            ks = _block_drop_scale(q_i, kv_idx, block_q, block_k,
+                                   seed_ref, dropout_rate)
+            p_v = p * ks              # dV sees the dropped probabilities
+            dp = dp * ks
+        # dV += (P∘M̃)^T dO
+        dv_acc[...] += jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         # dK += dS^T Q
         dk_acc[...] += jax.lax.dot_general(
@@ -304,7 +380,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
-                      block_k=128, interpret=False):
+                      block_k=128, interpret=False, dropout_rate=0.0,
+                      seed=None):
     """FlashAttention-2 backward. q,k,v,o,do: [BH, T, D]; lse: [BH, T]."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
@@ -333,16 +410,29 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
 
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, t_k=t_k, causal_offset=t_k - t_q,
-                  mask_tail=(t_k_pad != t_k))
+                  mask_tail=(t_k_pad != t_k), dropout_rate=dropout_rate)
+    seed_extra = ()
+    seed_spec = []
+    if dropout_rate > 0.0:
+        seed_extra = (jnp.full((8, 128), jnp.asarray(seed, jnp.int32)),)
+        seed_spec = [pl.BlockSpec((8, 128), lambda b, i, j: (0, 0))]
     cparams = (pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
         if (pltpu is not None and not interpret
             and hasattr(pltpu, "CompilerParams")) else None)
 
     grid_dq = (bh, t_q_pad // block_q, t_k_pad // block_k)
+    dq_base = functools.partial(_flash_bwd_dq_kernel, kv_steps=grid_dq[2],
+                                **common)
+    if dropout_rate > 0.0:
+        def dq_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, seed_r, dq_r,
+                      dq_a):
+            return dq_base(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, dq_a,
+                           seed_ref=seed_r)
+    else:
+        dq_kernel = dq_base
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, kv_steps=grid_dq[2],
-                          **common),
+        dq_kernel,
         grid=grid_dq,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -351,19 +441,27 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
-        ],
+        ] + seed_spec,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q_pad, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
         if pltpu is not None else [],
         interpret=interpret,
         compiler_params=cparams,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seed_extra)
 
     grid_dkv = (bh, t_k_pad // block_k, t_q_pad // block_q)
+    dkv_base = functools.partial(_flash_bwd_dkv_kernel, q_steps=grid_dkv[2],
+                                 **common)
+    if dropout_rate > 0.0:
+        def dkv_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, seed_r, dk_r,
+                       dv_r, dk_a, dv_a):
+            return dkv_base(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r,
+                            dk_a, dv_a, seed_ref=seed_r)
+    else:
+        dkv_kernel = dkv_base
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, q_steps=grid_dkv[2],
-                          **common),
+        dkv_kernel,
         grid=grid_dkv,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
@@ -372,7 +470,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
-        ],
+        ] + seed_spec,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -384,7 +482,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
         if pltpu is not None else [],
         interpret=interpret,
         compiler_params=cparams,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seed_extra)
 
     if t_q_pad != t_q:
         dq = dq[:, :t_q]
@@ -394,7 +492,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q=128,
     return dq, dk, dv
 
 
-def _mha_jnp(q, k, v, causal, sm_scale):
+def _mha_jnp(q, k, v, causal, sm_scale, dropout_rate=0.0, seed=None):
     # [B,H,T,D] reference fallback
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
@@ -403,6 +501,15 @@ def _mha_jnp(q, k, v, causal, sm_scale):
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and seed is not None:
+        # identical position-hash mask as the kernels ([B,H] folds to bh)
+        b, h, tq, tk = p.shape
+        row = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)[None]
+        col = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)[None]
+        bh = jnp.arange(b * h, dtype=jnp.int32).reshape(b * h, 1, 1)
+        ks = _keep_scale(row, col, bh, jnp.asarray(seed, jnp.int32),
+                         dropout_rate).reshape(b, h, tq, tk)
+        p = (p * ks).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -410,41 +517,50 @@ def _mha_jnp(q, k, v, causal, sm_scale):
 _FORCE_INTERPRET = False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _native_flash_bhtd(q, k, v, causal, sm_scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _native_flash_bhtd(q, k, v, seed, causal, sm_scale, dropout_rate=0.0):
     b, h, t, d = q.shape
     o = _flash_fwd_pallas(q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
                           v.reshape(b * h, -1, d), causal, sm_scale,
-                          interpret=_FORCE_INTERPRET)
+                          interpret=_FORCE_INTERPRET,
+                          dropout_rate=dropout_rate, seed=seed)
     return o.reshape(b, h, t, d)
 
 
-def _native_fwd(q, k, v, causal, sm_scale):
+def _native_fwd(q, k, v, seed, causal, sm_scale, dropout_rate):
     b, h, t, d = q.shape
     o, lse = _flash_fwd_pallas(
         q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
         v.reshape(b * h, -1, d), causal, sm_scale,
-        interpret=_FORCE_INTERPRET, return_lse=True)
-    return o.reshape(b, h, t, d), (q, k, v, o.reshape(b, h, t, d), lse)
+        interpret=_FORCE_INTERPRET, return_lse=True,
+        dropout_rate=dropout_rate, seed=seed)
+    return o.reshape(b, h, t, d), (q, k, v, o.reshape(b, h, t, d), lse,
+                                   seed)
 
 
-def _native_bwd(causal, sm_scale, res, do):
-    q, k, v, o, lse = res
+def _native_bwd(causal, sm_scale, dropout_rate, res, do):
+    import numpy as np
+    q, k, v, o, lse, seed = res
     b, h, t, d = q.shape
     dq, dk, dv = _flash_bwd_pallas(
         q.reshape(b * h, t, d), k.reshape(b * h, -1, d),
         v.reshape(b * h, -1, d), o.reshape(b * h, t, d), lse,
         do.reshape(b * h, t, d), causal, sm_scale,
-        interpret=_FORCE_INTERPRET)
+        interpret=_FORCE_INTERPRET, dropout_rate=dropout_rate, seed=seed)
+    dseed = np.zeros((), jax.dtypes.float0)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, -1, d),
-            dv.reshape(b, h, -1, d))
+            dv.reshape(b, h, -1, d), dseed)
 
 
 _native_flash_bhtd.defvjp(_native_fwd, _native_bwd)
 
 
-def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
-    """Differentiable flash attention, paddle layout [B, L, H, D]."""
+def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
+                         dropout_rate=0.0, seed=None):
+    """Differentiable flash attention, paddle layout [B, L, H, D].
+    dropout_rate > 0 applies in-kernel attention-probability dropout
+    (needs a traced int32 `seed`; jax's tuned kernel has no dropout, so
+    the native kernel carries it)."""
     from ...flags import get_flag
     sm_scale = sm_scale if sm_scale is not None else \
         1.0 / math.sqrt(q.shape[-1])
@@ -452,11 +568,22 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
     kh = jnp.moveaxis(k, 1, 2)
     vh = jnp.moveaxis(v, 1, 2)
     impl = get_flag("FLAGS_tpu_flash_impl", "jax")
+    if dropout_rate > 0.0:
+        if not 0.0 < dropout_rate < 1.0:
+            # rate 1.0 drops every probability: the output is zeros
+            return jnp.zeros_like(q)
+        if seed is None:
+            raise ValueError(
+                "flash_attention_blhd: dropout_rate > 0 needs a seed")
+        impl = "native"
     if causal and q.shape[1] > k.shape[1]:
         # t_q > t_k causal has fully-masked rows whose forward degrades to
         # uniform attention; the hand-written backward zeroes them instead,
         # so use the dense path where AD matches the primal exactly
-        out = _mha_jnp(qh, kh, vh, True, sm_scale)
+        # (applying the SAME position-hash dropout mask as the kernel)
+        out = _mha_jnp(qh, kh, vh, True, sm_scale,
+                       dropout_rate=dropout_rate,
+                       seed=None if dropout_rate == 0.0 else seed)
         return jnp.moveaxis(out, 1, 2)
     if causal and q.shape[1] != k.shape[1]:
         # jax's tuned kernel masks top-left (col <= row, no cross-length
@@ -464,7 +591,10 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
         # so cross-length causal (t_k > t_q) must use the native kernel
         impl = "native"
     if impl == "native":
-        out = _native_flash_bhtd(qh, kh, vh, causal, sm_scale)
+        out = _native_flash_bhtd(
+            qh, kh, vh,
+            jnp.asarray(seed if seed is not None else 0, jnp.int32),
+            causal, sm_scale, dropout_rate)
     else:
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -483,7 +613,8 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None):
                     "FLAGS_tpu_flash_impl=native to silence.",
                     stacklevel=2)
                 _warned_fallback = True
-            out = _native_flash_bhtd(qh, kh, vh, causal, sm_scale)
+            out = _native_flash_bhtd(qh, kh, vh, jnp.int32(0), causal,
+                                     sm_scale, 0.0)
     return jnp.moveaxis(out, 1, 2)
 
 
