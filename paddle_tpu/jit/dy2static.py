@@ -12,13 +12,17 @@ rewrites the two dominant patterns —
     if <pred>:  ... else: ...        ->  _pt_ifelse(pred, t_fn, f_fn, vars)
     while <pred>: ...                ->  _pt_while(cond_fn, body_fn, vars)
     for i in range(<n>): ...         ->  while-form, then _pt_while
+    for x in <iterable>: ...         ->  _pt_for (tensor: leading dim)
+    break / continue                 ->  bool-guard flags (_JumpEliminator,
+                                         the reference's rewriting in
+                                         break_continue_transformer.py)
 
 — into runtime helpers that dispatch exactly like static/control_flow.py's
 ``cond``/``while_loop``: concrete predicate -> plain Python; traced
 predicate (inside @to_static's jax.jit) -> ``lax.cond``/``lax.while_loop``.
-Anything the pass cannot prove safe (return/break/continue inside the
-block, no source available) is left untouched, so untranslatable code
-still raises the instructive Dy2StaticError.
+Anything the pass cannot prove safe (return/yield inside the block,
+jumps inside try/with, no source available) is left untouched, so
+untranslatable code still raises the instructive Dy2StaticError.
 
 The pass runs LAZILY: StaticFunction first traces the original function
 (zero overhead for code that already traces); only when tracing hits a
@@ -89,8 +93,14 @@ def _pt_ifelse(pred, true_fn: Callable, false_fn: Callable, init: tuple):
         # branch that ran (reference dygraph behavior)
         return true_fn(init) if bool(np.asarray(arr)) else false_fn(init)
     init2 = tuple(_tensorize(v) for v in init)
+
+    def run(fn):
+        # scalar literals assigned in a branch (e.g. a jump flag set to
+        # True) must become tensors so both branches return one structure
+        return tuple(_tensorize(v) for v in fn(init2))
+
     try:
-        out = cond(pred, lambda: true_fn(init2), lambda: false_fn(init2))
+        out = cond(pred, lambda: run(true_fn), lambda: run(false_fn))
     except (ValueError, TypeError):
         if any(v is _PT_UNDEF for v in init2):
             _check_no_undef([_PT_UNDEF], "if")
@@ -139,6 +149,116 @@ def _pt_range_keep(i, stop, step):
                     jnp.asarray(i_) < jnp.asarray(stop_),
                     jnp.asarray(i_) > jnp.asarray(stop_))
     return Tensor(out)
+
+
+def _pt_not_any(*flags):
+    """Guard predicate for rewritten break/continue: True iff no jump
+    flag is set. Concrete flags stay Python bools (zero overhead in
+    eager); traced/symbolic flags build a tensor predicate that
+    _pt_ifelse can lower to lax.cond."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+    if (any(isinstance(f, SymbolicTensor) for f in flags)
+            or any(_is_traced_value(f) for f in flags)):
+        from ..ops.logic import logical_not, logical_or
+        acc = None
+        for f in flags:
+            b = f if isinstance(f, Tensor) else Tensor(jnp.asarray(f))
+            b = b.astype("bool")
+            acc = b if acc is None else logical_or(acc, b)
+        return logical_not(acc)
+    vals = [f.data if isinstance(f, Tensor) else f for f in flags]
+    return not any(bool(np.asarray(v)) for v in vals)
+
+
+def _pt_and_not(keep_fn, brk):
+    """Loop-continue predicate ``not brk and keep_fn()`` for loops
+    rewritten around a ``break`` flag (the reference's bool-guard
+    approach, ref convert_operators.py:126 + break_continue_transformer).
+    ``keep_fn`` is a thunk so a concrete set flag SHORT-CIRCUITS —
+    Python never re-evaluates a while test after break, and tests like
+    ``data[i] > 0`` may only be valid pre-break."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+    if not (isinstance(brk, SymbolicTensor) or _is_traced_value(brk)):
+        b = brk.data if isinstance(brk, Tensor) else brk
+        if bool(np.asarray(b)):
+            return False
+        return keep_fn()
+    # traced/symbolic flag: both sides must be materialized for lax
+    keep = keep_fn()
+    from ..ops.logic import logical_and, logical_not
+    k = keep if isinstance(keep, Tensor) else Tensor(jnp.asarray(keep))
+    b = brk if isinstance(brk, Tensor) else Tensor(jnp.asarray(brk))
+    return logical_and(k.astype("bool"), logical_not(b.astype("bool")))
+
+
+def _pt_for(seq, body_fn, init, brk_idx=None):
+    """Runtime dispatch for a rewritten ``for <name> in <iterable>``
+    (ref convert_operators.py convert-for semantics): ordinary Python
+    iterables run a plain loop (layer lists etc. keep exact eager
+    semantics, and unroll harmlessly under trace); a Tensor iterates its
+    leading dim in while-form so a traced loop lowers to
+    lax.while_loop with dynamic indexing.
+
+    ``body_fn(x, vals) -> (target_after_body, *vals)``; returns
+    ``(target_last, *vals_last)`` so the loop variable keeps its Python
+    post-loop binding. ``brk_idx`` indexes a break flag inside ``vals``
+    set by the rewritten body: a concrete flag stops iteration mid-
+    iterable (so unbounded iterators terminate); a traced flag can only
+    no-op the remaining iterations of a bounded iterable."""
+    from ..framework.symbolic import SymbolicTensor
+    from ..framework.tensor import Tensor
+
+    def flag_set(vals):
+        if brk_idx is None:
+            return False
+        f = vals[brk_idx]
+        if isinstance(f, SymbolicTensor) or _is_traced_value(f):
+            return False  # host cannot branch on a traced flag
+        arr = f.data if isinstance(f, Tensor) else f
+        return bool(np.asarray(arr))
+
+    if isinstance(seq, SymbolicTensor):
+        # static-graph build: leading dim is a known static shape; unroll
+        vals = tuple(init)
+        last = _PT_UNDEF
+        for i in range(int(seq.shape[0])):
+            if flag_set(vals):
+                break
+            res = tuple(body_fn(seq[i], vals))
+            last, vals = res[0], res[1:]
+        return (last,) + vals
+    if isinstance(seq, Tensor):
+        n = int(seq.shape[0])
+        if n == 0:
+            return (_PT_UNDEF,) + tuple(init)
+        x0 = seq[0]
+        x0 = Tensor(jnp.zeros_like(x0.data if isinstance(x0, Tensor)
+                                   else jnp.asarray(x0)))
+
+        def cond_fn(vals):
+            def keep():
+                return _pt_range_keep(vals[0], n, 1)
+            if brk_idx is None:
+                return keep()
+            return _pt_and_not(keep, vals[2 + brk_idx])
+
+        def step_fn(vals):
+            i = vals[0]
+            res = tuple(body_fn(seq[i], tuple(vals[2:])))
+            return (i + 1, res[0]) + res[1:]
+
+        res = _pt_while(cond_fn, step_fn, (0, x0) + tuple(init))
+        return tuple(res[1:])
+    vals = tuple(init)
+    last = _PT_UNDEF
+    for x in seq:
+        if flag_set(vals):
+            break
+        res = tuple(body_fn(x, vals))
+        last, vals = res[0], res[1:]
+    return (last,) + vals
 
 
 def _pt_cast(v, kind: str):
@@ -248,8 +368,175 @@ def _has_disallowed(stmts: Sequence[ast.stmt]) -> bool:
     return False
 
 
+class _OwnJumps(ast.NodeVisitor):
+    """Break/Continue statements belonging to the CURRENT loop body —
+    no descent into nested loops (their jumps are their own) or defs."""
+
+    def __init__(self):
+        self.brk = False
+        self.cont = False
+
+    def visit_For(self, node):
+        pass
+
+    visit_AsyncFor = visit_For
+    visit_While = visit_For
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Try(self, node):
+        # jumps inside try/with are left to plain Python (finally /
+        # __exit__ semantics can't ride a lax carry)
+        pass
+
+    visit_TryStar = visit_Try
+    visit_With = visit_Try
+    visit_AsyncWith = visit_Try
+
+    def visit_Break(self, node):
+        self.brk = True
+
+    def visit_Continue(self, node):
+        self.cont = True
+
+
+def _own_jumps(stmts: Sequence[ast.stmt]):
+    v = _OwnJumps()
+    for s in stmts:
+        v.visit(s)
+    return v.brk, v.cont
+
+
+def _assign_const(name: str, value) -> ast.stmt:
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value))
+
+
+class _JumpEliminator(ast.NodeTransformer):
+    """Rewrite ``break``/``continue`` into bool-guard flags — the
+    reference's approach (ref convert_operators.py:126 and the
+    BreakContinueTransformer): ``break`` sets a flag that is folded into
+    the loop condition; statements that follow a potential jump are
+    guarded by ``if <no flag set>:``. After this pass the loop body has
+    no jump statements, so the main control-flow transformer can lower
+    it to lax.while_loop. Loops without jumps are left untouched."""
+
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    def _rewrite_block(self, stmts, brk, cont, flags):
+        out: List[ast.stmt] = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign_const(brk, True))
+                return out  # rest of the block is unreachable
+            if isinstance(s, ast.Continue):
+                out.append(_assign_const(cont, True))
+                return out
+            if isinstance(s, ast.If):
+                b, c = _own_jumps([s])
+                if b or c:
+                    out.append(ast.If(
+                        test=s.test,
+                        body=self._rewrite_block(s.body, brk, cont,
+                                                 flags),
+                        orelse=(self._rewrite_block(s.orelse, brk, cont,
+                                                    flags)
+                                if s.orelse else [])))
+                    rest = self._rewrite_block(stmts[idx + 1:], brk,
+                                               cont, flags)
+                    if rest:
+                        guard = ast.Call(
+                            func=_name("_pt_not_any"),
+                            args=[_name(f) for f in flags], keywords=[])
+                        out.append(ast.If(test=guard, body=rest,
+                                          orelse=[]))
+                    return out
+            out.append(s)
+        return out
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)  # bottom-up: nested loops first
+        if node.orelse:
+            return node
+        brk_used, cont_used = _own_jumps(node.body)
+        if not (brk_used or cont_used):
+            return node
+        uid = self._uid()
+        brk = f"_pt_brk_{uid}"
+        cont = f"_pt_cont_{uid}"
+        flags = ([brk] if brk_used else []) + ([cont] if cont_used else [])
+        body = self._rewrite_block(list(node.body), brk, cont, flags)
+        if cont_used:
+            body = [_assign_const(cont, False)] + body
+        test = node.test
+        if brk_used:
+            # thunk the original test so a set flag short-circuits it
+            test = ast.Call(func=_name("_pt_and_not"),
+                            args=[_thunk(node.test), _name(brk)],
+                            keywords=[])
+        self.changed = True
+        return ([_assign_const(f, False) for f in flags]
+                + [ast.While(test=test, body=body, orelse=[])])
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        brk_used, cont_used = _own_jumps(node.body)
+        if not (brk_used or cont_used):
+            return node
+        uid = self._uid()
+        brk = f"_pt_brk_{uid}"
+        cont = f"_pt_cont_{uid}"
+        flags = ([brk] if brk_used else []) + ([cont] if cont_used else [])
+        body = self._rewrite_block(list(node.body), brk, cont, flags)
+        if cont_used:
+            body = [_assign_const(cont, False)] + body
+        if brk_used:
+            # guard makes any iteration after the break a no-op; the
+            # main pass additionally folds the flag into the loop
+            # termination (via the _pt_brk marker) so iteration stops
+            guard = ast.Call(func=_name("_pt_not_any"),
+                             args=[_name(brk)], keywords=[])
+            body = [ast.If(test=guard, body=body, orelse=[])]
+        self.changed = True
+        new_for = ast.For(target=node.target, iter=node.iter, body=body,
+                          orelse=[])
+        if brk_used:
+            new_for._pt_brk = brk
+        return ([_assign_const(f, False) for f in flags] + [new_for])
+
+
+def _keep_name(n: str) -> bool:
+    """Loop-var filter: helper temporaries are excluded from captures,
+    but the jump flags introduced by _JumpEliminator must ride the
+    carry."""
+    return (not n.startswith("_pt_")
+            or n.startswith(("_pt_brk_", "_pt_cont_")))
+
+
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _thunk(expr: ast.expr) -> ast.expr:
+    """lambda: <expr>"""
+    args = ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+    return ast.Lambda(args=args, body=expr)
 
 
 def _capture_tuple(names: List[str]) -> ast.expr:
@@ -271,18 +558,25 @@ def _unpack_stmt(names: List[str], src: str) -> ast.stmt:
     return ast.Assign(targets=[tgt], value=_name(src))
 
 
-def _branch_funcdef(fname: str, names: List[str],
-                    body: List[ast.stmt]) -> ast.stmt:
-    """def <fname>(_pt_in): (a, b) = _pt_in; <body>; return (a, b)"""
+def _branch_funcdef(fname: str, names: List[str], body: List[ast.stmt],
+                    extra_args: Sequence[str] = (),
+                    pre: Sequence[ast.stmt] = (),
+                    ret_names: Optional[List[str]] = None) -> ast.stmt:
+    """def <fname>(*extra, _pt_in): (a, b) = _pt_in; <pre>; <body>;
+    return (<ret_names or names>)"""
     stmts: List[ast.stmt] = []
     if names:
         stmts.append(_unpack_stmt(names, "_pt_in"))
+    stmts.extend(pre)
     stmts.extend(body)
+    rn = names if ret_names is None else ret_names
     stmts.append(ast.Return(value=ast.Tuple(
-        elts=[_name(n) for n in names], ctx=ast.Load())))
-    args = ast.arguments(posonlyargs=[], args=[ast.arg(arg="_pt_in")],
-                         vararg=None, kwonlyargs=[], kw_defaults=[],
-                         kwarg=None, defaults=[])
+        elts=[_name(n) for n in rn], ctx=ast.Load())))
+    args = ast.arguments(
+        posonlyargs=[],
+        args=[ast.arg(arg=a) for a in extra_args] + [ast.arg(arg="_pt_in")],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
     return ast.FunctionDef(name=fname, args=args, body=stmts,
                            decorator_list=[], returns=None)
 
@@ -315,7 +609,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if _has_disallowed(node.body) or _has_disallowed(node.orelse):
             return node
         out = sorted(_assigned(node.body) | _assigned(node.orelse))
-        out = [n for n in out if not n.startswith("_pt_")]
+        out = [n for n in out if _keep_name(n)]
         if not out:
             return node                   # side-effect-only branch
         uid = self._uid()
@@ -342,7 +636,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _has_disallowed(node.body):
             return node
         out = sorted(_assigned(node.body))
-        out = [n for n in out if not n.startswith("_pt_")]
+        out = [n for n in out if _keep_name(n)]
         if not out:
             return node
         uid = self._uid()
@@ -380,7 +674,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 and not node.iter.keywords
                 and 1 <= len(node.iter.args) <= 3
                 and isinstance(node.target, ast.Name)):
-            return node
+            return self._rewrite_for_iterable(node)
         uid = self._uid()
         i_name = node.target.id
         stop_v, step_v = f"_pt_stop_{uid}", f"_pt_step_{uid}"
@@ -399,6 +693,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         test = ast.Call(func=_name("_pt_range_keep"),
                         args=[_name(i_name), _name(stop_v), _name(step_v)],
                         keywords=[])
+        brk = getattr(node, "_pt_brk", None)
+        if brk is not None:
+            # fold the break flag into loop termination so a broken
+            # range loop stops instead of running no-op iterations
+            test = ast.Call(func=_name("_pt_and_not"),
+                            args=[_thunk(test), _name(brk)], keywords=[])
         incr = ast.Assign(
             targets=[_name(i_name, ast.Store())],
             value=ast.BinOp(left=_name(i_name), op=ast.Add(),
@@ -411,6 +711,47 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return init + (replaced if isinstance(replaced, list)
                        else [replaced])
+
+    # -- for x in <iterable> (tensor iterates its leading dim) --------------
+    def _rewrite_for_iterable(self, node: ast.For):
+        """``for x in seq`` -> _pt_for(seq, body_fn, vars). Runtime
+        dispatch keeps plain-Python semantics for ordinary iterables;
+        Tensor sequences iterate dim 0 in while-form (ref
+        convert_operators.py convert-for over a Variable)."""
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        out = sorted(_assigned(node.body) - {node.target.id})
+        out = [n for n in out if _keep_name(n)]
+        if not out:
+            return node
+        uid = self._uid()
+        target = node.target.id
+        seq_v = f"_pt_seq_{uid}"
+        b_name = f"_pt_forbody_{uid}"
+        tmp = f"_pt_out_{uid}"
+        bind = ast.Assign(targets=[ast.Name(id=target, ctx=ast.Store())],
+                          value=_name("_pt_x"))
+        body_def = _branch_funcdef(b_name, out, list(node.body),
+                                   extra_args=["_pt_x"], pre=[bind],
+                                   ret_names=[target] + out)
+        brk = getattr(node, "_pt_brk", None)
+        kw = []
+        if brk is not None and brk in out:
+            kw = [ast.keyword(arg="brk_idx",
+                              value=ast.Constant(out.index(brk)))]
+        self.changed = True
+        return [
+            ast.Assign(targets=[_name(seq_v, ast.Store())],
+                       value=node.iter),
+            body_def,
+            ast.Assign(
+                targets=[_name(tmp, ast.Store())],
+                value=ast.Call(func=_name("_pt_for"),
+                               args=[_name(seq_v), _name(b_name),
+                                     _capture_tuple(out)],
+                               keywords=kw)),
+            _unpack_stmt([target] + out, tmp),
+        ]
 
 
 def translate_function(fn: Callable) -> Optional[Callable]:
@@ -425,9 +766,11 @@ def translate_function(fn: Callable) -> Optional[Callable]:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []              # strip @to_static etc.
+    jumps = _JumpEliminator()
+    jumps.visit(fdef)
     tr = _ControlFlowTransformer()
     tr.visit(fdef)
-    if not tr.changed:
+    if not (tr.changed or jumps.changed):
         return None
     ast.fix_missing_locations(tree)
 
@@ -442,7 +785,9 @@ def translate_function(fn: Callable) -> Optional[Callable]:
                 pass
     glb.update(_pt_ifelse=_pt_ifelse, _pt_while=_pt_while,
                _pt_get=_pt_get, _pt_range_keep=_pt_range_keep,
-               _pt_cast=_pt_cast, _PT_UNDEF=_PT_UNDEF)
+               _pt_cast=_pt_cast, _PT_UNDEF=_PT_UNDEF,
+               _pt_not_any=_pt_not_any, _pt_and_not=_pt_and_not,
+               _pt_for=_pt_for)
     code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
                    mode="exec")
     ns: dict = {}

@@ -212,6 +212,211 @@ def test_for_with_break_falls_back_cleanly():
     _allclose(sf(x, 5), np.array([2.0, 2.0], np.float32))
 
 
+def test_break_in_tensor_while():
+    # ref convert_operators.py:126 + break_continue_transformer: break
+    # becomes a bool-guard flag folded into the loop condition
+    def f(x):
+        s = x.sum()
+        n = paddle.zeros_like(s)
+        while s < 100.0:
+            s = s * 2.0
+            n = n + 1.0
+            if n > 3.0:
+                break
+        return s, n
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    es, en = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    ts, tn = sf(x)
+    _allclose(ts, es)
+    _allclose(tn, en)
+
+
+def test_break_with_statements_after_guard():
+    # statements after a potential break must be skipped on the broken
+    # iteration (the guarded-rest rewriting)
+    def f(x):
+        s = x.sum()
+        n = paddle.zeros_like(s)
+        while s < 100.0:
+            if s > 20.0:
+                break
+            s = s * 2.0
+            n = n + 1.0
+        return s, n
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    es, en = f(paddle.to_tensor(np.array([3.0], np.float32)))
+    ts, tn = sf(x)
+    _allclose(ts, es)
+    _allclose(tn, en)
+
+
+def test_break_in_while_true():
+    def f(x):
+        s = x.sum()
+        n = paddle.zeros_like(s)
+        while True:
+            s = s * 2.0
+            n = n + 1.0
+            if s > 100.0:
+                break
+        return s, n
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.5], np.float32))
+    es, en = f(paddle.to_tensor(np.array([1.5], np.float32)))
+    ts, tn = sf(x)
+    _allclose(ts, es)
+    _allclose(tn, en)
+
+
+def test_continue_in_tensor_while():
+    def f(x):
+        s = x.sum()
+        acc = paddle.zeros_like(s)
+        while s < 10.0:
+            s = s + 1.0
+            if s > 5.0:
+                continue
+            acc = acc + s
+        return s, acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    es, ea = f(paddle.to_tensor(np.array([1.0], np.float32)))
+    ts, ta = sf(x)
+    _allclose(ts, es)
+    _allclose(ta, ea)
+
+
+def test_continue_in_range_for_tensor_bound():
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            if float(i) > 2.0:
+                continue
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    n = paddle.to_tensor(np.int32(5))
+    # adds for i in 0,1,2 -> 3x
+    _allclose(sf(x, n), np.array([3.0, 3.0], np.float32))
+
+
+def test_break_in_range_for_tensor_bound():
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            if float(i) > 1.0:
+                break
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    n = paddle.to_tensor(np.int32(6))
+    # adds for i in 0,1 -> 2x
+    _allclose(sf(x, n), np.array([2.0, 2.0], np.float32))
+
+
+def test_for_over_tensor_rows():
+    # iterate a tensor's leading dim (ref convert-for over a Variable);
+    # the tensor-dependent branch inside forces translation
+    def f(xs):
+        acc = paddle.zeros([2], "float32")
+        for row in xs:
+            if row.sum() > 0:
+                acc = acc + row
+            else:
+                acc = acc - row
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    arr = np.array([[1.0, 2.0], [-3.0, -1.0], [0.5, 0.5]], np.float32)
+    xs = paddle.to_tensor(arr)
+    expect = arr[0] + (-arr[1]) + arr[2]
+    _allclose(sf(xs), expect)
+
+
+def test_for_over_python_list_keeps_semantics():
+    # translation rewrites every for; plain iterables must keep exact
+    # Python semantics through the _pt_for runtime dispatch
+    def f(x):
+        acc = paddle.zeros_like(x)
+        for s in [1.0, 2.0, 3.0]:
+            acc = acc + x * s
+        if acc.sum() > 0:
+            acc = acc * 2.0
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    _allclose(sf(x), np.array([12.0, 12.0], np.float32))
+
+
+def test_break_stops_unbounded_iterator():
+    # regression: a broken for over an unbounded iterator must stop
+    # (concrete flag short-circuits iteration inside _pt_for)
+    import itertools
+
+    def f(x):
+        acc = paddle.zeros_like(x)
+        if x.sum() > 0:             # forces translation
+            acc = acc + 1.0
+        for i in itertools.count():
+            acc = acc + x
+            if i >= 2:
+                break
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _allclose(sf(x), np.array([4.0], np.float32))  # 1 + 3*x
+
+
+def test_loop_var_bound_after_for():
+    # regression: Python leaves the loop variable bound after the loop
+    def f(x):
+        acc = paddle.zeros_like(x)
+        for s in [1.0, 2.0, 3.0]:
+            acc = acc + x * s
+        if acc.sum() > 0:           # forces translation
+            acc = acc * 1.0
+        return acc + s              # s == 3.0 after the loop
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _allclose(sf(x), np.array([9.0], np.float32))
+
+
+def test_break_short_circuits_while_test():
+    # regression: Python never re-evaluates a while test after break;
+    # tests valid only pre-break (list indexing) must not be re-run
+    def f(x):
+        if x.sum() > 0:             # forces translation
+            y = x * 2.0
+        else:
+            y = x
+        data = [3.0, 2.0, 1.0]
+        i = 0
+        total = 0.0
+        while data[i] > 0:
+            total += data[i]
+            i += 1
+            if i == len(data):
+                break
+        return y * total
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _allclose(sf(x), np.array([12.0], np.float32))
+
+
 def test_augmented_assign_in_branch():
     def f(x):
         y = x * 1.0
