@@ -4,12 +4,16 @@ dist_saver.py + converter.py reshard checkpoints across meshes).
 
 TPU-native: orbax sharded, async-capable checkpointing of global arrays.
 Because parameters are GLOBAL logical tensors (not per-rank shards), the
-reference's pp/tp re-mapping adaptors reduce to loading with a different
-NamedSharding — restore takes the target mesh/sharding and orbax reshards."""
+reference's mesh re-mapping reduces to restoring with a different
+NamedSharding — ``load_state_dict(target_state_dict=...)`` reshards into
+the targets' current shardings, whatever mesh they live on. The pickle
+fallback is used ONLY when orbax is not importable; format dispatch at
+load time is by on-disk layout, never by swallowing orbax errors."""
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+import re
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 import numpy as np
@@ -22,68 +26,148 @@ def _flatten_state(state_dict):
             for k, v in state_dict.items()}
 
 
+def _pickle_path(path: str) -> str:
+    return path if path.endswith(".pdparams") \
+        else os.path.join(path, "state.pdparams")
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     async_save: bool = False):
     """Sharded save of a (possibly distributed) state dict."""
     try:
         import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.abspath(path), _flatten_state(state_dict),
-                   force=True)
-        return
-    except Exception:
+    except ImportError:
         # portable fallback: gather to host + pickle
         from ..framework.io import save
-        save(state_dict, os.path.join(path, "state.pdparams")
-             if os.path.isdir(path) or not path.endswith(".pdparams")
-             else path)
+        save(state_dict, _pickle_path(path))
+        return
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(path), _flatten_state(state_dict),
+               force=True)
 
 
 def load_state_dict(path: str, target_state_dict=None, shardings=None):
     """Load; if `target_state_dict` given, restore INTO its tensors keeping
-    their current shardings (cross-mesh reshard happens here)."""
-    try:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        if target_state_dict is not None:
-            targets = {
-                k: jax.ShapeDtypeStruct(
-                    tuple(v.shape), np.dtype(v.dtype),
-                    sharding=v.data.sharding if hasattr(v.data, "sharding")
-                    else None)
-                for k, v in target_state_dict.items()
-                if isinstance(v, Tensor)}
-            restored = ckptr.restore(
-                os.path.abspath(path),
-                restore_args=jax.tree_util.tree_map(
-                    lambda s: ocp.ArrayRestoreArgs(
-                        sharding=s.sharding, global_shape=s.shape,
-                        dtype=s.dtype), targets))
-            for k, v in restored.items():
-                if k in target_state_dict:
-                    target_state_dict[k]._data = v
-            return target_state_dict
-        return {k: Tensor(v) for k, v in ckptr.restore(
-            os.path.abspath(path)).items()}
-    except Exception:
+    their current shardings (cross-mesh reshard happens here: save under
+    mesh A, restore under mesh B — orbax reads global arrays and lays
+    them out per the requested sharding)."""
+    if os.path.exists(_pickle_path(path)):
+        # pickle-format checkpoint (written by the no-orbax fallback)
         from ..framework.io import load
-        p = os.path.join(path, "state.pdparams") if not \
-            path.endswith(".pdparams") else path
-        state = load(p)
+        state = load(_pickle_path(path))
         if target_state_dict is not None:
             for k, v in state.items():
                 if k in target_state_dict:
                     target_state_dict[k].set_value(v)
             return target_state_dict
         return state
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        raise ImportError(
+            f"checkpoint at {path!r} is an orbax sharded checkpoint but "
+            f"orbax.checkpoint is not importable in this environment")
+    ckptr = ocp.PyTreeCheckpointer()
+    if target_state_dict is not None:
+        targets = {
+            k: jax.ShapeDtypeStruct(
+                tuple(v.shape), np.dtype(v.dtype),
+                sharding=v.data.sharding if hasattr(v.data, "sharding")
+                else None)
+            for k, v in target_state_dict.items()
+            if isinstance(v, Tensor)}
+        restored = ckptr.restore(
+            os.path.abspath(path),
+            restore_args=jax.tree_util.tree_map(
+                lambda s: ocp.ArrayRestoreArgs(
+                    sharding=s.sharding, global_shape=s.shape,
+                    dtype=s.dtype), targets))
+        for k, v in restored.items():
+            if k in target_state_dict:
+                target_state_dict[k]._data = v
+        return target_state_dict
+    return {k: Tensor(v) for k, v in ckptr.restore(
+        os.path.abspath(path)).items()}
 
 
 class PPParallelAdaptor:
-    """ref: fleet/utils/pp_parallel_adaptor.py — remap a checkpoint saved
-    under one pp/tp layout to another. Global-view checkpoints make this a
-    key-rename + reshard exercise."""
+    """ref: fleet/utils/pp_parallel_adaptor.py — remap checkpoints between
+    pipeline layouts. The reference's PipelineLayer saves per-stage state
+    dicts whose ``<layer_key>.<i>.*`` indices are STAGE-LOCAL; converting
+    src_pp -> dst_pp renumbers through the global layer index assuming the
+    contiguous balanced partition (the reference's default 'uniform' seg
+    method; np.array_split semantics). Non-layer keys (embeddings, heads)
+    ride on stage 0, matching the reference's shared-weight placement."""
 
     @staticmethod
-    def convert(state_dict, src_pp=1, dst_pp=1, layer_key="layers"):
-        # keys are layout-independent in the global view; pass through
-        return state_dict
+    def _bounds(n_layers: int, pp: int) -> List[int]:
+        sizes = [len(c) for c in np.array_split(np.arange(n_layers), pp)]
+        bounds = [0]
+        for s in sizes:
+            bounds.append(bounds[-1] + s)
+        return bounds
+
+    @classmethod
+    def to_global(cls, stage_dicts: List[Dict[str, Any]],
+                  layer_key: str = "layers") -> Dict[str, Any]:
+        """Merge per-stage state dicts (stage-local layer indices) into
+        one global-view dict."""
+        pat = re.compile(rf"^{re.escape(layer_key)}\.(\d+)\.(.*)$")
+        counts = [len({int(m.group(1)) for k in sd
+                       if (m := pat.match(k)) is not None})
+                  for sd in stage_dicts]
+        bounds = [0]
+        for c in counts:
+            bounds.append(bounds[-1] + c)
+        out: Dict[str, Any] = {}
+        for stage, sd in enumerate(stage_dicts):
+            for k, v in sd.items():
+                m = pat.match(k)
+                if m is None:
+                    out.setdefault(k, v)
+                    continue
+                g = bounds[stage] + int(m.group(1))
+                out[f"{layer_key}.{g}.{m.group(2)}"] = v
+        return out
+
+    @classmethod
+    def convert(cls, state_dict: Union[Dict[str, Any],
+                                       List[Dict[str, Any]]],
+                src_pp: int = 1, dst_pp: int = 1,
+                layer_key: str = "layers"):
+        """Remap ``state_dict`` saved under ``src_pp`` pipeline stages to
+        ``dst_pp`` stages. A list input is per-stage dicts (stage-local
+        indices); a single dict is the global view (src_pp must be 1).
+        Returns a list of ``dst_pp`` per-stage dicts, or the global dict
+        when ``dst_pp == 1``."""
+        if isinstance(state_dict, list):
+            if len(state_dict) != src_pp:
+                raise ValueError(
+                    f"PPParallelAdaptor.convert: got {len(state_dict)} "
+                    f"stage dicts but src_pp={src_pp}")
+            global_sd = cls.to_global(state_dict, layer_key)
+        else:
+            if src_pp != 1:
+                raise ValueError(
+                    "PPParallelAdaptor.convert: a single state dict is "
+                    "the global view; pass the per-stage dicts as a list "
+                    "when src_pp > 1")
+            global_sd = dict(state_dict)
+        if dst_pp == 1:
+            return global_sd
+        pat = re.compile(rf"^{re.escape(layer_key)}\.(\d+)\.(.*)$")
+        layer_ids = {int(m.group(1)) for k in global_sd
+                     if (m := pat.match(k)) is not None}
+        n_layers = (max(layer_ids) + 1) if layer_ids else 0
+        bounds = cls._bounds(n_layers, dst_pp)
+        stages: List[Dict[str, Any]] = [dict() for _ in range(dst_pp)]
+        for k, v in global_sd.items():
+            m = pat.match(k)
+            if m is None:
+                stages[0][k] = v
+                continue
+            g = int(m.group(1))
+            stage = int(np.searchsorted(bounds, g, side="right") - 1)
+            local = g - bounds[stage]
+            stages[stage][f"{layer_key}.{local}.{m.group(2)}"] = v
+        return stages
