@@ -145,9 +145,66 @@ def reshard(x, process_mesh=None, placements=None, dist_attr=None):
     return shard_tensor(x, process_mesh, placements, dist_attr=dist_attr)
 
 
-def shard_op(op_fn, process_mesh=None, in_shardings=None, out_shardings=None):
+def _to_spec(process_mesh, s) -> Optional[PartitionSpec]:
+    """Accept a PartitionSpec, a placements list, a TensorDistAttr, or a
+    dims_mapping list."""
+    if s is None:
+        return None
+    if isinstance(s, PartitionSpec):
+        return s
+    if isinstance(s, TensorDistAttr):
+        return s.to_partition_spec()
+    if isinstance(s, (list, tuple)):
+        if any(isinstance(p, (Shard, Replicate, Partial)) for p in s):
+            return _placements_to_spec(process_mesh, s)
+        return TensorDistAttr(process_mesh, list(s)).to_partition_spec()
+    raise TypeError(f"shard_op: cannot interpret sharding {s!r}")
+
+
+def shard_op(op_fn, process_mesh=None, in_shardings=None,
+             out_shardings=None):
+    """ref: auto_parallel shard_op — annotate an op with input/output
+    dist attrs. GSPMD-native: each annotation becomes a sharding
+    constraint (lax.with_sharding_constraint under trace, a placing
+    device_put eagerly); XLA's partitioner inserts the collectives the
+    reference's Resharder would."""
+    jmesh = process_mesh.jax_mesh if process_mesh is not None else None
+
+    def constrain(v, s):
+        spec = _to_spec(process_mesh, s)
+        if spec is None:
+            return v
+        mesh = jmesh if jmesh is not None else mesh_mod.get_mesh()
+        sharding = NamedSharding(mesh, spec)
+        arr = v.data if isinstance(v, Tensor) else v
+        if isinstance(arr, jax.core.Tracer):
+            out = jax.lax.with_sharding_constraint(arr, sharding)
+        else:
+            out = jax.device_put(arr, sharding)
+        if isinstance(v, Tensor):
+            v._data = out
+            return v
+        return out
+
+    def apply_shardings(vals, shardings):
+        if shardings is None:
+            return vals
+        if not isinstance(shardings, (list, tuple)):
+            shardings = [shardings]
+        return tuple(
+            constrain(v, shardings[i]) if i < len(shardings) else v
+            for i, v in enumerate(vals))
+
     def wrapper(*args, **kwargs):
-        return op_fn(*args, **kwargs)
+        args = apply_shardings(args, in_shardings)
+        out = op_fn(*args, **kwargs)
+        if out_shardings is None:
+            return out
+        if isinstance(out, (tuple, list)):
+            res = apply_shardings(out, out_shardings)
+            return type(out)(res) if isinstance(out, list) else res
+        return apply_shardings((out,), out_shardings)[0]
+
     return wrapper
 
 
@@ -169,11 +226,106 @@ class Strategy:
         self.fused_passes = _Config(enable=False, fused_passes_list=[])
 
 
+class _PipelinedSequential:
+    """Engine pipeline path: runs a homogeneous block list as a compiled
+    spmd pipeline over the mesh's 'pp' axis (ref: engine.py _parallel
+    applying the pipeline pass + fleet PipelineLayer segmentation).
+
+    Wraps the ORIGINAL model object — parameters stay owned by the real
+    sublayers (so TrainStep/optimizer see them unchanged); forward stacks
+    the block params [n_stages, layers_per_stage, ...], micro-batches the
+    input, and routes through parallel.pipeline.spmd_pipeline, which
+    lowers to a collective-permute ring. Differentiation flows through
+    the stacking, so backward/update remain the standard path."""
+
+    def __init__(self, model, micro_batch_size: int):
+        self._model = model
+        subs = getattr(model, "_sub_layers", None)
+        self._blocks = list(subs.values()) if subs else []
+        if not self._blocks:
+            raise ValueError(
+                "Engine pipeline strategy needs a Sequential-style model "
+                "(a flat list of structurally identical sublayers)")
+        sig0 = [(n, tuple(p.shape), str(p.dtype))
+                for n, p in self._blocks[0].named_parameters()]
+        for b in self._blocks[1:]:
+            sig = [(n, tuple(p.shape), str(p.dtype))
+                   for n, p in b.named_parameters()]
+            if sig != sig0:
+                raise ValueError(
+                    "Engine pipeline strategy needs structurally "
+                    f"identical stages; got {sig0} vs {sig}")
+        self.micro_batch_size = int(micro_batch_size)
+
+    def named_parameters(self, *a, **k):
+        return self._model.named_parameters(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._model.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._model.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._model.set_state_dict(*a, **k)
+
+    def __call__(self, x, *rest):
+        import jax.numpy as jnp
+        from ...parallel.pipeline import spmd_pipeline
+        mesh = mesh_mod.get_mesh()
+        n_stages = mesh.shape.get("pp", 1)
+        blocks = self._blocks
+        L = len(blocks)
+        if L % max(n_stages, 1) != 0:
+            raise ValueError(
+                f"pipeline: {L} blocks not divisible by pp={n_stages}")
+        per = L // max(n_stages, 1)
+        names = [n for n, _ in blocks[0].named_parameters()]
+        stacked = {}
+        for name in names:
+            leaves = [dict(b.named_parameters())[name].data
+                      for b in blocks]
+            arr = jnp.stack(leaves)  # [L, ...]
+            stacked[name] = arr.reshape((n_stages, per) + arr.shape[1:])
+        b0 = blocks[0]
+        p0 = dict(b0.named_parameters())
+
+        def one_block(pdict, xa):
+            saved = {n: p._data for n, p in p0.items()}
+            for n, p in p0.items():
+                p._data = pdict[n]
+            try:
+                out = b0(Tensor(xa, stop_gradient=True))
+                return out.data if isinstance(out, Tensor) else out
+            finally:
+                for n, p in p0.items():
+                    p._data = saved[n]
+
+        def stage_fn(chunk, xa):
+            out, _ = jax.lax.scan(
+                lambda c, sl: (one_block(sl, c), None), xa, chunk)
+            return out
+
+        xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        B = xa.shape[0]
+        mb = self.micro_batch_size
+        if B % mb != 0:
+            raise ValueError(
+                f"pipeline: batch {B} not divisible by micro_batch_size "
+                f"{mb}")
+        x_micro = xa.reshape((B // mb, mb) + xa.shape[1:])
+        out = spmd_pipeline(stage_fn, stacked, x_micro, axis="pp")
+        return Tensor(out.reshape((B,) + out.shape[2:]))
+
+
 class Engine:
     """ref: auto_parallel/engine.py:55 — fit/evaluate/predict over an
     annotated model. _build/_plan/_parallel (engine.py:563,722,750) collapse
     into: trace once under jit with parameter NamedShardings; XLA completes
-    and partitions."""
+    and partitions. Strategy knobs are APPLIED in fit(): amp
+    (auto_cast/decorate), gradient_merge (k-step device-side grad
+    accumulation), sharding (ZeRO placement of optimizer states/params),
+    pipeline (spmd_pipeline over the mesh's pp axis), recompute."""
 
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
                  cluster=None, strategy=None):
@@ -186,7 +338,14 @@ class Engine:
 
     def _loss_fn(self, layer, *batch):
         *inputs, label = batch if len(batch) > 1 else (batch[0], None)
-        out = layer(*inputs)
+        amp_cfg = self.strategy.amp
+        if amp_cfg["enable"]:
+            from ... import amp as amp_mod
+            with amp_mod.auto_cast(enable=True, dtype=amp_cfg["dtype"],
+                                   level=str(amp_cfg["level"]).upper()):
+                out = layer(*inputs)
+        else:
+            out = layer(*inputs)
         if isinstance(out, (tuple, list)):
             out = out[0]
         if self.loss is not None and label is not None:
@@ -200,13 +359,41 @@ class Engine:
             verbose=2, num_workers=0):
         from ...io import DataLoader
         from ...parallel.train_step import TrainStep
-        if self.strategy.recompute["enable"]:
+        strat = self.strategy
+        if strat.recompute["enable"]:
             if hasattr(self.model, "config"):
                 self.model.config.recompute = True
+        model_for_step = self.model
+        mesh = mesh_mod.get_mesh()
+        if strat.pipeline["enable"] and dict(mesh.shape).get("pp", 1) > 1:
+            model_for_step = _PipelinedSequential(
+                self.model, strat.pipeline["micro_batch_size"])
+        if strat.amp["enable"] and str(strat.amp["level"]).upper() == "O2":
+            # O2: params live in the amp dtype (fp32 path via masters)
+            from ...amp import decorate
+            decorate(models=self.model, optimizers=self.optimizer,
+                     level="O2", dtype=strat.amp["dtype"])
+        shard_axis = None
+        if strat.sharding["enable"]:
+            from ..fleet.meta_parallel.sharding import (shard_accumulators,
+                                                        shard_parameters)
+            shard_axis = "sharding" \
+                if mesh_mod.mesh_axis_size("sharding") > 1 else "dp"
+            if int(strat.sharding["stage"]) >= 3:
+                shard_parameters(self.model, axis=shard_axis)
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=True)
-        step_fn = TrainStep(self.model, self.optimizer,
-                            loss_fn=self._loss_fn)
+        k_steps = int(strat.gradient_merge["k_steps"]) \
+            if strat.gradient_merge["enable"] else 1
+        step_fn = TrainStep(model_for_step, self.optimizer,
+                            loss_fn=self._loss_fn,
+                            grad_accum_steps=k_steps,
+                            grad_accum_avg=bool(
+                                strat.gradient_merge["avg"]))
+        if shard_axis is not None:
+            # states were created by TrainStep above; place them sharded
+            # (ZeRO-1/2 semantics — XLA partitions the update)
+            shard_accumulators(self.optimizer, axis=shard_axis)
         self._train_step = step_fn
         history = {"loss": []}
         it = 0

@@ -199,6 +199,11 @@ class Optimizer:
                 np_, nst = self._update(p, g, st, lr, step, plr, wd)
                 if wd and wd_mode == "decoupled":
                     np_ = np_ - lr * plr * wd * p
+                if np_.dtype != p.dtype:
+                    # fp32 scalars (lr, step) promote low-precision params;
+                    # the update must preserve the param's storage dtype
+                    # (amp-O2 keeps bf16 params, masters carry fp32)
+                    np_ = np_.astype(p.dtype)
                 new_ps.append(np_)
                 new_sts.append(nst)
             return new_ps, new_sts
