@@ -1,0 +1,89 @@
+"""Pure-stream HBM bandwidth probe — the falsifiable roofline behind
+memory-bound perf claims (BERT encoder, decode int8). Prints ONE JSON
+line: {"hbm_gbps_copy": ..., "hbm_gbps_triad": ..., ...}.
+
+Method: k dependent elementwise passes inside one jit, separated by
+lax.optimization_barrier so XLA cannot fuse them into a single memory
+pass. Copy traffic = 2*size/iter (read+write); triad = 3*size/iter.
+Timing follows the axon-tunnel rule: jax.block_until_ready does NOT
+synchronize there, so every window edge forces a host transfer
+(float(jnp.sum(...))).
+
+Usage: python tools/hbm_probe.py [--mb 256] [--k 16] [--reps 5] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256,
+                    help="array size in MiB (float32)")
+    ap.add_argument("--k", type=int, default=16,
+                    help="dependent passes per timed call")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="smoke-test on CPU (numbers meaningless)")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = args.mb * (1 << 20) // 4
+    x0 = jnp.arange(n, dtype=jnp.float32) * 1e-9
+    y0 = jnp.ones((n,), jnp.float32)
+
+    k = args.k
+
+    @jax.jit
+    def copy_chain(x):
+        for _ in range(k):
+            x = jax.lax.optimization_barrier(x * 1.0000001)
+        return x
+
+    @jax.jit
+    def triad_chain(x, y):
+        for _ in range(k):
+            z = x * 1.0000001 + y
+            x, y = jax.lax.optimization_barrier((z, x))
+        return x
+
+    def sync(*arrays):
+        return [float(jnp.sum(a[:8])) for a in arrays]
+
+    def bench(fn, args_, bytes_per_iter):
+        out = fn(*args_)  # warm compile
+        out = out if isinstance(out, tuple) else (out,)
+        sync(*out)
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = fn(*args_)
+            out = out if isinstance(out, tuple) else (out,)
+            sync(*out)
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        return (k * bytes_per_iter / med) / 1e9, med
+
+    size = n * 4
+    copy_gbps, copy_s = bench(copy_chain, (x0,), 2 * size)
+    triad_gbps, triad_s = bench(triad_chain, (x0, y0), 3 * size)
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "hbm_gbps_copy": round(copy_gbps, 1),
+        "hbm_gbps_triad": round(triad_gbps, 1),
+        "array_mib": args.mb, "k": k, "reps": args.reps,
+        "copy_s": round(copy_s, 4), "triad_s": round(triad_s, 4),
+        "device": str(dev.platform) + ":" + str(dev.device_kind),
+    }))
+
+
+if __name__ == "__main__":
+    main()
