@@ -78,10 +78,14 @@ class LlamaSpmdTrainer:
         # 'save_dots': keep tagged matmul outputs so backward recompute is
         # mostly elementwise — except the dense attention path (sep>1/CPU),
         # whose O(T^2) QK^T/softmax is rematerialized either way
-        # (the reference's recompute granularity knob, RecomputeConfig)
-        if remat_policy not in ("full", "save_dots"):
-            raise ValueError(f"remat_policy must be 'full' or 'save_dots', "
-                             f"got {remat_policy!r}")
+        # (the reference's recompute granularity knob, RecomputeConfig);
+        # 'save_attn': keep only q/k/v/attn_out (what the flash backward
+        # reads) and recompute the MLP — the long-context point between
+        # 'full' and 'save_dots' where the ffn_gate/ffn_up buffers
+        # (2.7x hidden per token) dominate the saved bytes
+        if remat_policy not in ("full", "save_dots", "save_attn"):
+            raise ValueError(f"remat_policy must be 'full', 'save_dots' "
+                             f"or 'save_attn', got {remat_policy!r}")
         self.remat_policy = remat_policy
         self.compute_dtype = compute_dtype
         # AdamW moment storage dtype. fp32 is the default (exact parity
@@ -346,6 +350,10 @@ class LlamaSpmdTrainer:
             if self.remat_policy == "save_dots":
                 pol = jax.checkpoint_policies.save_only_these_names(
                     "q", "k", "v", "attn_out", "ffn_gate", "ffn_up")
+                block = jax.checkpoint(block, policy=pol)
+            elif self.remat_policy == "save_attn":
+                pol = jax.checkpoint_policies.save_only_these_names(
+                    "q", "k", "v", "attn_out")
                 block = jax.checkpoint(block, policy=pol)
             else:
                 block = jax.checkpoint(block)

@@ -12,7 +12,8 @@ from ...framework.tensor import Tensor
 from ...ops._helpers import op, normalize_axis
 
 __all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm",
-           "group_norm", "local_response_norm", "rms_norm"]
+           "group_norm", "local_response_norm", "rms_norm",
+           "fused_ln_residual_dropout"]
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
@@ -20,6 +21,32 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
         n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
         return a / jnp.maximum(n, epsilon)
     return op("normalize", impl, x)
+
+
+def fused_ln_residual_dropout(x, residual, weight, bias, epsilon=1e-5,
+                              dropout_p=0.0, training=True, name=None):
+    """y = layernorm(dropout(x) + residual) in ONE fused HBM pass — the
+    encoder hot pattern (ref: /root/reference/paddle/phi/kernels/fusion/
+    gpu/fused_layernorm_residual_dropout_bias.h). Routes to the Pallas
+    kernel family (ops/pallas/fused_norm.py); dropout uses the on-core
+    TPU PRNG seeded from the framework generator."""
+    from ...framework import random as _random
+    rate = float(dropout_p) if training else 0.0
+    key = _random.next_key() if rate > 0.0 else None
+
+    def impl(a, r, w, b, k=None):
+        from ...ops.pallas.fused_norm import (
+            fused_layer_norm_residual_dropout)
+        import jax as _jax
+        seed = (_jax.random.randint(k, (), 0, 2 ** 31 - 1)
+                if k is not None else 0)
+        y, _ = fused_layer_norm_residual_dropout(
+            a, r, w, b, eps=float(epsilon), dropout_rate=rate, seed=seed)
+        return y
+
+    args = (x, residual, weight, bias) + ((key,) if key is not None
+                                          else ())
+    return op("fused_ln_residual_dropout", impl, *args)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,

@@ -126,6 +126,11 @@ class TransformerEncoderLayer(Layer):
         self.dropout2 = Dropout(dropout, mode="upscale_in_train")
         self.activation = getattr(F, activation)
 
+    def _fuse_post_ln(self):
+        from ...flags import get_flag
+        return (not self.normalize_before
+                and bool(get_flag("FLAGS_tpu_fused_encoder")))
+
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         if self.normalize_before:
@@ -134,16 +139,28 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        if self._fuse_post_ln():
+            # dropout+residual+LN in one Pallas pass (ref
+            # fused_layernorm_residual_dropout_bias.h)
+            src = F.fused_ln_residual_dropout(
+                src, residual, self.norm1.weight, self.norm1.bias,
+                self.norm1._epsilon, self.dropout1.p, self.training)
+        else:
+            src = residual + self.dropout1(src)
+            if not self.normalize_before:
+                src = self.norm1(src)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        if self._fuse_post_ln():
+            src = F.fused_ln_residual_dropout(
+                src, residual, self.norm2.weight, self.norm2.bias,
+                self.norm2._epsilon, self.dropout2.p, self.training)
+        else:
+            src = residual + self.dropout2(src)
+            if not self.normalize_before:
+                src = self.norm2(src)
         return src if cache is None else (src, cache)
 
     def gen_cache(self, src):

@@ -1,0 +1,110 @@
+"""Sweep BACKWARD block sizes of jax's tuned flash kernel at the bench
+shape — the fwd blocks are already tuned (q1024/k512, attn_bench.py);
+this isolates dq/dkv blocks, the open lever on flagship backward MFU
+(VERDICT r4 weak #3). Prints one JSON line per variant.
+
+Run on the real chip with nothing else on the host:
+    python tools/attn_bwd_sweep.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, steps=5):
+    f = jax.jit(fn)
+    for _ in range(2):
+        out = f(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]
+                      .astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--bq", type=int, default=1024,
+                    help="tuned FWD block_q")
+    ap.add_argument("--bk", type=int, default=512,
+                    help="tuned FWD block_k")
+    args = ap.parse_args()
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+
+    B, T, NH, HD = 12, 2048, 32, 128
+    key = jax.random.PRNGKey(0)
+    qh = jax.random.normal(key, (B, NH, T, HD), jnp.bfloat16)
+    scale = HD ** -0.5
+
+    def loss_of(bs):
+        def f(q):
+            o = flash_attention(q, q, q, causal=True, sm_scale=scale,
+                                block_sizes=bs)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return jax.grad(f)
+
+    def make(bq_dq, bk_dq, bq_dkv, bk_dkv):
+        return BlockSizes(
+            block_q=args.bq, block_k_major=args.bk, block_k=args.bk,
+            block_b=1,
+            block_q_major_dkv=bq_dkv, block_k_major_dkv=bk_dkv,
+            block_k_dkv=bk_dkv, block_q_dkv=bq_dkv,
+            block_k_major_dq=bk_dq, block_k_dq=bk_dq,
+            block_q_dq=bq_dq)
+
+    # current production setting (bwd blocks == fwd blocks)
+    base = make(args.bq, args.bk, args.bq, args.bk)
+    ms0 = timeit(loss_of(base), qh)
+    print(json.dumps({"variant": "base_fwd_blocks", "ms": round(ms0, 2)}),
+          flush=True)
+
+    # Sweep dq and dkv blocks INDEPENDENTLY (each variant pays a fresh
+    # ~30s remote compile, so a full cross product is infeasible); the
+    # two grids are separate pallas_calls, so their optima compose.
+    qs = [256, 512, 1024] if args.quick else [256, 512, 1024, 2048]
+    ks = [256, 512] if args.quick else [128, 256, 512, 1024]
+
+    def sweep(tag, mk):
+        best = (f"base", ms0)
+        for bq, bk in itertools.product(qs, ks):
+            name = f"{tag}{bq}x{bk}"
+            try:
+                ms = timeit(loss_of(mk(bq, bk)), qh, steps=3)
+            except Exception as e:
+                print(json.dumps({"variant": name,
+                                  "error": type(e).__name__}), flush=True)
+                continue
+            print(json.dumps({"variant": name, "ms": round(ms, 2)}),
+                  flush=True)
+            if ms < best[1]:
+                best = ((bq, bk), ms)
+        return best
+
+    best_dq = sweep("dq", lambda bq, bk: make(bq, bk, args.bq, args.bk))
+    best_dkv = sweep("dkv", lambda bq, bk: make(args.bq, args.bk, bq, bk))
+    if best_dq[0] != "base" or best_dkv[0] != "base":
+        dq = best_dq[0] if best_dq[0] != "base" else (args.bq, args.bk)
+        dkv = best_dkv[0] if best_dkv[0] != "base" else (args.bq, args.bk)
+        ms = timeit(loss_of(make(dq[0], dq[1], dkv[0], dkv[1])), qh)
+        print(json.dumps({"combined": f"dq{dq}_dkv{dkv}",
+                          "ms": round(ms, 2),
+                          "speedup_vs_base": round(ms0 / ms, 3)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
