@@ -26,6 +26,14 @@ def _define(name, default, doc=""):
 # the subset of reference flags that are meaningful on a TPU runtime
 _define("FLAGS_check_nan_inf", False,
         "scan op outputs for nan/inf (ref: fluid/framework/operator.cc:2010)")
+_define("FLAGS_tpu_fused_encoder", False,
+        "route TransformerEncoderLayer residual+dropout+LayerNorm through "
+        "the fused Pallas kernel (ops/pallas/fused_norm.py) instead of "
+        "XLA fusion of the separate ops")
+_define("FLAGS_eager_layer_jit", True,
+        "capture top-level dygraph Layer calls as cached compiled "
+        "programs (framework/layer_jit.py; the eager fast path — the "
+        "reference's eager_gen.py C++ dispatch analog)")
 _define("FLAGS_cudnn_deterministic", False)
 _define("FLAGS_benchmark", False)
 _define("FLAGS_eager_delete_tensor_gb", 0.0)
@@ -150,11 +158,23 @@ def get_flags(flags):
     return out
 
 
+_version = [0]
+
+
+def flags_version() -> int:
+    """Monotonic counter bumped by set_flags — compiled-capture caches
+    (framework/layer_jit.py) key on it so flag changes retrace."""
+    return _version[0]
+
+
 def set_flags(flags: Dict[str, Any]):
-    for k, v in flags.items():
+    # validate everything first: a bad key must not leave earlier keys
+    # applied without the version bump (stale capture caches)
+    for k in flags:
         if k not in _REGISTRY:
             raise ValueError(f"unknown flag {k}")
-        _REGISTRY[k] = v
+    _REGISTRY.update(flags)
+    _version[0] += 1
 
 
 def get_flag(name, default=None):

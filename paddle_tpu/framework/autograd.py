@@ -161,27 +161,31 @@ def _run_backward(seed_tensors, seed_grads, retain_graph=False,
     prev_enabled = _tape.enabled
     _tape.enabled = False  # ops run inside vjp_fns (e.g. PyLayer.backward)
     # must not append to the tape being walked
-    for node in reversed(_tape.nodes):
-        if not any(oid in grads for oid in node.output_ids):
-            continue
-        cots = []
-        for oid, (shape, dtype) in zip(node.output_ids, node.output_metas):
-            g = grads.pop(oid, None)
-            if g is not None and oid in care:
-                saved[oid] = g
-            if g is None:
-                g = jnp.zeros(shape, dtype)
-            cots.append(g)
-        cot = tuple(cots) if node.multi else cots[0]
-        in_grads = node.vjp_fn(cot)
-        for t, g in zip(node.inputs, in_grads):
-            if t is None or t.stop_gradient:
+    try:
+        for node in reversed(_tape.nodes):
+            if not any(oid in grads for oid in node.output_ids):
                 continue
-            _accumulate(grads, id(t), g)
-            if id(t) not in _tape.produced:
-                leaf_hits[id(t)] = t
-
-    _tape.enabled = prev_enabled
+            cots = []
+            for oid, (shape, dtype) in zip(node.output_ids,
+                                           node.output_metas):
+                g = grads.pop(oid, None)
+                if g is not None and oid in care:
+                    saved[oid] = g
+                if g is None:
+                    g = jnp.zeros(shape, dtype)
+                cots.append(g)
+            cot = tuple(cots) if node.multi else cots[0]
+            in_grads = node.vjp_fn(cot)
+            for t, g in zip(node.inputs, in_grads):
+                if t is None or t.stop_gradient:
+                    continue
+                _accumulate(grads, id(t), g)
+                if id(t) not in _tape.produced:
+                    leaf_hits[id(t)] = t
+    finally:
+        # a raising vjp (bad kernel, failed compile) must not leave the
+        # tape disabled for the whole process
+        _tape.enabled = prev_enabled
     final = dict(grads)
     final.update(saved)
 

@@ -25,6 +25,7 @@ class _RNGState(threading.local):
         self._key = None
         self.injected = None  # traced key during jit capture
         self.injected_count = 0
+        self.chained = False  # injected key advances by split (layer_jit)
 
     @property
     def key(self):
@@ -57,8 +58,13 @@ def set_rng_state(key):
 
 def next_key():
     """Draw a fresh PRNG key. Inside a key_scope, folds a counter into the
-    injected (possibly traced) key so randomness is per-step under jit."""
+    injected (possibly traced) key so randomness is per-step under jit.
+    Inside a chain_scope, split-advances the injected key exactly like
+    the global generator would."""
     if _state.injected is not None:
+        if _state.chained:
+            _state.injected, sub = jax.random.split(_state.injected)
+            return sub
         k = jax.random.fold_in(_state.injected, _state.injected_count)
         _state.injected_count += 1
         return k
@@ -69,9 +75,30 @@ def next_key():
 @contextlib.contextmanager
 def key_scope(key):
     """Route next_key() draws through `key` (typically a traced array)."""
-    prev, prev_count = _state.injected, _state.injected_count
-    _state.injected, _state.injected_count = key, 0
+    prev = (_state.injected, _state.injected_count, _state.chained)
+    _state.injected, _state.injected_count, _state.chained = key, 0, False
     try:
         yield
     finally:
-        _state.injected, _state.injected_count = prev, prev_count
+        _state.injected, _state.injected_count, _state.chained = prev
+
+
+class _ChainHandle:
+    @staticmethod
+    def current():
+        return _state.injected
+
+
+@contextlib.contextmanager
+def chain_scope(key):
+    """Route next_key() through `key` with the SAME split-advance the
+    global generator uses — draws and the advanced state match an
+    uncaptured eager run bit-for-bit (layer_jit capture contract).
+    Yields a handle whose .current() returns the advanced key; write it
+    back via set_rng_state after the captured call."""
+    prev = (_state.injected, _state.injected_count, _state.chained)
+    _state.injected, _state.injected_count, _state.chained = key, 0, True
+    try:
+        yield _ChainHandle
+    finally:
+        _state.injected, _state.injected_count, _state.chained = prev

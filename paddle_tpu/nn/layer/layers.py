@@ -307,6 +307,14 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if not (self._forward_pre_hooks or self._forward_post_hooks):
+            # eager layer-jit: capture this call as one compiled program
+            # (framework/layer_jit.py; falls through to per-op eager on
+            # any unsupported construct)
+            from ...framework import layer_jit
+            handled, out = layer_jit.try_call(self, inputs, kwargs)
+            if handled:
+                return out
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
