@@ -30,6 +30,10 @@ _define("FLAGS_tpu_fused_encoder", False,
         "route TransformerEncoderLayer residual+dropout+LayerNorm through "
         "the fused Pallas kernel (ops/pallas/fused_norm.py) instead of "
         "XLA fusion of the separate ops")
+_define("FLAGS_eager_op_jit", True,
+        "run each concrete eager op application as one cached compiled "
+        "executable (framework/op.py _OpExec) instead of launching every "
+        "jnp primitive separately")
 _define("FLAGS_eager_layer_jit", True,
         "capture top-level dygraph Layer calls as cached compiled "
         "programs (framework/layer_jit.py; the eager fast path — the "

@@ -57,6 +57,143 @@ def _check_nan_inf(op_name, outs):
                 f"dtype={o.dtype}). Triggered by FLAGS_check_nan_inf.")
 
 
+# ---------------------------------------------------------------------------
+# Eager op-executable cache: run each concrete op application as ONE
+# compiled XLA call (fwd + residuals; backward a second cached call)
+# instead of eagerly launching every jnp primitive inside `impl`. The
+# TPU analog of the reference's cached kernel dispatch in the generated
+# *_ad_func fast path (eager_gen.py:1293) — on the tunneled backend each
+# eager primitive launch costs ~1.5ms, so a 15-primitive op (e.g.
+# cross_entropy) pays ~20-140ms/step without this.
+# ---------------------------------------------------------------------------
+
+_OP_JIT_CACHE: dict = {}
+_OP_JIT_MISSES: dict = {}   # impl code object -> distinct keys seen
+_OP_JIT_MAX_VARIANTS = 64   # per-call-varying closures: stop compiling
+
+
+class _OpExec:
+    """Compiled fwd(+bwd) pair for one (impl, closure, kwargs, avals)."""
+
+    __slots__ = ("_fwd", "_trees", "_bwds", "with_grad", "broken")
+
+    def __init__(self, impl, kwargs, with_grad):
+        self._trees = {}
+        self._bwds = {}
+        self.with_grad = with_grad
+        self.broken = False
+
+        def fwd(*arrays):
+            if not with_grad:
+                out = impl(*arrays, **kwargs)
+                multi = isinstance(out, (tuple, list))
+                leaves = tuple(out) if multi else (out,)
+                self._trees[(len(leaves), 0)] = (multi, None)
+                return leaves, ()
+            out, vjp_fn = jax.vjp(lambda *xs: impl(*xs, **kwargs),
+                                  *arrays)
+            multi = isinstance(out, (tuple, list))
+            leaves = tuple(out) if multi else (out,)
+            res, res_tree = jax.tree_util.tree_flatten(vjp_fn)
+            self._trees[(len(leaves), len(res))] = (multi, res_tree)
+            return leaves, tuple(res)
+
+        self._fwd = jax.jit(fwd)
+
+    def run(self, arrays):
+        leaves, res = self._fwd(*arrays)
+        info = self._trees.get((len(leaves), len(res)))
+        if info is None:
+            raise RuntimeError("op-exec trace bookkeeping mismatch")
+        multi, res_tree = info
+        vjp_fn = None
+        if self.with_grad:
+            bwd = self._bwds.get(len(res))
+            if bwd is None:
+                def bwd_impl(res_leaves, cots):
+                    f = jax.tree_util.tree_unflatten(res_tree,
+                                                     list(res_leaves))
+                    return tuple(f(cots if multi else cots[0]))
+                bwd = jax.jit(bwd_impl)
+                self._bwds[len(res)] = bwd
+
+            def vjp_fn(cot):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                return bwd(res, tuple(cots))
+        return leaves, multi, vjp_fn
+
+
+def _op_exec_key(impl, kwargs, arrays, needs_grad):
+    """Hashable identity of this op application, or None (stay eager):
+    the impl's code + closure values + kwargs + input avals. Closures
+    holding arrays (e.g. RNG keys drawn per call) are not cacheable."""
+    try:
+        cells = getattr(impl, "__closure__", None) or ()
+        vals = []
+        for c in cells:
+            v = c.cell_contents
+            if isinstance(v, (jax.Array,)) or hasattr(v, "__array__"):
+                return None
+            hash(v)
+            vals.append(v)
+        kw = tuple(sorted(kwargs.items()))
+        hash(kw)
+        metas = tuple(
+            (a.shape, str(a.dtype), bool(getattr(a, "weak_type", False)))
+            if hasattr(a, "dtype") and hasattr(a, "shape")
+            else (type(a).__name__, a)
+            for a in arrays)
+        hash(metas)
+        code = getattr(impl, "__code__", impl)  # ufuncs/partials: self-key
+        hash(code)
+    except (TypeError, ValueError, AttributeError):
+        return None
+    return (code, tuple(vals), kw, metas, needs_grad)
+
+
+def _trace_clean():
+    try:
+        return jax.core.trace_state_clean()
+    except AttributeError:
+        return True
+
+
+def _op_exec_for(impl, kwargs, arrays, needs_grad):
+    from ..flags import get_flag
+    if not get_flag("FLAGS_eager_op_jit", True):
+        return None
+    if not _trace_clean():
+        return None  # inside someone's trace: plain path composes fine
+    key = _op_exec_key(impl, kwargs, arrays, needs_grad)
+    if key is None:
+        return None
+    code = key[0]
+    if _OP_JIT_MISSES.get(code, 0) > _OP_JIT_MAX_VARIANTS:
+        return None
+    exec_ = _OP_JIT_CACHE.get(key)
+    if exec_ is None:
+        _OP_JIT_MISSES[code] = _OP_JIT_MISSES.get(code, 0) + 1
+        exec_ = _OpExec(impl, kwargs, needs_grad)
+        _OP_JIT_CACHE[key] = exec_
+    if exec_.broken:
+        return None
+    return exec_
+
+
+def _execute(impl, kwargs, arrays, needs_grad):
+    """(out, vjp_fn) through the cached op executable, else plain eager."""
+    exec_ = _op_exec_for(impl, kwargs, arrays, needs_grad)
+    if exec_ is not None:
+        try:
+            leaves, multi, vjp_fn = exec_.run(arrays)
+            return (tuple(leaves) if multi else leaves[0]), vjp_fn
+        except Exception:
+            exec_.broken = True
+    if needs_grad:
+        return jax.vjp(lambda *xs: impl(*xs, **kwargs), *arrays)
+    return impl(*arrays, **kwargs), None
+
+
 def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
           differentiable=True, op_name=None):
     """Run `impl(*arrays, **kwargs)` with autograd recording.
@@ -86,16 +223,11 @@ def apply(impl: Callable, tensor_args: Sequence[Any], kwargs=None,
     if _prof_host is not None and _prof_host.enabled:
         import time as _time
         _t0 = _time.perf_counter_ns()
-        if needs_grad:
-            out, vjp_fn = jax.vjp(lambda *xs: impl(*xs, **kwargs), *arrays)
-        else:
-            out = impl(*arrays, **kwargs)
+        out, vjp_fn = _execute(impl, kwargs, arrays, needs_grad)
         _prof_host.events.append((op_name or getattr(impl, "__name__", "op"),
                                   _t0, _time.perf_counter_ns()))
-    elif needs_grad:
-        out, vjp_fn = jax.vjp(lambda *xs: impl(*xs, **kwargs), *arrays)
     else:
-        out = impl(*arrays, **kwargs)
+        out, vjp_fn = _execute(impl, kwargs, arrays, needs_grad)
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
