@@ -594,9 +594,13 @@ def bench_long_context():
         seq, batch, steps = 256, 1, 2
         dtype = moments = jnp.float32
     import os
-    policy = os.environ.get("PT_LONGCTX_REMAT", "save_attn")
-    trainer = LlamaSpmdTrainer(cfg, compute_dtype=dtype, remat=True,
-                               remat_policy=policy,
+    policy = os.environ.get("PT_LONGCTX_REMAT", "save_dots")
+    ce_remat = os.environ.get("PT_LONGCTX_CE_REMAT", "0") != "0"
+    trainer = LlamaSpmdTrainer(cfg, compute_dtype=dtype,
+                               remat=(policy != "none"),
+                               remat_policy=policy if policy != "none"
+                               else "full",
+                               ce_remat=ce_remat,
                                moments_dtype=moments)
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq))
     loss_box = [None]

@@ -40,12 +40,14 @@ def main():
                     help="tuned FWD block_q")
     ap.add_argument("--bk", type=int, default=512,
                     help="tuned FWD block_k")
+    ap.add_argument("--batch", type=int, default=12)
+    ap.add_argument("--seq", type=int, default=2048)
     args = ap.parse_args()
 
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention)
 
-    B, T, NH, HD = 12, 2048, 32, 128
+    B, T, NH, HD = args.batch, args.seq, 32, 128
     key = jax.random.PRNGKey(0)
     qh = jax.random.normal(key, (B, NH, T, HD), jnp.bfloat16)
     scale = HD ** -0.5
