@@ -26,6 +26,9 @@ def _define(name, default, doc=""):
 # the subset of reference flags that are meaningful on a TPU runtime
 _define("FLAGS_check_nan_inf", False,
         "scan op outputs for nan/inf (ref: fluid/framework/operator.cc:2010)")
+_define("FLAGS_tpu_fused_dropout", True,
+        "route F.dropout through the one-pass Pallas kernel with the "
+        "on-core TPU PRNG (ops/pallas/fused_norm.py) on TPU platforms")
 _define("FLAGS_tpu_fused_encoder", False,
         "route TransformerEncoderLayer residual+dropout+LayerNorm through "
         "the fused Pallas kernel (ops/pallas/fused_norm.py) instead of "

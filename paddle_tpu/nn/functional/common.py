@@ -28,6 +28,16 @@ def linear(x, weight, bias=None, name=None):
     return op("linear", lambda a, w, b: a @ w + b, x, weight, bias)
 
 
+def _tpu_dropout_ok():
+    from ...flags import get_flag
+    if not get_flag("FLAGS_tpu_fused_dropout", True):
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
@@ -37,6 +47,16 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     if isinstance(p, Tensor):
         p = float(p.numpy())
     key = _random.next_key()
+    if axis is None and mode == "upscale_in_train" and _tpu_dropout_ok():
+        # one-pass Pallas dropout with the on-core TPU PRNG: threefry
+        # bernoulli costs ~2ms per site at encoder shapes (measured,
+        # tools/bert_profile.py); the kernel generates the mask in-core
+        def impl_fused(a, k):
+            from ...ops.pallas.fused_norm import _dropout_via_vjp
+            seed = jax.random.randint(k, (), 0, 2 ** 31 - 1)
+            return _dropout_via_vjp(a, float(p), seed)
+        return op("dropout", impl_fused, x, key)
+
     def impl(a):
         shape = list(a.shape)
         if axis is not None:

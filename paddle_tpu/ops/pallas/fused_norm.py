@@ -92,8 +92,13 @@ def _row_grid(x, block_rows=None):
         # ~8MB fp32 so large-H models don't blow the ~16MB VMEM
         block_rows = max(8, min(256, (2 * 1024 * 1024) // max(h * 4, 1)))
     br = min(block_rows, rows)
-    while rows % br:
-        br //= 2
+    # Mosaic needs the sublane dim divisible by 8 (or the full array):
+    # search downward in multiples of 8 for a divisor of rows
+    br -= br % 8
+    while br >= 8 and rows % br:
+        br -= 8
+    if br < 8:
+        br = rows  # full-array block is always legal
     return rows // br, br, h
 
 
@@ -341,20 +346,25 @@ def fused_layer_norm_residual_dropout(x, residual, w, b, eps=1e-5,
     return _ln_core(x, residual, w, b, float(eps))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _dropout_via_vjp(x, rate, seed):
+    # seed rides as a DIFFERENTIABLE-position arg (float0 cotangent):
+    # nondiff_argnums must never receive traced values, and per-step
+    # seeds are traced under jit
     return _fused_dropout(x, rate, seed)
 
 
 def _dropout_fwd(x, rate, seed):
-    return _fused_dropout(x, rate, seed), None
+    return _fused_dropout(x, rate, seed), seed
 
 
-def _dropout_bwd(rate, seed, _, gy):
+def _dropout_bwd(rate, seed, gy):
     # the PRNG is deterministic per (seed, shape): regenerate the scaled
     # mask exactly instead of saving it (saves an HBM buffer)
+    import numpy as _np
     scaled_keep = _fused_dropout(jnp.ones(gy.shape, gy.dtype), rate, seed)
-    return (gy * scaled_keep,)
+    return (gy * scaled_keep,
+            _np.zeros(_np.shape(seed), jax.dtypes.float0))
 
 
 _dropout_via_vjp.defvjp(_dropout_fwd, _dropout_bwd)
