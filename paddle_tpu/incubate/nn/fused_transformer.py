@@ -197,15 +197,38 @@ class FusedMultiTransformer(nn.Layer):
                     time_step, (int, np.integer)) else None
                 t = time_step.data if isinstance(time_step, Tensor) \
                     else jnp.asarray(time_step, jnp.int32)
-                t = t.reshape(())
+                # ragged = per-row positions, a [batch] vector
+                # (continuous-batching serving, every slot at its own
+                # cache offset — ref masked-mha per-batch lens,
+                # fused_multi_transformer_op.cu.h:835). The reference
+                # API's documented shape-[1] time_step stays a SCALAR
+                # (b==1 per-row is equivalent anyway).
+                ragged = t.ndim == 1 and b > 1 and t.shape[0] == b
+                if not ragged:
+                    t = t.reshape(())
 
-                def upd(c, ka, va):
-                    kc = jax.lax.dynamic_update_slice(
-                        c[0], jnp.moveaxis(ka, 1, 2), (0, 0, t, 0))
-                    vc = jax.lax.dynamic_update_slice(
-                        c[1], jnp.moveaxis(va, 1, 2), (0, 0, t, 0))
-                    return jnp.stack([kc, vc])
-                cache = apply(upd, (cache, k, v), op_name="cache_kv")
+                if ragged:
+                    def upd(c, ka, va, tv):
+                        def row(cs, ks, vs, tb):  # cs [2, H, S, D]
+                            kc = jax.lax.dynamic_update_slice(
+                                cs[0], ks, (0, tb, 0))
+                            vc = jax.lax.dynamic_update_slice(
+                                cs[1], vs, (0, tb, 0))
+                            return jnp.stack([kc, vc])
+                        return jax.vmap(row, in_axes=(1, 0, 0, 0),
+                                        out_axes=1)(
+                            c, jnp.moveaxis(ka, 1, 2),
+                            jnp.moveaxis(va, 1, 2), tv)
+                    cache = apply(upd, (cache, k, v, Tensor(t)),
+                                  op_name="cache_kv")
+                else:
+                    def upd(c, ka, va):
+                        kc = jax.lax.dynamic_update_slice(
+                            c[0], jnp.moveaxis(ka, 1, 2), (0, 0, t, 0))
+                        vc = jax.lax.dynamic_update_slice(
+                            c[1], jnp.moveaxis(va, 1, 2), (0, 0, t, 0))
+                        return jnp.stack([kc, vc])
+                    cache = apply(upd, (cache, k, v), op_name="cache_kv")
                 new_caches.append(cache)
                 if l == 1 and _use_decode_kernel():
                     # flash-decoding over the static cache (ref
@@ -213,15 +236,26 @@ class FusedMultiTransformer(nn.Layer):
                     from ...ops.pallas.decode_attention import \
                         decode_attention
 
-                    def dec(c, q_):
-                        kc = jnp.swapaxes(c[0], 1, 2)  # [B, S, H, D]
-                        vc = jnp.swapaxes(c[1], 1, 2)
-                        lens = jnp.zeros((q_.shape[0],), jnp.int32) \
-                            + (t + 1)
-                        return decode_attention(q_[:, 0], kc, vc,
-                                                lens)[:, None]
-                    attn = apply(dec, (cache, q),
-                                 op_name="decode_attention")
+                    if ragged:
+                        # t rides as an ARGUMENT: a traced closure cell
+                        # would bust the per-op executable cache
+                        def dec_r(c, q_, tv):
+                            kc = jnp.swapaxes(c[0], 1, 2)  # [B,S,H,D]
+                            vc = jnp.swapaxes(c[1], 1, 2)
+                            return decode_attention(q_[:, 0], kc, vc,
+                                                    tv + 1)[:, None]
+                        attn = apply(dec_r, (cache, q, Tensor(t)),
+                                     op_name="decode_attention")
+                    else:
+                        def dec(c, q_):
+                            kc = jnp.swapaxes(c[0], 1, 2)
+                            vc = jnp.swapaxes(c[1], 1, 2)
+                            lens = jnp.zeros((q_.shape[0],), jnp.int32) \
+                                + (t + 1)
+                            return decode_attention(q_[:, 0], kc, vc,
+                                                    lens)[:, None]
+                        attn = apply(dec, (cache, q),
+                                     op_name="decode_attention")
                 elif t_static is not None:
                     # static t: slice just the valid prefix (much
                     # cheaper than attending over max_len when t << S)
@@ -239,12 +273,18 @@ class FusedMultiTransformer(nn.Layer):
                 else:
                     # traced t: attend over the FULL static cache with a
                     # validity mask (a [:t+l] slice would need static
-                    # t): query i sees cache pos <= t+i
+                    # t): query i sees cache pos <= t+i. Ragged t ([B])
+                    # builds a per-row mask [B, 1, l, S].
                     S = cache.shape[3]
                     k_full = transpose(cache[0], [0, 2, 1, 3])
                     v_full = transpose(cache[1], [0, 2, 1, 3])
-                    qpos = t + jnp.arange(l)[:, None]
-                    kpos = jnp.arange(S)[None, :]
+                    if ragged:
+                        qpos = (t[:, None, None, None]
+                                + jnp.arange(l)[None, None, :, None])
+                        kpos = jnp.arange(S)[None, None, None, :]
+                    else:
+                        qpos = t + jnp.arange(l)[:, None]
+                        kpos = jnp.arange(S)[None, :]
                     mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
                                   .astype(jnp.float32))
                     attn = F.scaled_dot_product_attention(

@@ -31,8 +31,10 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
+from .serving import ContinuousBatchingEngine  # noqa: F401
+
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "ContinuousBatchingEngine"]
 
 
 class PrecisionType:

@@ -1,0 +1,94 @@
+"""Continuous-batching serving engine over the fused decoder stack.
+
+ref: /root/reference/paddle/fluid/operators/fused/
+fused_multi_transformer_op.cu.h:835 — the reference decodes a FIXED
+batch with per-batch valid lengths (masked mha over cache_kv). This
+engine supplies the serving shape the reference leaves to external
+stacks (and the PAPERS.md ragged-serving direction): a fixed pool of
+cache SLOTS, each an independent sequence at its own position; one
+fused decode step advances every active slot (ragged lengths ride the
+per-row seq_lens of the flash-decode kernel / per-row mask), and
+finished slots are freed and re-filled by new requests WITHOUT
+stopping the batch — continuous batching.
+
+The model contract is FusedMultiTransformer's decode protocol:
+``model(x, caches=..., time_step=...) -> (hidden, new_caches)`` with
+caches shaped [2, B, H, max_len, D] per layer and time_step a per-row
+int32 vector. Prefill of a new request runs batch-1 against a fresh
+single-row cache and is scattered into the slot, so in-flight slots
+never stall.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ContinuousBatchingEngine"]
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, max_batch: int, max_len: int,
+                 dtype: str = "float32"):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_len = int(max_len)
+        self.dtype = dtype
+        self.caches: List[Tensor] = model.gen_cache(self.max_batch,
+                                                    self.max_len,
+                                                    dtype=dtype)
+        self.lens = np.zeros(self.max_batch, np.int32)
+        self.active = np.zeros(self.max_batch, bool)
+
+    # -- slot management ----------------------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return int((~self.active).sum())
+
+    def add_request(self, prompt: Tensor) -> Tuple[int, Tensor]:
+        """Admit a prompt ([T, d_model] embeddings). Prefills a fresh
+        single-row cache and scatters it into a free slot. Returns
+        (slot, last_hidden [1, d_model])."""
+        import jax.numpy as jnp
+        free = np.flatnonzero(~self.active)
+        if free.size == 0:
+            raise RuntimeError(
+                "ContinuousBatchingEngine: no free slots "
+                f"(max_batch={self.max_batch}); release() one first")
+        slot = int(free[0])
+        T = prompt.shape[0]
+        if T > self.max_len:
+            raise ValueError(f"prompt length {T} > max_len "
+                             f"{self.max_len}")
+        row_caches = self.model.gen_cache(1, self.max_len,
+                                          dtype=self.dtype)
+        out, row_caches = self.model(prompt.unsqueeze(0),
+                                     caches=row_caches, time_step=0)
+        for c, row in zip(self.caches, row_caches):
+            c._data = c.data.at[:, slot].set(row.data[:, 0])
+        self.lens[slot] = T
+        self.active[slot] = True
+        return slot, out[:, -1]
+
+    def release(self, slot: int):
+        self.active[slot] = False
+        self.lens[slot] = 0
+
+    # -- decode -------------------------------------------------------------
+    def step(self, x: Tensor) -> Tensor:
+        """One fused decode step for ALL slots. x: [max_batch, 1,
+        d_model] next-token embeddings (inactive rows: any values —
+        their cache rows are fully overwritten on reuse). Returns
+        hidden [max_batch, 1, d_model]; only active rows are
+        meaningful. Advances every active slot's length."""
+        if int(self.active.sum()) == 0:
+            raise RuntimeError("step() with no active slots")
+        if int(self.lens[self.active].max()) >= self.max_len:
+            raise RuntimeError("a slot reached max_len; release() it")
+        t = Tensor(np.asarray(self.lens, np.int32))
+        out, self.caches = self.model(x, caches=self.caches,
+                                      time_step=t)
+        self.lens[self.active] += 1
+        return out

@@ -47,7 +47,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     if isinstance(p, Tensor):
         p = float(p.numpy())
     key = _random.next_key()
-    if axis is None and mode == "upscale_in_train" and _tpu_dropout_ok():
+    if (axis is None and mode == "upscale_in_train" and 0.0 < p < 1.0
+            and _tpu_dropout_ok()):
+        # p >= 1.0 falls through to the jnp path (all-zeros; the kernel
+        # would compute 0/0)
         # one-pass Pallas dropout with the on-core TPU PRNG: threefry
         # bernoulli costs ~2ms per site at encoder shapes (measured,
         # tools/bert_profile.py); the kernel generates the mask in-core

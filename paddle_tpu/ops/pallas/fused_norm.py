@@ -306,6 +306,12 @@ def _fused_dropout(x, rate, seed):
     orig_shape = x.shape
     h = orig_shape[-1]
     x2 = x.reshape(-1, h)
+    rows = x2.shape[0]
+    pad = (-rows) % 8
+    if pad:
+        # Mosaic sublane rule: pad rows to a multiple of 8 rather than
+        # fall into a whole-array block (VMEM blowup for odd big rows)
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
     n_blocks, br, _ = _row_grid(x2)
     spec = pl.BlockSpec((br, h), lambda i: (i, 0))
     if _interpret():
@@ -313,7 +319,8 @@ def _fused_dropout(x, rate, seed):
         import jax.random as jrandom
         keep = (jrandom.uniform(jrandom.PRNGKey(seed), x2.shape)
                 >= rate).astype(x2.dtype)
-        return (x2 * keep / (1.0 - rate)).reshape(orig_shape)
+        out = x2 * keep / (1.0 - rate)
+        return out[:rows].reshape(orig_shape)
     sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     out = pl.pallas_call(
         functools.partial(_dropout_kernel, rate=float(rate)),
@@ -322,7 +329,7 @@ def _fused_dropout(x, rate, seed):
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
     )(jnp.asarray([seed], jnp.int32), x2)
-    return out.reshape(orig_shape)
+    return out[:rows].reshape(orig_shape)
 
 
 def fused_rms_norm_residual_dropout(x, residual, w, eps=1e-6,
@@ -359,11 +366,11 @@ def _dropout_fwd(x, rate, seed):
 
 
 def _dropout_bwd(rate, seed, gy):
-    # the PRNG is deterministic per (seed, shape): regenerate the scaled
-    # mask exactly instead of saving it (saves an HBM buffer)
+    # inverted dropout is elementwise-linear: the cotangent is the SAME
+    # kernel applied to gy (the PRNG is deterministic per (seed, shape),
+    # so the mask regenerates exactly — no saved HBM buffer, one pass)
     import numpy as _np
-    scaled_keep = _fused_dropout(jnp.ones(gy.shape, gy.dtype), rate, seed)
-    return (gy * scaled_keep,
+    return (_fused_dropout(gy, rate, seed),
             _np.zeros(_np.shape(seed), jax.dtypes.float0))
 
 

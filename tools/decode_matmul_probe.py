@@ -1,0 +1,73 @@
+"""Decode GEMM probe: achieved HBM GB/s of the bf16 matmul vs the
+w8a16 Pallas kernel at serving shapes (M small, weights [K,N]) — the
+falsifiable 'what bounds int8 decode' measurement (VERDICT r4 #7).
+Sweeps w8a16 block sizes to find the skinny-M optimum.
+
+Run alone on the chip: python tools/decode_matmul_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(fn, *args, reps=20):
+    out = fn(*args)
+    float(jnp.sum(out.astype(jnp.float32)[:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        float(jnp.sum(out.astype(jnp.float32)[:1]))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best * 1e3
+
+
+def main():
+    from paddle_tpu.ops.pallas.int8_matmul import w8a16_matmul
+
+    K, N = 4096, 11008
+    rng = np.random.RandomState(0)
+    w_bf16 = jnp.asarray(rng.randn(K, N), jnp.bfloat16)
+    w_int8 = jnp.asarray(rng.randint(-127, 127, (K, N)), jnp.int8)
+
+    for M in (1, 8, 16):
+        x = jnp.asarray(rng.randn(M, K), jnp.bfloat16)
+
+        ms_bf16 = bench(jax.jit(lambda a, w: a @ w), x, w_bf16)
+        gbps = 2 * K * N / ms_bf16 / 1e6
+        print(json.dumps({"M": M, "kernel": "bf16_dot",
+                          "ms": round(ms_bf16, 3),
+                          "weight_gbps": round(gbps, 1)}), flush=True)
+
+        for bk, bn in ((512, 512), (1024, 512), (2048, 512),
+                       (512, 1024), (1024, 1024), (4096, 512)):
+            try:
+                f = jax.jit(lambda a, w, bk=bk, bn=bn: w8a16_matmul(
+                    a, w, block_k=bk, block_n=bn))
+                ms = bench(f, x, w_int8)
+            except Exception as e:
+                print(json.dumps({"M": M, "kernel": f"w8a16_{bk}x{bn}",
+                                  "error": type(e).__name__}), flush=True)
+                continue
+            gbps = K * N / ms / 1e6
+            print(json.dumps({"M": M, "kernel": f"w8a16_{bk}x{bn}",
+                              "ms": round(ms, 3),
+                              "weight_gbps": round(gbps, 1),
+                              "vs_bf16": round(ms_bf16 / ms, 2)}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
