@@ -27,15 +27,22 @@ _float0 = jax.dtypes.float0
 
 
 class Node:
-    __slots__ = ("vjp_fn", "inputs", "output_ids", "output_metas", "multi")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "output_ids",
+                 "output_metas", "multi")
 
-    def __init__(self, vjp_fn, inputs, output_ids, output_metas, multi=None):
+    def __init__(self, vjp_fn, inputs, outputs, output_metas, multi=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs            # list[Tensor] aligned with vjp arg order
-        self.output_ids = output_ids    # list[int] id() of output Tensors
+        # STRONG refs: the walk routes cotangents by id(), so a node's
+        # output Tensors must stay alive as long as the node does — a
+        # collected output whose id() CPython reuses for a later tensor
+        # would otherwise fire this node's vjp with a foreign cotangent
+        # (observed as a shape mismatch deep in a stale vjp closure)
+        self.outputs = outputs          # list[Tensor]
+        self.output_ids = [id(o) for o in outputs]
         self.output_metas = output_metas  # list[(shape, dtype)]
         # whether the impl returned a tuple (vjp cotangent must match)
-        self.multi = len(output_ids) > 1 if multi is None else multi
+        self.multi = len(outputs) > 1 if multi is None else multi
 
 
 class _TapeState(threading.local):
@@ -116,7 +123,7 @@ def set_grad_enabled(mode: bool):
 def record(vjp_fn, inputs, outputs, multi=None):
     """Append a node for an op application. `outputs` are Tensor objects."""
     metas = [(tuple(o.shape), o.dtype) for o in outputs]
-    node = Node(vjp_fn, list(inputs), [id(o) for o in outputs], metas, multi)
+    node = Node(vjp_fn, list(inputs), list(outputs), metas, multi)
     _tape.nodes.append(node)
     for o in outputs:
         _tape.produced.add(id(o))

@@ -166,6 +166,17 @@ class FusedMultiTransformer(nn.Layer):
                               self.head_dim], dtype=dtype)
                 for _ in range(self.num_layers)]
 
+    def gen_paged_cache(self, block_size, num_blocks, max_seqs,
+                        max_blocks_per_seq=None, dtype="float32"):
+        """Block-paged alternative to gen_cache: returns a PagedKVCache
+        whose ``.views`` list rides in the same ``caches=`` argument —
+        the cache layout is a protocol, not a tensor shape (see
+        inference/paged_cache.py)."""
+        from ...inference.paged_cache import PagedKVCache
+        return PagedKVCache.for_model(
+            self, block_size, num_blocks, max_seqs,
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+
     def _proj(self, i, blk, name, x):
         """Linear-projection hook; the int8 subclass overrides this."""
         return getattr(blk, name)(x)
@@ -183,7 +194,30 @@ class FusedMultiTransformer(nn.Layer):
             q = reshape(q, [b, l, self.num_heads, self.head_dim])
             k = reshape(k, [b, l, self.num_heads, self.head_dim])
             v = reshape(v, [b, l, self.num_heads, self.head_dim])
-            if caches is not None and time_step is not None:
+            if caches is not None and time_step is not None and \
+                    getattr(caches[i], "is_paged", False):
+                # paged-cache protocol (inference/paged_cache.py): the
+                # per-layer view appends k/v through its block table
+                # and attends over the sequence's pages — Pallas paged
+                # kernel on TPU, jnp gather + the same masked-sdpa
+                # codepath as the dense ragged branch on CPU (so paged
+                # and dense decode stay bit-identical there)
+                if l != 1:
+                    raise ValueError(
+                        "paged caches decode one token per step "
+                        "(seq_len==1); run prefill through a dense "
+                        "scratch cache and PagedKVCache.write_prefill "
+                        "(see inference/scheduler.py)")
+                t = time_step.data if isinstance(time_step, Tensor) \
+                    else jnp.asarray(time_step, jnp.int32)
+                # per-row positions like the ragged dense path; a
+                # scalar/shape-[1] time_step broadcasts across rows
+                t = jnp.broadcast_to(t.reshape(-1).astype(jnp.int32),
+                                     (b,))
+                attn = caches[i].decode(q, k, v, t,
+                                        use_kernel=_use_decode_kernel())
+                new_caches.append(caches[i])
+            elif caches is not None and time_step is not None:
                 # decode: append k/v at time_step into the static cache.
                 # time_step stays a TRACED scalar (dynamic_update_slice,
                 # the decode-kernel lens, and the mask below all accept

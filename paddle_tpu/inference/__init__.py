@@ -32,9 +32,14 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 from .serving import ContinuousBatchingEngine  # noqa: F401
+from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
+                          PagedKVCache, PagedLayerCache)
+from .scheduler import PagedRequest, PagedServingEngine  # noqa: F401
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "ContinuousBatchingEngine"]
+           "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
+           "BlockOOM", "PagedKVCache", "PagedLayerCache",
+           "PagedRequest", "PagedServingEngine"]
 
 
 class PrecisionType:

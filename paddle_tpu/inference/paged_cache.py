@@ -1,0 +1,367 @@
+"""Paged KV cache: block pool + free-list allocator + cache-protocol views.
+
+The reference serving path (fused_multi_transformer_op.cu.h decode) and
+the round-5 ContinuousBatchingEngine both pre-allocate a dense
+[2, B, H, max_len, D] cache row per slot, so HBM — not compute — caps
+concurrency. Here K/V live in a per-layer POOL of fixed-size blocks
+[num_blocks, 2, H, block_size, D] (PAPERS.md "Ragged Paged Attention",
+arxiv 2604.15464); each sequence owns a block table (int32 row of pool
+indices) and grows allocate-on-write, one block at a time. Blocks are
+refcounted so a forked request can share its prefix pages and split
+them copy-on-write at the first divergent append.
+
+The cache layout is a PROTOCOL, not a tensor shape:
+``FusedMultiTransformer.forward(..., caches=..., time_step=...)``
+accepts either dense per-layer Tensors or the `PagedLayerCache` views
+below (duck-typed via ``is_paged``), so dense and paged serving are
+interchangeable — see the shim in incubate/nn/fused_transformer.py.
+
+Block 0 of every pool is reserved as the TRASH block: inactive batch
+rows in a fused decode step scatter their (ignored) k/v there, and
+block-table entries past a sequence's allocation point at it so the
+kernel's gather always reads a valid pool row (masked by length).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import apply
+from ..framework.tensor import Tensor
+
+__all__ = ["BlockOOM", "BlockAllocator", "PagedKVCache",
+           "PagedLayerCache"]
+
+
+class BlockOOM(RuntimeError):
+    """No free blocks in the pool (the scheduler preempts on this)."""
+
+
+class BlockAllocator:
+    """Free-list allocator over pool rows 1..num_blocks-1 with
+    refcounts (row 0 is the reserved trash block). Shared-prefix
+    blocks hold refcount > 1 and are split copy-on-write by the
+    cache."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.num_blocks = int(num_blocks)
+        # pop() from the end -> lowest ids first (stable tests)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self.refcount = np.zeros(self.num_blocks, np.int32)
+        self.refcount[0] = 1  # trash block: never allocated, never freed
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise BlockOOM(f"need {n} blocks, {len(self._free)} free")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self.refcount[b] = 1
+        return blocks
+
+    def ref(self, blocks) -> None:
+        """Share blocks (forked prefix): one more owner each."""
+        for b in blocks:
+            if self.refcount[b] <= 0:
+                raise ValueError(f"ref of unallocated block {b}")
+            self.refcount[b] += 1
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 is reserved")
+            if self.refcount[b] <= 0:
+                raise ValueError(f"double free of block {b}")
+            self.refcount[b] -= 1
+            if self.refcount[b] == 0:
+                self._free.append(int(b))
+
+
+# --- per-op impls at module scope: the factory closures carry only ----
+# --- hashable ints, so framework/op.py's executable cache hits --------
+
+def _make_append(block_size):
+    def paged_cache_kv(pool, k, v, t, bt):
+        # pool [NB, 2, H, bs, D]; k/v [B, 1, H, D]; t int32 [B]; bt
+        # [B, MB]. Write row b's k/v at position t[b] through its block
+        # table. Inactive rows point at the trash block — duplicate
+        # scatter indices there are fine, nothing reads it unmasked.
+        blk = jnp.take_along_axis(bt, (t // block_size)[:, None],
+                                  axis=1)[:, 0]
+        off = t % block_size
+        pool = pool.at[blk, 0, :, off, :].set(
+            k[:, 0].astype(pool.dtype))
+        return pool.at[blk, 1, :, off, :].set(
+            v[:, 0].astype(pool.dtype))
+    return paged_cache_kv
+
+
+def _block_copy(pool, src, dst):
+    # copy-on-write split: pool[dst[i]] = pool[src[i]]
+    return pool.at[dst].set(pool[src])
+
+
+def _make_prefill_scatter(n_blocks, block_size):
+    def paged_prefill_scatter(pool, row_cache, blks):
+        # row_cache [2, 1, H, S, D] (dense single-row scratch) -> the
+        # first n_blocks pages of this sequence
+        seg = row_cache[:, 0, :, :n_blocks * block_size, :]
+        two, H, _, D = seg.shape
+        seg = seg.reshape(two, H, n_blocks, block_size, D)
+        seg = jnp.transpose(seg, (2, 0, 1, 3, 4))  # [n, 2, H, bs, D]
+        return pool.at[blks].set(seg.astype(pool.dtype))
+    return paged_prefill_scatter
+
+
+class PagedLayerCache:
+    """One layer's view of the paged cache — the object that rides in
+    the ``caches=`` list of FusedMultiTransformer.forward. Duck-typed
+    protocol: ``is_paged`` marks it, ``decode(q, k, v, t)`` appends one
+    token per row through the block table and returns the attention
+    output [B, 1, nh, hd]."""
+
+    is_paged = True
+
+    def __init__(self, cache: "PagedKVCache", layer: int):
+        self._cache = cache
+        self._layer = layer
+
+    @property
+    def pool(self) -> Tensor:
+        return self._cache.pools[self._layer]
+
+    @property
+    def shape(self):
+        return self.pool.shape
+
+    def decode(self, q, k, v, t, use_kernel: bool = False):
+        """q/k/v: [B, 1, H, D] Tensors (one decode step). t: traced
+        int32 [B] per-row positions (the write position == current
+        length). Appends k/v in place (the pool Tensor is rebound) and
+        returns attention over each row's valid prefix incl. the new
+        token. PRECONDITION: ``ensure(row, t[row]+1)`` for every
+        active row — the write position must be covered by the row's
+        block table. use_kernel routes to the Pallas paged kernel
+        (TPU); otherwise a pure-jnp gather + the SAME masked-sdpa
+        codepath the dense ragged decode uses, so paged and dense CPU
+        decode are bit-identical when page capacity == dense
+        max_len."""
+        import jax as _jax
+        c = self._cache
+        B = q.shape[0]
+        if B != c.max_seqs:
+            raise ValueError(f"batch {B} != cache max_seqs {c.max_seqs}")
+        if self._layer == 0 and not isinstance(t, _jax.core.Tracer):
+            # eager: catch a forgotten ensure() — the write would land
+            # in the shared trash block and silently corrupt this
+            # row's attention (rows with NO blocks at t == 0 are
+            # inactive by convention and write trash on purpose).
+            # Layer 0 only: every layer shares t and the tables, and
+            # reading t costs a device->host sync on TPU. Under jit t
+            # is a tracer and the precondition is the caller's
+            # contract.
+            tv = np.asarray(t)
+            for row in range(B):
+                have = len(c.seq_blocks[row])
+                pos = int(tv[row])
+                if (have and c.blocks_needed(pos + 1) > have) or \
+                        (not have and pos > 0):
+                    raise ValueError(
+                        f"decode at position {pos} of row {row} is "
+                        f"not covered by its {have} allocated "
+                        f"block(s); call ensure(row, position+1) "
+                        f"first")
+        bt = c.bt_tensor()
+        tt = Tensor(t)
+        new_pool = apply(_make_append(c.block_size),
+                         (self.pool, k, v, tt, bt),
+                         op_name="paged_cache_kv")
+        c.pools[self._layer] = new_pool
+
+        if use_kernel:
+            def dec(p, q_, tv, bta):
+                from ..ops.pallas.paged_attention import paged_attention
+                return paged_attention(q_[:, 0], p, bta, tv + 1)[:, None]
+            return apply(dec, (new_pool, q, tt, bt),
+                         op_name="paged_attention")
+
+        # CPU / fallback: gather pages dense (the kernel module's
+        # gather, so both paths share one layout definition), then
+        # mirror the dense ragged decode branch (same mask, same sdpa
+        # op executable)
+        from ..nn import functional as F
+        from ..ops.pallas.paged_attention import gather_pages
+        k_full, v_full = apply(gather_pages, (new_pool, bt),
+                               op_name="paged_gather")
+        S = k_full.shape[1]
+        qpos = (t[:, None, None, None]
+                + jnp.arange(1)[None, None, :, None])
+        kpos = jnp.arange(S)[None, None, None, :]
+        mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                      .astype(jnp.float32))
+        return F.scaled_dot_product_attention(q, k_full, v_full,
+                                              attn_mask=mask)
+
+
+class PagedKVCache:
+    """Per-layer block pools + one block allocator + per-sequence block
+    tables. ``views`` is the list consumed as ``caches=`` by the fused
+    decoder; allocation/free/fork are host-side (numpy free list), the
+    pool writes are jnp scatters."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 block_size: int, num_blocks: int, max_seqs: int,
+                 max_blocks_per_seq: Optional[int] = None,
+                 dtype: str = "float32"):
+        import paddle_tpu as paddle
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_seqs = int(max_seqs)
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = self.num_blocks - 1
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.dtype = dtype
+        self.allocator = BlockAllocator(self.num_blocks)
+        self.pools: List[Tensor] = [
+            paddle.zeros([self.num_blocks, 2, self.num_heads,
+                          self.block_size, self.head_dim], dtype=dtype)
+            for _ in range(self.num_layers)]
+        # all entries at the trash block until allocated
+        self.block_tables = np.zeros(
+            (self.max_seqs, self.max_blocks_per_seq), np.int32)
+        self.seq_blocks: List[List[int]] = [[] for _ in
+                                            range(self.max_seqs)]
+        self.views = [PagedLayerCache(self, i)
+                      for i in range(self.num_layers)]
+        self._bt_cached: Optional[Tensor] = None
+        self.peak_blocks_used = 0
+
+    # -- construction -------------------------------------------------
+    @classmethod
+    def for_model(cls, model, block_size, num_blocks, max_seqs,
+                  max_blocks_per_seq=None, dtype="float32"):
+        return cls(model.num_layers, model.num_heads, model.head_dim,
+                   block_size, num_blocks, max_seqs,
+                   max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+
+    # -- geometry -----------------------------------------------------
+    @property
+    def capacity_per_seq(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+    def blocks_needed(self, length: int) -> int:
+        return -(-int(length) // self.block_size)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - 1 - self.allocator.num_free
+
+    def pool_bytes(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   * np.dtype(str(p.dtype)).itemsize for p in self.pools)
+
+    def bt_tensor(self) -> Tensor:
+        """Device copy of the block tables; rebuilt only after a
+        host-side table mutation."""
+        if self._bt_cached is None:
+            self._bt_cached = Tensor(
+                jnp.asarray(self.block_tables, jnp.int32))
+        return self._bt_cached
+
+    def _tables_dirty(self):
+        self._bt_cached = None
+        self.peak_blocks_used = max(self.peak_blocks_used,
+                                    self.blocks_in_use)
+
+    # -- allocation ---------------------------------------------------
+    def ensure(self, slot: int, length: int) -> None:
+        """Grow slot's table to cover ``length`` tokens
+        (allocate-on-write) and copy-on-write split the block the next
+        append lands in if it is shared. Raises BlockOOM when the pool
+        is exhausted (callers preempt) and ValueError past the per-seq
+        table capacity."""
+        if length <= 0:
+            return  # nothing to cover (and no write block to COW)
+        need = self.blocks_needed(length)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence length {length} exceeds per-seq capacity "
+                f"{self.capacity_per_seq} (max_blocks_per_seq="
+                f"{self.max_blocks_per_seq})")
+        have = self.seq_blocks[slot]
+        if need > len(have):
+            new = self.allocator.alloc(need - len(have))
+            self.block_tables[slot, len(have):need] = new
+            have.extend(new)
+            self._tables_dirty()
+        # COW: the block the write at position length-1 lands in
+        bpos = (int(length) - 1) // self.block_size
+        if self.allocator.refcount[have[bpos]] > 1:
+            self._copy_block(slot, bpos)
+
+    def free_seq(self, slot: int) -> None:
+        if self.seq_blocks[slot]:
+            self.allocator.free(self.seq_blocks[slot])
+            self.seq_blocks[slot] = []
+            self.block_tables[slot, :] = 0
+            self._tables_dirty()
+
+    def fork(self, src: int, dst: int, length: int) -> None:
+        """Share src's first ``blocks_needed(length)`` blocks with dst
+        (refcounted, including a partial last block — the first
+        divergent append splits it copy-on-write)."""
+        if self.seq_blocks[dst]:
+            raise ValueError(f"dst slot {dst} already allocated")
+        shared = self.seq_blocks[src][:self.blocks_needed(length)]
+        self.allocator.ref(shared)
+        self.seq_blocks[dst] = list(shared)
+        self.block_tables[dst, :len(shared)] = shared
+        self._tables_dirty()
+
+    def _copy_block(self, slot: int, bpos: int, copy: bool = True) -> None:
+        """Copy-on-write: give slot a private block at table position
+        bpos. copy=False skips the pool copy for callers about to
+        overwrite the whole block anyway (write_prefill)."""
+        old = self.seq_blocks[slot][bpos]
+        new = self.allocator.alloc(1)[0]
+        if copy:
+            src = Tensor(jnp.asarray([old], jnp.int32))
+            dst = Tensor(jnp.asarray([new], jnp.int32))
+            for i, pool in enumerate(self.pools):
+                self.pools[i] = apply(_block_copy, (pool, src, dst),
+                                      op_name="paged_block_copy")
+        self.allocator.free([old])
+        self.seq_blocks[slot][bpos] = new
+        self.block_tables[slot, bpos] = new
+        self._tables_dirty()
+
+    # -- prefill ------------------------------------------------------
+    def write_prefill(self, slot: int, row_caches, length: int) -> None:
+        """Scatter a dense single-row scratch cache (the per-layer
+        [2, 1, H, S, D] Tensors a batch-1 prefill produced) into this
+        slot's pages. ensure(slot, length) must have run first."""
+        n = self.blocks_needed(length)
+        if n > len(self.seq_blocks[slot]):
+            raise ValueError("ensure() the slot before write_prefill")
+        # the scatter rewrites every covered block wholesale, so any
+        # fork-shared block in range must be split first (no pool copy
+        # needed — its contents are about to be replaced) or the peer
+        # sequence would read this prefill through the shared page
+        for bpos in range(n):
+            if self.allocator.refcount[self.seq_blocks[slot][bpos]] > 1:
+                self._copy_block(slot, bpos, copy=False)
+        blks = Tensor(jnp.asarray(self.seq_blocks[slot][:n], jnp.int32))
+        impl = _make_prefill_scatter(n, self.block_size)
+        for i, (pool, rc) in enumerate(zip(self.pools, row_caches)):
+            self.pools[i] = apply(impl, (pool, rc, blks),
+                                  op_name="paged_prefill_scatter")

@@ -1,0 +1,263 @@
+"""Paged serving engine: block-budget admission, preemption by block
+eviction, continuous slot refill.
+
+Sits where ContinuousBatchingEngine sits (same model contract:
+``model(x, caches=..., time_step=...)`` with per-row int32 positions),
+but the cache is a PagedKVCache — sequences reserve pages as they
+grow instead of a dense max_len row, so the concurrency limit is the
+BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
+
+  * admission: a queued request is admitted only when a slot is free
+    AND the allocator can cover its prompt's pages plus a watermark;
+    prefill runs batch-1 against a persistent dense scratch cache and
+    is scattered into freshly allocated pages.
+  * growth: before each fused step, every active row crossing a block
+    boundary allocates its next page (allocate-on-write).
+  * preemption: when the pool is exhausted, the YOUNGEST active
+    request is evicted — all its pages are freed at once and the
+    request goes back to the FRONT of the queue for re-prefill from
+    its recorded history (prompt + every decode input), so a later
+    re-admission reproduces its cache exactly.
+  * refill: releases/preemptions re-run admission, so the batch stays
+    full without stopping in-flight rows.
+
+Events are surfaced in ``admitted`` / ``finished`` / ``preempted``
+lists the caller drains between steps (prefill outputs ride along so
+the caller can seed the next input row).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+from .paged_cache import BlockOOM, PagedKVCache
+
+__all__ = ["PagedRequest", "PagedServingEngine"]
+
+
+class PagedRequest:
+    """One sequence. ``history`` is every embedding row the model has
+    consumed for it (prompt rows + each decode-step input row): exactly
+    what a re-prefill needs to rebuild the evicted cache."""
+
+    def __init__(self, rid: int, history: np.ndarray):
+        self.rid = rid
+        self.history = [np.asarray(r, np.float32) for r in history]
+        self.slot: Optional[int] = None
+        self.admit_seq = -1
+        self.preemptions = 0
+
+    def __len__(self):
+        return len(self.history)
+
+
+class PagedServingEngine:
+    def __init__(self, model, max_batch: int, block_size: int,
+                 num_blocks: int, max_blocks_per_seq: Optional[int] = None,
+                 dtype: str = "float32", watermark_blocks: int = 0):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.dtype = dtype
+        self.watermark_blocks = int(watermark_blocks)
+        self.cache = PagedKVCache.for_model(
+            model, block_size, num_blocks, max_seqs=max_batch,
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+        self.max_len = self.cache.capacity_per_seq
+        self.lens = np.zeros(self.max_batch, np.int32)
+        self.active = np.zeros(self.max_batch, bool)
+        self._requests: List[Optional[PagedRequest]] = \
+            [None] * self.max_batch
+        self.queue: Deque[PagedRequest] = deque()
+        # decode inputs not yet attributed to request histories:
+        # (x, active-mask) per step, materialized to host lazily so the
+        # hot decode loop never pays a device->host sync for the
+        # (rare) preemption path
+        self._pending_history: List[Tuple[Tensor, np.ndarray]] = []
+        self._scratch = None          # persistent single-row prefill cache
+        self._next_rid = 0
+        self._next_admit_seq = 0
+        # event queues the caller drains
+        self.admitted: List[Tuple[int, int, Tensor]] = []
+        self.finished: List[Tuple[int, int, int]] = []
+        self.preempted: List[int] = []
+
+    # -- introspection ------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def free_slots(self) -> int:
+        return int((~self.active).sum())
+
+    @property
+    def free_blocks(self) -> int:
+        return self.cache.allocator.num_free
+
+    # -- admission ----------------------------------------------------
+    def submit(self, prompt) -> int:
+        """Queue a prompt ([T, d_model] embeddings) and try to admit.
+        Returns the request id; if admission succeeded an
+        ``(rid, slot, last_hidden)`` event is in ``admitted``."""
+        arr = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
+                         else prompt, np.float32)
+        if arr.shape[0] == 0:
+            raise ValueError("empty prompt")
+        if arr.shape[0] > self.max_len:
+            raise ValueError(
+                f"prompt length {arr.shape[0]} > per-seq page capacity "
+                f"{self.max_len}")
+        req = PagedRequest(self._next_rid, arr)
+        self._next_rid += 1
+        self.queue.append(req)
+        self._try_admit()
+        return req.rid
+
+    def _try_admit(self) -> None:
+        """Admit from the queue head while a slot is free and the
+        block budget covers the prompt plus the watermark."""
+        while self.queue and self.free_slots > 0:
+            req = self.queue[0]
+            # cover the prompt AND the first decode token's page —
+            # admitting with zero headroom would re-preempt a request
+            # sitting on a block boundary every step (prefill/evict
+            # livelock)
+            need = self.cache.blocks_needed(
+                min(len(req) + 1, self.max_len))
+            if need + self.watermark_blocks > self.free_blocks:
+                return  # head-of-line blocks; keep FIFO fairness
+            self.queue.popleft()
+            self._prefill(req)
+
+    def _prefill(self, req: PagedRequest) -> None:
+        import paddle_tpu as paddle
+        slot = int(np.flatnonzero(~self.active)[0])
+        T = len(req)
+        if self._scratch is None:
+            self._scratch = self.model.gen_cache(1, self.max_len,
+                                                 dtype=self.dtype)
+        x = paddle.to_tensor(np.stack(req.history)[None]
+                             .astype(np.float32))
+        # serving never backprops: without no_grad the tape would pin
+        # every superseded scratch/pool version across the loop
+        with no_grad():
+            out, row_caches = self.model(x, caches=self._scratch,
+                                         time_step=0)
+        self._scratch = row_caches  # persistent: reused next admission
+        self.cache.ensure(slot, T)
+        self.cache.write_prefill(slot, row_caches, T)
+        self.lens[slot] = T
+        self.active[slot] = True
+        self._requests[slot] = req
+        req.slot = slot
+        req.admit_seq = self._next_admit_seq
+        self._next_admit_seq += 1
+        self.admitted.append((req.rid, slot, out[:, -1]))
+
+    # -- release / preemption -----------------------------------------
+    def release(self, slot: int) -> None:
+        """Caller-side finish (e.g. EOS): free the pages, refill."""
+        self._drop(slot)
+        self._try_admit()
+
+    def _flush_history(self) -> None:
+        """Attribute buffered decode inputs to their requests'
+        histories. Must run before any slot->request mapping change
+        (drop/preempt), which is the only time histories are read."""
+        if not self._pending_history:
+            return
+        pending, self._pending_history = self._pending_history, []
+        for xt, mask in pending:
+            xv = np.asarray(xt.numpy(), np.float32)
+            for slot in np.flatnonzero(mask):
+                req = self._requests[int(slot)]
+                if req is not None:
+                    req.history.append(xv[int(slot), 0].copy())
+
+    def _drop(self, slot: int) -> None:
+        self._flush_history()
+        self.cache.free_seq(slot)
+        self.active[slot] = False
+        self.lens[slot] = 0
+        self._requests[slot] = None
+
+    def preempt(self, slot: int) -> None:
+        """Evict a running request: free ALL its pages and requeue it
+        at the front for re-prefill from its history."""
+        req = self._requests[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} not active")
+        self._drop(slot)
+        req.slot = None
+        req.preemptions += 1
+        self.queue.appendleft(req)
+        self.preempted.append(req.rid)
+
+    def _preempt_youngest(self) -> int:
+        cands = [int(s) for s in np.flatnonzero(self.active)]
+        victim = max(cands, key=lambda s: self._requests[s].admit_seq)
+        self.preempt(victim)
+        return victim
+
+    # -- decode -------------------------------------------------------
+    def step(self, x: Tensor):
+        """One fused decode step for every active slot. x: [max_batch,
+        1, d_model] next-token embeddings (inactive rows: any values —
+        they scatter into the trash block). Slots at page capacity are
+        auto-released first (reported in ``finished``) so one full
+        sequence never stalls the batch; rows crossing a block boundary
+        allocate their next page, preempting the youngest request if
+        the pool is dry. Returns hidden [max_batch, 1, d_model] (only
+        rows active during this step are meaningful), or None if every
+        slot finished before the step could run."""
+        if self.num_active == 0:
+            raise RuntimeError("step() with no active slots")
+        # 1. capacity-finished slots: report + release, keep the rest
+        for slot in np.flatnonzero(self.active & (self.lens >=
+                                                  self.max_len)):
+            req = self._requests[int(slot)]
+            self.finished.append((req.rid, int(slot),
+                                  int(self.lens[slot])))
+            self._drop(int(slot))
+        if self.num_active == 0:
+            self._try_admit()
+            return None
+        # 2. grow pages (allocate-on-write), preempting on OOM.
+        #    Oldest first: under pressure the young yield to the old.
+        order = sorted(np.flatnonzero(self.active),
+                       key=lambda s: self._requests[s].admit_seq)
+        for slot in order:
+            slot = int(slot)
+            while self.active[slot]:
+                try:
+                    self.cache.ensure(slot, int(self.lens[slot]) + 1)
+                    break
+                except BlockOOM:
+                    # victim = youngest active request — possibly this
+                    # row itself (then the while condition ends its
+                    # growth attempt and it re-queues for re-prefill)
+                    if self.num_active == 1:
+                        raise RuntimeError(
+                            "pool too small: one sequence cannot grow "
+                            "even with every other request evicted")
+                    self._preempt_youngest()
+        # 3. record the inputs being consumed (re-prefill history) —
+        #    a Tensor ref + mask snapshot only; the device->host read
+        #    is deferred to _flush_history (next drop/preempt, or the
+        #    periodic bound below so long-lived batches don't pin an
+        #    unbounded window of input buffers)
+        if len(self._pending_history) >= 32:
+            self._flush_history()
+        self._pending_history.append((x, self.active.copy()))
+        # 4. fused ragged step over the paged views
+        t = Tensor(np.asarray(self.lens, np.int32))
+        with no_grad():
+            out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        self.lens[self.active] += 1
+        # 5. continuous refill
+        self._try_admit()
+        return out

@@ -41,6 +41,12 @@ class ContinuousBatchingEngine:
                                                     dtype=dtype)
         self.lens = np.zeros(self.max_batch, np.int32)
         self.active = np.zeros(self.max_batch, bool)
+        # persistent single-row prefill scratch, reused across
+        # admissions (stale tail positions are masked by time_step, so
+        # re-zeroing between prompts is unnecessary)
+        self._scratch: Optional[List[Tensor]] = None
+        # slots auto-released by step() on reaching max_len
+        self.finished: List[int] = []
 
     # -- slot management ----------------------------------------------------
     @property
@@ -62,10 +68,17 @@ class ContinuousBatchingEngine:
         if T > self.max_len:
             raise ValueError(f"prompt length {T} > max_len "
                              f"{self.max_len}")
-        row_caches = self.model.gen_cache(1, self.max_len,
-                                          dtype=self.dtype)
-        out, row_caches = self.model(prompt.unsqueeze(0),
-                                     caches=row_caches, time_step=0)
+        from ..framework.autograd import no_grad
+        if self._scratch is None:
+            self._scratch = self.model.gen_cache(1, self.max_len,
+                                                 dtype=self.dtype)
+        # serving never backprops: without no_grad the tape would pin
+        # every superseded cache version across the decode loop
+        with no_grad():
+            out, row_caches = self.model(prompt.unsqueeze(0),
+                                         caches=self._scratch,
+                                         time_step=0)
+        self._scratch = row_caches  # reuse the buffers next admission
         for c, row in zip(self.caches, row_caches):
             c._data = c.data.at[:, slot].set(row.data[:, 0])
         self.lens[slot] = T
@@ -77,18 +90,29 @@ class ContinuousBatchingEngine:
         self.lens[slot] = 0
 
     # -- decode -------------------------------------------------------------
-    def step(self, x: Tensor) -> Tensor:
+    def step(self, x: Tensor) -> Optional[Tensor]:
         """One fused decode step for ALL slots. x: [max_batch, 1,
         d_model] next-token embeddings (inactive rows: any values —
         their cache rows are fully overwritten on reuse). Returns
         hidden [max_batch, 1, d_model]; only active rows are
-        meaningful. Advances every active slot's length."""
+        meaningful. Advances every active slot's length.
+
+        Slots that already reached max_len are auto-released and
+        recorded in ``finished`` — one full sequence no longer stalls
+        the rest of the batch. If that empties the batch, returns None
+        (drain ``finished`` and admit new requests)."""
         if int(self.active.sum()) == 0:
             raise RuntimeError("step() with no active slots")
-        if int(self.lens[self.active].max()) >= self.max_len:
-            raise RuntimeError("a slot reached max_len; release() it")
+        for slot in np.flatnonzero(self.active &
+                                   (self.lens >= self.max_len)):
+            self.finished.append(int(slot))
+            self.release(int(slot))
+        if int(self.active.sum()) == 0:
+            return None
+        from ..framework.autograd import no_grad
         t = Tensor(np.asarray(self.lens, np.int32))
-        out, self.caches = self.model(x, caches=self.caches,
-                                      time_step=t)
+        with no_grad():
+            out, self.caches = self.model(x, caches=self.caches,
+                                          time_step=t)
         self.lens[self.active] += 1
         return out

@@ -8,6 +8,9 @@ fused/cutlass kernels, SURVEY.md §2.1 phi/kernels/fusion).
 - grouped_gemm:     MoE expert grouped GEMM (cutlass moe_kernel.cu analog)
 - decode_attention: cache-KV flash-decoding
                     (fused_multi_transformer_op.cu.h:835 analog)
+- paged_attention:  ragged paged-attention decode over a block-paged
+                    KV pool (block table via scalar prefetch;
+                    PAPERS.md arxiv 2604.15464)
 
 All kernels run in interpret mode on CPU for tests and compile via
 Mosaic on TPU.
@@ -20,3 +23,5 @@ from .fused_norm import (fused_layer_norm,  # noqa: F401
                          fused_layer_norm_residual, fused_rms_norm,
                          fused_rms_norm_residual)
 from .grouped_gemm import gmm, gmm_reference, make_group_metadata  # noqa: F401
+from .paged_attention import (gather_pages, paged_attention,  # noqa: F401
+                              paged_attention_reference)

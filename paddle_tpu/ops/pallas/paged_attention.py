@@ -1,0 +1,211 @@
+"""Ragged paged-attention decode kernel over a block-paged KV cache.
+
+TPU analog of vLLM's PagedAttention in the layout of PAPERS.md "Ragged
+Paged Attention" (arxiv 2604.15464): instead of one dense
+[B, max_len, H, D] cache per batch, K/V live in a shared pool of
+fixed-size blocks [num_blocks, 2, nkv, block_size, hd]; each sequence
+owns an int32 row of block ids (its block table) and a valid length.
+One query step per sequence attends over its pages with an online
+softmax, exactly like decode_attention but with the cache axis
+INDIRECTED through the block table.
+
+On real TPU the block table rides as a SCALAR-PREFETCH argument
+(pltpu.PrefetchScalarGridSpec): the BlockSpec index_map reads
+``bt[seq, step]`` so each page is DMA'd HBM->VMEM directly from its
+pool row — the gathered [B, S, H, D] view never materializes. On CPU
+the same kernel body runs in interpret mode over pre-gathered pages
+(interpret mode has no scalar-prefetch index maps, same trade as
+grouped_gemm); the model-level CPU fallback in
+inference/paged_cache.py uses a pure-jnp gather instead so tier-1
+serving tests exercise the full protocol without Mosaic.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    # 'axon' is the tunneled TPU backend — same Mosaic compile path
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _require_pltpu():
+    if pltpu is None:
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable in this jax build; "
+            "the fused kernels need it even for interpret mode (scratch "
+            "shapes) — use the jnp path instead")
+
+
+def _paged_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                block_s, n_blocks, sm_scale):
+    """Online-softmax update for one (sequence*kv-head, page) grid step.
+
+    kv_ref holds one page of this row's K and V — (1, 2, 1, bs, hd) on
+    the prefetch path, (1, 1, 2, bs, hd) pre-gathered in interpret mode;
+    both reshape to (2, bs, hd). `length` is this row's valid length
+    (already read out of SMEM by the wrapper)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
+    k = kv[0].astype(jnp.float32)               # [block_s, hd]
+    v = kv[1].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)            # [g, hd]
+
+    # pages at or past the valid length are pure padding (their block
+    # table entries point at the reserved trash block) — skip the FLOPs,
+    # the running stats already ignore them
+    @pl.when(j * block_s < length)
+    def _update():
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [g, block_s]
+        pos = j * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < length, scores, NEG_INF)
+
+        m_prev = m_scr[...]                     # [g, 1]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        # mask the probabilities too: a fully-masked row would otherwise
+        # turn exp(NEG_INF - NEG_INF) into ones
+        p = jnp.exp(scores - m_new) * (pos < length)
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _done():
+        l = l_scr[...]
+        # length-0 rows emit zeros, not NaN
+        o_ref[0] = (acc_scr[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _kernel_prefetch(bt_ref, lens_ref, q_ref, pool_ref, o_ref, m_scr,
+                     l_scr, acc_scr, *, nkv, **kw):
+    # bt_ref feeds the index maps only; lens is a prefetched [B] vector
+    del bt_ref
+    _paged_body(lens_ref[pl.program_id(0) // nkv], q_ref, pool_ref,
+                o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
+def _kernel_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr, l_scr,
+                      acc_scr, **kw):
+    _paged_body(lens_ref[pl.program_id(0), 0], q_ref, pg_ref, o_ref,
+                m_scr, l_scr, acc_scr, **kw)
+
+
+def gather_pages(kv_pool, block_tables):
+    """Pure-jnp page gather: materialize the block-table indirection as
+    dense K/V. kv_pool: [NB, 2, nkv, bs, hd]; block_tables: int32
+    [B, MB]. Returns (k, v) each [B, MB*bs, nkv, hd] — the layout
+    decode_attention consumes. Positions past a sequence's length hold
+    whatever its (trash/stale) pages hold; callers mask by length."""
+    pages = kv_pool[jnp.asarray(block_tables, jnp.int32)]
+    # [B, MB, 2, nkv, bs, hd] -> [B, MB, bs, nkv, hd] per K/V
+    k = jnp.moveaxis(pages[:, :, 0], 2, 3)
+    v = jnp.moveaxis(pages[:, :, 1], 2, 3)
+    B, MB, bs, nkv, hd = k.shape
+    return (k.reshape(B, MB * bs, nkv, hd),
+            v.reshape(B, MB * bs, nkv, hd))
+
+
+def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None):
+    """q: [B, nh, hd] (one decode step per sequence). kv_pool:
+    [num_blocks, 2, nkv, block_size, hd]. block_tables: int32 [B, MB] —
+    entry j is the pool row holding positions [j*bs, (j+1)*bs); entries
+    past a sequence's allocation must point at a valid (e.g. reserved)
+    block. seq_lens: int32 [B] valid lengths. Returns [B, nh, hd]."""
+    B, nh, hd = q.shape
+    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
+    MB = block_tables.shape[1]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+
+    qg = q.reshape(B, nkv, g, hd).reshape(B * nkv, g, hd)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    _require_pltpu()
+    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale)
+    scratch = [pltpu.VMEM((g, 1), jnp.float32),
+               pltpu.VMEM((g, 1), jnp.float32),
+               pltpu.VMEM((g, hd), jnp.float32)]
+    out_shape = jax.ShapeDtypeStruct((B * nkv, g, hd), q.dtype)
+    q_spec = pl.BlockSpec((1, g, hd), lambda i, j: (i, 0, 0))
+    o_spec = pl.BlockSpec((1, g, hd), lambda i, j: (i, 0, 0))
+
+    if _interpret():
+        # no scalar prefetch in interpret mode: pre-gather each row's
+        # pages (test path only; the kernel body is identical)
+        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
+        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
+            B * nkv, MB, 2, block_s, hd)
+        lens_r = jnp.repeat(lens, nkv).reshape(B * nkv, 1)
+        out = pl.pallas_call(
+            functools.partial(_kernel_interpret, **kw),
+            grid=(B * nkv, MB),
+            in_specs=[
+                pl.BlockSpec((B * nkv, 1), lambda i, j: (0, 0)),
+                q_spec,
+                pl.BlockSpec((1, 1, 2, block_s, hd),
+                             lambda i, j: (i, j, 0, 0, 0)),
+            ],
+            out_specs=o_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=True,
+        )(lens_r, qg, pg)
+        return out.reshape(B, nkv, g, hd).reshape(B, nh, hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # block tables + lens ride in SMEM
+        grid=(B * nkv, MB),
+        in_specs=[
+            pl.BlockSpec((1, g, hd), lambda i, j, bt_, l_: (i, 0, 0)),
+            # one page per step, straight out of the pool row named by
+            # the block table — this is the whole paged-attention trick
+            pl.BlockSpec((1, 2, 1, block_s, hd),
+                         lambda i, j, bt_, l_: (bt_[i // nkv, j], 0,
+                                                i % nkv, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, g, hd), lambda i, j, bt_, l_:
+                               (i, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_prefetch, nkv=nkv, **kw),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+    )(bt, lens, qg, kv_pool)
+    return out.reshape(B, nkv, g, hd).reshape(B, nh, hd)
+
+
+def paged_attention_reference(q, kv_pool, block_tables, seq_lens,
+                              sm_scale=None):
+    """jnp reference: gather pages dense, then the decode reference."""
+    from .decode_attention import decode_attention_reference
+    k, v = gather_pages(kv_pool, block_tables)
+    return decode_attention_reference(q, k, v, seq_lens,
+                                      sm_scale=sm_scale)

@@ -122,3 +122,27 @@ def test_second_use_same_tensor():
     y = x * x + x
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_tape_nodes_hold_outputs_alive():
+    """Stale tape nodes (forward run without backward) route
+    cotangents by id(); a node's outputs must be STRONGLY held so a
+    collected output's id can never be reused by a later tensor and
+    fire the stale vjp with a foreign cotangent (caused intermittent
+    shape-mismatch crashes in unrelated backwards)."""
+    import gc
+    from paddle_tpu.framework import autograd as ag
+    x = _param([1.0, 2.0])
+    out = x * 3
+    node = ag._tape.nodes[-1]
+    oid = node.output_ids[0]
+    assert node.outputs[0] is out
+    del out
+    gc.collect()
+    # the id stays pinned to the recorded output while the node lives
+    assert id(node.outputs[0]) == oid
+    # and an unrelated backward still works and clears the tape
+    y = _param([5.0])
+    (y * 2).backward()
+    np.testing.assert_allclose(y.grad.numpy(), [2.0])
+    assert not ag._tape.nodes

@@ -1,0 +1,407 @@
+"""Paged KV-cache subsystem (inference/paged_cache.py + scheduler.py).
+
+The cache layout is a protocol: the same FusedMultiTransformer decode
+must produce BIT-IDENTICAL hiddens through a PagedKVCache (block pool
++ block tables) and through the dense slot cache — including after a
+preempt -> re-prefill cycle and after freed blocks are reused by a new
+request. The paged engine must also sustain strictly more concurrent
+sequences than the dense engine under the same simulated HBM budget
+(the whole point of paging)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (BlockAllocator, BlockOOM,
+                                  ContinuousBatchingEngine,
+                                  PagedServingEngine)
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+BS, MB = 16, 4            # 16-token pages, 4 pages/seq
+MAXLEN = BS * MB          # dense max_len == paged per-seq capacity
+
+
+def _model():
+    paddle.seed(0)
+    return FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+
+
+def _prompt(rng, n):
+    return paddle.to_tensor(rng.randn(n, D).astype(np.float32))
+
+
+def _admit(eng, prompt):
+    """submit() + drain the admission event -> (slot, last_hidden)."""
+    rid = eng.submit(prompt)
+    admitted = {r: (s, h) for r, s, h in eng.admitted}
+    eng.admitted.clear()
+    assert rid in admitted, "expected immediate admission"
+    return admitted[rid]
+
+
+# deterministic greedy readout: hidden -> token -> next embedding.
+# identical hiddens => identical token streams.
+_RNG = np.random.RandomState(1234)
+_VOCAB = 50
+_W_OUT = _RNG.randn(D, _VOCAB).astype(np.float32)
+_EMBED = _RNG.randn(_VOCAB, D).astype(np.float32)
+
+
+def _readout(hidden_row):
+    tok = int(np.argmax(hidden_row @ _W_OUT))
+    return tok, _EMBED[tok]
+
+
+class TestBlockAllocator:
+    def test_freelist_refcount_oom(self):
+        a = BlockAllocator(6)          # block 0 reserved
+        assert a.num_free == 5
+        b1 = a.alloc(2)
+        assert 0 not in b1 and a.num_free == 3
+        a.ref(b1)                      # shared prefix: two owners
+        a.free(b1)
+        assert a.num_free == 3         # still held by the fork
+        a.free(b1)
+        assert a.num_free == 5
+        a.alloc(5)
+        with pytest.raises(BlockOOM):
+            a.alloc(1)
+
+    def test_trash_block_protected(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError):
+            a.free([0])
+        assert 0 not in a.alloc(3)  # trash block never handed out
+
+
+class TestPagedDenseParity:
+    def test_bitwise_identical_decode(self):
+        """Same prompts, dense slots vs paged blocks: every decode
+        hidden must be bit-identical (acceptance criterion), across a
+        page boundary, and the greedy token streams must match."""
+        model = _model()
+        rng = np.random.RandomState(0)
+        pa, pb = _prompt(rng, 5), _prompt(rng, 13)
+
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=MAXLEN)
+        sa, la = dense.add_request(pa)
+        sb, lb = dense.add_request(pb)
+        paged = PagedServingEngine(model, max_batch=2, block_size=BS,
+                                   num_blocks=9, max_blocks_per_seq=MB)
+        psa, pla = _admit(paged, pa)
+        psb, plb = _admit(paged, pb)
+        np.testing.assert_array_equal(np.asarray(la.numpy()),
+                                      np.asarray(pla.numpy()))
+
+        toks_d, toks_p = [], []
+        xd = np.zeros((2, 1, D), np.float32)
+        xp = np.zeros((2, 1, D), np.float32)
+        for (s, h, x, toks) in ((sa, la, xd, None), (sb, lb, xd, None),
+                                (psa, pla, xp, None), (psb, plb, xp, None)):
+            x[s, 0] = _readout(np.asarray(h.numpy())[0])[1]
+        # 6 steps takes pb from 13 -> 19: crosses the 16-token page edge
+        for _ in range(6):
+            od = np.asarray(dense.step(paddle.to_tensor(xd)).numpy())
+            op = np.asarray(paged.step(paddle.to_tensor(xp)).numpy())
+            np.testing.assert_array_equal(od[sa], op[psa])
+            np.testing.assert_array_equal(od[sb], op[psb])
+            for s, toks, x, o in ((sa, toks_d, xd, od), (sb, toks_d, xd, od)):
+                tok, emb = _readout(o[s, 0])
+                toks.append(tok)
+                x[s, 0] = emb
+            for s, toks, x, o in ((psa, toks_p, xp, op), (psb, toks_p, xp, op)):
+                tok, emb = _readout(o[s, 0])
+                toks.append(tok)
+                x[s, 0] = emb
+        assert toks_d == toks_p
+        # growth actually went paged: pb's slot holds 2 pages now
+        assert len(paged.cache.seq_blocks[psb]) == 2
+
+    def test_block_reuse_is_exact(self):
+        """A finishes and releases; B reuses A's freed blocks. Stale
+        page contents must not perturb B (mask underflow is exact)."""
+        model = _model()
+        rng = np.random.RandomState(2)
+        pa, pb = _prompt(rng, 6), _prompt(rng, 5)
+
+        paged = PagedServingEngine(model, max_batch=2, block_size=BS,
+                                   num_blocks=5, max_blocks_per_seq=MB)
+        psa, pla = _admit(paged, pa)
+        xp = np.zeros((2, 1, D), np.float32)
+        xp[psa, 0] = np.asarray(pla.numpy())[0]
+        for _ in range(3):
+            op = np.asarray(paged.step(paddle.to_tensor(xp)).numpy())
+            xp = op[:, :1].copy()
+        a_blocks = set(paged.cache.seq_blocks[psa])
+        paged.release(psa)
+        psb, plb = _admit(paged, pb)
+        assert set(paged.cache.seq_blocks[psb]) & a_blocks, \
+            "B should reuse A's freed blocks"
+
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=MAXLEN)
+        sb, lb = dense.add_request(pb)
+        np.testing.assert_array_equal(np.asarray(plb.numpy()),
+                                      np.asarray(lb.numpy()))
+        xp = np.zeros((2, 1, D), np.float32)
+        xd = np.zeros((2, 1, D), np.float32)
+        xp[psb, 0] = np.asarray(plb.numpy())[0]
+        xd[sb, 0] = np.asarray(lb.numpy())[0]
+        for _ in range(4):
+            op = np.asarray(paged.step(paddle.to_tensor(xp)).numpy())
+            od = np.asarray(dense.step(paddle.to_tensor(xd)).numpy())
+            np.testing.assert_array_equal(op[psb], od[sb])
+            xp, xd = op[:, :1].copy(), od[:, :1].copy()
+
+
+class TestPreemption:
+    def test_preempt_reprefill_cycle(self):
+        """Pool pressure evicts the youngest request (pages freed,
+        request re-queued); the survivor decodes on bit-identically,
+        and after re-admission the victim's re-prefilled decode is
+        bit-identical to a dense engine given the same history."""
+        model = _model()
+        rng = np.random.RandomState(1)
+        pa, pb = _prompt(rng, 14), _prompt(rng, 14)
+
+        # 5 usable pages: A+B fit until both need a 3rd page at len 32
+        eng = PagedServingEngine(model, max_batch=2, block_size=BS,
+                                 num_blocks=6, max_blocks_per_seq=MB)
+        sa, ha = _admit(eng, pa)
+        sb, hb = _admit(eng, pb)
+        # dense shadow of A alone (same 2-row batch shape)
+        dense_a = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_len=MAXLEN)
+        da, dha = dense_a.add_request(pa)
+        assert da == sa
+        np.testing.assert_array_equal(np.asarray(ha.numpy()),
+                                      np.asarray(dha.numpy()))
+
+        x = np.zeros((2, 1, D), np.float32)
+        x[sa, 0] = np.asarray(ha.numpy())[0]
+        x[sb, 0] = np.asarray(hb.numpy())[0]
+        xd = np.zeros((2, 1, D), np.float32)
+        xd[da, 0] = np.asarray(dha.numpy())[0]
+        preempt_seen = False
+        for _ in range(20):
+            o = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            od = np.asarray(dense_a.step(paddle.to_tensor(xd)).numpy())
+            # A must be untouched by B's presence OR eviction
+            np.testing.assert_array_equal(o[sa], od[da])
+            x = o[:, :1].copy()
+            xd = od[:, :1].copy()
+            if eng.preempted:
+                assert eng.preempted == [1]  # B (younger) evicted
+                eng.preempted.clear()
+                preempt_seen = True
+                assert [r.rid for r in eng.queue] == [1]
+        assert preempt_seen
+        req_b = eng.queue[0]
+        assert req_b.preemptions == 1
+        # B's recorded history covers prompt + every consumed input
+        assert len(req_b.history) == 14 + (32 - 14)
+
+        # release A -> continuous refill re-prefills B from history
+        eng.release(sa)
+        (rid, slot, hb2), = eng.admitted
+        eng.admitted.clear()
+        assert rid == 1 and eng.lens[slot] == len(req_b.history)
+
+        # dense engine fed B's FULL history as its prompt == the
+        # re-prefill contract (preemption is semantically a restart)
+        hist = paddle.to_tensor(np.stack(req_b.history))
+        dense_b = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_len=MAXLEN)
+        db, dhb = dense_b.add_request(hist)
+        np.testing.assert_array_equal(np.asarray(hb2.numpy()),
+                                      np.asarray(dhb.numpy()))
+        xp = np.zeros((2, 1, D), np.float32)
+        xd = np.zeros((2, 1, D), np.float32)
+        xp[slot, 0] = np.asarray(hb2.numpy())[0]
+        xd[db, 0] = np.asarray(dhb.numpy())[0]
+        for _ in range(4):
+            op = np.asarray(eng.step(paddle.to_tensor(xp)).numpy())
+            od = np.asarray(dense_b.step(paddle.to_tensor(xd)).numpy())
+            np.testing.assert_array_equal(op[slot], od[db])
+            xp, xd = op[:, :1].copy(), od[:, :1].copy()
+
+    def test_pool_too_small_raises(self):
+        model = _model()
+        rng = np.random.RandomState(3)
+        eng = PagedServingEngine(model, max_batch=1, block_size=8,
+                                 num_blocks=2, max_blocks_per_seq=4)
+        _admit(eng, _prompt(rng, 7))
+        x = paddle.to_tensor(np.zeros((1, 1, D), np.float32))
+        eng.step(x)  # 7 -> 8 still fits the single page
+        with pytest.raises(RuntimeError, match="pool too small"):
+            eng.step(x)  # needs a 2nd page, no victim available
+
+
+class TestSchedulerPolicy:
+    def test_strictly_more_concurrency_than_dense(self):
+        """ACCEPTANCE: under the same simulated HBM budget (identical
+        KV-pool bytes), the paged engine sustains strictly more
+        concurrent sequences than the dense engine."""
+        model = _model()
+        rng = np.random.RandomState(4)
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=MAXLEN)
+        # same token budget: 2 slots * 64 == 8 pages * 16
+        paged = PagedServingEngine(model, max_batch=8, block_size=BS,
+                                   num_blocks=8, max_blocks_per_seq=MB)
+        dense_bytes = sum(
+            int(np.prod(c.shape)) * 4 for c in dense.caches)
+        assert paged.cache.pool_bytes() <= dense_bytes
+
+        prompts = [_prompt(rng, 7) for _ in range(8)]
+        for p in prompts[:2]:
+            dense.add_request(p)
+        assert dense.free_slots == 0          # dense caps at 2
+        for p in prompts:
+            paged.submit(p)
+        # 7 usable pages -> 7 concurrent 7-token sequences; the 8th
+        # waits in the queue under block-budget admission control
+        assert paged.num_active == 7
+        assert paged.num_active > dense.max_batch  # strict
+        assert len(paged.queue) == 1
+
+        x = paddle.to_tensor(np.zeros((8, 1, D), np.float32))
+        o = paged.step(x)                     # all 7 advance together
+        assert o is not None and list(o.shape) == [8, 1, D]
+        assert int(paged.lens[paged.active].min()) == 8
+
+        # releasing one slot refills from the queue (continuous refill)
+        victim = int(np.flatnonzero(paged.active)[0])
+        paged.release(victim)
+        assert paged.num_active == 7 and not paged.queue
+
+    def test_capacity_finish_reported_not_stalling(self):
+        """A sequence at page capacity is auto-released + reported;
+        the rest of the batch keeps stepping (dense satellite twin)."""
+        model = _model()
+        rng = np.random.RandomState(5)
+        eng = PagedServingEngine(model, max_batch=2, block_size=8,
+                                 num_blocks=8, max_blocks_per_seq=2)
+        assert eng.max_len == 16
+        sa, ha = _admit(eng, _prompt(rng, 12))
+        sb, hb = _admit(eng, _prompt(rng, 8))
+        x = np.zeros((2, 1, D), np.float32)
+        x[sa, 0] = np.asarray(ha.numpy())[0]
+        x[sb, 0] = np.asarray(hb.numpy())[0]
+        for _ in range(4):                    # A: 12 -> 16 (capacity)
+            o = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            x = o[:, :1].copy()
+        assert not eng.finished
+        out = eng.step(paddle.to_tensor(x))   # A retired, B steps on
+        assert out is not None
+        assert eng.finished == [(0, sa, 16)]
+        assert not eng.active[sa] and eng.active[sb]
+        assert eng.lens[sb] == 13
+        # freed pages are back in the pool
+        assert eng.cache.seq_blocks[sa] == []
+
+    def test_guards(self):
+        model = _model()
+        rng = np.random.RandomState(6)
+        eng = PagedServingEngine(model, max_batch=1, block_size=8,
+                                 num_blocks=8, max_blocks_per_seq=2)
+        with pytest.raises(RuntimeError):
+            eng.step(paddle.to_tensor(np.zeros((1, 1, D), np.float32)))
+        with pytest.raises(ValueError):
+            eng.submit(_prompt(rng, 17))      # > 2 pages * 8
+
+
+class TestSharedPrefixCOW:
+    def test_fork_shares_then_copies_on_write(self):
+        """Refcounted shared-prefix pages: a fork shares the prefix
+        blocks; the first divergent append splits the shared page
+        copy-on-write, and both rows then decode bit-identically to a
+        dense engine given the same prompt twice."""
+        model = _model()
+        rng = np.random.RandomState(7)
+        prompt = _prompt(rng, 14)
+
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB)
+        scratch = model.gen_cache(1, MAXLEN)
+        with paddle.no_grad():
+            _, rc = model(prompt.unsqueeze(0), caches=scratch,
+                          time_step=0)
+        cache.ensure(0, 14)
+        cache.write_prefill(0, rc, 14)
+        cache.fork(0, 1, 14)
+        shared = cache.seq_blocks[0][0]
+        assert cache.seq_blocks[1] == [shared]
+        assert cache.allocator.refcount[shared] == 2
+
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=MAXLEN)
+        dense.add_request(prompt)
+        dense.add_request(prompt)
+
+        lens = np.array([14, 14], np.int32)
+        x = np.asarray(rng.randn(2, 1, D), np.float32)  # divergent
+        for step in range(4):
+            for slot in (0, 1):
+                cache.ensure(slot, int(lens[slot]) + 1)
+            if step == 0:
+                # first divergent write split the shared page
+                assert cache.seq_blocks[0][0] != cache.seq_blocks[1][0]
+                assert cache.allocator.refcount[shared] == 1
+            xt = paddle.to_tensor(x)
+            with paddle.no_grad():
+                out, _ = model(xt, caches=cache.views,
+                               time_step=paddle.to_tensor(lens))
+            od = dense.step(xt)
+            lens += 1
+            np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                          np.asarray(od.numpy()))
+            x = np.asarray(out.numpy())[:, :1].copy()
+
+    def test_write_prefill_splits_shared_blocks(self):
+        """write_prefill rewrites every covered page wholesale, so a
+        fork-shared page must be split first — otherwise the prefill
+        would leak into the peer sequence through the shared block."""
+        model = _model()
+        rng = np.random.RandomState(8)
+        prompt = _prompt(rng, 14)
+        other = _prompt(rng, 10)
+
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB)
+        scratch = model.gen_cache(1, MAXLEN)
+        with paddle.no_grad():
+            _, rc = model(prompt.unsqueeze(0), caches=scratch,
+                          time_step=0)
+        cache.ensure(0, 14)
+        cache.write_prefill(0, rc, 14)
+        cache.fork(0, 1, 14)
+        shared = cache.seq_blocks[0][0]
+        # re-prefill slot 1 with DIFFERENT content over the shared page
+        with paddle.no_grad():
+            _, rc2 = model(other.unsqueeze(0), caches=scratch,
+                           time_step=0)
+        cache.ensure(1, 10)
+        cache.write_prefill(1, rc2, 10)
+        assert cache.seq_blocks[1][0] != shared
+        assert cache.allocator.refcount[shared] == 1
+
+        # slot 0 must decode as if the fork never happened
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=MAXLEN)
+        dense.add_request(prompt)
+        lens = np.array([14, 10], np.int32)
+        x = np.asarray(rng.randn(2, 1, D), np.float32)
+        for _ in range(3):
+            for slot in (0, 1):
+                cache.ensure(slot, int(lens[slot]) + 1)
+            xt = paddle.to_tensor(x)
+            with paddle.no_grad():
+                out, _ = model(xt, caches=cache.views,
+                               time_step=paddle.to_tensor(lens))
+            od = dense.step(xt)
+            lens += 1
+            np.testing.assert_array_equal(
+                np.asarray(out.numpy())[0], np.asarray(od.numpy())[0])
+            x = np.asarray(out.numpy())[:, :1].copy()

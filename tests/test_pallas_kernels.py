@@ -18,6 +18,8 @@ from paddle_tpu.ops.pallas.fused_norm import (
     fused_rms_norm_residual)
 from paddle_tpu.ops.pallas.grouped_gemm import (
     gmm, gmm_reference, make_group_metadata)
+from paddle_tpu.ops.pallas.paged_attention import (
+    gather_pages, paged_attention, paged_attention_reference)
 
 rng = np.random.default_rng(0)
 
@@ -211,6 +213,60 @@ class TestGroupedGemm:
         offsets, be, total = make_group_metadata([5, 8, 0, 1], block_m=8)
         assert total == 24 and list(offsets) == [0, 8, 16, 16, 24]
         assert list(be) == [0, 1, 3]
+
+
+class TestPagedAttention:
+    """Ragged paged-attention decode: KV pages gathered through a block
+    table (PAPERS.md arxiv 2604.15464). Same online softmax as
+    decode_attention; the cache axis is indirected through the table."""
+
+    def _pool(self, NB, nkv, bs, hd):
+        return _rand(NB, 2, nkv, bs, hd)
+
+    @pytest.mark.parametrize("nh,nkv", [(8, 4), (4, 4)])
+    def test_matches_reference(self, nh, nkv):
+        B, hd, bs, MB, NB = 3, 32, 16, 4, 12
+        q = _rand(B, nh, hd)
+        pool = self._pool(NB, nkv, bs, hd)
+        bt = jnp.asarray(rng.integers(0, NB, (B, MB)), jnp.int32)
+        lens = jnp.asarray([5, 64, 17], jnp.int32)  # partial/full/mid
+        out = paged_attention(q, pool, bt, lens)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(paged_attention_reference(q, pool, bt, lens)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_matches_dense_decode_on_gathered_pages(self):
+        """Paged over a table == dense decode over the gathered cache:
+        the block indirection must be a pure layout change."""
+        B, nh, hd, bs, MB, NB = 2, 4, 16, 8, 4, 9
+        q = _rand(B, nh, hd)
+        pool = self._pool(NB, nh, bs, hd)
+        bt = jnp.asarray(rng.integers(0, NB, (B, MB)), jnp.int32)
+        lens = jnp.asarray([9, 32], jnp.int32)
+        k, v = gather_pages(pool, bt)
+        np.testing.assert_allclose(
+            np.asarray(paged_attention(q, pool, bt, lens)),
+            np.asarray(decode_attention(q, k, v, lens, block_s=bs)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_trash_block_rows_masked(self):
+        """Table entries past a row's length point at block 0 (the
+        reserved trash block); its garbage must not leak into the
+        output, and block-boundary lengths must be exact."""
+        B, nh, hd, bs, MB, NB = 2, 4, 16, 8, 3, 6
+        q = _rand(B, nh, hd)
+        pool = self._pool(NB, nh, bs, hd)
+        # row 0: one real block then trash; row 1: exactly two blocks
+        bt = jnp.asarray([[3, 0, 0], [4, 5, 0]], jnp.int32)
+        lens = jnp.asarray([8, 16], jnp.int32)
+        out = paged_attention(q, pool, bt, lens)
+        k, v = gather_pages(pool, bt)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(decode_attention_reference(q, k, v, lens)),
+            atol=1e-5, rtol=1e-5)
+        assert np.all(np.isfinite(np.asarray(out)))
 
 
 class TestDecodeAttention:

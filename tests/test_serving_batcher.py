@@ -119,6 +119,64 @@ def test_engine_guards():
             rng.randn(MAXLEN + 1, D).astype(np.float32)))
 
 
+def test_prefill_scratch_reused_across_admissions():
+    """add_request must not allocate a fresh gen_cache per admission:
+    one persistent single-row scratch is reused (stale tail positions
+    are masked by time_step, so reuse is exact — the parity tests
+    above run through the reused scratch)."""
+    model = _model()
+    calls = []
+    orig = model.gen_cache
+
+    def counting(*a, **kw):
+        calls.append(a)
+        return orig(*a, **kw)
+
+    model.gen_cache = counting
+    try:
+        eng = ContinuousBatchingEngine(model, max_batch=3,
+                                       max_len=MAXLEN)
+        rng = np.random.RandomState(7)
+        for n in (4, 2, 6):
+            eng.add_request(paddle.to_tensor(
+                rng.randn(n, D).astype(np.float32)))
+    finally:
+        model.gen_cache = orig
+    # one batch cache + ONE scratch, not one scratch per admission
+    assert len(calls) == 2
+    assert eng._scratch is not None
+
+
+def test_finished_slot_released_not_stalling():
+    """A slot at max_len no longer hard-errors the whole batch: it is
+    auto-released into ``finished`` and the other slots keep going."""
+    model = _model()
+    rng = np.random.RandomState(8)
+    eng = ContinuousBatchingEngine(model, max_batch=2, max_len=8)
+    sa, ha = eng.add_request(paddle.to_tensor(
+        rng.randn(6, D).astype(np.float32)))
+    sb, hb = eng.add_request(paddle.to_tensor(
+        rng.randn(3, D).astype(np.float32)))
+    x = np.zeros((2, 1, D), np.float32)
+    x[sa, 0] = np.asarray(ha.numpy())[0]
+    x[sb, 0] = np.asarray(hb.numpy())[0]
+    for _ in range(2):                   # A: 6 -> 8 == max_len
+        o = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+        x = o[:, :1].copy()
+    assert not eng.finished
+    out = eng.step(paddle.to_tensor(x))  # A retired, B advances
+    assert out is not None
+    assert eng.finished == [sa]
+    assert not eng.active[sa] and eng.active[sb]
+    assert eng.lens[sb] == 6
+    # B runs to max_len alone; the final step drains to an empty batch
+    for _ in range(2):
+        out = eng.step(paddle.to_tensor(x))
+    assert out is not None and eng.lens[sb] == 8
+    assert eng.step(paddle.to_tensor(x)) is None
+    assert eng.finished == [sa, sb] and eng.free_slots == 2
+
+
 def test_reference_shape1_time_step_still_scalar():
     # the reference documents time_step as a shape-[1] Tensor; it must
     # take the scalar path (not ragged) at any batch size
