@@ -167,15 +167,18 @@ class FusedMultiTransformer(nn.Layer):
                 for _ in range(self.num_layers)]
 
     def gen_paged_cache(self, block_size, num_blocks, max_seqs,
-                        max_blocks_per_seq=None, dtype="float32"):
+                        max_blocks_per_seq=None, dtype="float32",
+                        prefix_cache=False):
         """Block-paged alternative to gen_cache: returns a PagedKVCache
         whose ``.views`` list rides in the same ``caches=`` argument —
         the cache layout is a protocol, not a tensor shape (see
-        inference/paged_cache.py)."""
+        inference/paged_cache.py). ``prefix_cache`` turns on the
+        cross-request chained-hash block index + cached-free tier."""
         from ...inference.paged_cache import PagedKVCache
         return PagedKVCache.for_model(
             self, block_size, num_blocks, max_seqs,
-            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
+            prefix_cache=prefix_cache)
 
     def _proj(self, i, blk, name, x):
         """Linear-projection hook; the int8 subclass overrides this."""

@@ -31,15 +31,18 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-from .serving import ContinuousBatchingEngine  # noqa: F401
+from .serving import (ContinuousBatchingEngine,  # noqa: F401
+                      PrefixCacheStats)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
-                          PagedKVCache, PagedLayerCache)
+                          PagedKVCache, PagedLayerCache,
+                          chain_block_hashes, chain_hash)
 from .scheduler import PagedRequest, PagedServingEngine  # noqa: F401
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
            "BlockOOM", "PagedKVCache", "PagedLayerCache",
-           "PagedRequest", "PagedServingEngine"]
+           "PagedRequest", "PagedServingEngine", "PrefixCacheStats",
+           "chain_block_hashes", "chain_hash"]
 
 
 class PrecisionType:

@@ -20,10 +20,27 @@ Block 0 of every pool is reserved as the TRASH block: inactive batch
 rows in a fused decode step scatter their (ignored) k/v there, and
 block-table entries past a sequence's allocation point at it so the
 kernel's gather always reads a valid pool row (masked by length).
+
+Cross-request PREFIX CACHING (``prefix_cache=True``) layers a content
+index over the pool: every full prompt block gets a chained hash
+``h_i = H(h_{i-1}, tokens_in_block_i)`` (vLLM-style block identity —
+the chain makes the hash position- and prefix-dependent, so a match on
+h_i proves the whole prefix up to block i is identical). A new
+request's prompt is matched block-by-block against the index
+(``match_prefix``/``adopt_prefix``) and shares the hit pages by
+refcount — the existing copy-on-write split handles later divergence.
+Freed blocks whose hash is still indexed don't return to the free
+list: they park in a CACHED-FREE second-chance tier
+(``release_to_cache``), resurrectable on a later hit, and are
+reclaimed least-recently-used only when the free list runs dry. Block
+lifecycle: free -> active -> cached-free -> (resurrect -> active |
+reclaim -> free).
 """
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -32,7 +49,30 @@ from ..framework.op import apply
 from ..framework.tensor import Tensor
 
 __all__ = ["BlockOOM", "BlockAllocator", "PagedKVCache",
-           "PagedLayerCache"]
+           "PagedLayerCache", "chain_hash", "chain_block_hashes"]
+
+
+def chain_hash(parent: bytes, block_tokens) -> bytes:
+    """One link of the block-identity chain: hash of the parent block's
+    chained hash + this block's token content (prompt rows are
+    embeddings here, so content identity is float32 byte identity)."""
+    arr = np.ascontiguousarray(np.asarray(block_tokens, np.float32))
+    return hashlib.blake2b(parent + arr.tobytes(),
+                           digest_size=16).digest()
+
+
+def chain_block_hashes(tokens, block_size: int,
+                       parent: bytes = b"") -> List[bytes]:
+    """Chained hashes for every FULL block of ``tokens`` ([T, ...]).
+    Partial trailing blocks are never indexed — their content is not
+    yet block-identity-stable (the owner keeps appending into them)."""
+    arr = np.asarray(tokens)
+    out: List[bytes] = []
+    h = parent
+    for i in range(arr.shape[0] // block_size):
+        h = chain_hash(h, arr[i * block_size:(i + 1) * block_size])
+        out.append(h)
+    return out
 
 
 class BlockOOM(RuntimeError):
@@ -43,27 +83,53 @@ class BlockAllocator:
     """Free-list allocator over pool rows 1..num_blocks-1 with
     refcounts (row 0 is the reserved trash block). Shared-prefix
     blocks hold refcount > 1 and are split copy-on-write by the
-    cache."""
+    cache.
 
-    def __init__(self, num_blocks: int):
+    With prefix caching the allocator grows a SECOND-CHANCE tier:
+    refcount-0 blocks whose content is still hash-indexed park in
+    ``_cached`` (cached-free) instead of the free list. They count as
+    free — ``alloc`` drains the true free list first, then reclaims
+    cached-free blocks least-recently-used, announcing each reclaim
+    through ``on_reclaim`` so the owner drops its index entry. A
+    BlockOOM therefore means BOTH tiers are dry (callers preempt)."""
+
+    def __init__(self, num_blocks: int, on_reclaim=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved)")
         self.num_blocks = int(num_blocks)
         # pop() from the end -> lowest ids first (stable tests)
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        # cached-free tier: insertion order == release order, so
+        # popitem(last=False) evicts the least-recently-released block
+        self._cached: "OrderedDict[int, bool]" = OrderedDict()
+        self.on_reclaim = on_reclaim
+        self.reclaimed = 0
         self.refcount = np.zeros(self.num_blocks, np.int32)
         self.refcount[0] = 1  # trash block: never allocated, never freed
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        return len(self._free) + len(self._cached)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._cached)
 
     def alloc(self, n: int = 1) -> List[int]:
-        if n > len(self._free):
-            raise BlockOOM(f"need {n} blocks, {len(self._free)} free")
-        blocks = [self._free.pop() for _ in range(n)]
-        for b in blocks:
+        if n > self.num_free:
+            raise BlockOOM(f"need {n} blocks, {self.num_free} free")
+        blocks = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                # LRU reclaim from the second-chance tier
+                b, _ = self._cached.popitem(last=False)
+                self.reclaimed += 1
+                if self.on_reclaim is not None:
+                    self.on_reclaim(b)
             self.refcount[b] = 1
+            blocks.append(b)
         return blocks
 
     def ref(self, blocks) -> None:
@@ -73,7 +139,10 @@ class BlockAllocator:
                 raise ValueError(f"ref of unallocated block {b}")
             self.refcount[b] += 1
 
-    def free(self, blocks) -> None:
+    def free(self, blocks, to_cache: bool = False) -> None:
+        """Drop one owner per block. A block reaching refcount 0 goes
+        to the free list — or, with ``to_cache``, to the cached-free
+        tier (still-indexed content, resurrectable on a prefix hit)."""
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is reserved")
@@ -81,7 +150,17 @@ class BlockAllocator:
                 raise ValueError(f"double free of block {b}")
             self.refcount[b] -= 1
             if self.refcount[b] == 0:
-                self._free.append(int(b))
+                if to_cache:
+                    self._cached[int(b)] = True
+                else:
+                    self._free.append(int(b))
+
+    def resurrect(self, block: int) -> None:
+        """cached-free -> active again (a prefix hit adopted it)."""
+        if block not in self._cached:
+            raise ValueError(f"block {block} is not cached-free")
+        del self._cached[block]
+        self.refcount[block] = 1
 
 
 # --- per-op impls at module scope: the factory closures carry only ----
@@ -108,16 +187,32 @@ def _block_copy(pool, src, dst):
     return pool.at[dst].set(pool[src])
 
 
-def _make_prefill_scatter(n_blocks, block_size):
+def _make_prefill_scatter(start_block, n_blocks, block_size):
     def paged_prefill_scatter(pool, row_cache, blks):
-        # row_cache [2, 1, H, S, D] (dense single-row scratch) -> the
-        # first n_blocks pages of this sequence
-        seg = row_cache[:, 0, :, :n_blocks * block_size, :]
+        # row_cache [2, 1, H, S, D] (dense single-row scratch) -> pages
+        # [start_block, start_block + n_blocks) of this sequence (a
+        # prefix-cache hit skips the shared prefix pages)
+        lo = start_block * block_size
+        seg = row_cache[:, 0, :, lo:lo + n_blocks * block_size, :]
         two, H, _, D = seg.shape
         seg = seg.reshape(two, H, n_blocks, block_size, D)
         seg = jnp.transpose(seg, (2, 0, 1, 3, 4))  # [n, 2, H, bs, D]
         return pool.at[blks].set(seg.astype(pool.dtype))
     return paged_prefill_scatter
+
+
+def _make_prefix_gather(n_blocks, block_size):
+    def paged_prefix_gather(row_cache, pool, blks):
+        # inverse of the prefill scatter: pages -> the dense scratch's
+        # rows [0, n_blocks * block_size) so a partial prefill can
+        # attend over the cached prefix
+        seg = jnp.transpose(pool[blks], (1, 2, 0, 3, 4))  # [2,H,n,bs,D]
+        two, H = seg.shape[0], seg.shape[1]
+        D = seg.shape[-1]
+        seg = seg.reshape(two, H, n_blocks * block_size, D)
+        return row_cache.at[:, 0, :, :n_blocks * block_size, :].set(
+            seg.astype(row_cache.dtype))
+    return paged_prefix_gather
 
 
 class PagedLayerCache:
@@ -219,7 +314,7 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  block_size: int, num_blocks: int, max_seqs: int,
                  max_blocks_per_seq: Optional[int] = None,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", prefix_cache: bool = False):
         import paddle_tpu as paddle
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
@@ -231,7 +326,14 @@ class PagedKVCache:
             max_blocks_per_seq = self.num_blocks - 1
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.dtype = dtype
-        self.allocator = BlockAllocator(self.num_blocks)
+        self.prefix_cache = bool(prefix_cache)
+        # chained-hash block index (prefix caching): both maps stay in
+        # lockstep — a block is indexed iff hash_to_block[h] == b and
+        # block_hash[b] == h. Reclaim drops both via _on_reclaim.
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        self.allocator = BlockAllocator(self.num_blocks,
+                                        on_reclaim=self._on_reclaim)
         self.pools: List[Tensor] = [
             paddle.zeros([self.num_blocks, 2, self.num_heads,
                           self.block_size, self.head_dim], dtype=dtype)
@@ -249,10 +351,12 @@ class PagedKVCache:
     # -- construction -------------------------------------------------
     @classmethod
     def for_model(cls, model, block_size, num_blocks, max_seqs,
-                  max_blocks_per_seq=None, dtype="float32"):
+                  max_blocks_per_seq=None, dtype="float32",
+                  prefix_cache=False):
         return cls(model.num_layers, model.num_heads, model.head_dim,
                    block_size, num_blocks, max_seqs,
-                   max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+                   max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
+                   prefix_cache=prefix_cache)
 
     # -- geometry -----------------------------------------------------
     @property
@@ -267,8 +371,10 @@ class PagedKVCache:
         return self.num_blocks - 1 - self.allocator.num_free
 
     def pool_bytes(self) -> int:
-        return sum(int(np.prod(p.shape))
-                   * np.dtype(str(p.dtype)).itemsize for p in self.pools)
+        # itemsize off the array's own dtype: np.dtype(str(...)) has no
+        # parse for ml_dtypes names, so a bfloat16 pool would raise
+        return sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
+                   for p in self.pools)
 
     def bt_tensor(self) -> Tensor:
         """Device copy of the block tables; rebuilt only after a
@@ -284,12 +390,17 @@ class PagedKVCache:
                                     self.blocks_in_use)
 
     # -- allocation ---------------------------------------------------
-    def ensure(self, slot: int, length: int) -> None:
+    def ensure(self, slot: int, length: int,
+               start_block: int = 0) -> None:
         """Grow slot's table to cover ``length`` tokens
         (allocate-on-write) and copy-on-write split the block the next
-        append lands in if it is shared. Raises BlockOOM when the pool
-        is exhausted (callers preempt) and ValueError past the per-seq
-        table capacity."""
+        append lands in if it is shared. ``start_block``: table
+        positions below it are adopted prefix pages the caller will
+        never write (suffix-only prefill) — the COW split is skipped
+        there, so a fully cached prompt keeps its last page shared
+        instead of paying a pointless pool copy. Raises BlockOOM when
+        the pool is exhausted (callers preempt) and ValueError past the
+        per-seq table capacity."""
         if length <= 0:
             return  # nothing to cover (and no write block to COW)
         need = self.blocks_needed(length)
@@ -306,12 +417,13 @@ class PagedKVCache:
             self._tables_dirty()
         # COW: the block the write at position length-1 lands in
         bpos = (int(length) - 1) // self.block_size
-        if self.allocator.refcount[have[bpos]] > 1:
+        if bpos >= start_block and \
+                self.allocator.refcount[have[bpos]] > 1:
             self._copy_block(slot, bpos)
 
     def free_seq(self, slot: int) -> None:
         if self.seq_blocks[slot]:
-            self.allocator.free(self.seq_blocks[slot])
+            self.release_to_cache(self.seq_blocks[slot])
             self.seq_blocks[slot] = []
             self.block_tables[slot, :] = 0
             self._tables_dirty()
@@ -340,16 +452,99 @@ class PagedKVCache:
             for i, pool in enumerate(self.pools):
                 self.pools[i] = apply(_block_copy, (pool, src, dst),
                                       op_name="paged_block_copy")
-        self.allocator.free([old])
+        self.release_to_cache([old])
         self.seq_blocks[slot][bpos] = new
         self.block_tables[slot, bpos] = new
         self._tables_dirty()
 
+    # -- prefix caching -----------------------------------------------
+    def _on_reclaim(self, block: int) -> None:
+        """Allocator reclaimed a cached-free block: its content is
+        about to be overwritten, drop the index entry."""
+        h = self._block_hash.pop(block, None)
+        if h is not None and self._hash_to_block.get(h) == block:
+            del self._hash_to_block[h]
+
+    def release_to_cache(self, blocks) -> None:
+        """Drop ownership of ``blocks``; indexed blocks reaching
+        refcount 0 park in the allocator's cached-free tier
+        (resurrectable on a later ``match_prefix`` hit) instead of
+        returning to the free list. Unindexed blocks (partial tails,
+        decode pages, or any block when ``prefix_cache`` is off) free
+        normally."""
+        for b in blocks:
+            self.allocator.free([b], to_cache=b in self._block_hash)
+
+    def match_prefix(self, hashes) -> List[int]:
+        """Longest indexed prefix of the hash chain -> pool block ids
+        (a pure lookup: no refcounts move; use ``adopt_prefix`` to take
+        ownership). A break in the chain ends the match — later links
+        hash over the missing parent, so they cannot be present."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def adopt_prefix(self, slot, hashes) -> int:
+        """Take shared ownership of the longest indexed prefix for an
+        empty slot: active blocks gain an owner (``ref``), cached-free
+        blocks are resurrected. Returns the number of blocks adopted —
+        the caller prefills only tokens past ``n * block_size``."""
+        if not self.prefix_cache:
+            return 0
+        if self.seq_blocks[slot]:
+            raise ValueError(f"slot {slot} already allocated")
+        matched = self.match_prefix(hashes)
+        for b in matched:
+            if self.allocator.refcount[b] > 0:
+                self.allocator.ref([b])
+            else:
+                self.allocator.resurrect(b)
+        if matched:
+            self.seq_blocks[slot] = list(matched)
+            self.block_tables[slot, :len(matched)] = matched
+            self._tables_dirty()
+        return len(matched)
+
+    def register_prefix(self, slot, hashes) -> None:
+        """Index the slot's first ``len(hashes)`` blocks under their
+        chain hashes (first writer wins: a hash already indexed keeps
+        its original block — both hold identical content, and 1:1
+        block<->hash bookkeeping is what reclaim relies on)."""
+        if not self.prefix_cache:
+            return
+        for h, b in zip(hashes, self.seq_blocks[slot]):
+            b = int(b)
+            if h in self._hash_to_block or b in self._block_hash:
+                continue
+            self._hash_to_block[h] = b
+            self._block_hash[b] = h
+
+    def load_prefix(self, slot: int, n_blocks: int, row_caches):
+        """Gather the slot's first ``n_blocks`` pages into the dense
+        single-row scratch's positions [0, n_blocks * block_size) (per
+        layer), so a suffix-only prefill at time_step = cached tokens
+        attends over the cached prefix. Returns the updated scratch
+        Tensors."""
+        blks = Tensor(jnp.asarray(self.seq_blocks[slot][:n_blocks],
+                                  jnp.int32))
+        impl = _make_prefix_gather(n_blocks, self.block_size)
+        return [apply(impl, (rc, pool, blks),
+                      op_name="paged_prefix_gather")
+                for rc, pool in zip(row_caches, self.pools)]
+
     # -- prefill ------------------------------------------------------
-    def write_prefill(self, slot: int, row_caches, length: int) -> None:
+    def write_prefill(self, slot: int, row_caches, length: int,
+                      start_block: int = 0) -> None:
         """Scatter a dense single-row scratch cache (the per-layer
         [2, 1, H, S, D] Tensors a batch-1 prefill produced) into this
-        slot's pages. ensure(slot, length) must have run first."""
+        slot's pages from ``start_block`` on — an ``adopt_prefix`` hit
+        passes the number of adopted blocks so the shared prefix pages
+        are neither rewritten nor COW-split. ensure(slot, length) must
+        have run first."""
         n = self.blocks_needed(length)
         if n > len(self.seq_blocks[slot]):
             raise ValueError("ensure() the slot before write_prefill")
@@ -357,11 +552,15 @@ class PagedKVCache:
         # fork-shared block in range must be split first (no pool copy
         # needed — its contents are about to be replaced) or the peer
         # sequence would read this prefill through the shared page
-        for bpos in range(n):
+        for bpos in range(start_block, n):
             if self.allocator.refcount[self.seq_blocks[slot][bpos]] > 1:
                 self._copy_block(slot, bpos, copy=False)
-        blks = Tensor(jnp.asarray(self.seq_blocks[slot][:n], jnp.int32))
-        impl = _make_prefill_scatter(n, self.block_size)
+        if start_block >= n:
+            return  # fully cached prompt: every page already written
+        blks = Tensor(jnp.asarray(self.seq_blocks[slot][start_block:n],
+                                  jnp.int32))
+        impl = _make_prefill_scatter(start_block, n - start_block,
+                                     self.block_size)
         for i, (pool, rc) in enumerate(zip(self.pools, row_caches)):
             self.pools[i] = apply(impl, (pool, rc, blks),
                                   op_name="paged_prefill_scatter")

@@ -20,6 +20,13 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     re-admission reproduces its cache exactly.
   * refill: releases/preemptions re-run admission, so the batch stays
     full without stopping in-flight rows.
+  * prefix caching (``prefix_cache=True``): admission matches the
+    prompt's chained block hashes against previously computed pages
+    (paged_cache.match_prefix), ``ref``s the hits into the new slot's
+    table, and prefills ONLY the uncached suffix — cached prefix
+    tokens cost zero prefill FLOPs and zero new blocks. Released
+    pages park cached-free (resurrectable) until LRU reclaim; hit
+    accounting rides in ``prefix_stats``.
 
 Events are surfaced in ``admitted`` / ``finished`` / ``preempted``
 lists the caller drains between steps (prefill outputs ride along so
@@ -34,7 +41,8 @@ import numpy as np
 
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
-from .paged_cache import BlockOOM, PagedKVCache
+from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
+from .serving import PrefixCacheStats
 
 __all__ = ["PagedRequest", "PagedServingEngine"]
 
@@ -42,30 +50,70 @@ __all__ = ["PagedRequest", "PagedServingEngine"]
 class PagedRequest:
     """One sequence. ``history`` is every embedding row the model has
     consumed for it (prompt rows + each decode-step input row): exactly
-    what a re-prefill needs to rebuild the evicted cache."""
+    what a re-prefill needs to rebuild the evicted cache. It is ONE
+    growable [T, d_model] ndarray (amortized append), not a list of
+    rows — re-admission previously paid an O(T) np.stack on every
+    prefill and a per-row list append on every history flush."""
 
     def __init__(self, rid: int, history: np.ndarray):
         self.rid = rid
-        self.history = [np.asarray(r, np.float32) for r in history]
+        arr = np.array(history, np.float32, copy=True)
+        if arr.ndim != 2:
+            raise ValueError("history must be [T, d_model] rows")
+        self._hist = arr
+        self._len = arr.shape[0]
+        # chain hashes are append-only like the history: memoized and
+        # extended in place, never recomputed across re-admissions
+        self._hashes: List[bytes] = []
         self.slot: Optional[int] = None
         self.admit_seq = -1
         self.preemptions = 0
 
+    @property
+    def history(self) -> np.ndarray:
+        """[T, d_model] view of every consumed row (no copy)."""
+        return self._hist[:self._len]
+
+    def append_history(self, row) -> None:
+        if self._len == self._hist.shape[0]:
+            grown = np.empty((max(8, 2 * self._hist.shape[0]),
+                              self._hist.shape[1]), np.float32)
+            grown[:self._len] = self._hist[:self._len]
+            self._hist = grown
+        self._hist[self._len] = row
+        self._len += 1
+
+    def block_hashes(self, block_size: int) -> List[bytes]:
+        """Chained hashes of every FULL block of the history (the
+        prompt-hash identity the prefix cache indexes by)."""
+        n_full = self._len // block_size
+        have = len(self._hashes)
+        if have < n_full:
+            self._hashes.extend(chain_block_hashes(
+                self._hist[have * block_size:n_full * block_size],
+                block_size,
+                parent=self._hashes[-1] if self._hashes else b""))
+        return self._hashes[:n_full]
+
     def __len__(self):
-        return len(self.history)
+        return self._len
 
 
 class PagedServingEngine:
     def __init__(self, model, max_batch: int, block_size: int,
                  num_blocks: int, max_blocks_per_seq: Optional[int] = None,
-                 dtype: str = "float32", watermark_blocks: int = 0):
+                 dtype: str = "float32", watermark_blocks: int = 0,
+                 prefix_cache: bool = False):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
         self.watermark_blocks = int(watermark_blocks)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_stats = PrefixCacheStats()
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
-            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype)
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
+            prefix_cache=prefix_cache)
         self.max_len = self.cache.capacity_per_seq
         self.lens = np.zeros(self.max_batch, np.int32)
         self.active = np.zeros(self.max_batch, bool)
@@ -96,7 +144,13 @@ class PagedServingEngine:
 
     @property
     def free_blocks(self) -> int:
+        """Allocatable blocks: the true free list PLUS the cached-free
+        second-chance tier (reclaimable on demand)."""
         return self.cache.allocator.num_free
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return self.prefix_stats.hit_rate
 
     # -- admission ----------------------------------------------------
     def submit(self, prompt) -> int:
@@ -128,6 +182,15 @@ class PagedServingEngine:
             # livelock)
             need = self.cache.blocks_needed(
                 min(len(req) + 1, self.max_len))
+            if self.prefix_cache:
+                # actively shared prefix hits cost no pool draw at all;
+                # cached-free hits come out of free_blocks (a resurrect
+                # consumes one free unit, same as an alloc) so only the
+                # active ones discount `need`
+                matched = self.cache.match_prefix(
+                    req.block_hashes(self.cache.block_size))
+                rc = self.cache.allocator.refcount
+                need -= sum(1 for b in matched if rc[b] > 0)
             if need + self.watermark_blocks > self.free_blocks:
                 return  # head-of-line blocks; keep FIFO fairness
             self.queue.popleft()
@@ -137,19 +200,49 @@ class PagedServingEngine:
         import paddle_tpu as paddle
         slot = int(np.flatnonzero(~self.active)[0])
         T = len(req)
+        bs = self.cache.block_size
+        hashes: List[bytes] = []
+        n_cached = 0
+        if self.prefix_cache:
+            hashes = req.block_hashes(bs)
+            n_cached = self.cache.adopt_prefix(slot, hashes)
+            self.prefix_stats.lookups += 1
+            self.prefix_stats.lookup_blocks += len(hashes)
+            self.prefix_stats.hit_blocks += n_cached
+        # cached tokens skip prefill entirely, but the recomputed
+        # suffix keeps at least TWO rows: a fully cached prompt must
+        # still produce its last hidden for the admission event, and a
+        # 1-row attention lowers to a GEMV whose accumulation order
+        # differs from the same row inside a multi-row prefill —
+        # bit-identity with the cold path would break
+        P = max(0, min(n_cached * bs, T - 2)) if n_cached else 0
         if self._scratch is None:
             self._scratch = self.model.gen_cache(1, self.max_len,
                                                  dtype=self.dtype)
-        x = paddle.to_tensor(np.stack(req.history)[None]
-                             .astype(np.float32))
+        if n_cached:
+            # seed the scratch with the cached prefix K/V so the
+            # suffix attends over it (partial prefill at time_step=P)
+            self._scratch = self.cache.load_prefix(slot, n_cached,
+                                                   self._scratch)
+        x = paddle.to_tensor(req.history[P:][None])
         # serving never backprops: without no_grad the tape would pin
-        # every superseded scratch/pool version across the loop
+        # every superseded scratch/pool version across the loop.
+        # time_step as a TENSOR scalar routes to the full-extent masked
+        # attention (same convention as ContinuousBatchingEngine):
+        # prefill reductions see ONE extent regardless of prompt
+        # length, so pages computed under any prompt are bit-exact
+        # reusable by any later prompt sharing the prefix
         with no_grad():
             out, row_caches = self.model(x, caches=self._scratch,
-                                         time_step=0)
+                                         time_step=Tensor(np.int32(P)))
         self._scratch = row_caches  # persistent: reused next admission
-        self.cache.ensure(slot, T)
-        self.cache.write_prefill(slot, row_caches, T)
+        self.cache.ensure(slot, T, start_block=n_cached)
+        self.cache.write_prefill(slot, row_caches, T,
+                                 start_block=n_cached)
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, hashes)
+            self.prefix_stats.tokens_computed += T - P
+            self.prefix_stats.tokens_skipped += P
         self.lens[slot] = T
         self.active[slot] = True
         self._requests[slot] = req
@@ -176,7 +269,7 @@ class PagedServingEngine:
             for slot in np.flatnonzero(mask):
                 req = self._requests[int(slot)]
                 if req is not None:
-                    req.history.append(xv[int(slot), 0].copy())
+                    req.append_history(xv[int(slot), 0])
 
     def _drop(self, slot: int) -> None:
         self._flush_history()

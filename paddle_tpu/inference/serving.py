@@ -26,7 +26,49 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-__all__ = ["ContinuousBatchingEngine"]
+__all__ = ["ContinuousBatchingEngine", "PrefixCacheStats"]
+
+
+class PrefixCacheStats:
+    """Serving-surface accounting for the cross-request prefix cache
+    (PagedServingEngine(prefix_cache=True)): block-level hit rate and
+    the prefill work the cache saved. One instance per engine, read by
+    benches/dashboards; counters only ever grow."""
+
+    __slots__ = ("lookups", "lookup_blocks", "hit_blocks",
+                 "tokens_skipped", "tokens_computed")
+
+    def __init__(self):
+        self.lookups = 0         # admissions that probed the index
+        self.lookup_blocks = 0   # full prompt blocks eligible to hit
+        self.hit_blocks = 0      # blocks shared instead of allocated
+        self.tokens_skipped = 0  # prompt tokens whose prefill was skipped
+        self.tokens_computed = 0  # prompt tokens actually prefilled
+
+    @property
+    def blocks_saved(self) -> int:
+        """Pages neither allocated nor prefilled thanks to sharing."""
+        return self.hit_blocks
+
+    @property
+    def hit_rate(self) -> float:
+        if self.lookup_blocks == 0:
+            return 0.0
+        return self.hit_blocks / self.lookup_blocks
+
+    def as_dict(self) -> dict:
+        return {"lookups": self.lookups,
+                "lookup_blocks": self.lookup_blocks,
+                "hit_blocks": self.hit_blocks,
+                "blocks_saved": self.blocks_saved,
+                "hit_rate": round(self.hit_rate, 4),
+                "tokens_skipped": self.tokens_skipped,
+                "tokens_computed": self.tokens_computed}
+
+    def __repr__(self):
+        return (f"PrefixCacheStats(hit_rate={self.hit_rate:.2%}, "
+                f"blocks_saved={self.blocks_saved}, "
+                f"tokens_skipped={self.tokens_skipped})")
 
 
 class ContinuousBatchingEngine:
@@ -73,11 +115,16 @@ class ContinuousBatchingEngine:
             self._scratch = self.model.gen_cache(1, self.max_len,
                                                  dtype=self.dtype)
         # serving never backprops: without no_grad the tape would pin
-        # every superseded cache version across the decode loop
+        # every superseded cache version across the decode loop.
+        # time_step rides as a TENSOR scalar so prefill attends over
+        # the scratch's FULL extent with a validity mask (not the
+        # int-t [:T] slice): reductions then have one extent for every
+        # prompt length, keeping prefill numerics length-independent —
+        # the property cross-request prefix reuse is bit-exact under
         with no_grad():
-            out, row_caches = self.model(prompt.unsqueeze(0),
-                                         caches=self._scratch,
-                                         time_step=0)
+            out, row_caches = self.model(
+                prompt.unsqueeze(0), caches=self._scratch,
+                time_step=Tensor(np.int32(0)))
         self._scratch = row_caches  # reuse the buffers next admission
         for c, row in zip(self.caches, row_caches):
             c._data = c.data.at[:, slot].set(row.data[:, 0])

@@ -1,0 +1,29 @@
+"""The serving bench legs in --smoke mode: tiny shapes inside the
+tier-1 time budget, so the bench path (engine wiring, stats surface,
+JSON fields) can't silently rot between bench rounds."""
+import os
+import sys
+
+import numpy as np  # noqa: F401  (bench legs expect it importable)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench_extra  # noqa: E402
+
+
+def test_serving_prefix_smoke_leg():
+    res = bench_extra.bench_serving_prefix(smoke=True)
+    assert res["metric"] == "serving_prefix_cache_shared_system_prompt"
+    # acceptance: >= 80% block hit rate after warmup on the shared-
+    # system-prompt workload, and measurably less prefill compute
+    assert res["prefix"]["hit_rate_pct"] >= 80.0
+    assert (res["prefix"]["prefill_tokens_computed"]
+            < res["cold"]["prefill_tokens_computed"])
+    assert (res["prefix"]["prefill_tokens_skipped"]
+            + res["prefix"]["prefill_tokens_computed"]
+            == res["cold"]["prefill_tokens_computed"])
+    assert res["prefix"]["blocks_saved"] > 0
+    # both paths generated every requested token
+    assert res["cold"]["decode_steps"] > 0
+    assert res["prefix"]["decode_steps"] > 0

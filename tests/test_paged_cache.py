@@ -14,7 +14,7 @@ import paddle_tpu as paddle
 from paddle_tpu.incubate.nn import FusedMultiTransformer
 from paddle_tpu.inference import (BlockAllocator, BlockOOM,
                                   ContinuousBatchingEngine,
-                                  PagedServingEngine)
+                                  PagedKVCache, PagedServingEngine)
 
 D, HEADS, FFN, LAYERS = 32, 4, 64, 2
 BS, MB = 16, 4            # 16-token pages, 4 pages/seq
@@ -72,6 +72,76 @@ class TestBlockAllocator:
         with pytest.raises(ValueError):
             a.free([0])
         assert 0 not in a.alloc(3)  # trash block never handed out
+
+    def test_error_paths(self):
+        """Misuse must fail loudly, not corrupt the refcounts: ref of a
+        block nobody owns, double free, and freeing after the last
+        owner left."""
+        a = BlockAllocator(6)
+        with pytest.raises(ValueError, match="ref of unallocated"):
+            a.ref([3])                 # never allocated
+        b = a.alloc(1)
+        a.free(b)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(b)
+        with pytest.raises(ValueError, match="ref of unallocated"):
+            a.ref(b)                   # freed: no owner to share with
+        assert a.num_free == 5         # failed calls changed nothing
+
+    def test_fork_write_prefill_cow_split_rewires_not_copies(self):
+        """fork -> write_prefill on the shared block takes the
+        copy=False COW split: the writer gets a fresh page (its content
+        is about to be fully rewritten, so no pool copy), the peer
+        keeps the original, and the refcounts return to 1/1."""
+        model = _model()
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB)
+        scratch = model.gen_cache(1, MAXLEN)
+        rng = np.random.RandomState(11)
+        with paddle.no_grad():
+            _, rc = model(_prompt(rng, 10).unsqueeze(0), caches=scratch,
+                          time_step=0)
+        cache.ensure(0, 10)
+        cache.write_prefill(0, rc, 10)
+        shared = cache.seq_blocks[0][0]
+        cache.fork(0, 1, 10)
+        assert cache.allocator.refcount[shared] == 2
+        before = np.asarray(cache.pools[0].numpy())[shared].copy()
+        with paddle.no_grad():
+            _, rc2 = model(_prompt(rng, 9).unsqueeze(0), caches=scratch,
+                           time_step=0)
+        cache.ensure(1, 9)
+        cache.write_prefill(1, rc2, 9)
+        new = cache.seq_blocks[1][0]
+        assert new != shared
+        assert cache.allocator.refcount[shared] == 1   # slot 0 only
+        assert cache.allocator.refcount[new] == 1      # slot 1 only
+        assert cache.block_tables[1, 0] == new
+        # peer's page was never touched by the split or the prefill
+        np.testing.assert_array_equal(
+            np.asarray(cache.pools[0].numpy())[shared], before)
+
+
+class TestBf16Pool:
+    def test_bf16_pool_bytes_and_decode_smoke(self):
+        """pool_bytes crashed on bfloat16 pools (np.dtype(str(...))
+        can't parse ml_dtypes names); it must report 2 bytes/elem, and
+        the paged append/decode path must run on a bf16 pool (appends
+        cast to the pool dtype)."""
+        hd = D // HEADS
+        cache = PagedKVCache(1, HEADS, hd, block_size=8, num_blocks=4,
+                             max_seqs=1, dtype="bfloat16")
+        assert cache.pool_bytes() == 4 * 2 * HEADS * 8 * hd * 2
+        cache.ensure(0, 1)
+        rng = np.random.RandomState(12)
+        q, k, v = (paddle.to_tensor(rng.randn(1, 1, HEADS, hd)
+                                    .astype(np.float32))
+                   for _ in range(3))
+        out = cache.views[0].decode(q, k, v,
+                                    np.zeros(1, np.int32))
+        assert list(out.shape) == [1, 1, HEADS, hd]
+        assert np.isfinite(np.asarray(out.numpy())).all()
+        assert str(cache.pools[0].dtype) == "bfloat16"
 
 
 class TestPagedDenseParity:
@@ -326,8 +396,11 @@ class TestSharedPrefixCOW:
                                       max_seqs=2, max_blocks_per_seq=MB)
         scratch = model.gen_cache(1, MAXLEN)
         with paddle.no_grad():
+            # Tensor time_step == the engines' full-extent prefill
+            # convention (length-independent numerics); required for
+            # bitwise parity with ContinuousBatchingEngine below
             _, rc = model(prompt.unsqueeze(0), caches=scratch,
-                          time_step=0)
+                          time_step=paddle.to_tensor(np.int32(0)))
         cache.ensure(0, 14)
         cache.write_prefill(0, rc, 14)
         cache.fork(0, 1, 14)
@@ -372,8 +445,11 @@ class TestSharedPrefixCOW:
                                       max_seqs=2, max_blocks_per_seq=MB)
         scratch = model.gen_cache(1, MAXLEN)
         with paddle.no_grad():
+            # Tensor time_step == the engines' full-extent prefill
+            # convention (length-independent numerics); required for
+            # bitwise parity with ContinuousBatchingEngine below
             _, rc = model(prompt.unsqueeze(0), caches=scratch,
-                          time_step=0)
+                          time_step=paddle.to_tensor(np.int32(0)))
         cache.ensure(0, 14)
         cache.write_prefill(0, rc, 14)
         cache.fork(0, 1, 14)
@@ -381,7 +457,7 @@ class TestSharedPrefixCOW:
         # re-prefill slot 1 with DIFFERENT content over the shared page
         with paddle.no_grad():
             _, rc2 = model(other.unsqueeze(0), caches=scratch,
-                           time_step=0)
+                           time_step=paddle.to_tensor(np.int32(0)))
         cache.ensure(1, 10)
         cache.write_prefill(1, rc2, 10)
         assert cache.seq_blocks[1][0] != shared
