@@ -204,13 +204,14 @@ class FusedMultiTransformer(nn.Layer):
                 # and attends over the sequence's pages — Pallas paged
                 # kernel on TPU, jnp gather + the same masked-sdpa
                 # codepath as the dense ragged branch on CPU (so paged
-                # and dense decode stay bit-identical there)
-                if l != 1:
-                    raise ValueError(
-                        "paged caches decode one token per step "
-                        "(seq_len==1); run prefill through a dense "
-                        "scratch cache and PagedKVCache.write_prefill "
-                        "(see inference/scheduler.py)")
+                # and dense decode stay bit-identical there). l == 1
+                # is the plain decode step; l > 1 appends l tokens per
+                # row from time_step on and scores each causally (the
+                # speculative-decode verification step). Prompt
+                # PREFILL still runs through a dense scratch cache +
+                # PagedKVCache.write_prefill (see inference/
+                # scheduler.py) — the multi-token path assumes the
+                # block tables already cover [t, t+l).
                 t = time_step.data if isinstance(time_step, Tensor) \
                     else jnp.asarray(time_step, jnp.int32)
                 # per-row positions like the ragged dense path; a

@@ -32,16 +32,21 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
-                      PrefixCacheStats)
+                      PrefixCacheStats, SpecDecodeStats)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           chain_block_hashes, chain_hash)
-from .scheduler import PagedRequest, PagedServingEngine  # noqa: F401
+from .scheduler import (MIN_PREFILL_SUFFIX_ROWS,  # noqa: F401
+                        PagedRequest, PagedServingEngine)
+from .speculative import (SpeculativeEngine,  # noqa: F401
+                          TokenServingModel)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
            "BlockOOM", "PagedKVCache", "PagedLayerCache",
            "PagedRequest", "PagedServingEngine", "PrefixCacheStats",
+           "SpecDecodeStats", "SpeculativeEngine", "TokenServingModel",
+           "MIN_PREFILL_SUFFIX_ROWS",
            "chain_block_hashes", "chain_hash"]
 
 

@@ -182,6 +182,21 @@ def _make_append(block_size):
     return paged_cache_kv
 
 
+def _make_append_multi(block_size, n_tokens):
+    def paged_cache_kv_multi(pool, k, v, t, bt):
+        # multi-token append (speculative-decode verification): k/v
+        # [B, L, H, D] land at positions t[b] .. t[b]+L-1 through the
+        # block table. Positions within an active row are distinct, so
+        # the scatter never collides; inactive rows (t == 0, table all
+        # trash) duplicate-write block 0, which nothing reads unmasked.
+        pos = t[:, None] + jnp.arange(n_tokens, dtype=t.dtype)[None, :]
+        blk = jnp.take_along_axis(bt, pos // block_size, axis=1)
+        off = pos % block_size                        # [B, L]
+        pool = pool.at[blk, 0, :, off, :].set(k.astype(pool.dtype))
+        return pool.at[blk, 1, :, off, :].set(v.astype(pool.dtype))
+    return paged_cache_kv_multi
+
+
 def _block_copy(pool, src, dst):
     # copy-on-write split: pool[dst[i]] = pool[src[i]]
     return pool.at[dst].set(pool[src])
@@ -237,20 +252,23 @@ class PagedLayerCache:
         return self.pool.shape
 
     def decode(self, q, k, v, t, use_kernel: bool = False):
-        """q/k/v: [B, 1, H, D] Tensors (one decode step). t: traced
-        int32 [B] per-row positions (the write position == current
-        length). Appends k/v in place (the pool Tensor is rebound) and
-        returns attention over each row's valid prefix incl. the new
-        token. PRECONDITION: ``ensure(row, t[row]+1)`` for every
-        active row — the write position must be covered by the row's
-        block table. use_kernel routes to the Pallas paged kernel
-        (TPU); otherwise a pure-jnp gather + the SAME masked-sdpa
-        codepath the dense ragged decode uses, so paged and dense CPU
-        decode are bit-identical when page capacity == dense
-        max_len."""
+        """q/k/v: [B, L, H, D] Tensors (L == 1 is the plain decode
+        step; L > 1 is the multi-query speculative-verification step —
+        row b's L tokens land at positions t[b] .. t[b]+L-1 and each
+        query attends causally up to its own position). t: traced
+        int32 [B] per-row START positions (== current length). Appends
+        k/v in place (the pool Tensor is rebound) and returns the
+        attention output [B, L, nh, hd]. PRECONDITION:
+        ``ensure(row, t[row]+L, write_from=t[row])`` for every active
+        row — every write position must be covered by the row's block
+        table (and shared pages in the write range COW-split).
+        use_kernel routes to the Pallas paged kernel (TPU); otherwise
+        a pure-jnp gather + the SAME masked-sdpa codepath the dense
+        ragged decode uses, so paged and dense CPU decode are
+        bit-identical when page capacity == dense max_len."""
         import jax as _jax
         c = self._cache
-        B = q.shape[0]
+        B, L = q.shape[0], q.shape[1]
         if B != c.max_seqs:
             raise ValueError(f"batch {B} != cache max_seqs {c.max_seqs}")
         if self._layer == 0 and not isinstance(t, _jax.core.Tracer):
@@ -266,43 +284,82 @@ class PagedLayerCache:
             for row in range(B):
                 have = len(c.seq_blocks[row])
                 pos = int(tv[row])
-                if (have and c.blocks_needed(pos + 1) > have) or \
+                if (have and c.blocks_needed(pos + L) > have) or \
                         (not have and pos > 0):
                     raise ValueError(
-                        f"decode at position {pos} of row {row} is "
-                        f"not covered by its {have} allocated "
-                        f"block(s); call ensure(row, position+1) "
-                        f"first")
+                        f"decode of {L} token(s) at position {pos} of "
+                        f"row {row} is not covered by its {have} "
+                        f"allocated block(s); call "
+                        f"ensure(row, position+{L}) first")
         bt = c.bt_tensor()
         tt = Tensor(t)
-        new_pool = apply(_make_append(c.block_size),
-                         (self.pool, k, v, tt, bt),
-                         op_name="paged_cache_kv")
+        if L == 1:
+            new_pool = apply(_make_append(c.block_size),
+                             (self.pool, k, v, tt, bt),
+                             op_name="paged_cache_kv")
+        else:
+            new_pool = apply(_make_append_multi(c.block_size, L),
+                             (self.pool, k, v, tt, bt),
+                             op_name="paged_cache_kv_multi")
         c.pools[self._layer] = new_pool
 
         if use_kernel:
-            def dec(p, q_, tv, bta):
-                from ..ops.pallas.paged_attention import paged_attention
-                return paged_attention(q_[:, 0], p, bta, tv + 1)[:, None]
-            return apply(dec, (new_pool, q, tt, bt),
-                         op_name="paged_attention")
+            if L == 1:
+                def dec(p, q_, tv, bta):
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention
+                    return paged_attention(q_[:, 0], p, bta,
+                                           tv + 1)[:, None]
+                return apply(dec, (new_pool, q, tt, bt),
+                             op_name="paged_attention")
+
+            def dec_multi(p, q_, tv, bta):
+                from ..ops.pallas.paged_attention import \
+                    paged_attention_multi
+                return paged_attention_multi(q_, p, bta, tv + L)
+            return apply(dec_multi, (new_pool, q, tt, bt),
+                         op_name="paged_attention_multi")
 
         # CPU / fallback: gather pages dense (the kernel module's
         # gather, so both paths share one layout definition), then
         # mirror the dense ragged decode branch (same mask, same sdpa
-        # op executable)
+        # op executable). For L > 1 the L axis FOLDS INTO THE BATCH
+        # axis (virtual rows [b*L+i] share slot b's pages, query i at
+        # position t[b]+i): the sdpa executable then has the exact
+        # q-length-1 shape of the plain decode step, which is what
+        # makes a multi-token verification bit-identical to L single
+        # steps — an [L, S] attention fuses with different reduction
+        # grouping than L [1, S] attentions (~1 ulp), the same
+        # lowering trap as scheduler.MIN_PREFILL_SUFFIX_ROWS.
         from ..nn import functional as F
         from ..ops.pallas.paged_attention import gather_pages
         k_full, v_full = apply(gather_pages, (new_pool, bt),
                                op_name="paged_gather")
         S = k_full.shape[1]
-        qpos = (t[:, None, None, None]
-                + jnp.arange(1)[None, None, :, None])
+        if L == 1:
+            qpos = (t[:, None, None, None]
+                    + jnp.arange(1)[None, None, :, None])
+            kpos = jnp.arange(S)[None, None, None, :]
+            mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                          .astype(jnp.float32))
+            return F.scaled_dot_product_attention(q, k_full, v_full,
+                                                  attn_mask=mask)
+
+        qf = apply(lambda a: a.reshape((B * L, 1) + a.shape[2:]),
+                   (q,), op_name="spec_fold_q")
+        kf = apply(lambda a: jnp.repeat(a, L, axis=0), (k_full,),
+                   op_name="spec_fold_kv")
+        vf = apply(lambda a: jnp.repeat(a, L, axis=0), (v_full,),
+                   op_name="spec_fold_kv")
+        tf = (jnp.repeat(t, L) + jnp.tile(jnp.arange(L, dtype=t.dtype),
+                                          B))
+        qpos = tf[:, None, None, None]
         kpos = jnp.arange(S)[None, None, None, :]
         mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
                       .astype(jnp.float32))
-        return F.scaled_dot_product_attention(q, k_full, v_full,
-                                              attn_mask=mask)
+        out = F.scaled_dot_product_attention(qf, kf, vf, attn_mask=mask)
+        return apply(lambda a: a.reshape((B, L) + a.shape[2:]),
+                     (out,), op_name="spec_unfold")
 
 
 class PagedKVCache:
@@ -391,16 +448,20 @@ class PagedKVCache:
 
     # -- allocation ---------------------------------------------------
     def ensure(self, slot: int, length: int,
-               start_block: int = 0) -> None:
+               start_block: int = 0,
+               write_from: Optional[int] = None) -> None:
         """Grow slot's table to cover ``length`` tokens
-        (allocate-on-write) and copy-on-write split the block the next
-        append lands in if it is shared. ``start_block``: table
-        positions below it are adopted prefix pages the caller will
-        never write (suffix-only prefill) — the COW split is skipped
-        there, so a fully cached prompt keeps its last page shared
-        instead of paying a pointless pool copy. Raises BlockOOM when
-        the pool is exhausted (callers preempt) and ValueError past the
-        per-seq table capacity."""
+        (allocate-on-write) and copy-on-write split every shared block
+        the coming write touches. ``write_from``: first position the
+        caller will write (defaults to ``length - 1``, the single-token
+        append); a multi-token append passes its start position so a
+        shared page in the MIDDLE of the write range splits too.
+        ``start_block``: table positions below it are adopted prefix
+        pages the caller will never write (suffix-only prefill) — the
+        COW split is skipped there, so a fully cached prompt keeps its
+        last page shared instead of paying a pointless pool copy.
+        Raises BlockOOM when the pool is exhausted (callers preempt)
+        and ValueError past the per-seq table capacity."""
         if length <= 0:
             return  # nothing to cover (and no write block to COW)
         need = self.blocks_needed(length)
@@ -415,11 +476,38 @@ class PagedKVCache:
             self.block_tables[slot, len(have):need] = new
             have.extend(new)
             self._tables_dirty()
-        # COW: the block the write at position length-1 lands in
-        bpos = (int(length) - 1) // self.block_size
-        if bpos >= start_block and \
-                self.allocator.refcount[have[bpos]] > 1:
-            self._copy_block(slot, bpos)
+        # COW: every block the write range [write_from, length) lands in
+        if write_from is None:
+            write_from = int(length) - 1
+        lo = max(int(write_from), 0) // self.block_size
+        hi = (int(length) - 1) // self.block_size
+        for bpos in range(max(lo, start_block), hi + 1):
+            if self.allocator.refcount[have[bpos]] > 1:
+                self._copy_block(slot, bpos)
+
+    def truncate(self, slot: int, length: int) -> None:
+        """Roll the slot back to ``length`` tokens (speculative-decode
+        rejection): every block past ``blocks_needed(length)`` leaves
+        the table, tail-first. Refcount-aware: a fork-shared page just
+        drops one owner (the peer keeps it); a hash-indexed page
+        reaching refcount 0 parks in the cached-free tier
+        (resurrectable by a later ``match_prefix`` hit) instead of
+        freeing — the same second-chance path ``free_seq`` takes. The
+        kept partial last block is NOT cleared: positions past
+        ``length`` are stale but masked by length everywhere, and the
+        next append overwrites them (COW-splitting first if the block
+        is shared, via ``ensure``'s write-range split)."""
+        if length < 0:
+            raise ValueError(f"negative truncate length {length}")
+        have = self.seq_blocks[slot]
+        keep = self.blocks_needed(length)
+        if keep >= len(have):
+            return  # nothing past the boundary
+        drop = have[keep:]
+        self.release_to_cache(drop)
+        del have[keep:]
+        self.block_tables[slot, keep:] = 0
+        self._tables_dirty()
 
     def free_seq(self, slot: int) -> None:
         if self.seq_blocks[slot]:

@@ -44,7 +44,17 @@ from ..framework.tensor import Tensor
 from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
 from .serving import PrefixCacheStats
 
-__all__ = ["PagedRequest", "PagedServingEngine"]
+__all__ = ["PagedRequest", "PagedServingEngine",
+           "MIN_PREFILL_SUFFIX_ROWS"]
+
+# A partial (suffix-only) prefill must recompute at least this many
+# trailing prompt rows, even when the prefix cache covers more: a
+# 1-row attention lowers to a GEMV whose accumulation order differs
+# from the same row computed inside a multi-row prefill, so a 1-row
+# suffix would break bit-identity with the cold path (and a fully
+# cached prompt still needs its last hidden for the admission event).
+# See tests/test_prefix_cache.py::test_one_row_suffix_regression.
+MIN_PREFILL_SUFFIX_ROWS = 2
 
 
 class PagedRequest:
@@ -94,6 +104,18 @@ class PagedRequest:
                 block_size,
                 parent=self._hashes[-1] if self._hashes else b""))
         return self._hashes[:n_full]
+
+    def truncate_history(self, length: int, block_size: int) -> None:
+        """Roll the recorded history back to ``length`` rows
+        (speculative rejection): rows past it were consumed
+        speculatively and rejected, so a re-prefill must not replay
+        them. Memoized chain hashes past the new last full block are
+        dropped with them."""
+        if length < 0 or length > self._len:
+            raise ValueError(
+                f"truncate to {length} outside [0, {self._len}]")
+        self._len = length
+        del self._hashes[length // block_size:]
 
     def __len__(self):
         return self._len
@@ -210,12 +232,11 @@ class PagedServingEngine:
             self.prefix_stats.lookup_blocks += len(hashes)
             self.prefix_stats.hit_blocks += n_cached
         # cached tokens skip prefill entirely, but the recomputed
-        # suffix keeps at least TWO rows: a fully cached prompt must
-        # still produce its last hidden for the admission event, and a
-        # 1-row attention lowers to a GEMV whose accumulation order
-        # differs from the same row inside a multi-row prefill —
-        # bit-identity with the cold path would break
-        P = max(0, min(n_cached * bs, T - 2)) if n_cached else 0
+        # suffix keeps at least MIN_PREFILL_SUFFIX_ROWS (see the
+        # constant's comment: 1-row GEMV accumulation breaks
+        # bit-identity, and the admission event needs a last hidden)
+        P = max(0, min(n_cached * bs, T - MIN_PREFILL_SUFFIX_ROWS)) \
+            if n_cached else 0
         if self._scratch is None:
             self._scratch = self.model.gen_cache(1, self.max_len,
                                                  dtype=self.dtype)
@@ -269,7 +290,10 @@ class PagedServingEngine:
             for slot in np.flatnonzero(mask):
                 req = self._requests[int(slot)]
                 if req is not None:
-                    req.append_history(xv[int(slot), 0])
+                    # all L rows of a multi-token (speculative) step;
+                    # rejected rows are trimmed back by rollback()
+                    for row in xv[int(slot)]:
+                        req.append_history(row)
 
     def _drop(self, slot: int) -> None:
         self._flush_history()
@@ -354,3 +378,72 @@ class PagedServingEngine:
         # 5. continuous refill
         self._try_admit()
         return out
+
+    # -- speculative decode (multi-token verify + rollback) -----------
+    def step_multi(self, x: Tensor):
+        """One fused MULTI-TOKEN step for every active slot: row b's L
+        tokens are appended at positions lens[b] .. lens[b]+L-1 and
+        scored causally in ONE model call — the speculative-decode
+        verification step (inference/speculative.py). x: [max_batch,
+        L, d_model]. The caller guarantees lens + L <= capacity for
+        every active slot (clamp L; slots AT capacity must be released
+        first) — unlike ``step`` there is no auto-release here, since
+        a capacity-finished slot cannot ride a multi-token call at
+        all. Page growth covers all L positions (preempting youngest
+        on OOM, as in ``step``); ``rollback`` drops the rejected tail.
+        Returns hidden [max_batch, L, d_model]."""
+        L = int(x.shape[1])
+        if self.num_active == 0:
+            raise RuntimeError("step_multi() with no active slots")
+        over = self.active & (self.lens + L > self.max_len)
+        if over.any():
+            raise ValueError(
+                f"slots {np.flatnonzero(over).tolist()} cannot take "
+                f"{L} tokens within capacity {self.max_len}; clamp L "
+                f"or release them first")
+        # grow pages to cover the whole write range, oldest first
+        order = sorted(np.flatnonzero(self.active),
+                       key=lambda s: self._requests[s].admit_seq)
+        for slot in order:
+            slot = int(slot)
+            while self.active[slot]:
+                try:
+                    self.cache.ensure(slot, int(self.lens[slot]) + L,
+                                      write_from=int(self.lens[slot]))
+                    break
+                except BlockOOM:
+                    if self.num_active == 1:
+                        raise RuntimeError(
+                            "pool too small: one sequence cannot grow "
+                            "even with every other request evicted")
+                    self._preempt_youngest()
+        if len(self._pending_history) >= 32:
+            self._flush_history()
+        self._pending_history.append((x, self.active.copy()))
+        t = Tensor(np.asarray(self.lens, np.int32))
+        with no_grad():
+            out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        self.lens[self.active] += L
+        self._try_admit()
+        return out
+
+    def rollback(self, slot: int, new_len: int) -> None:
+        """Roll an active slot back to ``new_len`` consumed tokens
+        (speculative rejection): the pages past the boundary are
+        released block-table-tail-first (refcount/cached-free aware —
+        PagedKVCache.truncate), the recorded history is trimmed so a
+        later preempt -> re-prefill replays only ACCEPTED tokens, and
+        the slot keeps decoding from ``new_len``."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} not active")
+        new_len = int(new_len)
+        if new_len < 1 or new_len > int(self.lens[slot]):
+            raise ValueError(
+                f"rollback of slot {slot} to {new_len} outside "
+                f"[1, {int(self.lens[slot])}]")
+        # buffered inputs must reach the history BEFORE trimming it
+        self._flush_history()
+        self._requests[slot].truncate_history(new_len,
+                                              self.cache.block_size)
+        self.cache.truncate(slot, new_len)
+        self.lens[slot] = new_len

@@ -26,7 +26,8 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-__all__ = ["ContinuousBatchingEngine", "PrefixCacheStats"]
+__all__ = ["ContinuousBatchingEngine", "PrefixCacheStats",
+           "SpecDecodeStats"]
 
 
 class PrefixCacheStats:
@@ -69,6 +70,66 @@ class PrefixCacheStats:
         return (f"PrefixCacheStats(hit_rate={self.hit_rate:.2%}, "
                 f"blocks_saved={self.blocks_saved}, "
                 f"tokens_skipped={self.tokens_skipped})")
+
+
+class SpecDecodeStats:
+    """Serving-surface accounting for speculative decoding
+    (inference/speculative.py), the sibling of PrefixCacheStats. One
+    counter bump per (slot, verification step); counters only grow.
+
+      proposed          draft tokens offered to verification
+      accepted          draft tokens the target model agreed with
+      emitted           tokens actually emitted (accepted + the one
+                        bonus/correction token per step)
+      target_steps      per-slot target verification steps — the cost
+                        unit speculation amortizes
+      draft_steps       per-slot draft model forward steps
+      rolled_back       rejected tokens rolled back via page-table
+                        truncation
+    """
+
+    __slots__ = ("proposed", "accepted", "emitted", "target_steps",
+                 "draft_steps", "rolled_back")
+
+    def __init__(self):
+        self.proposed = 0
+        self.accepted = 0
+        self.emitted = 0
+        self.target_steps = 0
+        self.draft_steps = 0
+        self.rolled_back = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        if self.proposed == 0:
+            return 0.0
+        return self.accepted / self.proposed
+
+    @property
+    def tokens_per_target_step(self) -> float:
+        """Mean tokens emitted per target-model step — the speculative
+        speedup signal (1.0 == plain decode; K+1 == every proposal
+        accepted)."""
+        if self.target_steps == 0:
+            return 0.0
+        return self.emitted / self.target_steps
+
+    def as_dict(self) -> dict:
+        return {"proposed": self.proposed,
+                "accepted": self.accepted,
+                "emitted": self.emitted,
+                "target_steps": self.target_steps,
+                "draft_steps": self.draft_steps,
+                "rolled_back": self.rolled_back,
+                "acceptance_rate": round(self.acceptance_rate, 4),
+                "tokens_per_target_step":
+                    round(self.tokens_per_target_step, 4)}
+
+    def __repr__(self):
+        return (f"SpecDecodeStats(acceptance_rate="
+                f"{self.acceptance_rate:.2%}, tokens_per_target_step="
+                f"{self.tokens_per_target_step:.2f}, "
+                f"emitted={self.emitted})")
 
 
 class ContinuousBatchingEngine:

@@ -1,0 +1,556 @@
+"""Speculative decoding over the paged serving engine, behind a
+token-ID serving surface.
+
+The paged KV cache made rejection CHEAP: a speculative tail that the
+target model refuses is a block-table truncation
+(``PagedKVCache.truncate``) — pages fall off the tail, shared pages
+just deref, and nothing is copied. This module adds the two layers the
+ROADMAP names on top of that:
+
+* ``TokenServingModel`` — the token-ID serving surface. The engines
+  underneath speak embeddings; this wrapper owns the embedding table
+  and the readout head, so the serving API is token ids in, logits
+  out, with greedy / temperature / top-k sampling computed on-device.
+
+* ``SpeculativeEngine`` — draft / verify / rollback. Per step it
+  (1) rolls a small DRAFT model K tokens ahead through its own
+  (second, smaller) paged cache, (2) verifies all K+1 positions in ONE
+  target-model call (``PagedServingEngine.step_multi`` — the ragged
+  multi-token attention shape the multi-query paged kernel serves on
+  TPU), (3) accepts the longest agreeing prefix by standard
+  (rejection-sampling) acceptance, and (4) rolls the rejected tail
+  back page-wise (``PagedServingEngine.rollback``). ``k=0`` degrades
+  to plain (non-speculative) token-ID paged serving — the baseline the
+  bench compares against.
+
+Greedy bit-identity: with ``sampling="greedy"`` the emitted stream is
+BIT-IDENTICAL to non-speculative paged decode, whatever the draft
+proposes. Every emitted token is an argmax over TARGET logits; the
+multi-query verification computes each position's hidden with the same
+masked full-extent reductions as the one-token step, and per-row
+matmul results on this backend are invariant to the number of rows
+ridden in the call (the l==1 GEMV caveat of
+scheduler.MIN_PREFILL_SUFFIX_ROWS is about 1-ROW calls, which the
+verify path never makes: it rides max_batch*(K+1) rows). Asserted in
+tests/test_speculative.py, including across mid-stream rejection
+rollbacks, preempt -> re-prefill, and prefix caching.
+
+Scheduling composition: the target path IS a ``PagedServingEngine`` —
+admission, block-budget watermark, preemption with re-prefill from
+(accepted-only) history, and cross-request prefix caching all apply
+unchanged. The draft cache is slot-for-slot aligned with the target's
+and is sized to never be the bottleneck (it is fully reservable:
+``max_batch * max_blocks_per_seq + 1`` blocks by default — cheap,
+because the draft model is small); on a target preemption the draft
+slot is dropped and re-prefilled from the token stream at
+re-admission.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+from .paged_cache import PagedKVCache
+from .scheduler import PagedServingEngine
+from .serving import SpecDecodeStats
+
+__all__ = ["TokenServingModel", "SpeculativeEngine", "SpecDecodeStats"]
+
+
+class TokenServingModel:
+    """Token-ID serving surface over a FusedMultiTransformer-protocol
+    core: owns the embedding table ([vocab, d_model]) and the readout
+    head ([d_model, vocab], tied to the embedding transpose when not
+    given), so callers speak token ids while the serving engines keep
+    speaking embeddings. ``logits``/``sample`` run on-device (matmul /
+    softmax / argmax / top-k masking); only the final categorical draw
+    (and the probability rows rejection sampling needs) come to
+    host."""
+
+    def __init__(self, model, embedding, lm_head=None):
+        import jax.numpy as jnp
+        self.core = model
+        emb = np.asarray(embedding.numpy() if hasattr(embedding, "numpy")
+                         else embedding, np.float32)
+        if emb.ndim != 2:
+            raise ValueError("embedding must be [vocab, d_model]")
+        self._embed_np = emb
+        head_shape = (emb.shape[1], emb.shape[0])
+        if lm_head is None:
+            self.lm_head = Tensor(jnp.asarray(emb.T.copy()))  # tied
+        elif isinstance(lm_head, Tensor):
+            # share the device buffer (truncated_draft hands the
+            # target's own head over — no host round-trip, no copy)
+            if tuple(lm_head.shape) != head_shape:
+                raise ValueError(f"lm_head must be [d_model, vocab] = "
+                                 f"{head_shape}, got {lm_head.shape}")
+            self.lm_head = lm_head
+        else:
+            head = np.asarray(lm_head, np.float32)
+            if head.shape != head_shape:
+                raise ValueError(f"lm_head must be [d_model, vocab] = "
+                                 f"{head_shape}, got {head.shape}")
+            self.lm_head = Tensor(jnp.asarray(head))
+
+    @property
+    def vocab_size(self) -> int:
+        return self._embed_np.shape[0]
+
+    @property
+    def d_model(self) -> int:
+        return self._embed_np.shape[1]
+
+    # -- token <-> embedding ------------------------------------------
+    def embed(self, token_ids) -> np.ndarray:
+        """Token ids (any int sequence/array) -> float32 embedding rows
+        [..., d_model] — the currency the serving engines consume."""
+        ids = np.asarray(token_ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.vocab_size):
+            raise ValueError("token id out of range")
+        return self._embed_np[ids]
+
+    def logits(self, hidden) -> Tensor:
+        """hidden [..., d_model] Tensor -> logits [..., vocab] Tensor
+        (on-device readout matmul)."""
+        import paddle_tpu as paddle
+        return paddle.matmul(hidden, self.lm_head)
+
+    # -- sampling ------------------------------------------------------
+    def probs(self, logits, temperature: float = 1.0,
+              top_k: Optional[int] = None) -> Tensor:
+        """Temperature-scaled, top-k-masked softmax over the last axis,
+        computed on-device. The distribution rejection sampling prices
+        proposals against."""
+        import paddle_tpu as paddle
+        from ..nn import functional as F
+        z = logits
+        if temperature != 1.0:
+            if temperature <= 0:
+                raise ValueError("temperature must be > 0 (use "
+                                 "mode='greedy' for argmax decoding)")
+            z = z / temperature
+        if top_k is not None and top_k < self.vocab_size:
+            kth = paddle.topk(z, k=top_k, axis=-1)[0].min(axis=-1,
+                                                          keepdim=True)
+            z = paddle.where(z < kth, paddle.full_like(z, -1e30), z)
+        return F.softmax(z, axis=-1)
+
+    def sample(self, logits, mode: str = "greedy",
+               temperature: float = 1.0, top_k: Optional[int] = None,
+               rng: Optional[np.random.RandomState] = None
+               ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """logits [..., vocab] Tensor -> (token ids int64 [...], probs
+        float32 [..., vocab] or None). Greedy is a pure on-device
+        argmax (probs None). Stochastic modes build the distribution
+        on-device and draw per row on host with ``rng`` (inverse-CDF),
+        returning the probs so speculative rejection sampling can
+        price the draws."""
+        import paddle_tpu as paddle
+        if mode == "greedy":
+            toks = np.asarray(paddle.argmax(logits, axis=-1).numpy())
+            return toks.astype(np.int64), None
+        if mode not in ("sample", "top_k", "temperature"):
+            raise ValueError(f"unknown sampling mode {mode!r}")
+        p = np.asarray(self.probs(logits, temperature, top_k).numpy(),
+                       np.float32)
+        if rng is None:
+            rng = np.random
+        flat = p.reshape(-1, p.shape[-1]).astype(np.float64)
+        flat = flat / flat.sum(axis=-1, keepdims=True)
+        u = rng.random_sample(flat.shape[0])
+        cdf = np.cumsum(flat, axis=-1)
+        toks = np.empty(flat.shape[0], np.int64)
+        for i in range(flat.shape[0]):
+            toks[i] = int(np.searchsorted(cdf[i], u[i], side="right"))
+        toks = np.minimum(toks, p.shape[-1] - 1)
+        return toks.reshape(p.shape[:-1]), p
+
+    # -- draft construction -------------------------------------------
+    def truncated_draft(self, num_layers: int) -> "TokenServingModel":
+        """A draft that runs only the first ``num_layers`` of the core
+        (weights SHARED by array reference — jnp arrays are immutable)
+        behind the same embedding/readout. The cheapest 'distilled'
+        draft: useful when the deep layers refine rather than redirect
+        the argmax."""
+        from ..incubate.nn.fused_transformer import FusedMultiTransformer
+        m = self.core
+        if num_layers >= m.num_layers:
+            raise ValueError("draft must be shallower than the target")
+        d = FusedMultiTransformer(
+            m.embed_dim, m.num_heads,
+            m.layers[0].ffn1.weight.shape[1],
+            activation=m._act_name, num_layers=num_layers,
+            normalize_before=m.normalize_before,
+            epsilon=m.layers[0].ln._epsilon)
+        for dst, src in zip(d.layers, m.layers):
+            for name in ("ln", "qkv", "out_proj", "ffn_ln", "ffn1",
+                         "ffn2"):
+                dmod, smod = getattr(dst, name), getattr(src, name)
+                for pname, par in smod._parameters.items():
+                    if par is not None and \
+                            dmod._parameters.get(pname) is not None:
+                        dmod._parameters[pname]._data = par.data
+        return TokenServingModel(d, self._embed_np, self.lm_head)
+
+
+class _SpecSeq:
+    """Host-side token state of one request: the full stream (prompt +
+    every emitted token; the LAST entry is the pending token — emitted
+    to the caller but not yet consumed by the models)."""
+
+    __slots__ = ("rid", "toks", "prompt_len", "slot", "started")
+
+    def __init__(self, rid: int, prompt: List[int]):
+        self.rid = rid
+        self.toks: List[int] = list(prompt)
+        self.prompt_len = len(prompt)
+        self.slot: Optional[int] = None
+        self.started = False    # first token sampled at admission?
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.toks) - self.prompt_len
+
+
+class SpeculativeEngine:
+    """Draft/verify/rollback speculative decoding behind a token-ID
+    API. ``target``/``draft`` are TokenServingModels; ``draft=None``
+    with ``k > 0`` self-drafts with the target model (useful as a
+    correctness harness — acceptance is then ~100% in greedy mode but
+    there is no speedup); ``k = 0`` disables speculation entirely and
+    serves plain token-ID paged decode (the baseline).
+
+    Protocol: ``submit(token_ids) -> rid``; ``step() -> {rid: [tokens
+    emitted this round]}``; ``tokens(rid)`` the full stream;
+    ``release(rid)`` frees the pages. Capacity-finished requests land
+    in ``finished`` as (rid, total_tokens) — their PAGES are already
+    freed, but the host-side token stream stays readable via
+    ``tokens(rid)`` until the caller ``release(rid)``s it, so a
+    long-running server must release finished rids too or the
+    per-request stream state accumulates. Engine events (admission,
+    preemption with re-prefill, prefix caching) ride the wrapped
+    PagedServingEngine and are reconciled between rounds; accounting
+    lives in ``stats`` (SpecDecodeStats) next to the engine's
+    ``prefix_stats``."""
+
+    def __init__(self, target: TokenServingModel,
+                 draft: Optional[TokenServingModel] = None, *,
+                 k: int = 4, max_batch: int, block_size: int,
+                 num_blocks: int,
+                 max_blocks_per_seq: Optional[int] = None,
+                 draft_num_blocks: Optional[int] = None,
+                 prefix_cache: bool = False, sampling: str = "greedy",
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 watermark_blocks: int = 0, seed: int = 0):
+        if k < 0:
+            raise ValueError("k must be >= 0")
+        self.target = target
+        self.k = int(k)
+        self.draft = (draft if draft is not None else target) \
+            if self.k > 0 else None
+        self.sampling = sampling
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self._rng = np.random.RandomState(seed)
+        self.engine = PagedServingEngine(
+            target.core, max_batch, block_size, num_blocks,
+            max_blocks_per_seq=max_blocks_per_seq,
+            watermark_blocks=watermark_blocks,
+            prefix_cache=prefix_cache)
+        self.max_batch = self.engine.max_batch
+        self.stats = SpecDecodeStats()
+        self.finished: List[Tuple[int, int]] = []
+        self._seqs: Dict[int, _SpecSeq] = {}     # by target slot
+        self._by_rid: Dict[int, _SpecSeq] = {}
+        if self.k > 0:
+            # second, smaller pool: same per-seq page capacity as the
+            # target (the draft never runs ahead of the target's
+            # verified length within a round), fully reservable for
+            # every slot so a mid-roll draft OOM cannot happen — the
+            # TARGET pool stays the only preemption authority
+            mbps = self.engine.cache.max_blocks_per_seq
+            if draft_num_blocks is None:
+                draft_num_blocks = self.max_batch * mbps + 1
+            self.draft_cache = PagedKVCache.for_model(
+                self.draft.core, block_size, draft_num_blocks,
+                max_seqs=self.max_batch, max_blocks_per_seq=mbps)
+            self._draft_lens = np.zeros(self.max_batch, np.int32)
+            self._draft_scratch = None
+        else:
+            self.draft_cache = None
+
+    # -- submission / events ------------------------------------------
+    def submit(self, token_ids) -> int:
+        """Queue a token-ID prompt; admission (now or later) samples
+        the first token on-device and prefills the draft cache."""
+        toks = [int(t) for t in np.asarray(token_ids).reshape(-1)]
+        if not toks:
+            raise ValueError("empty prompt")
+        rid = self.engine.submit(self.target.embed(toks))
+        self._by_rid[rid] = _SpecSeq(rid, toks)
+        self._handle_events()
+        return rid
+
+    def tokens(self, rid: int) -> List[int]:
+        """Full stream (prompt + generated) of a request."""
+        return list(self._by_rid[rid].toks)
+
+    def generated(self, rid: int) -> List[int]:
+        seq = self._by_rid[rid]
+        return list(seq.toks[seq.prompt_len:])
+
+    def release(self, rid: int) -> None:
+        """Caller-side finish: free the request's pages (both pools)
+        and refill from the queue. A request released before it was
+        ever admitted leaves the engine queue too — otherwise a later
+        refill would admit an orphan slot this wrapper no longer
+        tracks."""
+        seq = self._by_rid.pop(rid)
+        if seq.slot is not None:
+            slot = seq.slot
+            self._seqs.pop(slot, None)
+            seq.slot = None
+            self._clear_draft_slot(slot)
+            self.engine.release(slot)   # frees pages + refills
+        else:
+            for req in list(self.engine.queue):
+                if req.rid == rid:
+                    self.engine.queue.remove(req)
+        self._handle_events()
+
+    def _clear_draft_slot(self, slot: int) -> None:
+        if self.draft_cache is not None:
+            self.draft_cache.free_seq(slot)
+            self._draft_lens[slot] = 0
+
+    def _sample(self, model: TokenServingModel, logits):
+        return model.sample(logits, mode=self.sampling,
+                            temperature=self.temperature,
+                            top_k=self.top_k, rng=self._rng)
+
+    def _handle_events(self) -> None:
+        """Reconcile wrapped-engine events: preemptions drop the draft
+        slot (the token stream and pending token survive host-side);
+        admissions sample the first token (fresh requests only — a
+        re-admitted request keeps its pending token, so the emitted
+        stream never forks) and prefill the draft cache from the
+        stream."""
+        eng = self.engine
+        for rid in eng.preempted:
+            seq = self._by_rid.get(rid)
+            if seq is None or seq.slot is None:
+                continue
+            self._seqs.pop(seq.slot, None)
+            self._clear_draft_slot(seq.slot)
+            seq.slot = None
+        eng.preempted.clear()
+        for rid, slot, length in eng.finished:
+            # engine-side capacity release (only reachable through
+            # engine.step, which this wrapper does not call — but keep
+            # the books straight if a caller mixes the APIs)
+            seq = self._by_rid.get(rid)
+            if seq is not None:
+                self._seqs.pop(slot, None)
+                self._clear_draft_slot(slot)
+                seq.slot = None
+                self.finished.append((rid, len(seq.toks)))
+        eng.finished.clear()
+        for rid, slot, h in eng.admitted:
+            seq = self._by_rid.get(rid)
+            if seq is None:
+                # released while queued (release() drops queued
+                # requests, so this is a belt-and-braces path): never
+                # leave an engine slot active that this wrapper does
+                # not track
+                eng.release(slot)
+                continue
+            seq.slot = slot
+            self._seqs[slot] = seq
+            if not seq.started:
+                tok, _ = self._sample(self.target, self.logits_of(h))
+                seq.toks.append(int(tok.reshape(-1)[0]))
+                seq.started = True
+            self._draft_prefill(slot, seq)
+        eng.admitted.clear()
+
+    def logits_of(self, hidden) -> Tensor:
+        return self.target.logits(hidden)
+
+    def _draft_prefill(self, slot: int, seq: _SpecSeq) -> None:
+        """(Re-)build the draft cache for a slot from the token stream
+        (everything but the pending token — exactly what the target
+        has consumed)."""
+        if self.draft_cache is None:
+            return
+        import paddle_tpu as paddle
+        consumed = seq.toks[:-1]
+        cap = self.draft_cache.capacity_per_seq
+        if len(consumed) > cap:
+            raise ValueError("draft capacity exceeded")   # unreachable
+        self._clear_draft_slot(slot)
+        x = paddle.to_tensor(self.draft.embed(consumed)[None])
+        if self._draft_scratch is None:
+            self._draft_scratch = self.draft.core.gen_cache(1, cap)
+        with no_grad():
+            _, rc = self.draft.core(x, caches=self._draft_scratch,
+                                    time_step=Tensor(np.int32(0)))
+        self._draft_scratch = rc
+        self.draft_cache.ensure(slot, len(consumed))
+        self.draft_cache.write_prefill(slot, rc, len(consumed))
+        self._draft_lens[slot] = len(consumed)
+
+    # -- the speculative round ----------------------------------------
+    def step(self) -> Dict[int, List[int]]:
+        """One draft/verify/rollback round over every active slot.
+        Returns {rid: tokens emitted this round} (>= 1 token per
+        active request). Capacity-finished requests are released and
+        reported in ``finished`` instead."""
+        import paddle_tpu as paddle
+        eng = self.engine
+        # requests at page capacity cannot take another token: retire.
+        # Loop to a fixed point — a release can refill the slot with a
+        # queued prompt that is ITSELF at capacity (a full-length
+        # prompt generates nothing), which must retire too rather
+        # than crash the multi-token capacity check below.
+        while True:
+            self._handle_events()
+            full = [s for s in sorted(self._seqs)
+                    if int(eng.lens[s]) >= eng.max_len]
+            if not full:
+                break
+            for slot in full:
+                seq = self._seqs.pop(slot)
+                self.finished.append((seq.rid, len(seq.toks)))
+                seq.slot = None
+                self._clear_draft_slot(slot)
+                eng.release(slot)
+        slots = sorted(self._seqs)
+        if not slots:
+            return {}
+        B = self.max_batch
+        # every active slot rides every call, so the speculation depth
+        # clamps to the tightest remaining capacity
+        remaining = min(eng.max_len - int(eng.lens[s]) for s in slots)
+        L = max(1, min(self.k + 1, remaining))
+        k_eff = L - 1
+
+        # 1. draft roll: k_eff proposals, then one append-only step so
+        #    the draft cache ends the round at the target's length
+        #    (uniform rollback, no per-slot catch-up next round)
+        drafts: Dict[int, List[int]] = {s: [] for s in slots}
+        dprobs: Dict[int, List[np.ndarray]] = {s: [] for s in slots}
+        if self.draft_cache is not None and k_eff > 0:
+            cur = {s: self._seqs[s].toks[-1] for s in slots}
+            d_d = self.draft.d_model
+            for j in range(k_eff + 1):
+                x = np.zeros((B, 1, d_d), np.float32)
+                for s in slots:
+                    x[s, 0] = self.draft.embed(cur[s])
+                    self.draft_cache.ensure(
+                        s, int(self._draft_lens[s]) + 1)
+                t = Tensor(np.asarray(self._draft_lens, np.int32))
+                with no_grad():
+                    out, _ = self.draft.core(
+                        paddle.to_tensor(x),
+                        caches=self.draft_cache.views, time_step=t)
+                for s in slots:
+                    self._draft_lens[s] += 1
+                self.stats.draft_steps += len(slots)
+                if j < k_eff:
+                    toks, probs = self._sample(self.draft,
+                                               self.draft.logits(
+                                                   out[:, -1]))
+                    for s in slots:
+                        drafts[s].append(int(toks[s]))
+                        if probs is not None:
+                            dprobs[s].append(probs[s])
+                        cur[s] = int(toks[s])
+        elif self.draft_cache is not None:
+            # depth clamped to 0: keep the draft cache in lockstep by
+            # consuming the pending token alongside the target
+            x = np.zeros((B, 1, self.draft.d_model), np.float32)
+            for s in slots:
+                x[s, 0] = self.draft.embed(self._seqs[s].toks[-1])
+                self.draft_cache.ensure(s, int(self._draft_lens[s]) + 1)
+            t = Tensor(np.asarray(self._draft_lens, np.int32))
+            with no_grad():
+                self.draft.core(paddle.to_tensor(x),
+                                caches=self.draft_cache.views,
+                                time_step=t)
+            for s in slots:
+                self._draft_lens[s] += 1
+            self.stats.draft_steps += len(slots)
+
+        # 2. verify: ONE target call scores the pending token plus all
+        #    k_eff proposals through the paged cache
+        d_t = self.target.d_model
+        x = np.zeros((B, L, d_t), np.float32)
+        pre_lens = {s: int(eng.lens[s]) for s in slots}
+        for s in slots:
+            x[s] = self.target.embed([self._seqs[s].toks[-1]]
+                                     + drafts[s])
+        out = eng.step_multi(paddle.to_tensor(x))
+        g_toks, g_probs = self._sample(self.target,
+                                       self.target.logits(out))
+        preempted_mid = {rid for rid in eng.preempted}
+
+        # 3. accept + rollback per slot
+        emitted_by_rid: Dict[int, List[int]] = {}
+        for s in slots:
+            seq = self._seqs.get(s)
+            if seq is None or seq.rid in preempted_mid or \
+                    not eng.active[s]:
+                continue        # evicted during verification growth
+            d = drafts[s]
+            if self.sampling == "greedy":
+                n = 0
+                while n < k_eff and d[n] == int(g_toks[s, n]):
+                    n += 1
+                emitted = d[:n] + [int(g_toks[s, n])]
+            else:
+                n, correction = self._reject_sample(
+                    d, dprobs[s], g_probs[s])
+                bonus = int(g_toks[s, k_eff]) if n == k_eff \
+                    else correction
+                emitted = d[:n] + [bonus]
+            new_len = pre_lens[s] + 1 + n
+            eng.rollback(s, new_len)
+            if self.draft_cache is not None:
+                self.draft_cache.truncate(s, new_len)
+                self._draft_lens[s] = new_len
+            seq.toks.extend(emitted)
+            self.stats.proposed += k_eff
+            self.stats.accepted += n
+            self.stats.rolled_back += k_eff - n
+            self.stats.emitted += len(emitted)
+            self.stats.target_steps += 1
+            emitted_by_rid[seq.rid] = emitted
+        self._handle_events()
+        return emitted_by_rid
+
+    def _reject_sample(self, d: List[int], q_rows: List[np.ndarray],
+                       p_rows: np.ndarray) -> Tuple[int, int]:
+        """Standard speculative rejection sampling: accept proposal
+        d[i] with prob min(1, p_i[d_i] / q_i[d_i]); at the first
+        rejection draw the correction from the residual
+        normalize(max(p_i - q_i, 0)). Returns (n_accepted,
+        correction_token) — correction is only meaningful when
+        n_accepted < len(d)."""
+        for i, tok in enumerate(d):
+            p_i = p_rows[i].astype(np.float64)
+            q_i = q_rows[i].astype(np.float64)
+            ratio = p_i[tok] / max(q_i[tok], 1e-30)
+            if self._rng.random_sample() < min(1.0, ratio):
+                continue
+            resid = np.maximum(p_i - q_i, 0.0)
+            tot = resid.sum()
+            if tot <= 0.0:      # p == q: accept-equivalent, take p draw
+                resid, tot = p_i, p_i.sum()
+            cdf = np.cumsum(resid / tot)
+            c = int(np.searchsorted(cdf, self._rng.random_sample(),
+                                    side="right"))
+            return i, min(c, len(p_i) - 1)
+        return len(d), -1

@@ -116,6 +116,70 @@ def _kernel_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr, l_scr,
                 m_scr, l_scr, acc_scr, **kw)
 
 
+def _paged_multi_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr,
+                      acc_scr, *, block_s, n_blocks, sm_scale, n_q, g):
+    """Multi-query variant of ``_paged_body`` for speculative-decode
+    verification: the q block holds this sequence*kv-head's n_q query
+    tokens folded with the group axis as (n_q * g) rows. Row r is
+    query index r // g at absolute position length - n_q + (r // g),
+    masked causally per row — so one grid sweep over the pages scores
+    all n_q positions with the same online softmax."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
+    k = kv[0].astype(jnp.float32)               # [block_s, hd]
+    v = kv[1].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)            # [n_q * g, hd]
+
+    @pl.when(j * block_s < length)
+    def _update():
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        kpos = j * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        # per-row causal horizon: query r//g sits at length-n_q+r//g
+        qpos = (length - n_q) + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // g
+        valid = kpos <= qpos                    # implies kpos < length
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new) * valid
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _done():
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _kernel_multi_prefetch(bt_ref, lens_ref, q_ref, pool_ref, o_ref,
+                           m_scr, l_scr, acc_scr, *, nkv, **kw):
+    del bt_ref
+    _paged_multi_body(lens_ref[pl.program_id(0) // nkv], q_ref,
+                      pool_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
+def _kernel_multi_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr,
+                            l_scr, acc_scr, **kw):
+    _paged_multi_body(lens_ref[pl.program_id(0), 0], q_ref, pg_ref,
+                      o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
 def gather_pages(kv_pool, block_tables):
     """Pure-jnp page gather: materialize the block-table indirection as
     dense K/V. kv_pool: [NB, 2, nkv, bs, hd]; block_tables: int32
@@ -202,6 +266,83 @@ def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None):
     return out.reshape(B, nkv, g, hd).reshape(B, nh, hd)
 
 
+def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
+                          sm_scale=None):
+    """Multi-query paged decode (speculative-decode verification):
+    q: [B, n_q, nh, hd] — each sequence scores n_q query tokens in one
+    sweep, query i at absolute position seq_lens[b] - n_q + i, masked
+    causally per query. seq_lens: int32 [B] valid lengths INCLUDING
+    the n_q new tokens (whose K/V must already sit in the pool).
+    Same block-table contract as ``paged_attention``; rides the same
+    scalar-prefetch grid on TPU (the n_q axis folds into the q block,
+    so each page is still DMA'd once per sequence*kv-head). Returns
+    [B, n_q, nh, hd]."""
+    B, n_q, nh, hd = q.shape
+    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
+    MB = block_tables.shape[1]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+
+    # [B, n_q, nkv, g, hd] -> [B, nkv, n_q, g, hd] -> rows (n_q, g)
+    qg = jnp.transpose(q.reshape(B, n_q, nkv, g, hd),
+                       (0, 2, 1, 3, 4)).reshape(B * nkv, n_q * g, hd)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    _require_pltpu()
+    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
+              n_q=n_q, g=g)
+    rows = n_q * g
+    scratch = [pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, hd), jnp.float32)]
+    out_shape = jax.ShapeDtypeStruct((B * nkv, rows, hd), q.dtype)
+    q_spec = pl.BlockSpec((1, rows, hd), lambda i, j: (i, 0, 0))
+    o_spec = pl.BlockSpec((1, rows, hd), lambda i, j: (i, 0, 0))
+
+    if _interpret():
+        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
+        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
+            B * nkv, MB, 2, block_s, hd)
+        lens_r = jnp.repeat(lens, nkv).reshape(B * nkv, 1)
+        out = pl.pallas_call(
+            functools.partial(_kernel_multi_interpret, **kw),
+            grid=(B * nkv, MB),
+            in_specs=[
+                pl.BlockSpec((B * nkv, 1), lambda i, j: (0, 0)),
+                q_spec,
+                pl.BlockSpec((1, 1, 2, block_s, hd),
+                             lambda i, j: (i, j, 0, 0, 0)),
+            ],
+            out_specs=o_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=True,
+        )(lens_r, qg, pg)
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * nkv, MB),
+            in_specs=[
+                pl.BlockSpec((1, rows, hd),
+                             lambda i, j, bt_, l_: (i, 0, 0)),
+                pl.BlockSpec((1, 2, 1, block_s, hd),
+                             lambda i, j, bt_, l_: (bt_[i // nkv, j], 0,
+                                                    i % nkv, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, hd), lambda i, j, bt_, l_:
+                                   (i, 0, 0)),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            functools.partial(_kernel_multi_prefetch, nkv=nkv, **kw),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+        )(bt, lens, qg, kv_pool)
+    out = out.reshape(B, nkv, n_q, g, hd)
+    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, n_q, nh, hd)
+
+
 def paged_attention_reference(q, kv_pool, block_tables, seq_lens,
                               sm_scale=None):
     """jnp reference: gather pages dense, then the decode reference."""
@@ -209,3 +350,29 @@ def paged_attention_reference(q, kv_pool, block_tables, seq_lens,
     k, v = gather_pages(kv_pool, block_tables)
     return decode_attention_reference(q, k, v, seq_lens,
                                       sm_scale=sm_scale)
+
+
+def paged_attention_multi_reference(q, kv_pool, block_tables, seq_lens,
+                                    sm_scale=None):
+    """jnp reference for the multi-query path: gather pages dense,
+    per-query causal mask, plain softmax."""
+    B, n_q, nh, hd = q.shape
+    nkv = kv_pool.shape[2]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    k, v = gather_pages(kv_pool, block_tables)   # [B, S, nkv, hd]
+    S = k.shape[1]
+    k = jnp.repeat(k, g, axis=2)                 # GQA: broadcast kv heads
+    v = jnp.repeat(v, g, axis=2)
+    scores = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    qpos = (lens[:, None] - n_q)[:, None, :, None] + \
+        jnp.arange(n_q)[None, None, :, None]
+    kpos = jnp.arange(S)[None, None, None, :]
+    scores = jnp.where(kpos <= qpos, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    # rows with no valid key (inactive, lens <= n_q - 1 - i) -> zeros
+    p = jnp.where((kpos <= qpos) & (qpos >= 0), p, 0.0)
+    out = jnp.einsum("bhqs,bshd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

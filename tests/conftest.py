@@ -26,3 +26,38 @@ def _seed_everything():
     import paddle_tpu as paddle
     paddle.seed(0)
     yield
+
+
+# --- speculative-decode per-test budget (tools/spec_budget.py) -------
+# The spec subsystem's tests drive whole serving loops; an accidental
+# blowup there would eat the tier-1 timeout. Any ``spec``-marked test
+# (and anything in tests/test_spec*, marker or not) whose CALL phase
+# exceeds the budget fails the SESSION with a named report.
+_SPEC_DURATIONS = {}
+_SPEC_NODEIDS = set()
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("spec") is not None or \
+                "/test_spec" in str(item.fspath).replace("\\", "/"):
+            _SPEC_NODEIDS.add(item.nodeid)
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and report.nodeid in _SPEC_NODEIDS:
+        _SPEC_DURATIONS[report.nodeid] = report.duration
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _SPEC_DURATIONS:
+        return
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from tools import spec_budget
+    over = spec_budget.check(_SPEC_DURATIONS)
+    if over:
+        print("\n" + spec_budget.report(over))
+        session.exitstatus = 1

@@ -27,3 +27,22 @@ def test_serving_prefix_smoke_leg():
     # both paths generated every requested token
     assert res["cold"]["decode_steps"] > 0
     assert res["prefix"]["decode_steps"] > 0
+
+
+def test_serving_spec_smoke_leg():
+    res = bench_extra.bench_serving_spec(smoke=True)
+    assert res["metric"] == "serving_speculative_vs_plain_token_decode"
+    spec = res["speculative"]
+    # the truncated-layer draft really speculates: proposals flow,
+    # most verify, and each target step emits more than one token
+    assert spec["proposed"] > 0
+    assert spec["acceptance_rate_pct"] >= 50.0
+    assert spec["tokens_per_target_step"] > 1.5
+    assert spec["proposed"] == spec["accepted"] + spec["rolled_back"]
+    # fewer target-model steps than emitted tokens == the whole point;
+    # the wall-clock ratio itself is asserted only at bench scale
+    # (timing at smoke shapes is jitter-dominated)
+    total = res["requests"] * res["gen_per_request"]
+    assert spec["target_steps"] < total
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["spec_vs_plain_tokens_per_sec"] > 0

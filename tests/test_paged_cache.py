@@ -122,6 +122,129 @@ class TestBlockAllocator:
             np.asarray(cache.pools[0].numpy())[shared], before)
 
 
+class TestTruncateRollback:
+    """Speculative-decode rollback at the allocator level:
+    PagedKVCache.truncate drops the block-table tail refcount- and
+    cached-free-aware (inference/speculative.py rolls back rejected
+    windows through it every round)."""
+
+    def _cache(self, prefix_cache=False, num_blocks=10):
+        return PagedKVCache(1, HEADS, D // HEADS, block_size=BS,
+                            num_blocks=num_blocks, max_seqs=2,
+                            max_blocks_per_seq=MB,
+                            prefix_cache=prefix_cache)
+
+    def test_truncate_across_block_boundary(self):
+        """A rollback spanning several pages frees every block past
+        the new boundary in one call; the kept partial block stays."""
+        cache = self._cache()
+        cache.ensure(0, 3 * BS + 5)            # 4 blocks
+        assert len(cache.seq_blocks[0]) == 4
+        free_before = cache.allocator.num_free
+        cache.truncate(0, BS + 3)              # keep 2 blocks
+        assert len(cache.seq_blocks[0]) == 2
+        assert cache.allocator.num_free == free_before + 2
+        assert (cache.block_tables[0, 2:] == 0).all()
+        # re-extend reuses the freed blocks (allocate-on-write again)
+        cache.ensure(0, 3 * BS)
+        assert len(cache.seq_blocks[0]) == 3
+        # truncate to an exact boundary drops nothing extra
+        cache.truncate(0, 2 * BS)
+        assert len(cache.seq_blocks[0]) == 2
+        # no-op when nothing lies past the boundary
+        cache.truncate(0, 2 * BS - 1)
+        assert len(cache.seq_blocks[0]) == 2
+        with pytest.raises(ValueError):
+            cache.truncate(0, -1)
+
+    def test_truncate_shared_page_derefs_not_frees(self):
+        """Truncating into a fork-shared (refcount > 1) page must drop
+        ONE owner: the peer keeps the block and its contents."""
+        model = _model()
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB)
+        scratch = model.gen_cache(1, MAXLEN)
+        rng = np.random.RandomState(21)
+        with paddle.no_grad():
+            _, rc = model(_prompt(rng, 2 * BS).unsqueeze(0),
+                          caches=scratch, time_step=0)
+        cache.ensure(0, 2 * BS)
+        cache.write_prefill(0, rc, 2 * BS)
+        cache.fork(0, 1, 2 * BS)               # both blocks shared
+        shared = list(cache.seq_blocks[0])
+        assert all(cache.allocator.refcount[b] == 2 for b in shared)
+        before = np.asarray(cache.pools[0].numpy())[shared[1]].copy()
+        free_before = cache.allocator.num_free
+        cache.truncate(1, BS)                  # slot 1 drops block 1
+        assert cache.seq_blocks[1] == shared[:1]
+        assert cache.allocator.refcount[shared[1]] == 1   # deref'd
+        assert cache.allocator.num_free == free_before    # NOT freed
+        np.testing.assert_array_equal(
+            np.asarray(cache.pools[0].numpy())[shared[1]], before)
+        # slot 0 still owns both; truncating IT now really frees
+        cache.truncate(0, BS)
+        assert cache.allocator.refcount[shared[1]] == 0
+        assert cache.allocator.num_free == free_before + 1
+
+    def test_truncate_to_boundary_parks_indexed_block_then_resurrects(self):
+        """Truncating a hash-indexed block to its boundary parks it
+        CACHED-FREE (not the free list); re-extending the same prefix
+        (a new adoption of the same chain) resurrects the very same
+        pool block instead of recomputing it."""
+        from paddle_tpu.inference import chain_block_hashes
+        model = _model()
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB,
+                                      prefix_cache=True)
+        scratch = model.gen_cache(1, MAXLEN)
+        rng = np.random.RandomState(22)
+        prompt = _prompt(rng, 2 * BS)
+        with paddle.no_grad():
+            _, rc = model(prompt.unsqueeze(0), caches=scratch,
+                          time_step=0)
+        cache.ensure(0, 2 * BS)
+        cache.write_prefill(0, rc, 2 * BS)
+        hashes = chain_block_hashes(np.asarray(prompt.numpy()), BS)
+        cache.register_prefix(0, hashes)
+        b1 = cache.seq_blocks[0][1]
+        assert cache.allocator.num_cached == 0
+        cache.truncate(0, BS)                  # drop the indexed page
+        assert cache.allocator.num_cached == 1  # parked, not freed
+        assert cache.match_prefix(hashes) == cache.seq_blocks[0] + [b1]
+        # re-extend via adoption on a fresh slot: the parked block
+        # resurrects (same id, no recompute, no pool draw)
+        n = cache.adopt_prefix(1, hashes)
+        assert n == 2
+        assert cache.seq_blocks[1][1] == b1
+        assert cache.allocator.num_cached == 0
+        assert cache.allocator.refcount[b1] == 1
+
+    def test_truncate_then_append_cow_splits_kept_shared_page(self):
+        """After a rollback to mid-page of a SHARED page, the next
+        append must still COW-split it (ensure's write-range split):
+        the peer's view of the page never changes."""
+        model = _model()
+        cache = model.gen_paged_cache(block_size=BS, num_blocks=10,
+                                      max_seqs=2, max_blocks_per_seq=MB)
+        scratch = model.gen_cache(1, MAXLEN)
+        rng = np.random.RandomState(23)
+        with paddle.no_grad():
+            _, rc = model(_prompt(rng, BS + 8).unsqueeze(0),
+                          caches=scratch, time_step=0)
+        cache.ensure(0, BS + 8)
+        cache.write_prefill(0, rc, BS + 8)
+        cache.fork(0, 1, BS + 8)
+        shared = cache.seq_blocks[0][1]
+        cache.truncate(1, BS + 4)              # keeps the shared page
+        assert cache.seq_blocks[1][1] == shared
+        before = np.asarray(cache.pools[0].numpy())[shared].copy()
+        cache.ensure(1, BS + 5)                # next write: COW fires
+        assert cache.seq_blocks[1][1] != shared
+        assert cache.allocator.refcount[shared] == 1
+        np.testing.assert_array_equal(
+            np.asarray(cache.pools[0].numpy())[shared], before)
+
+
 class TestBf16Pool:
     def test_bf16_pool_bytes_and_decode_smoke(self):
         """pool_bytes crashed on bfloat16 pools (np.dtype(str(...))

@@ -19,7 +19,8 @@ from paddle_tpu.ops.pallas.fused_norm import (
 from paddle_tpu.ops.pallas.grouped_gemm import (
     gmm, gmm_reference, make_group_metadata)
 from paddle_tpu.ops.pallas.paged_attention import (
-    gather_pages, paged_attention, paged_attention_reference)
+    gather_pages, paged_attention, paged_attention_multi,
+    paged_attention_multi_reference, paged_attention_reference)
 
 rng = np.random.default_rng(0)
 
@@ -267,6 +268,73 @@ class TestPagedAttention:
             np.asarray(decode_attention_reference(q, k, v, lens)),
             atol=1e-5, rtol=1e-5)
         assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestPagedAttentionMulti:
+    """Multi-query paged decode (speculative-decode verification):
+    n_q query tokens per sequence score all their positions in one
+    sweep over the pages, each masked causally to its own position."""
+
+    @pytest.mark.parametrize("nh,nkv", [(8, 4), (4, 4)])
+    def test_matches_reference(self, nh, nkv):
+        B, n_q, hd, bs, MB, NB = 3, 4, 32, 16, 4, 12
+        q = _rand(B, n_q, nh, hd)
+        pool = _rand(NB, 2, nkv, bs, hd)
+        bt = jnp.asarray(rng.integers(0, NB, (B, MB)), jnp.int32)
+        lens = jnp.asarray([5, 64, 17], jnp.int32)  # incl. the n_q new
+        out = paged_attention_multi(q, pool, bt, lens)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(paged_attention_multi_reference(q, pool, bt,
+                                                       lens)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_nq1_equals_single_query_kernel(self):
+        """n_q == 1 must be exactly the plain paged decode."""
+        B, nh, hd, bs, MB, NB = 2, 4, 16, 8, 4, 9
+        q = _rand(B, 1, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray(rng.integers(0, NB, (B, MB)), jnp.int32)
+        lens = jnp.asarray([9, 32], jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(paged_attention_multi(q, pool, bt, lens))[:, 0],
+            np.asarray(paged_attention(q[:, 0], pool, bt, lens)))
+
+    def test_last_row_equals_single_at_same_length(self):
+        """The final query of an n_q sweep sees exactly the window a
+        single-query call at the same length sees (to float tolerance:
+        the folded [n_q*g, bs] dots group differently than [g, bs] —
+        bit-identity is the CPU fallback's contract, not the
+        kernel's)."""
+        B, n_q, nh, hd, bs, MB, NB = 2, 3, 4, 16, 8, 4, 9
+        q = _rand(B, n_q, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray(rng.integers(0, NB, (B, MB)), jnp.int32)
+        lens = jnp.asarray([9, 30], jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(paged_attention_multi(q, pool, bt, lens))[:, -1],
+            np.asarray(paged_attention(q[:, -1], pool, bt, lens)),
+            atol=2e-6, rtol=2e-6)
+
+    def test_causal_within_window_and_trash_masked(self):
+        """Query i must not see positions past lens-n_q+i (the yet-
+        unaccepted speculative tail), and table entries past the
+        allocation (trash block 0) must not leak."""
+        B, n_q, nh, hd, bs, MB, NB = 1, 3, 4, 16, 8, 3, 6
+        q = _rand(B, n_q, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray([[3, 0, 0]], jnp.int32)   # 1 real page + trash
+        lens = jnp.asarray([7], jnp.int32)         # 4 old + 3 new
+        out = np.asarray(paged_attention_multi(q, pool, bt, lens))
+        # row 0 (position 4): perturbing positions 5.. must not move it
+        pool2 = pool.at[3, :, :, 5:8, :].set(123.0)
+        out2 = np.asarray(paged_attention_multi(q, pool2, bt, lens))
+        np.testing.assert_array_equal(out[:, 0], out2[:, 0])
+        # trash-block garbage must not move anything
+        pool3 = pool.at[0].set(1e6)
+        out3 = np.asarray(paged_attention_multi(q, pool3, bt, lens))
+        np.testing.assert_array_equal(out, out3)
+        assert np.isfinite(out).all()
 
 
 class TestDecodeAttention:

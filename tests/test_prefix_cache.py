@@ -182,6 +182,46 @@ class TestSharedSystemPrompt:
         assert st.lookup_blocks == 6 and st.hit_blocks == 2
 
 
+class TestMinSuffixRows:
+    def test_one_row_suffix_regression(self):
+        """Regression for the hoisted MIN_PREFILL_SUFFIX_ROWS
+        constant: a prompt whose uncached tail is ONE row must still
+        admit and decode bit-identically. Without the clamp the
+        suffix-only prefill would run a 1-row attention, which lowers
+        to a GEMV with different accumulation than the same row inside
+        a multi-row prefill — the partial prefill keeps at least
+        MIN_PREFILL_SUFFIX_ROWS recomputed rows instead."""
+        from paddle_tpu.inference import MIN_PREFILL_SUFFIX_ROWS
+        assert MIN_PREFILL_SUFFIX_ROWS >= 2
+        model = _model()
+        rng = np.random.RandomState(9)
+        sys_prompt = rng.randn(3 * BS, D).astype(np.float32)
+        # 1-token tail: the dangerous shape
+        prompt = np.concatenate(
+            [sys_prompt, rng.randn(1, D).astype(np.float32)])
+
+        cold = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                  num_blocks=12, max_blocks_per_seq=MB)
+        warm = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                  num_blocks=12, max_blocks_per_seq=MB,
+                                  prefix_cache=True)
+        _serve_one(cold, prompt, 0)
+        _serve_one(warm, prompt, 0)     # registers the 3 prompt pages
+        hc, sc, tc = _serve_one(cold, prompt, 6)
+        hw, sw, tw = _serve_one(warm, prompt, 6)
+        np.testing.assert_array_equal(hc, hw)
+        for a, b in zip(sc, sw):
+            np.testing.assert_array_equal(a, b)
+        assert tc == tw
+        st = warm.prefix_stats
+        # all 3 blocks hit on the second admission, but the suffix
+        # kept MIN_PREFILL_SUFFIX_ROWS rows: skipped tokens stop at
+        # T - MIN_PREFILL_SUFFIX_ROWS, not at the 3-block boundary
+        T = 3 * BS + 1
+        assert st.hit_blocks == 3
+        assert st.tokens_skipped == T - MIN_PREFILL_SUFFIX_ROWS
+
+
 class TestHitDivergeCOW:
     def test_fully_cached_prompt_shares_every_page(self):
         """B's prompt fully matches A's 3 registered pages while A is

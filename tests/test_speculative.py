@@ -1,0 +1,387 @@
+"""Speculative decoding subsystem (inference/speculative.py +
+PagedServingEngine.step_multi/rollback + PagedKVCache.truncate).
+
+The acceptance bar is BIT-IDENTITY: with greedy sampling, every token
+a SpeculativeEngine emits must equal the non-speculative paged decode
+stream for the same prompts — whatever the draft proposes, after
+mid-stream rejection rollbacks, under prefix caching, and across a
+preempt -> re-prefill cycle. Every emitted token is an argmax over
+TARGET logits, and the multi-query verification computes each
+position's hidden with the same masked full-extent reductions as the
+one-token step.
+
+Each test carries the ``spec`` marker; the conftest budget hook
+(tools/spec_budget.py) fails the session if any of them exceeds the
+60 s budget, so this subsystem cannot blow the tier-1 timeout.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (PagedServingEngine, SpecDecodeStats,
+                                  SpeculativeEngine, TokenServingModel)
+
+pytestmark = pytest.mark.spec
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+BS, MB = 16, 4            # 16-token pages, 4 pages/seq (64 tokens)
+VOCAB = 50
+
+_RNG = np.random.RandomState(1234)
+_EMBED = _RNG.randn(VOCAB, D).astype(np.float32)
+_HEAD = _RNG.randn(D, VOCAB).astype(np.float32)
+
+
+def _target():
+    paddle.seed(0)
+    core = FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+    return TokenServingModel(core, _EMBED, _HEAD)
+
+
+def _adversarial_draft():
+    """An unrelated random model sharing only the token surface: its
+    proposals are near-noise to the target, so almost every round
+    rejects mid-window and exercises the rollback path."""
+    paddle.seed(99)
+    core = FusedMultiTransformer(D, HEADS, FFN, num_layers=1)
+    return TokenServingModel(core, _EMBED, _HEAD)
+
+
+def _prompts(n, lens=(7, 12, 5, 9)):
+    rng = np.random.default_rng(42)
+    return [list(rng.integers(0, VOCAB, lens[i % len(lens)]))
+            for i in range(n)]
+
+
+def _serve(eng, prompts, n_gen, max_rounds=200):
+    """Submit everything, step until every request generated n_gen
+    tokens (releasing as they finish). Returns per-prompt streams."""
+    rids = [eng.submit(p) for p in prompts]
+    done = {}
+    for _ in range(max_rounds):
+        live = [r for r in rids if r not in done]
+        if not live:
+            break
+        eng.step()
+        for r in live:
+            if r in eng._by_rid and len(eng.generated(r)) >= n_gen:
+                done[r] = eng.generated(r)[:n_gen]
+                eng.release(r)
+    assert len(done) == len(rids), "serve loop did not converge"
+    return [done[r] for r in rids]
+
+
+def _raw_paged_decode(tsm, prompts, n_gen, max_batch=2):
+    """The PRE-EXISTING non-speculative paged decode loop, driven at
+    the embedding level (PagedServingEngine.step, one token per call)
+    with the token readout done through the same TokenServingModel
+    ops — the reference stream the speculative engine must reproduce
+    bit-for-bit."""
+    eng = PagedServingEngine(tsm.core, max_batch=max_batch,
+                             block_size=BS, num_blocks=40,
+                             max_blocks_per_seq=MB)
+    out_toks = {}
+    pending = {}
+    for p in prompts:
+        rid = eng.submit(paddle.to_tensor(tsm.embed(p)))
+        (r, slot, h), = eng.admitted
+        eng.admitted.clear()
+        tok = int(np.asarray(paddle.argmax(tsm.logits(h),
+                                           axis=-1).numpy()).reshape(-1)[0])
+        toks = [tok]
+        x = np.zeros((max_batch, 1, D), np.float32)
+        while len(toks) < n_gen:
+            x[slot, 0] = tsm.embed(toks[-1])
+            out = eng.step(paddle.to_tensor(x))
+            nxt = np.asarray(paddle.argmax(tsm.logits(out),
+                                           axis=-1).numpy())
+            toks.append(int(nxt[slot, 0]))
+        eng.release(slot)
+        out_toks[rid] = toks
+    return [out_toks[r] for r in sorted(out_toks)]
+
+
+class TestTokenServingModel:
+    def test_embed_logits_greedy(self):
+        tsm = _target()
+        assert tsm.vocab_size == VOCAB and tsm.d_model == D
+        rows = tsm.embed([3, 7])
+        np.testing.assert_array_equal(rows, _EMBED[[3, 7]])
+        h = paddle.to_tensor(np.random.randn(2, 3, D).astype(np.float32))
+        lg = tsm.logits(h)
+        assert list(lg.shape) == [2, 3, VOCAB]
+        toks, probs = tsm.sample(lg)           # greedy
+        assert probs is None and toks.shape == (2, 3)
+        np.testing.assert_array_equal(
+            toks, np.argmax(np.asarray(lg.numpy()), axis=-1))
+
+    def test_tied_head_default(self):
+        tsm = TokenServingModel(_target().core, _EMBED)
+        h = paddle.to_tensor(_EMBED[:2][None])
+        lg = np.asarray(tsm.logits(h).numpy())
+        np.testing.assert_allclose(lg[0], _EMBED[:2] @ _EMBED.T,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_probs_temperature_topk(self):
+        tsm = _target()
+        lg = paddle.to_tensor(np.random.randn(4, VOCAB).astype(np.float32))
+        p = np.asarray(tsm.probs(lg, temperature=0.7, top_k=5).numpy())
+        np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-5)
+        assert ((p > 1e-8).sum(-1) <= 5).all()   # top-k masked
+        rng = np.random.RandomState(0)
+        toks, probs = tsm.sample(lg, mode="top_k", temperature=0.7,
+                                 top_k=5, rng=rng)
+        assert toks.shape == (4,) and probs.shape == (4, VOCAB)
+        # every draw must come from the top-k support
+        assert all(probs[i, toks[i]] > 1e-8 for i in range(4))
+
+    def test_bad_token_raises(self):
+        tsm = _target()
+        with pytest.raises(ValueError):
+            tsm.embed([VOCAB])
+        with pytest.raises(ValueError):
+            tsm.sample(paddle.to_tensor(np.zeros((1, VOCAB), np.float32)),
+                       mode="nope")
+
+
+class TestGreedyBitIdentity:
+    """ACCEPTANCE: greedy speculative decode == non-speculative paged
+    decode, bit for bit, token for token."""
+
+    def test_selfdraft_matches_raw_and_k0(self):
+        tsm = _target()
+        prompts = _prompts(2)[:2]
+        raw = _raw_paged_decode(tsm, prompts, 12)
+
+        def eng(k):
+            return SpeculativeEngine(tsm, None, k=k, max_batch=2,
+                                     block_size=BS, num_blocks=40,
+                                     max_blocks_per_seq=MB)
+        base = _serve(eng(0), prompts, 12)
+        spec = _serve(eng(3), prompts, 12)
+        assert base == raw            # k=0 == the plain engine loop
+        assert spec == raw            # speculation changes nothing
+        # self-drafting: the draft IS the target, so greedy proposals
+        # always verify — every window fully accepted
+        e = eng(3)
+        _serve(e, prompts, 12)
+        assert e.stats.acceptance_rate == 1.0
+        assert e.stats.tokens_per_target_step == 4.0
+
+    def test_adversarial_draft_rolls_back_and_still_matches(self):
+        """Mid-stream rejection: a noise draft forces rollbacks nearly
+        every round; the emitted stream must still be the baseline's
+        (every emitted token is target-derived)."""
+        tsm = _target()
+        prompts = _prompts(2)
+        raw = _raw_paged_decode(tsm, prompts, 12)
+        e = SpeculativeEngine(tsm, _adversarial_draft(), k=3,
+                              max_batch=2, block_size=BS,
+                              num_blocks=40, max_blocks_per_seq=MB)
+        spec = _serve(e, prompts, 12)
+        assert spec == raw
+        assert e.stats.rolled_back > 0           # rollback exercised
+        assert e.stats.acceptance_rate < 0.5
+        assert e.stats.proposed == e.stats.accepted + e.stats.rolled_back
+
+    def test_prefix_cache_composes_bit_identical(self):
+        """prefix_cache=True under speculation: shared system-prompt
+        pages are adopted, speculative tails roll back over adopted
+        tables (COW-aware), and the stream still equals the cold
+        non-speculative baseline."""
+        tsm = _target()
+        rng = np.random.default_rng(7)
+        sysp = list(rng.integers(0, VOCAB, 2 * BS))
+        prompts = [sysp + list(rng.integers(0, VOCAB, 5))
+                   for _ in range(4)]
+        raw = _raw_paged_decode(tsm, prompts, 10)
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=2,
+                              block_size=BS, num_blocks=40,
+                              max_blocks_per_seq=MB, prefix_cache=True)
+        spec = _serve(e, prompts, 10)
+        assert spec == raw
+        assert e.engine.prefix_stats.hit_blocks > 0   # cache really hit
+
+    def test_preemption_reprefill_composes_bit_identical(self):
+        """A pool too small for both requests preempts mid-decode; the
+        victim re-prefills from its ACCEPTED-only history and the
+        emitted streams still equal the roomy baseline's."""
+        tsm = _target()
+        prompts = _prompts(2, lens=(14, 14))
+        raw = _raw_paged_decode(tsm, prompts, 20)
+        # 5 blocks -> 4 usable: the first sequence to need a 3rd page
+        # (len > 32) evicts the other, which re-prefills after the
+        # winner releases
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=2,
+                              block_size=BS, num_blocks=5,
+                              max_blocks_per_seq=MB)
+        evictions = []
+        orig_preempt = e.engine.preempt
+        e.engine.preempt = lambda slot: (evictions.append(slot),
+                                         orig_preempt(slot))[1]
+        spec = _serve(e, prompts, 20)
+        assert spec == raw
+        assert evictions, "pool pressure never evicted anyone"
+
+
+class TestRejectionSampling:
+    def test_selfdraft_sampling_accepts_everything(self):
+        """p == q when the draft is the target, so rejection sampling
+        must accept every proposal (ratio clamps to 1)."""
+        tsm = _target()
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=1,
+                              block_size=BS, num_blocks=20,
+                              max_blocks_per_seq=MB, sampling="top_k",
+                              temperature=0.8, top_k=8, seed=3)
+        _serve(e, _prompts(1), 12)
+        assert e.stats.proposed > 0
+        assert e.stats.accepted == e.stats.proposed
+
+    def test_adversarial_sampling_valid_tokens(self):
+        tsm = _target()
+        e = SpeculativeEngine(tsm, _adversarial_draft(), k=3,
+                              max_batch=1, block_size=BS,
+                              num_blocks=20, max_blocks_per_seq=MB,
+                              sampling="top_k", temperature=1.0,
+                              top_k=10, seed=5)
+        (toks,) = _serve(e, _prompts(1), 12)
+        assert all(0 <= t < VOCAB for t in toks)
+        assert e.stats.rolled_back > 0
+        # the first generated token is sampled at admission, outside
+        # the spec loop's accounting, hence >= n_gen - 1
+        assert e.stats.emitted >= 11
+
+
+class TestEngineMechanics:
+    def test_capacity_finish_and_depth_clamp(self):
+        """Near page capacity the speculation window clamps (L shrinks
+        to the remaining room); AT capacity the request retires into
+        ``finished`` instead of riding a multi-token call."""
+        tsm = _target()
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=1,
+                              block_size=8, num_blocks=20,
+                              max_blocks_per_seq=2)   # capacity 16
+        rid = e.submit(_prompts(1, lens=(10,))[0])
+        for _ in range(20):
+            e.step()
+            if e.finished:
+                break
+        assert e.finished and e.finished[0][0] == rid
+        assert len(e.tokens(rid)) == 16 + 1   # capacity + pending
+        # the k=0-degenerate clamped rounds still kept draft/target
+        # lengths in lockstep (no drift assertion == no crash)
+
+    def test_release_while_queued_no_orphan(self):
+        """Releasing a request BEFORE admission must pull it from the
+        engine queue too — otherwise a later refill admits a slot this
+        wrapper no longer tracks and the engine wedges."""
+        tsm = _target()
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=1,
+                              block_size=BS, num_blocks=20,
+                              max_blocks_per_seq=MB)
+        p = _prompts(3)
+        r1 = e.submit(p[0])             # admitted
+        r2 = e.submit(p[1])             # queued (one slot)
+        assert e._by_rid[r2].slot is None
+        e.release(r2)                   # never admitted
+        assert not any(req.rid == r2 for req in e.engine.queue)
+        # finish r1: the refill must NOT resurrect r2
+        for _ in range(30):
+            e.step()
+            if len(e.generated(r1)) >= 8:
+                break
+        e.release(r1)
+        assert e.engine.num_active == 0 and not e.engine.queue
+        # a fresh request still serves normally
+        r3 = e.submit(p[2])
+        for _ in range(30):
+            e.step()
+            if len(e.generated(r3)) >= 4:
+                break
+        assert len(e.generated(r3)) >= 4
+
+    def test_full_capacity_prompt_retires_not_crashes(self):
+        """A prompt of exactly page-capacity length admitted mid-step
+        (behind a full batch) generates nothing — it must retire into
+        ``finished``, not crash the multi-token capacity check."""
+        tsm = _target()
+        cap = 2 * 8                     # 2 pages * 8
+        e = SpeculativeEngine(tsm, None, k=3, max_batch=1,
+                              block_size=8, num_blocks=20,
+                              max_blocks_per_seq=2)
+        r1 = e.submit(_prompts(1, lens=(4,))[0])
+        r2 = e.submit([1] * cap)        # queued at full capacity
+        for _ in range(40):
+            e.step()
+            if len(e.generated(r1)) >= 8:
+                break
+        e.release(r1)                   # r2 admits at lens == cap
+        for _ in range(5):
+            e.step()                    # must retire r2, not raise
+            if any(rid == r2 for rid, _ in e.finished):
+                break
+        assert any(rid == r2 for rid, _ in e.finished)
+        assert len(e.tokens(r2)) == cap + 1   # prompt + pending
+
+    def test_step_multi_guards(self):
+        tsm = _target()
+        eng = PagedServingEngine(tsm.core, max_batch=1, block_size=8,
+                                 num_blocks=8, max_blocks_per_seq=2)
+        with pytest.raises(RuntimeError):
+            eng.step_multi(paddle.to_tensor(
+                np.zeros((1, 2, D), np.float32)))
+        rid = eng.submit(paddle.to_tensor(tsm.embed([1] * 15)))
+        eng.admitted.clear()
+        with pytest.raises(ValueError, match="within capacity"):
+            eng.step_multi(paddle.to_tensor(
+                np.zeros((1, 2, D), np.float32)))
+
+    def test_rollback_guards(self):
+        tsm = _target()
+        eng = PagedServingEngine(tsm.core, max_batch=1, block_size=8,
+                                 num_blocks=8, max_blocks_per_seq=2)
+        with pytest.raises(ValueError, match="not active"):
+            eng.rollback(0, 1)
+        eng.submit(paddle.to_tensor(tsm.embed([1, 2, 3])))
+        eng.admitted.clear()
+        with pytest.raises(ValueError, match="outside"):
+            eng.rollback(0, 4)     # beyond consumed length
+        eng.rollback(0, 2)         # drop one consumed token
+        assert eng.lens[0] == 2
+        assert len(eng._requests[0].history) == 2
+
+    def test_stats_export_next_to_prefix_stats(self):
+        st = SpecDecodeStats()
+        d = st.as_dict()
+        assert d["acceptance_rate"] == 0.0
+        st.proposed, st.accepted, st.emitted, st.target_steps = 8, 6, 8, 2
+        assert st.acceptance_rate == 0.75
+        assert st.tokens_per_target_step == 4.0
+        assert "tokens_per_target_step" in st.as_dict()
+
+
+class TestStepMultiParity:
+    def test_multi_token_rows_match_single_steps(self):
+        """The core numeric claim, isolated: hiddens from ONE L-token
+        step_multi call are bit-identical to the same tokens fed
+        through L single-token step calls (same engine state)."""
+        tsm = _target()
+
+        def fresh():
+            eng = PagedServingEngine(tsm.core, max_batch=2,
+                                     block_size=BS, num_blocks=20,
+                                     max_blocks_per_seq=MB)
+            for p in _prompts(2):
+                eng.submit(paddle.to_tensor(tsm.embed(p)))
+            eng.admitted.clear()
+            return eng
+        rows = np.random.default_rng(0).standard_normal(
+            (2, 3, D)).astype(np.float32)
+        multi = np.asarray(fresh().step_multi(
+            paddle.to_tensor(rows)).numpy())
+        eng = fresh()
+        singles = [np.asarray(eng.step(paddle.to_tensor(
+            rows[:, i:i + 1].copy())).numpy()) for i in range(3)]
+        for i in range(3):
+            np.testing.assert_array_equal(multi[:, i:i + 1], singles[i])

@@ -1,0 +1,60 @@
+"""Per-test wall-clock budget for the speculative-decoding subsystem.
+
+The spec tests (tests/test_spec*, marked ``spec``) drive full serving
+loops — draft rolls, multi-token verification, rollback — so they are
+the likeliest place for an accidental O(rounds * batch) blowup to hide
+until the tier-1 suite times out. tests/conftest.py records the call
+duration of every spec test and hands the table to ``check`` at
+session finish; any test over the budget FAILS THE SESSION (exit
+status 1) with a named report, so a slow spec test is a red build, not
+a slow build.
+
+Standalone use (e.g. against a saved report):
+
+    python tools/spec_budget.py durations.json
+    # durations.json: {"tests/test_speculative.py::test_x": 3.2, ...}
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Tuple
+
+#: seconds of test-call time any single spec test may spend
+SPEC_TEST_BUDGET_S = 60.0
+
+
+def check(durations: Dict[str, float],
+          budget: float = SPEC_TEST_BUDGET_S
+          ) -> List[Tuple[str, float]]:
+    """Return the (nodeid, seconds) pairs over budget, worst first."""
+    over = [(nid, dur) for nid, dur in durations.items()
+            if dur > budget]
+    return sorted(over, key=lambda p: -p[1])
+
+
+def report(over: List[Tuple[str, float]],
+           budget: float = SPEC_TEST_BUDGET_S) -> str:
+    lines = [f"speculative-decode tests over the {budget:.0f}s budget "
+             f"(tools/spec_budget.py):"]
+    lines += [f"  {dur:8.1f}s  {nid}" for nid, dur in over]
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print(__doc__)
+        return 2
+    with open(argv[1]) as f:
+        durations = json.load(f)
+    over = check({str(k): float(v) for k, v in durations.items()})
+    if over:
+        print(report(over))
+        return 1
+    print(f"all {len(durations)} spec tests within "
+          f"{SPEC_TEST_BUDGET_S:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
