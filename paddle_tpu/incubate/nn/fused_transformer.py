@@ -208,10 +208,13 @@ class FusedMultiTransformer(nn.Layer):
                 # is the plain decode step; l > 1 appends l tokens per
                 # row from time_step on and scores each causally (the
                 # speculative-decode verification step). Prompt
-                # PREFILL still runs through a dense scratch cache +
-                # PagedKVCache.write_prefill (see inference/
-                # scheduler.py) — the multi-token path assumes the
-                # block tables already cover [t, t+l).
+                # PREFILL rides the same protocol through
+                # PagedKVCache.prefill_views: batch-1 chunk calls
+                # whose per-layer PagedPrefillView appends the chunk
+                # straight into the slot's pages and attends with a
+                # multi-row masked sdpa (inference/scheduler.py
+                # chunked_prefill) — no dense scratch. All paths
+                # assume the block tables already cover [t, t+l).
                 t = time_step.data if isinstance(time_step, Tensor) \
                     else jnp.asarray(time_step, jnp.int32)
                 # per-row positions like the ragged dense path; a

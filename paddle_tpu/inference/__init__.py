@@ -32,21 +32,24 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
-                      PrefixCacheStats, SpecDecodeStats)
+                      PrefillStats, PrefixCacheStats, SpecDecodeStats)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
+                          PagedPrefillView,
                           chain_block_hashes, chain_hash)
 from .scheduler import (MIN_PREFILL_SUFFIX_ROWS,  # noqa: F401
-                        PagedRequest, PagedServingEngine)
+                        PagedRequest, PagedServingEngine,
+                        chunked_prefill)
 from .speculative import (SpeculativeEngine,  # noqa: F401
                           TokenServingModel)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
            "BlockOOM", "PagedKVCache", "PagedLayerCache",
-           "PagedRequest", "PagedServingEngine", "PrefixCacheStats",
+           "PagedPrefillView", "PagedRequest", "PagedServingEngine",
+           "PrefillStats", "PrefixCacheStats",
            "SpecDecodeStats", "SpeculativeEngine", "TokenServingModel",
-           "MIN_PREFILL_SUFFIX_ROWS",
+           "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
            "chain_block_hashes", "chain_hash"]
 
 

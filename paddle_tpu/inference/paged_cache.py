@@ -49,7 +49,8 @@ from ..framework.op import apply
 from ..framework.tensor import Tensor
 
 __all__ = ["BlockOOM", "BlockAllocator", "PagedKVCache",
-           "PagedLayerCache", "chain_hash", "chain_block_hashes"]
+           "PagedLayerCache", "PagedPrefillView", "chain_hash",
+           "chain_block_hashes"]
 
 
 def chain_hash(parent: bytes, block_tokens) -> bytes:
@@ -202,6 +203,23 @@ def _block_copy(pool, src, dst):
     return pool.at[dst].set(pool[src])
 
 
+def _make_append_chunk(block_size, n_tokens):
+    def paged_prefill_chunk_kv(pool, k, v, t, bt, ws):
+        # chunked-prefill append: k/v [1, C, H, D] land at positions
+        # t[0] .. t[0]+C-1 through the slot's block-table row bt
+        # [1, MB]. Positions below ws (a prefix-cache hit's adopted
+        # region, whose pages already hold these exact values and may
+        # be SHARED) route to the trash block instead of rewriting —
+        # duplicate trash indices are fine, nothing reads it unmasked.
+        pos = t[:, None] + jnp.arange(n_tokens, dtype=t.dtype)[None, :]
+        blk = jnp.take_along_axis(bt, pos // block_size, axis=1)
+        blk = jnp.where(pos >= ws, blk, 0)
+        off = pos % block_size                        # [1, C]
+        pool = pool.at[blk, 0, :, off, :].set(k.astype(pool.dtype))
+        return pool.at[blk, 1, :, off, :].set(v.astype(pool.dtype))
+    return paged_prefill_chunk_kv
+
+
 def _make_prefill_scatter(start_block, n_blocks, block_size):
     def paged_prefill_scatter(pool, row_cache, blks):
         # row_cache [2, 1, H, S, D] (dense single-row scratch) -> pages
@@ -214,20 +232,6 @@ def _make_prefill_scatter(start_block, n_blocks, block_size):
         seg = jnp.transpose(seg, (2, 0, 1, 3, 4))  # [n, 2, H, bs, D]
         return pool.at[blks].set(seg.astype(pool.dtype))
     return paged_prefill_scatter
-
-
-def _make_prefix_gather(n_blocks, block_size):
-    def paged_prefix_gather(row_cache, pool, blks):
-        # inverse of the prefill scatter: pages -> the dense scratch's
-        # rows [0, n_blocks * block_size) so a partial prefill can
-        # attend over the cached prefix
-        seg = jnp.transpose(pool[blks], (1, 2, 0, 3, 4))  # [2,H,n,bs,D]
-        two, H = seg.shape[0], seg.shape[1]
-        D = seg.shape[-1]
-        seg = seg.reshape(two, H, n_blocks * block_size, D)
-        return row_cache.at[:, 0, :, :n_blocks * block_size, :].set(
-            seg.astype(row_cache.dtype))
-    return paged_prefix_gather
 
 
 class PagedLayerCache:
@@ -282,6 +286,9 @@ class PagedLayerCache:
             # contract.
             tv = np.asarray(t)
             for row in range(B):
+                if c._decode_masked is not None and \
+                        c._decode_masked[row]:
+                    continue  # row presents a trash table this step
                 have = len(c.seq_blocks[row])
                 pos = int(tv[row])
                 if (have and c.blocks_needed(pos + L) > have) or \
@@ -362,6 +369,101 @@ class PagedLayerCache:
                      (out,), op_name="spec_unfold")
 
 
+class PagedPrefillView:
+    """One layer's CHUNKED-PREFILL view of a single slot — the object
+    that rides in ``caches=`` for a batch-1 chunk call
+    (``PagedKVCache.prefill_views``). Same duck-typed protocol as
+    PagedLayerCache (``is_paged`` + ``decode``), but the chunk's C
+    rows append STRAIGHT INTO the slot's pages (no dense scratch, no
+    scatter pass) and then attend over them with a per-row causal
+    mask at absolute positions ``t[0] + i``.
+
+    Numerics contract (what keeps chunked prefill bit-identical to
+    dense scratch prefill): the CPU path runs the chunk as ONE
+    multi-row masked sdpa — the same executable family as the dense
+    prefill branch — and must NOT fold rows into the batch axis the
+    way the speculative multi path does: a row computed at q-length 1
+    lowers to a GEMV with different accumulation than the same row
+    inside a multi-row call (scheduler.MIN_PREFILL_SUFFIX_ROWS), while
+    multi-row sdpa results are per-row invariant to BOTH the chunk
+    length and the masked key extent. On TPU the Pallas
+    ``paged_attention_prefill`` kernel serves the same contract
+    through the scalar-prefetch block table."""
+
+    is_paged = True
+
+    def __init__(self, cache: "PagedKVCache", layer: int, slot: int,
+                 write_start: int = 0):
+        self._cache = cache
+        self._layer = layer
+        self._slot = slot
+        # positions below write_start are an adopted (possibly shared)
+        # prefix whose pages already hold these exact K/V — recomputed
+        # rows there attend but do not write (see _make_append_chunk)
+        self._write_start = int(write_start)
+
+    @property
+    def pool(self) -> Tensor:
+        return self._cache.pools[self._layer]
+
+    @property
+    def shape(self):
+        return self.pool.shape
+
+    def decode(self, q, k, v, t, use_kernel: bool = False):
+        """q/k/v: [1, C, H, D] — one prompt chunk for this view's
+        slot, starting at absolute position t[0] (traced int32 [1]).
+        Appends the chunk's K/V through the slot's block-table row
+        (skipping positions below ``write_start``) and returns the
+        chunk's attention output [1, C, nh, hd]. PRECONDITION:
+        ``ensure(slot, t[0]+C, write_from=t[0], start_block=...)`` —
+        every write position covered and COW-split."""
+        import jax as _jax
+        c = self._cache
+        B, C = q.shape[0], q.shape[1]
+        if B != 1:
+            raise ValueError(
+                f"chunk prefill is a batch-1 call, got batch {B}")
+        if self._layer == 0 and not isinstance(t, _jax.core.Tracer):
+            pos = int(np.asarray(t).reshape(-1)[0])
+            have = len(c.seq_blocks[self._slot])
+            if c.blocks_needed(pos + C) > have:
+                raise ValueError(
+                    f"prefill chunk [{pos}, {pos + C}) of slot "
+                    f"{self._slot} is not covered by its {have} "
+                    f"allocated block(s); call ensure() first")
+        bt = c.bt_row_tensor(self._slot)
+        tt = Tensor(t)
+        ws = Tensor(jnp.asarray([self._write_start], jnp.int32))
+        new_pool = apply(_make_append_chunk(c.block_size, C),
+                         (self.pool, k, v, tt, bt, ws),
+                         op_name="paged_prefill_chunk_kv")
+        c.pools[self._layer] = new_pool
+
+        if use_kernel:
+            def att(p, q_, tv, bta):
+                from ..ops.pallas.paged_attention import \
+                    paged_attention_prefill
+                return paged_attention_prefill(q_, p, bta, tv)
+            return apply(att, (new_pool, q, tt, bt),
+                         op_name="paged_attention_prefill")
+
+        # CPU / fallback: gather the slot's pages dense and run the
+        # chunk as ONE multi-row masked sdpa (see class docstring; the
+        # mask mirrors the dense prefill branch's construction)
+        from ..nn import functional as F
+        from ..ops.pallas.paged_attention import gather_pages
+        k_full, v_full = apply(gather_pages, (new_pool, bt),
+                               op_name="paged_gather")
+        S = k_full.shape[1]
+        qpos = t[0] + jnp.arange(C)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                      .astype(jnp.float32))
+        return F.scaled_dot_product_attention(q, k_full, v_full,
+                                              attn_mask=mask)
+
+
 class PagedKVCache:
     """Per-layer block pools + one block allocator + per-sequence block
     tables. ``views`` is the list consumed as ``caches=`` by the fused
@@ -403,6 +505,11 @@ class PagedKVCache:
         self.views = [PagedLayerCache(self, i)
                       for i in range(self.num_layers)]
         self._bt_cached: Optional[Tensor] = None
+        self._bt_rows_cached: Dict[int, Tensor] = {}
+        # rows whose table presents as ALL-TRASH to the fused decode
+        # step (mid-prefill slots: they own real pages, but a decode
+        # append at lens==0 through them would corrupt position 0)
+        self._decode_masked: Optional[np.ndarray] = None
         self.peak_blocks_used = 0
 
     # -- construction -------------------------------------------------
@@ -435,14 +542,43 @@ class PagedKVCache:
 
     def bt_tensor(self) -> Tensor:
         """Device copy of the block tables; rebuilt only after a
-        host-side table mutation."""
+        host-side table mutation. Rows in the decode mask (slots
+        mid-chunked-prefill) present as all-trash so a fused decode
+        step cannot write into their half-built pages."""
         if self._bt_cached is None:
-            self._bt_cached = Tensor(
-                jnp.asarray(self.block_tables, jnp.int32))
+            tbl = self.block_tables
+            if self._decode_masked is not None and \
+                    self._decode_masked.any():
+                tbl = tbl.copy()
+                tbl[self._decode_masked] = 0
+            self._bt_cached = Tensor(jnp.asarray(tbl, jnp.int32))
         return self._bt_cached
+
+    def bt_row_tensor(self, slot: int) -> Tensor:
+        """Device copy of ONE slot's (unmasked) block-table row
+        [1, MB] — the indirection a chunked-prefill call rides;
+        invalidated with the full table."""
+        t = self._bt_rows_cached.get(slot)
+        if t is None:
+            t = Tensor(jnp.asarray(self.block_tables[slot:slot + 1],
+                                   jnp.int32))
+            self._bt_rows_cached[slot] = t
+        return t
+
+    def set_decode_mask(self, rows: Optional[np.ndarray]) -> None:
+        """Mark rows whose pages a fused DECODE step must not touch
+        (slots mid-chunked-prefill; see bt_tensor). ``rows``: bool
+        [max_seqs] or None to clear."""
+        new = None if rows is None or not rows.any() else rows.copy()
+        old = self._decode_masked
+        if (old is None) != (new is None) or \
+                (old is not None and not np.array_equal(old, new)):
+            self._decode_masked = new
+            self._bt_cached = None
 
     def _tables_dirty(self):
         self._bt_cached = None
+        self._bt_rows_cached.clear()
         self.peak_blocks_used = max(self.peak_blocks_used,
                                     self.blocks_in_use)
 
@@ -611,20 +747,37 @@ class PagedKVCache:
             self._hash_to_block[h] = b
             self._block_hash[b] = h
 
-    def load_prefix(self, slot: int, n_blocks: int, row_caches):
-        """Gather the slot's first ``n_blocks`` pages into the dense
-        single-row scratch's positions [0, n_blocks * block_size) (per
-        layer), so a suffix-only prefill at time_step = cached tokens
-        attends over the cached prefix. Returns the updated scratch
-        Tensors."""
-        blks = Tensor(jnp.asarray(self.seq_blocks[slot][:n_blocks],
-                                  jnp.int32))
-        impl = _make_prefix_gather(n_blocks, self.block_size)
-        return [apply(impl, (rc, pool, blks),
-                      op_name="paged_prefix_gather")
-                for rc, pool in zip(row_caches, self.pools)]
-
     # -- prefill ------------------------------------------------------
+    def prefill_views(self, slot: int,
+                      write_start: int = 0) -> List["PagedPrefillView"]:
+        """Per-layer chunked-prefill views of one slot — the
+        ``caches=`` list for a batch-1 chunk model call. A suffix-only
+        (prefix-cache hit) prefill passes ``write_start`` = adopted
+        tokens: recomputed rows below it attend over the adopted pages
+        but never rewrite them (they may be shared), which is what
+        replaced the old pages->scratch gather."""
+        return [PagedPrefillView(self, i, slot, write_start=write_start)
+                for i in range(self.num_layers)]
+
+    def write_prefill_chunk(self, slot: int, layer: int, k, v,
+                            start: int, write_start: int = 0) -> None:
+        """Chunk-granular append: write k/v [1, C, H, D] Tensors into
+        this slot's pages at positions [start, start + C) (skipping
+        positions below ``write_start`` — an adopted shared prefix).
+        ``ensure(slot, start + C, write_from=start)`` must have run.
+        The model path goes through ``prefill_views`` (append + attend
+        in one protocol call); this entry serves callers that already
+        hold projected K/V — e.g. migrating a dense cache row into
+        pages chunk by chunk."""
+        C = int(k.shape[1])
+        tt = Tensor(jnp.asarray([start], jnp.int32))
+        ws = Tensor(jnp.asarray([write_start], jnp.int32))
+        bt = self.bt_row_tensor(slot)
+        self.pools[layer] = apply(
+            _make_append_chunk(self.block_size, C),
+            (self.pools[layer], k, v, tt, bt, ws),
+            op_name="paged_prefill_chunk_kv")
+
     def write_prefill(self, slot: int, row_caches, length: int,
                       start_block: int = 0) -> None:
         """Scatter a dense single-row scratch cache (the per-layer

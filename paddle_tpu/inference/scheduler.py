@@ -9,8 +9,11 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
 
   * admission: a queued request is admitted only when a slot is free
     AND the allocator can cover its prompt's pages plus a watermark;
-    prefill runs batch-1 against a persistent dense scratch cache and
-    is scattered into freshly allocated pages.
+    prefill STREAMS the prompt straight into the slot's pages in
+    fixed-size causal chunks (``chunked_prefill`` below — batch-1
+    chunk calls through PagedKVCache.prefill_views), so there is no
+    dense ``[2, 1, H, max_len, D]`` scratch allocation and no
+    pages<->scratch scatter/gather pass: peak KV memory IS the pool.
   * growth: before each fused step, every active row crossing a block
     boundary allocates its next page (allocate-on-write).
   * preemption: when the pool is exhausted, the YOUNGEST active
@@ -24,9 +27,22 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     prompt's chained block hashes against previously computed pages
     (paged_cache.match_prefix), ``ref``s the hits into the new slot's
     table, and prefills ONLY the uncached suffix — cached prefix
-    tokens cost zero prefill FLOPs and zero new blocks. Released
-    pages park cached-free (resurrectable) until LRU reclaim; hit
-    accounting rides in ``prefix_stats``.
+    tokens cost zero prefill FLOPs and zero new blocks. The suffix
+    chunk simply ATTENDS over the adopted pages through the chunk
+    protocol (no pages->scratch gather). Released pages park
+    cached-free (resurrectable) until LRU reclaim; hit accounting
+    rides in ``prefix_stats``.
+  * mixed prefill/decode steps (``prefill_token_budget=N``,
+    Sarathi-style): admission only grants the slot; each ``step``
+    first spends up to N prompt tokens advancing pending prefills
+    chunk by chunk (oldest first, growing pages under the same
+    preemption rules — no max_len block reservation up front), then
+    runs the fused decode call for the active rows, so one long
+    prompt never stalls the running batch. The admitted event fires
+    when the last chunk lands. Without a budget (the default),
+    admission runs every chunk synchronously — same external
+    behavior as the old scratch path, still scratchless inside.
+    Chunk accounting rides in ``prefill_stats`` (PrefillStats).
 
 Events are surfaced in ``admitted`` / ``finished`` / ``preempted``
 lists the caller drains between steps (prefill outputs ride along so
@@ -35,16 +51,16 @@ the caller can seed the next input row).
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
-from .serving import PrefixCacheStats
+from .serving import PrefillStats, PrefixCacheStats
 
-__all__ = ["PagedRequest", "PagedServingEngine",
+__all__ = ["PagedRequest", "PagedServingEngine", "chunked_prefill",
            "MIN_PREFILL_SUFFIX_ROWS"]
 
 # A partial (suffix-only) prefill must recompute at least this many
@@ -53,8 +69,76 @@ __all__ = ["PagedRequest", "PagedServingEngine",
 # from the same row computed inside a multi-row prefill, so a 1-row
 # suffix would break bit-identity with the cold path (and a fully
 # cached prompt still needs its last hidden for the admission event).
+# The same floor governs CHUNK boundaries: every prefill chunk keeps
+# >= MIN_PREFILL_SUFFIX_ROWS rows (chunking is bit-transparent for
+# multi-row calls — per-row sdpa results are invariant to both chunk
+# length and masked key extent — but a 1-row tail chunk would take
+# the GEMV lowering).
 # See tests/test_prefix_cache.py::test_one_row_suffix_regression.
 MIN_PREFILL_SUFFIX_ROWS = 2
+
+
+def _chunk_len(total: int, pos: int, chunk_tokens: int,
+               budget: Optional[int] = None) -> int:
+    """Next chunk length for a prefill at ``pos`` of ``total`` rows:
+    ``chunk_tokens`` capped by the remaining prompt (and the remaining
+    step budget, floored at the 2-row minimum), then adjusted so the
+    REMAINING tail is never a single row — a 1-row chunk would break
+    bit-identity (MIN_PREFILL_SUFFIX_ROWS)."""
+    c = min(chunk_tokens, total - pos)
+    if budget is not None:
+        c = min(c, max(MIN_PREFILL_SUFFIX_ROWS, budget))
+    if total - (pos + c) == 1:
+        c = c - 1 if c > MIN_PREFILL_SUFFIX_ROWS else c + 1
+    return c
+
+
+def chunked_prefill(model, cache: PagedKVCache, slot: int, rows,
+                    *, pos: int = 0, target: Optional[int] = None,
+                    chunk_tokens: int = 64, start_block: int = 0,
+                    write_start: int = 0, stats: Optional[PrefillStats]
+                    = None):
+    """Stream ``rows[pos:target]`` ([T, d_model] ndarray) into
+    ``slot``'s pages in causal chunks: each chunk is one batch-1 model
+    call through ``cache.prefill_views`` — K/V append straight into
+    the pages, attention runs over them at ``time_step = chunk
+    start`` with full-extent masking, so the resulting pages AND the
+    final hidden are bit-identical to a dense scratch prefill of the
+    whole prompt (asserted in tests/test_paged_cache.py). The ONE
+    prefill implementation shared by PagedServingEngine (admission +
+    re-prefill + mixed steps) and SpeculativeEngine (draft prefill).
+
+    ``start_block``/``write_start``: adopted prefix-cache pages — the
+    chunks attend over them but never rewrite (or COW-split) them.
+    The caller must ``ensure`` page coverage only when running under
+    its own OOM policy; this helper ensures per chunk and lets
+    BlockOOM propagate. Returns ``(new_pos, last_hidden)`` —
+    last_hidden is the final chunk's trailing row ([1, d_model]), or
+    None when no chunk ran."""
+    import paddle_tpu as paddle
+    T = rows.shape[0] if target is None else int(target)
+    out = None
+    views = cache.prefill_views(slot, write_start=write_start)
+    while pos < T:
+        c = _chunk_len(T, pos, chunk_tokens)
+        cache.ensure(slot, pos + c, start_block=start_block,
+                     write_from=pos)
+        x = paddle.to_tensor(
+            np.ascontiguousarray(rows[pos:pos + c], np.float32)[None])
+        # serving never backprops (no_grad keeps the tape from pinning
+        # pool versions); time_step as a TENSOR scalar routes to the
+        # full-extent masked attention — the length-independence that
+        # makes chunking and prefix adoption bit-transparent
+        with no_grad():
+            out, _ = model(x, caches=views,
+                           time_step=Tensor(np.int32(pos)))
+        pos += c
+        if stats is not None:
+            stats.chunks += 1
+            stats.prefill_tokens += c
+            stats.peak_blocks = max(stats.peak_blocks,
+                                    cache.blocks_in_use)
+    return pos, (out[:, -1] if out is not None else None)
 
 
 class PagedRequest:
@@ -125,20 +209,48 @@ class PagedServingEngine:
     def __init__(self, model, max_batch: int, block_size: int,
                  num_blocks: int, max_blocks_per_seq: Optional[int] = None,
                  dtype: str = "float32", watermark_blocks: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 chunk_tokens: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
         self.watermark_blocks = int(watermark_blocks)
         self.prefix_cache = bool(prefix_cache)
         self.prefix_stats = PrefixCacheStats()
+        self.prefill_stats = PrefillStats()
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
             prefix_cache=prefix_cache)
         self.max_len = self.cache.capacity_per_seq
+        # prompt chunk size (chunked_prefill): a multiple of the block
+        # size by default so most chunk boundaries land on page edges;
+        # any value >= MIN_PREFILL_SUFFIX_ROWS is bit-transparent
+        if chunk_tokens is None:
+            chunk_tokens = 4 * self.cache.block_size
+        if chunk_tokens < MIN_PREFILL_SUFFIX_ROWS:
+            raise ValueError(
+                f"chunk_tokens must be >= {MIN_PREFILL_SUFFIX_ROWS}")
+        self.chunk_tokens = int(chunk_tokens)
+        # Sarathi-style mixed steps: with a budget, each step() spends
+        # ~this many prompt tokens advancing pending prefills before
+        # the fused decode call (a chunk may run ONE token past the
+        # cap rather than leave a 1-row tail — the GEMV bit-identity
+        # floor); admission only grants a slot. None (default):
+        # admission prefills synchronously.
+        if prefill_token_budget is not None and \
+                prefill_token_budget < MIN_PREFILL_SUFFIX_ROWS:
+            raise ValueError(
+                f"prefill_token_budget must be >= "
+                f"{MIN_PREFILL_SUFFIX_ROWS}")
+        self.prefill_token_budget = prefill_token_budget
         self.lens = np.zeros(self.max_batch, np.int32)
         self.active = np.zeros(self.max_batch, bool)
+        # slots granted but still streaming their prompt (mixed-step
+        # mode): they own pages but must not ride the decode call
+        self.prefilling = np.zeros(self.max_batch, bool)
+        self._prefills: Dict[int, dict] = {}
         self._requests: List[Optional[PagedRequest]] = \
             [None] * self.max_batch
         self.queue: Deque[PagedRequest] = deque()
@@ -147,7 +259,6 @@ class PagedServingEngine:
         # hot decode loop never pays a device->host sync for the
         # (rare) preemption path
         self._pending_history: List[Tuple[Tensor, np.ndarray]] = []
-        self._scratch = None          # persistent single-row prefill cache
         self._next_rid = 0
         self._next_admit_seq = 0
         # event queues the caller drains
@@ -161,8 +272,12 @@ class PagedServingEngine:
         return int(self.active.sum())
 
     @property
+    def num_prefilling(self) -> int:
+        return int(self.prefilling.sum())
+
+    @property
     def free_slots(self) -> int:
-        return int((~self.active).sum())
+        return int((~self.active & ~self.prefilling).sum())
 
     @property
     def free_blocks(self) -> int:
@@ -178,7 +293,10 @@ class PagedServingEngine:
     def submit(self, prompt) -> int:
         """Queue a prompt ([T, d_model] embeddings) and try to admit.
         Returns the request id; if admission succeeded an
-        ``(rid, slot, last_hidden)`` event is in ``admitted``."""
+        ``(rid, slot, last_hidden)`` event is in ``admitted``. With
+        ``prefill_token_budget`` set, admission only grants a slot —
+        the prompt streams during subsequent ``step`` calls and the
+        admitted event fires when the last chunk lands."""
         arr = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
                          else prompt, np.float32)
         if arr.shape[0] == 0:
@@ -195,15 +313,22 @@ class PagedServingEngine:
 
     def _try_admit(self) -> None:
         """Admit from the queue head while a slot is free and the
-        block budget covers the prompt plus the watermark."""
+        block budget covers the admission horizon plus the watermark:
+        the whole prompt (plus the first decode token's page) in
+        synchronous mode, only the FIRST chunk in token-budget mode —
+        chunked prefill grows the rest page by page under the normal
+        preemption rules."""
         while self.queue and self.free_slots > 0:
             req = self.queue[0]
-            # cover the prompt AND the first decode token's page —
-            # admitting with zero headroom would re-preempt a request
-            # sitting on a block boundary every step (prefill/evict
-            # livelock)
-            need = self.cache.blocks_needed(
-                min(len(req) + 1, self.max_len))
+            if self.prefill_token_budget is None:
+                # cover the prompt AND the first decode token's page —
+                # admitting with zero headroom would re-preempt a
+                # request sitting on a block boundary every step
+                # (prefill/evict livelock)
+                horizon = min(len(req) + 1, self.max_len)
+            else:
+                horizon = min(len(req), self.chunk_tokens)
+            need = self.cache.blocks_needed(horizon)
             if self.prefix_cache:
                 # actively shared prefix hits cost no pool draw at all;
                 # cached-free hits come out of free_blocks (a resurrect
@@ -213,14 +338,22 @@ class PagedServingEngine:
                     req.block_hashes(self.cache.block_size))
                 rc = self.cache.allocator.refcount
                 need -= sum(1 for b in matched if rc[b] > 0)
-            if need + self.watermark_blocks > self.free_blocks:
+            if max(need, 0) + self.watermark_blocks > self.free_blocks:
                 return  # head-of-line blocks; keep FIFO fairness
             self.queue.popleft()
-            self._prefill(req)
+            if self.prefill_token_budget is None:
+                self._prefill(req)
+            else:
+                # grant the slot only; step() streams the chunks
+                self._start_prefill(req)
 
-    def _prefill(self, req: PagedRequest) -> None:
-        import paddle_tpu as paddle
-        slot = int(np.flatnonzero(~self.active)[0])
+    def _start_prefill(self, req: PagedRequest) -> int:
+        """Grant a slot and set up chunked-prefill state: adopt any
+        cached prefix pages and compute the recompute start P (the
+        suffix keeps at least MIN_PREFILL_SUFFIX_ROWS rows — see the
+        constant's comment: 1-row GEMV accumulation breaks
+        bit-identity, and the admission event needs a last hidden)."""
+        slot = int(np.flatnonzero(~self.active & ~self.prefilling)[0])
         T = len(req)
         bs = self.cache.block_size
         hashes: List[bytes] = []
@@ -231,46 +364,109 @@ class PagedServingEngine:
             self.prefix_stats.lookups += 1
             self.prefix_stats.lookup_blocks += len(hashes)
             self.prefix_stats.hit_blocks += n_cached
-        # cached tokens skip prefill entirely, but the recomputed
-        # suffix keeps at least MIN_PREFILL_SUFFIX_ROWS (see the
-        # constant's comment: 1-row GEMV accumulation breaks
-        # bit-identity, and the admission event needs a last hidden)
         P = max(0, min(n_cached * bs, T - MIN_PREFILL_SUFFIX_ROWS)) \
             if n_cached else 0
-        if self._scratch is None:
-            self._scratch = self.model.gen_cache(1, self.max_len,
-                                                 dtype=self.dtype)
-        if n_cached:
-            # seed the scratch with the cached prefix K/V so the
-            # suffix attends over it (partial prefill at time_step=P)
-            self._scratch = self.cache.load_prefix(slot, n_cached,
-                                                   self._scratch)
-        x = paddle.to_tensor(req.history[P:][None])
-        # serving never backprops: without no_grad the tape would pin
-        # every superseded scratch/pool version across the loop.
-        # time_step as a TENSOR scalar routes to the full-extent masked
-        # attention (same convention as ContinuousBatchingEngine):
-        # prefill reductions see ONE extent regardless of prompt
-        # length, so pages computed under any prompt are bit-exact
-        # reusable by any later prompt sharing the prefix
-        with no_grad():
-            out, row_caches = self.model(x, caches=self._scratch,
-                                         time_step=Tensor(np.int32(P)))
-        self._scratch = row_caches  # persistent: reused next admission
-        self.cache.ensure(slot, T, start_block=n_cached)
-        self.cache.write_prefill(slot, row_caches, T,
-                                 start_block=n_cached)
-        if self.prefix_cache:
-            self.cache.register_prefix(slot, hashes)
-            self.prefix_stats.tokens_computed += T - P
-            self.prefix_stats.tokens_skipped += P
-        self.lens[slot] = T
-        self.active[slot] = True
+        self._prefills[slot] = {"pos": P, "start": P,
+                                "n_cached": n_cached, "hashes": hashes}
+        self.prefilling[slot] = True
         self._requests[slot] = req
         req.slot = slot
         req.admit_seq = self._next_admit_seq
         self._next_admit_seq += 1
-        self.admitted.append((req.rid, slot, out[:, -1]))
+        return slot
+
+    def _complete_prefill(self, slot: int, last_hidden) -> None:
+        """Last chunk landed: the slot turns decodable and the
+        admission event fires."""
+        st = self._prefills.pop(slot)
+        req = self._requests[slot]
+        T = len(req)
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, st["hashes"])
+            self.prefix_stats.tokens_computed += T - st["start"]
+            self.prefix_stats.tokens_skipped += st["start"]
+        self.prefilling[slot] = False
+        self.lens[slot] = T
+        self.active[slot] = True
+        self.admitted.append((req.rid, slot, last_hidden))
+
+    def _prefill(self, req: PagedRequest) -> None:
+        """Synchronous admission: stream every chunk now (block budget
+        for the whole prompt was checked by _try_admit, so the chunk
+        ensures cannot OOM)."""
+        slot = self._start_prefill(req)
+        st = self._prefills[slot]
+        _, h = chunked_prefill(
+            self.model, self.cache, slot, req.history,
+            pos=st["pos"], target=len(req),
+            chunk_tokens=self.chunk_tokens,
+            start_block=st["n_cached"],
+            write_start=st["n_cached"] * self.cache.block_size,
+            stats=self.prefill_stats)
+        self._complete_prefill(slot, h)
+
+    def _advance_prefills(self) -> Tuple[bool, List[int]]:
+        """Token-budget mode: spend ``prefill_token_budget`` prompt
+        tokens on pending prefills, oldest first (finish what was
+        started before newer grants). The cap is soft by one token:
+        a chunk never splits below MIN_PREFILL_SUFFIX_ROWS and never
+        leaves a 1-row tail, so when the remaining budget and prompt
+        collide with that floor the chunk runs one token long rather
+        than deferring (a deferral could never clear — the budget is
+        identical next step). Page growth preempts the
+        youngest request on OOM — possibly a prefilling one, possibly
+        the slot being advanced itself (it then re-queues whole).
+        Returns (ran, fresh): whether any chunk ran, and the slots
+        whose prefill COMPLETED just now — the caller hasn't drained
+        their admitted events yet, so they must sit this step's
+        decode out."""
+        if self.prefill_token_budget is None or \
+                self.num_prefilling == 0:
+            return False, []
+        budget = self.prefill_token_budget
+        ran = False
+        fresh: List[int] = []
+        while budget >= MIN_PREFILL_SUFFIX_ROWS:
+            slots = np.flatnonzero(self.prefilling)
+            if slots.size == 0:
+                break
+            slot = int(min(slots,
+                           key=lambda s: self._requests[s].admit_seq))
+            req = self._requests[slot]
+            st = self._prefills[slot]
+            T = len(req)
+            c = _chunk_len(T, st["pos"], self.chunk_tokens,
+                           budget=budget)
+            while self.prefilling[slot]:
+                try:
+                    self.cache.ensure(slot, st["pos"] + c,
+                                      start_block=st["n_cached"],
+                                      write_from=st["pos"])
+                    break
+                except BlockOOM:
+                    if self.num_active + self.num_prefilling == 1:
+                        raise RuntimeError(
+                            "pool too small: one sequence cannot grow "
+                            "even with every other request evicted")
+                    self._preempt_youngest()
+            if not self.prefilling[slot]:
+                continue  # the slot itself was the eviction victim
+            pos, h = chunked_prefill(
+                self.model, self.cache, slot, req.history,
+                pos=st["pos"], target=st["pos"] + c,
+                chunk_tokens=self.chunk_tokens,
+                start_block=st["n_cached"],
+                write_start=st["n_cached"] * self.cache.block_size,
+                stats=self.prefill_stats)
+            st["pos"] = pos
+            budget -= c
+            ran = True
+            if pos >= T:
+                self._complete_prefill(slot, h)
+                fresh.append(slot)
+        if ran:
+            self.prefill_stats.prefill_steps += 1
+        return ran, fresh
 
     # -- release / preemption -----------------------------------------
     def release(self, slot: int) -> None:
@@ -299,12 +495,16 @@ class PagedServingEngine:
         self._flush_history()
         self.cache.free_seq(slot)
         self.active[slot] = False
+        self.prefilling[slot] = False
+        self._prefills.pop(slot, None)
         self.lens[slot] = 0
         self._requests[slot] = None
 
     def preempt(self, slot: int) -> None:
-        """Evict a running request: free ALL its pages and requeue it
-        at the front for re-prefill from its history."""
+        """Evict a running (or mid-prefill) request: free ALL its
+        pages and requeue it at the front for re-prefill from its
+        history (a mid-prefill victim restarts its prompt stream on
+        re-admission)."""
         req = self._requests[slot]
         if req is None:
             raise ValueError(f"slot {slot} not active")
@@ -315,7 +515,8 @@ class PagedServingEngine:
         self.preempted.append(req.rid)
 
     def _preempt_youngest(self) -> int:
-        cands = [int(s) for s in np.flatnonzero(self.active)]
+        cands = [int(s) for s in
+                 np.flatnonzero(self.active | self.prefilling)]
         victim = max(cands, key=lambda s: self._requests[s].admit_seq)
         self.preempt(victim)
         return victim
@@ -328,10 +529,18 @@ class PagedServingEngine:
         auto-released first (reported in ``finished``) so one full
         sequence never stalls the batch; rows crossing a block boundary
         allocate their next page, preempting the youngest request if
-        the pool is dry. Returns hidden [max_batch, 1, d_model] (only
-        rows active during this step are meaningful), or None if every
+        the pool is dry. With ``prefill_token_budget`` set, the step
+        FIRST spends the budget advancing pending prefill chunks
+        (Sarathi-style mixed step) — and may legally run with zero
+        active slots while prompts are still streaming (returns
+        None). Returns hidden [max_batch, 1, d_model] (only rows
+        active during this step are meaningful), or None if every
         slot finished before the step could run."""
+        ran_prefill, fresh = self._advance_prefills()
         if self.num_active == 0:
+            if ran_prefill or self.num_prefilling > 0:
+                self._try_admit()
+                return None
             raise RuntimeError("step() with no active slots")
         # 1. capacity-finished slots: report + release, keep the rest
         for slot in np.flatnonzero(self.active & (self.lens >=
@@ -340,12 +549,19 @@ class PagedServingEngine:
             self.finished.append((req.rid, int(slot),
                                   int(self.lens[slot])))
             self._drop(int(slot))
-        if self.num_active == 0:
+        # slots whose prefill completed within THIS step sit the
+        # decode out: the caller has not drained their admitted event
+        # yet, so their row of x is garbage — they stay masked and
+        # their length does not advance
+        stepping = self.active.copy()
+        for slot in fresh:
+            stepping[slot] = False
+        if not stepping.any():
             self._try_admit()
             return None
         # 2. grow pages (allocate-on-write), preempting on OOM.
         #    Oldest first: under pressure the young yield to the old.
-        order = sorted(np.flatnonzero(self.active),
+        order = sorted(np.flatnonzero(stepping),
                        key=lambda s: self._requests[s].admit_seq)
         for slot in order:
             slot = int(slot)
@@ -357,11 +573,15 @@ class PagedServingEngine:
                     # victim = youngest active request — possibly this
                     # row itself (then the while condition ends its
                     # growth attempt and it re-queues for re-prefill)
-                    if self.num_active == 1:
+                    if self.num_active + self.num_prefilling == 1:
                         raise RuntimeError(
                             "pool too small: one sequence cannot grow "
                             "even with every other request evicted")
                     self._preempt_youngest()
+        stepping &= self.active     # growth may have evicted some
+        if not stepping.any():
+            self._try_admit()
+            return None
         # 3. record the inputs being consumed (re-prefill history) —
         #    a Tensor ref + mask snapshot only; the device->host read
         #    is deferred to _flush_history (next drop/preempt, or the
@@ -369,12 +589,23 @@ class PagedServingEngine:
         #    unbounded window of input buffers)
         if len(self._pending_history) >= 32:
             self._flush_history()
-        self._pending_history.append((x, self.active.copy()))
-        # 4. fused ragged step over the paged views
+        self._pending_history.append((x, stepping.copy()))
+        # 4. fused ragged step over the paged views; mid-prefill and
+        #    freshly admitted slots present all-trash tables so the
+        #    decode append cannot touch their pages
+        masked = self.prefilling | (self.active & ~stepping)
+        self.cache.set_decode_mask(masked if masked.any() else None)
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
-        self.lens[self.active] += 1
+        self.lens[stepping] += 1
+        self.prefill_stats.decode_steps += 1
+        if ran_prefill:
+            self.prefill_stats.mixed_steps += 1
+        # decode-phase allocate-on-write growth moves the high-water
+        # mark too, not just prefill chunks
+        self.prefill_stats.peak_blocks = max(
+            self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
         # 5. continuous refill
         self._try_admit()
         return out
@@ -391,8 +622,18 @@ class PagedServingEngine:
         a capacity-finished slot cannot ride a multi-token call at
         all. Page growth covers all L positions (preempting youngest
         on OOM, as in ``step``); ``rollback`` drops the rejected tail.
-        Returns hidden [max_batch, L, d_model]."""
+        Returns hidden [max_batch, L, d_model]. Not yet composed with
+        ``prefill_token_budget`` (the speculative engine runs
+        synchronous admission): a multi-token step cannot host
+        rows whose admitted hidden the caller has not seen, so the
+        combination raises instead of silently starving mid-prefill
+        slots."""
         L = int(x.shape[1])
+        if self.prefill_token_budget is not None:
+            raise RuntimeError(
+                "step_multi() does not support prefill_token_budget "
+                "mode; use synchronous admission (the default) for "
+                "multi-token verification")
         if self.num_active == 0:
             raise RuntimeError("step_multi() with no active slots")
         over = self.active & (self.lens + L > self.max_len)
@@ -420,10 +661,15 @@ class PagedServingEngine:
         if len(self._pending_history) >= 32:
             self._flush_history()
         self._pending_history.append((x, self.active.copy()))
+        self.cache.set_decode_mask(
+            self.prefilling if self.prefilling.any() else None)
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
         self.lens[self.active] += L
+        self.prefill_stats.decode_steps += 1
+        self.prefill_stats.peak_blocks = max(
+            self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
         self._try_admit()
         return out
 

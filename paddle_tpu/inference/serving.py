@@ -26,8 +26,8 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-__all__ = ["ContinuousBatchingEngine", "PrefixCacheStats",
-           "SpecDecodeStats"]
+__all__ = ["ContinuousBatchingEngine", "PrefillStats",
+           "PrefixCacheStats", "SpecDecodeStats"]
 
 
 class PrefixCacheStats:
@@ -70,6 +70,80 @@ class PrefixCacheStats:
         return (f"PrefixCacheStats(hit_rate={self.hit_rate:.2%}, "
                 f"blocks_saved={self.blocks_saved}, "
                 f"tokens_skipped={self.tokens_skipped})")
+
+
+class PrefillStats:
+    """Serving-surface accounting for CHUNKED PAGED PREFILL
+    (scheduler.chunked_prefill / PagedServingEngine), sibling of
+    PrefixCacheStats and SpecDecodeStats; counters only grow.
+
+      chunks          chunk model calls run (each writes its K/V
+                      straight into pages — no dense scratch)
+      prefill_tokens  prompt tokens streamed through those chunks
+      prefill_steps   engine steps that advanced at least one pending
+                      prefill (token-budget mixed-step mode)
+      decode_steps    engine steps that ran the fused decode call
+      mixed_steps     steps that did BOTH — the Sarathi-style packing
+                      signal (prefill riding along instead of
+                      stalling the running batch)
+      peak_blocks     high-water pool blocks in use (sampled after
+                      every chunk AND every decode step's growth) —
+                      with the dense scratch retired this IS the peak
+                      KV footprint
+    """
+
+    __slots__ = ("chunks", "prefill_tokens", "prefill_steps",
+                 "decode_steps", "mixed_steps", "peak_blocks")
+
+    def __init__(self):
+        self.chunks = 0
+        self.prefill_tokens = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.mixed_steps = 0
+        self.peak_blocks = 0
+
+    @property
+    def tokens_per_chunk(self) -> float:
+        if self.chunks == 0:
+            return 0.0
+        return self.prefill_tokens / self.chunks
+
+    @property
+    def prefill_tokens_per_step(self) -> float:
+        """Mean prompt tokens advanced per prefill-carrying step (the
+        token-budget utilization signal)."""
+        if self.prefill_steps == 0:
+            return 0.0
+        return self.prefill_tokens / self.prefill_steps
+
+    @property
+    def mixed_step_rate(self) -> float:
+        """Fraction of steps that packed prefill chunks alongside
+        decode rows."""
+        total = self.decode_steps + self.prefill_steps \
+            - self.mixed_steps
+        if total == 0:
+            return 0.0
+        return self.mixed_steps / total
+
+    def as_dict(self) -> dict:
+        return {"chunks": self.chunks,
+                "prefill_tokens": self.prefill_tokens,
+                "tokens_per_chunk": round(self.tokens_per_chunk, 2),
+                "prefill_steps": self.prefill_steps,
+                "decode_steps": self.decode_steps,
+                "mixed_steps": self.mixed_steps,
+                "mixed_step_rate": round(self.mixed_step_rate, 4),
+                "prefill_tokens_per_step":
+                    round(self.prefill_tokens_per_step, 2),
+                "peak_blocks": self.peak_blocks}
+
+    def __repr__(self):
+        return (f"PrefillStats(chunks={self.chunks}, "
+                f"prefill_tokens={self.prefill_tokens}, "
+                f"mixed_step_rate={self.mixed_step_rate:.2%}, "
+                f"peak_blocks={self.peak_blocks})")
 
 
 class SpecDecodeStats:

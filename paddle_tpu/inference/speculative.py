@@ -54,7 +54,7 @@ import numpy as np
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from .paged_cache import PagedKVCache
-from .scheduler import PagedServingEngine
+from .scheduler import PagedServingEngine, chunked_prefill
 from .serving import SpecDecodeStats
 
 __all__ = ["TokenServingModel", "SpeculativeEngine", "SpecDecodeStats"]
@@ -244,7 +244,8 @@ class SpeculativeEngine:
                  draft_num_blocks: Optional[int] = None,
                  prefix_cache: bool = False, sampling: str = "greedy",
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 watermark_blocks: int = 0, seed: int = 0):
+                 watermark_blocks: int = 0,
+                 chunk_tokens: Optional[int] = None, seed: int = 0):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -259,7 +260,7 @@ class SpeculativeEngine:
             target.core, max_batch, block_size, num_blocks,
             max_blocks_per_seq=max_blocks_per_seq,
             watermark_blocks=watermark_blocks,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, chunk_tokens=chunk_tokens)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
         self.finished: List[Tuple[int, int]] = []
@@ -278,7 +279,6 @@ class SpeculativeEngine:
                 self.draft.core, block_size, draft_num_blocks,
                 max_seqs=self.max_batch, max_blocks_per_seq=mbps)
             self._draft_lens = np.zeros(self.max_batch, np.int32)
-            self._draft_scratch = None
         else:
             self.draft_cache = None
 
@@ -382,24 +382,19 @@ class SpeculativeEngine:
     def _draft_prefill(self, slot: int, seq: _SpecSeq) -> None:
         """(Re-)build the draft cache for a slot from the token stream
         (everything but the pending token — exactly what the target
-        has consumed)."""
+        has consumed), through the SAME chunked-prefill path the
+        target engine uses: K/V stream straight into the draft pool's
+        pages, no dense scratch, no scatter pass."""
         if self.draft_cache is None:
             return
-        import paddle_tpu as paddle
         consumed = seq.toks[:-1]
         cap = self.draft_cache.capacity_per_seq
         if len(consumed) > cap:
             raise ValueError("draft capacity exceeded")   # unreachable
         self._clear_draft_slot(slot)
-        x = paddle.to_tensor(self.draft.embed(consumed)[None])
-        if self._draft_scratch is None:
-            self._draft_scratch = self.draft.core.gen_cache(1, cap)
-        with no_grad():
-            _, rc = self.draft.core(x, caches=self._draft_scratch,
-                                    time_step=Tensor(np.int32(0)))
-        self._draft_scratch = rc
-        self.draft_cache.ensure(slot, len(consumed))
-        self.draft_cache.write_prefill(slot, rc, len(consumed))
+        chunked_prefill(self.draft.core, self.draft_cache, slot,
+                        self.draft.embed(consumed),
+                        chunk_tokens=self.engine.chunk_tokens)
         self._draft_lens[slot] = len(consumed)
 
     # -- the speculative round ----------------------------------------

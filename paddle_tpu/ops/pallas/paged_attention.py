@@ -7,7 +7,12 @@ fixed-size blocks [num_blocks, 2, nkv, block_size, hd]; each sequence
 owns an int32 row of block ids (its block table) and a valid length.
 One query step per sequence attends over its pages with an online
 softmax, exactly like decode_attention but with the cache axis
-INDIRECTED through the block table.
+INDIRECTED through the block table. Three entry points share the
+layout: ``paged_attention`` (one decode query per row),
+``paged_attention_multi`` (K+1 speculative-verification queries per
+row), and ``paged_attention_prefill`` (a prompt CHUNK per row, tiled
+over a query-tile grid axis with causal page skipping — the kernel
+that lets prefill stream straight into pages with no dense scratch).
 
 On real TPU the block table rides as a SCALAR-PREFETCH argument
 (pltpu.PrefetchScalarGridSpec): the BlockSpec index_map reads
@@ -180,6 +185,79 @@ def _kernel_multi_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr,
                       o_ref, m_scr, l_scr, acc_scr, **kw)
 
 
+def _paged_prefill_body(start, q_ref, kv_ref, o_ref, m_scr, l_scr,
+                        acc_scr, *, block_s, n_blocks, sm_scale,
+                        tile_q, g):
+    """Chunked-prefill variant: the grid adds a QUERY-TILE axis, so a
+    long prompt chunk streams through VMEM tile_q queries at a time
+    instead of holding every row at once (the multi body's shape). The
+    q block holds tile qt's tile_q*g folded rows; row r is query
+    qt*tile_q + r//g at absolute position start + qt*tile_q + r//g.
+    Unlike decode there is no valid-length horizon ABOVE the queries —
+    the chunk's own K/V are the newest entries in the pool — so the
+    causal mask alone bounds the reduction, and pages that start past
+    a tile's last query are skipped outright (the FLOPs a prefill
+    saves over the decode-shaped sweep)."""
+    qt = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
+    k = kv[0].astype(jnp.float32)               # [block_s, hd]
+    v = kv[1].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)            # [tile_q * g, hd]
+    base = start + qt * tile_q                  # tile's first position
+
+    # a page whose first position lies past the tile's LAST query is
+    # fully masked: skip it (decode pages above the chunk don't exist
+    # yet, so this bounds work by the causal frontier, not max_len)
+    @pl.when(j * block_s <= base + tile_q - 1)
+    def _update():
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        kpos = j * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        qpos = base + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // g
+        valid = kpos <= qpos
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new) * valid
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _done():
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _kernel_prefill_prefetch(bt_ref, start_ref, q_ref, pool_ref, o_ref,
+                             m_scr, l_scr, acc_scr, *, nkv, **kw):
+    del bt_ref
+    _paged_prefill_body(start_ref[pl.program_id(0) // nkv], q_ref,
+                        pool_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
+def _kernel_prefill_interpret(start_ref, q_ref, pg_ref, o_ref, m_scr,
+                              l_scr, acc_scr, **kw):
+    _paged_prefill_body(start_ref[pl.program_id(0), 0], q_ref, pg_ref,
+                        o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
 def gather_pages(kv_pool, block_tables):
     """Pure-jnp page gather: materialize the block-table indirection as
     dense K/V. kv_pool: [NB, 2, nkv, bs, hd]; block_tables: int32
@@ -341,6 +419,115 @@ def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
         )(bt, lens, qg, kv_pool)
     out = out.reshape(B, nkv, n_q, g, hd)
     return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, n_q, nh, hd)
+
+
+def paged_attention_prefill(q, kv_pool, block_tables, start_pos,
+                            sm_scale=None, tile_q=None):
+    """Chunked paged PREFILL: q [B, C, nh, hd] holds one prompt chunk
+    per sequence — query i of row b sits at absolute position
+    start_pos[b] + i and attends causally over that row's pages
+    (positions <= its own), whose K/V — INCLUDING the chunk's own
+    rows — must already sit in the pool (the paged-cache protocol
+    appends before attending, same as decode). start_pos: int32 [B]
+    chunk start positions. Rides the same scalar-prefetch block table
+    as the decode/multi kernels, but the grid adds a query-tile axis
+    (``tile_q`` queries per step, default min(C, 64)) so a long chunk
+    never holds all its rows in VMEM at once, and pages past a tile's
+    causal frontier are skipped instead of masked — prefill work is
+    O(tokens written), not O(page capacity). Returns [B, C, nh, hd].
+
+    Interpret + pure-jnp fallbacks mirror the decode/multi kernels:
+    interpret mode pre-gathers pages (no scalar-prefetch index maps);
+    the bit-exact CPU serving path in inference/paged_cache.py uses a
+    jnp gather + the dense masked-sdpa codepath instead, which is what
+    keeps chunked prefill bit-identical to dense scratch prefill."""
+    B, C, nh, hd = q.shape
+    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
+    MB = block_tables.shape[1]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    if tile_q is None:
+        tile_q = min(C, 64)
+    n_qt = -(-C // tile_q)
+    C_pad = n_qt * tile_q
+    if C_pad != C:
+        # padded tail queries attend garbage (positions past the
+        # chunk) and are sliced off below
+        q = jnp.concatenate(
+            [q, jnp.zeros((B, C_pad - C, nh, hd), q.dtype)], axis=1)
+
+    # [B, C_pad, nkv, g, hd] -> [B, nkv, C_pad, g, hd] -> folded rows
+    qg = jnp.transpose(q.reshape(B, C_pad, nkv, g, hd),
+                       (0, 2, 1, 3, 4)).reshape(B * nkv, C_pad * g, hd)
+    start = jnp.asarray(start_pos, jnp.int32)
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    _require_pltpu()
+    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
+              tile_q=tile_q, g=g)
+    rows = tile_q * g
+    scratch = [pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, hd), jnp.float32)]
+    out_shape = jax.ShapeDtypeStruct((B * nkv, C_pad * g, hd), q.dtype)
+
+    if _interpret():
+        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
+        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
+            B * nkv, MB, 2, block_s, hd)
+        start_r = jnp.repeat(start, nkv).reshape(B * nkv, 1)
+        out = pl.pallas_call(
+            functools.partial(_kernel_prefill_interpret, **kw),
+            grid=(B * nkv, n_qt, MB),
+            in_specs=[
+                pl.BlockSpec((B * nkv, 1), lambda i, qt, j: (0, 0)),
+                pl.BlockSpec((1, rows, hd),
+                             lambda i, qt, j: (i, qt, 0)),
+                pl.BlockSpec((1, 1, 2, block_s, hd),
+                             lambda i, qt, j: (i, j, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, hd),
+                                   lambda i, qt, j: (i, qt, 0)),
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=True,
+        )(start_r, qg, pg)
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,   # block tables + starts in SMEM
+            grid=(B * nkv, n_qt, MB),
+            in_specs=[
+                pl.BlockSpec((1, rows, hd),
+                             lambda i, qt, j, bt_, s_: (i, qt, 0)),
+                pl.BlockSpec((1, 2, 1, block_s, hd),
+                             lambda i, qt, j, bt_, s_:
+                             (bt_[i // nkv, j], 0, i % nkv, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, hd),
+                                   lambda i, qt, j, bt_, s_:
+                                   (i, qt, 0)),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            functools.partial(_kernel_prefill_prefetch, nkv=nkv, **kw),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+        )(bt, start, qg, kv_pool)
+    out = out.reshape(B, nkv, C_pad, g, hd)
+    out = jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, C_pad, nh, hd)
+    return out[:, :C]
+
+
+def paged_attention_prefill_reference(q, kv_pool, block_tables,
+                                      start_pos, sm_scale=None):
+    """jnp reference for the chunked-prefill path: gather pages dense,
+    per-query causal mask at absolute positions start_pos[b] + i. The
+    multi-query reference already computes exactly this shape with
+    seq_lens = start + C (its queries sit at lens - n_q + i)."""
+    C = q.shape[1]
+    lens = jnp.asarray(start_pos, jnp.int32) + C
+    return paged_attention_multi_reference(q, kv_pool, block_tables,
+                                           lens, sm_scale=sm_scale)
 
 
 def paged_attention_reference(q, kv_pool, block_tables, seq_lens,

@@ -29,6 +29,25 @@ def test_serving_prefix_smoke_leg():
     assert res["prefix"]["decode_steps"] > 0
 
 
+def test_serving_longprompt_smoke_leg():
+    res = bench_extra.bench_serving_longprompt(smoke=True)
+    assert res["metric"] == "serving_chunked_prefill_long_prompts"
+    # acceptance: the chunked path carries NO dense scratch — its
+    # peak KV bytes are strictly the pool, below the scratch baseline
+    assert res["chunked"]["scratch_bytes"] == 0
+    assert res["scratch"]["scratch_bytes"] > 0
+    assert (res["chunked"]["peak_kv_bytes"]
+            < res["scratch"]["peak_kv_bytes"])
+    assert res["peak_kv_bytes_saved"] == res["scratch"]["scratch_bytes"]
+    # prompts really streamed in chunks (96 tokens / 32-token chunks)
+    assert res["chunked"]["prefill_chunks"] == res["requests"] * 3
+    assert (res["chunked"]["prefill_tokens"]
+            == res["requests"] * res["prompt_len"])
+    # both paths generated every requested token
+    assert res["chunked"]["tokens_per_sec"] > 0
+    assert res["scratch"]["tokens_per_sec"] > 0
+
+
 def test_serving_spec_smoke_leg():
     res = bench_extra.bench_serving_spec(smoke=True)
     assert res["metric"] == "serving_speculative_vs_plain_token_decode"

@@ -505,6 +505,292 @@ class TestSchedulerPolicy:
             eng.submit(_prompt(rng, 17))      # > 2 pages * 8
 
 
+class TestChunkedPrefill:
+    """Chunked paged prefill (scheduler.chunked_prefill +
+    PagedKVCache.prefill_views): prompts stream straight into pages in
+    causal chunks — no dense [2,1,H,max_len,D] scratch, no scatter
+    pass — and every hidden stays BIT-IDENTICAL to the dense engine,
+    because multi-row masked sdpa results are per-row invariant to
+    chunk length and masked key extent (1-row chunks are the only
+    hazard and are engineered away via MIN_PREFILL_SUFFIX_ROWS)."""
+
+    CAP_BS, CAP_MB = 16, 10          # 160-token capacity: well past
+    CAPACITY = CAP_BS * CAP_MB       # the old suite's 64-token scratch
+
+    def _no_gen_cache(self, model):
+        """Forbid dense KV scratch allocation for the engine's model:
+        the memory-regression tripwire for the retired _scratch."""
+        def boom(*a, **kw):
+            raise AssertionError(
+                "dense gen_cache scratch allocated during paged "
+                "serving — chunked prefill must be scratchless")
+        model.gen_cache = boom
+
+    def test_long_prompt_streams_scratchless_bit_identical(self):
+        """ACCEPTANCE: a prompt longer than the old tests' scratch
+        capacity serves through multi-chunk prefill with ZERO dense
+        scratch allocation, and admission hidden + every decode step
+        are bit-identical to the dense engine."""
+        model = _model()
+        rng = np.random.RandomState(30)
+        prompt = _prompt(rng, 150)           # 150 > 64, 5 chunks of 32
+        dense = ContinuousBatchingEngine(model, max_batch=2,
+                                         max_len=self.CAPACITY)
+        ds, dh = dense.add_request(prompt)
+        eng = PagedServingEngine(model, max_batch=2,
+                                 block_size=self.CAP_BS,
+                                 num_blocks=24,
+                                 max_blocks_per_seq=self.CAP_MB,
+                                 chunk_tokens=32)
+        assert not hasattr(eng, "_scratch")
+        self._no_gen_cache(model)
+        slot, h = _admit(eng, prompt)
+        np.testing.assert_array_equal(np.asarray(dh.numpy()),
+                                      np.asarray(h.numpy()))
+        assert eng.prefill_stats.chunks == 5
+        assert eng.prefill_stats.prefill_tokens == 150
+        x = np.zeros((2, 1, D), np.float32)
+        xd = np.zeros((2, 1, D), np.float32)
+        x[slot, 0] = xd[ds, 0] = np.asarray(h.numpy())[0]
+        for _ in range(6):
+            op = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            od = np.asarray(dense.step(paddle.to_tensor(xd)).numpy())
+            np.testing.assert_array_equal(op[slot], od[ds])
+            x, xd = op[:, :1].copy(), od[:, :1].copy()
+
+    def test_chunk_boundary_not_block_aligned(self):
+        """Chunk boundaries need not align to page boundaries: a
+        6-token chunk over 16-token pages (boundaries at 6, 12, 18,
+        24 inside pages) must be bit-transparent."""
+        model = _model()
+        rng = np.random.RandomState(31)
+        prompt = _prompt(rng, 29)
+        dense = ContinuousBatchingEngine(model, max_batch=1,
+                                         max_len=MAXLEN)
+        ds, dh = dense.add_request(prompt)
+        eng = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                 num_blocks=6, max_blocks_per_seq=MB,
+                                 chunk_tokens=6)
+        slot, h = _admit(eng, prompt)
+        np.testing.assert_array_equal(np.asarray(dh.numpy()),
+                                      np.asarray(h.numpy()))
+        # 6,6,6,6 then the 5-token tail in one >=2-row chunk
+        assert eng.prefill_stats.chunks == 5
+        x = np.zeros((1, 1, D), np.float32)
+        x[0, 0] = np.asarray(h.numpy())[0]
+        xd = x.copy()
+        for _ in range(4):
+            op = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            od = np.asarray(dense.step(paddle.to_tensor(xd)).numpy())
+            np.testing.assert_array_equal(op, od)
+            x, xd = op[:, :1].copy(), od[:, :1].copy()
+
+    def test_one_row_tail_chunk_is_avoided(self):
+        """A prompt of chunk_tokens*k + 1 rows must NOT end on a 1-row
+        chunk (the GEMV lowering would break bit-identity): the last
+        chunk absorbs the leftover row."""
+        model = _model()
+        rng = np.random.RandomState(32)
+        prompt = _prompt(rng, 33)            # 2*16 + 1
+        dense = ContinuousBatchingEngine(model, max_batch=1,
+                                         max_len=MAXLEN)
+        ds, dh = dense.add_request(prompt)
+        eng = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                 num_blocks=6, max_blocks_per_seq=MB,
+                                 chunk_tokens=16)
+        slot, h = _admit(eng, prompt)
+        np.testing.assert_array_equal(np.asarray(dh.numpy()),
+                                      np.asarray(h.numpy()))
+        # 16 + 15 + 2: the middle chunk shrinks so the tail keeps
+        # MIN_PREFILL_SUFFIX_ROWS rows (never 16 + 16 + 1)
+        assert eng.prefill_stats.chunks == 3
+        assert eng.prefill_stats.prefill_tokens == 33
+
+    def test_write_prefill_chunk_matches_scratch_scatter(self):
+        """The chunk-granular append API: writing projected K/V into
+        pages chunk by chunk (incl. a write_start skip region) must
+        leave the pool EXACTLY as the dense write_prefill scatter
+        does, and never touch the skipped positions' pages."""
+        hd = D // HEADS
+        rng = np.random.RandomState(36)
+        T = 2 * BS + 5
+        # reference: the dense scatter path (scratch at max_len extent)
+        kv = rng.randn(2, 1, HEADS, MAXLEN, hd).astype(np.float32)
+        ref = PagedKVCache(1, HEADS, hd, block_size=BS, num_blocks=6,
+                           max_seqs=1, max_blocks_per_seq=MB)
+        ref.ensure(0, T)
+        ref.write_prefill(0, [paddle.to_tensor(kv)], T)
+        # chunked: two unaligned chunks of projected [1, C, H, hd]
+        # rows through write_prefill_chunk
+        ch = PagedKVCache(1, HEADS, hd, block_size=BS, num_blocks=6,
+                          max_seqs=1, max_blocks_per_seq=MB)
+        ch.ensure(0, T)
+        k_rows = np.transpose(kv[0], (0, 2, 1, 3))[:, :T]  # [1,T,H,hd]
+        v_rows = np.transpose(kv[1], (0, 2, 1, 3))[:, :T]
+        for start, stop in ((0, 21), (21, T)):
+            ch.write_prefill_chunk(0, 0,
+                                   paddle.to_tensor(k_rows[:, start:stop]),
+                                   paddle.to_tensor(v_rows[:, start:stop]),
+                                   start)
+        ref_pool = np.asarray(ref.pools[0].numpy())
+        ch_pool = np.asarray(ch.pools[0].numpy())
+        for bpos, (rb, cb) in enumerate(zip(ref.seq_blocks[0],
+                                            ch.seq_blocks[0])):
+            lo, hi = bpos * BS, min((bpos + 1) * BS, T)
+            np.testing.assert_array_equal(
+                ref_pool[rb, :, :, :hi - lo], ch_pool[cb, :, :, :hi - lo])
+        # write_start: re-writing a range with the prefix skipped
+        # leaves the prefix page untouched (skipped rows route to trash)
+        before = ch_pool[ch.seq_blocks[0][0]].copy()
+        ch.write_prefill_chunk(0, 0,
+                               paddle.to_tensor(k_rows[:, 10:30]),
+                               paddle.to_tensor(v_rows[:, 10:30]),
+                               10, write_start=BS)
+        after = np.asarray(ch.pools[0].numpy())
+        np.testing.assert_array_equal(after[ch.seq_blocks[0][0]],
+                                      before)
+
+    def test_no_dense_scratch_memory_regression(self):
+        """Satellite regression: serving must allocate NO KV beyond
+        the preallocated pool — pool_bytes() is the whole KV
+        footprint, before and after a capacity-length admission."""
+        model = _model()
+        rng = np.random.RandomState(33)
+        eng = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                 num_blocks=6, max_blocks_per_seq=MB)
+        self._no_gen_cache(model)
+        pool_before = eng.cache.pool_bytes()
+        slot, h = _admit(eng, _prompt(rng, MAXLEN))   # full capacity
+        assert eng.cache.pool_bytes() == pool_before
+        # the pool high-water mark is the prompt's pages, nothing more
+        assert eng.cache.peak_blocks_used == MB
+        assert eng.prefill_stats.peak_blocks == MB
+
+    def test_mixed_step_budget_long_prompt_does_not_stall_batch(self):
+        """prefill_token_budget: a long prompt streams 32 tokens per
+        step WHILE the resident request keeps decoding (Sarathi-style
+        mixed steps) — no admission-time stall, and both streams stay
+        bit-identical to dense twins."""
+        model = _model()
+        rng = np.random.RandomState(34)
+        pshort = _prompt(rng, 6)
+        plong = _prompt(rng, 150)
+        eng = PagedServingEngine(model, max_batch=2,
+                                 block_size=self.CAP_BS,
+                                 num_blocks=24,
+                                 max_blocks_per_seq=self.CAP_MB,
+                                 chunk_tokens=32,
+                                 prefill_token_budget=32)
+        dense_s = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_len=self.CAPACITY)
+        ds, dh = dense_s.add_request(pshort)
+        rs = eng.submit(pshort)
+        assert not eng.admitted          # budget mode: step() admits
+        x = np.zeros((2, 1, D), np.float32)
+        assert eng.step(paddle.to_tensor(x)) is None  # prefill-only
+        (rid, slot, h), = eng.admitted
+        eng.admitted.clear()
+        assert rid == rs
+        np.testing.assert_array_equal(np.asarray(dh.numpy()),
+                                      np.asarray(h.numpy()))
+        x[slot, 0] = np.asarray(h.numpy())[0]
+        xs = np.zeros((2, 1, D), np.float32)
+        xs[ds, 0] = x[slot, 0]
+        rl = eng.submit(plong)
+        long_slot = dense_l = None
+        for i in range(12):
+            op = eng.step(paddle.to_tensor(x))
+            os_ = np.asarray(dense_s.step(paddle.to_tensor(xs)).numpy())
+            assert op is not None        # short row never stalls
+            op = np.asarray(op.numpy())
+            np.testing.assert_array_equal(op[slot], os_[ds])
+            x[slot, 0] = xs[ds, 0] = os_[ds, 0]
+            if dense_l is not None:
+                ol = np.asarray(dense_l.step(
+                    paddle.to_tensor(xl)).numpy())
+                np.testing.assert_array_equal(op[long_slot], ol[dl])
+                x[long_slot, 0] = xl[dl, 0] = ol[dl, 0]
+            for (rr, ss, hh) in eng.admitted:
+                assert rr == rl
+                long_slot = ss
+                dense_l = ContinuousBatchingEngine(
+                    model, max_batch=2, max_len=self.CAPACITY)
+                dl, dlh = dense_l.add_request(plong)
+                np.testing.assert_array_equal(
+                    np.asarray(dlh.numpy()), np.asarray(hh.numpy()))
+                x[ss, 0] = np.asarray(hh.numpy())[0]
+                xl = np.zeros((2, 1, D), np.float32)
+                xl[dl, 0] = x[ss, 0]
+            eng.admitted.clear()
+        assert dense_l is not None, "long prompt never admitted"
+        st = eng.prefill_stats
+        assert st.mixed_steps > 0        # prefill rode along decode
+        assert st.chunks >= 5 and st.prefill_tokens == 156
+
+    def test_preempt_mid_prefill_then_reprefill(self):
+        """Pool pressure can evict a request MID-PROMPT-STREAM (it is
+        the youngest): its pages free, it re-queues whole, the
+        resident request is untouched bitwise, and once pressure
+        clears the victim re-streams and decodes bit-identically."""
+        model = _model()
+        rng = np.random.RandomState(35)
+        pa = _prompt(rng, 8)
+        pb = _prompt(rng, 40)
+        # 7 usable blocks of 8: A holds 1-2, B needs 5 + headroom
+        eng = PagedServingEngine(model, max_batch=2, block_size=8,
+                                 num_blocks=8, max_blocks_per_seq=8,
+                                 chunk_tokens=16,
+                                 prefill_token_budget=16)
+        dense_a = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_len=64)
+        da, dha = dense_a.add_request(pa)
+        ra = eng.submit(pa)
+        x = np.zeros((2, 1, D), np.float32)
+        assert eng.step(paddle.to_tensor(x)) is None
+        (_, sa, ha), = eng.admitted
+        eng.admitted.clear()
+        np.testing.assert_array_equal(np.asarray(dha.numpy()),
+                                      np.asarray(ha.numpy()))
+        x[sa, 0] = np.asarray(ha.numpy())[0]
+        xa = np.zeros((2, 1, D), np.float32)
+        xa[da, 0] = x[sa, 0]
+        rb = eng.submit(pb)
+        preempted = 0
+        for _ in range(10):
+            op = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            od = np.asarray(dense_a.step(paddle.to_tensor(xa)).numpy())
+            np.testing.assert_array_equal(op[sa], od[da])
+            x[sa, 0] = xa[da, 0] = od[da, 0]
+            if eng.preempted:
+                assert eng.preempted == [rb]   # B, mid-prefill
+                preempted += len(eng.preempted)
+                eng.preempted.clear()
+            eng.admitted.clear()               # B never completes here
+        assert preempted > 0, "expected a mid-prefill eviction"
+        # pressure clears: A releases, B streams to completion
+        eng.release(sa)
+        for _ in range(6):
+            if eng.admitted:
+                break
+            assert eng.step(paddle.to_tensor(x)) is None
+        (rid, sb, hb), = eng.admitted
+        eng.admitted.clear()
+        assert rid == rb
+        dense_b = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_len=64)
+        db, dhb = dense_b.add_request(pb)
+        np.testing.assert_array_equal(np.asarray(dhb.numpy()),
+                                      np.asarray(hb.numpy()))
+        x = np.zeros((2, 1, D), np.float32)
+        xb = np.zeros((2, 1, D), np.float32)
+        x[sb, 0] = xb[db, 0] = np.asarray(hb.numpy())[0]
+        for _ in range(4):
+            op = np.asarray(eng.step(paddle.to_tensor(x)).numpy())
+            od = np.asarray(dense_b.step(paddle.to_tensor(xb)).numpy())
+            np.testing.assert_array_equal(op[sb], od[db])
+            x, xb = op[:, :1].copy(), od[:, :1].copy()
+
+
 class TestSharedPrefixCOW:
     def test_fork_shares_then_copies_on_write(self):
         """Refcounted shared-prefix pages: a fork shares the prefix

@@ -20,7 +20,8 @@ from paddle_tpu.ops.pallas.grouped_gemm import (
     gmm, gmm_reference, make_group_metadata)
 from paddle_tpu.ops.pallas.paged_attention import (
     gather_pages, paged_attention, paged_attention_multi,
-    paged_attention_multi_reference, paged_attention_reference)
+    paged_attention_multi_reference, paged_attention_prefill,
+    paged_attention_prefill_reference, paged_attention_reference)
 
 rng = np.random.default_rng(0)
 
@@ -333,6 +334,80 @@ class TestPagedAttentionMulti:
         # trash-block garbage must not move anything
         pool3 = pool.at[0].set(1e6)
         out3 = np.asarray(paged_attention_multi(q, pool3, bt, lens))
+        np.testing.assert_array_equal(out, out3)
+        assert np.isfinite(out).all()
+
+
+class TestPagedAttentionPrefill:
+    """Chunked paged prefill: a prompt chunk's queries (positions
+    start+i) attend causally over already-written pages through the
+    block table, tiled over a query-tile grid axis with pages past a
+    tile's causal frontier skipped."""
+
+    @pytest.mark.parametrize("nh,nkv", [(8, 4), (4, 4)])
+    def test_matches_reference(self, nh, nkv):
+        B, C, hd, bs, MB, NB = 3, 12, 32, 16, 5, 12
+        q = _rand(B, C, nh, hd)
+        pool = _rand(NB, 2, nkv, bs, hd)
+        bt = jnp.asarray(rng.integers(1, NB, (B, MB)), jnp.int32)
+        start = jnp.asarray([0, 23, 60], jnp.int32)  # aligned/mid/deep
+        out = paged_attention_prefill(q, pool, bt, start)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(paged_attention_prefill_reference(q, pool, bt,
+                                                         start)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_query_tiling_matches_untiled(self):
+        """tile_q smaller than (and not dividing) the chunk must give
+        the same result as one tile — padding rows and per-tile page
+        skipping are pure work-scheduling."""
+        B, C, nh, hd, bs, MB, NB = 2, 13, 4, 16, 8, 6, 10
+        q = _rand(B, C, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray(rng.integers(1, NB, (B, MB)), jnp.int32)
+        start = jnp.asarray([4, 19], jnp.int32)
+        ref = paged_attention_prefill_reference(q, pool, bt, start)
+        for tq in (1, 4, 5, 13):
+            np.testing.assert_allclose(
+                np.asarray(paged_attention_prefill(q, pool, bt, start,
+                                                   tile_q=tq)),
+                np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_equals_multi_kernel_at_same_positions(self):
+        """A prefill chunk at start S IS a multi-query sweep with
+        seq_lens = S + C — the two kernels must agree (same folded-row
+        math, different grids)."""
+        B, C, nh, hd, bs, MB, NB = 2, 6, 4, 16, 8, 4, 9
+        q = _rand(B, C, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray(rng.integers(1, NB, (B, MB)), jnp.int32)
+        start = jnp.asarray([3, 10], jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(paged_attention_prefill(q, pool, bt, start,
+                                               tile_q=3)),
+            np.asarray(paged_attention_multi(q, pool, bt, start + C)),
+            atol=2e-6, rtol=2e-6)
+
+    def test_causal_within_chunk_and_trash_masked(self):
+        """Query i must not see positions past start+i (later chunk
+        rows), and trash-block entries past the allocation must not
+        leak."""
+        B, C, nh, hd, bs, MB, NB = 1, 4, 4, 16, 8, 3, 6
+        q = _rand(B, C, nh, hd)
+        pool = _rand(NB, 2, nh, bs, hd)
+        bt = jnp.asarray([[3, 4, 0]], jnp.int32)
+        start = jnp.asarray([6], jnp.int32)     # chunk covers 6..9
+        out = np.asarray(paged_attention_prefill(q, pool, bt, start))
+        # row 0 (position 6): perturbing positions 7.. must not move it
+        pool2 = pool.at[3, :, :, 7:, :].set(123.0)
+        pool2 = pool2.at[4].set(123.0)
+        out2 = np.asarray(paged_attention_prefill(q, pool2, bt, start))
+        np.testing.assert_array_equal(out[:, 0], out2[:, 0])
+        # trash-block garbage must not move anything (positions <= 9
+        # all live in pages 0-1 of the table)
+        pool3 = pool.at[0].set(1e6)
+        out3 = np.asarray(paged_attention_prefill(q, pool3, bt, start))
         np.testing.assert_array_equal(out, out3)
         assert np.isfinite(out).all()
 

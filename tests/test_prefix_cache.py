@@ -222,6 +222,71 @@ class TestMinSuffixRows:
         assert st.tokens_skipped == T - MIN_PREFILL_SUFFIX_ROWS
 
 
+class TestChunkedSuffix:
+    """Prefix-cache adoption composed with CHUNKED prefill: hits seed
+    nothing — the suffix chunk(s) simply attend over the adopted pages
+    through the chunk protocol (the pages->scratch gather is gone)."""
+
+    def test_adoption_then_one_chunk_suffix(self):
+        """A long cached prefix (128 tokens — past the old suite's
+        64-token scratch shapes) followed by a short tail: the second
+        admission adopts every prefix page and runs the suffix as ONE
+        chunk, bit-identical to the cold engine."""
+        model = _model()
+        rng = np.random.RandomState(10)
+        sys_prompt = rng.randn(8 * BS, D).astype(np.float32)
+        prompt = np.concatenate(
+            [sys_prompt, rng.randn(5, D).astype(np.float32)])
+        kw = dict(max_batch=1, block_size=BS, num_blocks=24,
+                  max_blocks_per_seq=10, chunk_tokens=32)
+        cold = PagedServingEngine(model, **kw)
+        warm = PagedServingEngine(model, prefix_cache=True, **kw)
+        _serve_one(cold, prompt, 0)
+        _serve_one(warm, prompt, 0)        # registers 8 prefix pages
+        chunks_before = warm.prefill_stats.chunks
+        hc, sc, tc = _serve_one(cold, prompt, 6)
+        hw, sw, tw = _serve_one(warm, prompt, 6)
+        np.testing.assert_array_equal(hc, hw)
+        for a, b in zip(sc, sw):
+            np.testing.assert_array_equal(a, b)
+        assert tc == tw
+        st = warm.prefix_stats
+        assert st.hit_blocks == 8
+        # the 5-token suffix ran as exactly ONE chunk over the pages
+        assert warm.prefill_stats.chunks == chunks_before + 1
+
+    def test_partial_hit_multi_chunk_suffix(self):
+        """A suffix longer than one chunk after a partial hit: chunks
+        continue from the adopted boundary, never rewriting the shared
+        pages, still bit-identical."""
+        model = _model()
+        rng = np.random.RandomState(11)
+        sys_prompt = rng.randn(2 * BS, D).astype(np.float32)
+        p1 = np.concatenate([sys_prompt,
+                             rng.randn(40, D).astype(np.float32)])
+        p2 = np.concatenate([sys_prompt,
+                             rng.randn(40, D).astype(np.float32)])
+        kw = dict(max_batch=2, block_size=BS, num_blocks=24,
+                  max_blocks_per_seq=MB, chunk_tokens=16)
+        cold = PagedServingEngine(model, **kw)
+        warm = PagedServingEngine(model, prefix_cache=True, **kw)
+        _serve_one(cold, p1, 2)
+        _serve_one(warm, p1, 2)
+        hc, sc, tc = _serve_one(cold, p2, 4)
+        hw, sw, tw = _serve_one(warm, p2, 4)
+        np.testing.assert_array_equal(hc, hw)
+        for a, b in zip(sc, sw):
+            np.testing.assert_array_equal(a, b)
+        assert tc == tw
+        st = warm.prefix_stats
+        assert st.hit_blocks == 2          # the shared system pages
+        # shared pages stayed shared through the suffix chunks: the
+        # index still resolves them (no COW split rewrote them)
+        from paddle_tpu.inference import chain_block_hashes
+        hashes = chain_block_hashes(sys_prompt, BS)
+        assert len(warm.cache.match_prefix(hashes)) == 2
+
+
 class TestHitDivergeCOW:
     def test_fully_cached_prompt_shares_every_page(self):
         """B's prompt fully matches A's 3 registered pages while A is
