@@ -32,11 +32,13 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
-                      PrefillStats, PrefixCacheStats, SpecDecodeStats)
+                      PrefillStats, PrefixCacheStats, ResilienceStats,
+                      SpecDecodeStats)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           PagedPrefillView,
                           chain_block_hashes, chain_hash)
+from .resilience import FaultInjector, RequestOutcome  # noqa: F401
 from .scheduler import (MIN_PREFILL_SUFFIX_ROWS,  # noqa: F401
                         PagedRequest, PagedServingEngine,
                         chunked_prefill)
@@ -45,9 +47,10 @@ from .speculative import (SpeculativeEngine,  # noqa: F401
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
-           "BlockOOM", "PagedKVCache", "PagedLayerCache",
-           "PagedPrefillView", "PagedRequest", "PagedServingEngine",
-           "PrefillStats", "PrefixCacheStats",
+           "BlockOOM", "FaultInjector", "PagedKVCache",
+           "PagedLayerCache", "PagedPrefillView", "PagedRequest",
+           "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
+           "RequestOutcome", "ResilienceStats",
            "SpecDecodeStats", "SpeculativeEngine", "TokenServingModel",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
            "chain_block_hashes", "chain_hash"]

@@ -107,6 +107,22 @@ class BlockAllocator:
         self.reclaimed = 0
         self.refcount = np.zeros(self.num_blocks, np.int32)
         self.refcount[0] = 1  # trash block: never allocated, never freed
+        # diagnostics + fault injection, wired by the owning cache:
+        #   context()       -> str appended to BlockOOM messages (pool
+        #                      occupancy breakdown, owning-slot histogram)
+        #   describe(block) -> str appended to ref/free misuse errors
+        #                      (who owns the block)
+        #   fault_hook(n)   -> may raise BlockOOM (FaultInjector);
+        #                      consulted first so a forced OOM fires
+        #                      even with free blocks in the pool
+        self.context = None
+        self.describe = None
+        self.fault_hook = None
+
+    def _blurb(self, block: int) -> str:
+        if self.describe is None:
+            return ""
+        return f" ({self.describe(int(block))})"
 
     @property
     def num_free(self) -> int:
@@ -117,8 +133,14 @@ class BlockAllocator:
         return len(self._cached)
 
     def alloc(self, n: int = 1) -> List[int]:
+        if self.fault_hook is not None:
+            self.fault_hook(n)
         if n > self.num_free:
-            raise BlockOOM(f"need {n} blocks, {self.num_free} free")
+            raise BlockOOM(
+                f"need {n} block(s), {self.num_free} free "
+                f"({len(self._free)} free-list + {len(self._cached)} "
+                f"cached-free reclaimable)"
+                + (self.context() if self.context is not None else ""))
         blocks = []
         for _ in range(n):
             if self._free:
@@ -137,7 +159,8 @@ class BlockAllocator:
         """Share blocks (forked prefix): one more owner each."""
         for b in blocks:
             if self.refcount[b] <= 0:
-                raise ValueError(f"ref of unallocated block {b}")
+                raise ValueError(f"ref of unallocated block {b}"
+                                 + self._blurb(b))
             self.refcount[b] += 1
 
     def free(self, blocks, to_cache: bool = False) -> None:
@@ -148,7 +171,8 @@ class BlockAllocator:
             if b == 0:
                 raise ValueError("block 0 is reserved")
             if self.refcount[b] <= 0:
-                raise ValueError(f"double free of block {b}")
+                raise ValueError(f"double free of block {b}"
+                                 + self._blurb(b))
             self.refcount[b] -= 1
             if self.refcount[b] == 0:
                 if to_cache:
@@ -493,6 +517,16 @@ class PagedKVCache:
         self._block_hash: Dict[int, bytes] = {}
         self.allocator = BlockAllocator(self.num_blocks,
                                         on_reclaim=self._on_reclaim)
+        # actionable allocator errors: BlockOOM carries the occupancy
+        # breakdown, ref/free misuse names the owning slot(s)
+        self.allocator.context = self._pool_context
+        self.allocator.describe = self._describe_block
+        # content fingerprints for the "never written in place" audit
+        # (check_invariants): blocks that must be immutable — shared
+        # (refcount >= 2), hash-indexed, or parked cached-free — are
+        # hashed at audit time and re-verified while they stay in that
+        # state; fork/adopt re-shares drop the entry (fresh epoch)
+        self._audit_fp: Dict[int, bytes] = {}
         self.pools: List[Tensor] = [
             paddle.zeros([self.num_blocks, 2, self.num_heads,
                           self.block_size, self.head_dim], dtype=dtype)
@@ -539,6 +573,143 @@ class PagedKVCache:
         # parse for ml_dtypes names, so a bfloat16 pool would raise
         return sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
                    for p in self.pools)
+
+    # -- diagnostics ---------------------------------------------------
+    def owners_of(self, block: int) -> List[int]:
+        """Slots whose table holds ``block`` (error/audit paths only —
+        O(max_seqs * blocks_per_seq))."""
+        return [s for s in range(self.max_seqs)
+                if block in self.seq_blocks[s]]
+
+    def _pool_context(self) -> str:
+        """Occupancy breakdown appended to BlockOOM messages so an OOM
+        report is actionable: tier counts + owning-slot histogram."""
+        a = self.allocator
+        active = self.num_blocks - 1 - a.num_free
+        hist = {s: len(bl) for s, bl in enumerate(self.seq_blocks)
+                if bl}
+        return (f"; pool: {active} active / {a.num_cached} cached-free"
+                f" / {len(a._free)} free of {self.num_blocks - 1}"
+                f" usable; blocks per slot: {hist or '{}'}")
+
+    def _describe_block(self, block: int) -> str:
+        owners = self.owners_of(block)
+        state = ("cached-free" if block in self.allocator._cached
+                 else f"refcount {int(self.allocator.refcount[block])}")
+        tail = ", hash-indexed" if block in self._block_hash else ""
+        own = f"owned by slot(s) {owners}" if owners else "no owner"
+        return f"{state}, {own}{tail}"
+
+    def _fingerprint(self, block: int, pool_arrs) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for arr in pool_arrs:
+            h.update(np.ascontiguousarray(arr[block]).tobytes())
+        return h.digest()
+
+    def check_invariants(self, lens=None, active=None,
+                         deep: bool = True) -> bool:
+        """Audit the pool's bookkeeping; raises AssertionError naming
+        the violated invariant, returns True when clean. Verified:
+
+          1. refcounts == block-table references: every usable block's
+             refcount equals the number of slot tables holding it (a
+             block appears at most once per table).
+          2. partition: free list, cached-free tier and the active set
+             (refcount > 0) are pairwise disjoint and together cover
+             every usable block exactly once.
+          3. trash block 0: refcount pinned at 1, never in a table,
+             never in either free tier, never hash-indexed.
+          4. device tables mirror host state: block_tables[slot] is
+             seq_blocks[slot] then trash.
+          5. hash index: _hash_to_block and _block_hash are inverse
+             maps, and every indexed block is live (refcount > 0) or
+             parked cached-free — the index never points at a
+             free-list block.
+          6. cached-free blocks are refcount-0 and hash-indexed (the
+             second-chance tier exists only for resurrectable content).
+          7. with ``lens``/``active`` (the engine's view): every
+             active slot's table covers blocks_needed(lens[slot]).
+          8. ``deep``: immutable-content audit — blocks that must not
+             be written in place (refcount >= 2 shared pages, hash-
+             indexed pages, cached-free pages) are content-fingerprinted
+             and re-verified against the previous audit while they
+             remain in that state; an in-place write to a shared or
+             indexed page trips it. (Writers must COW-split first —
+             ensure()'s write-range split.)
+        """
+        a = self.allocator
+        counts: Dict[int, int] = {}
+        for slot in range(self.max_seqs):
+            blocks = self.seq_blocks[slot]
+            assert len(blocks) == len(set(blocks)), \
+                f"slot {slot} table holds duplicate blocks: {blocks}"
+            assert len(blocks) <= self.max_blocks_per_seq, \
+                f"slot {slot} table over capacity"
+            assert 0 not in blocks, \
+                f"slot {slot} table holds the trash block"
+            for b in blocks:
+                counts[int(b)] = counts.get(int(b), 0) + 1
+            row = self.block_tables[slot]
+            assert list(row[:len(blocks)]) == [int(b) for b in blocks] \
+                and not row[len(blocks):].any(), \
+                f"slot {slot} device table diverges from seq_blocks"
+        free_set, cached_set = set(a._free), set(a._cached)
+        active_set = {b for b in range(1, self.num_blocks)
+                      if a.refcount[b] > 0}
+        assert a.refcount[0] == 1 and 0 not in free_set \
+            and 0 not in cached_set and 0 not in self._block_hash, \
+            "trash block 0 left its reserved state"
+        for b in range(1, self.num_blocks):
+            assert int(a.refcount[b]) == counts.get(b, 0), \
+                (f"block {b} refcount {int(a.refcount[b])} != "
+                 f"{counts.get(b, 0)} table reference(s) "
+                 f"(slots {self.owners_of(b)})")
+        assert not (free_set & cached_set) \
+            and not (free_set & active_set) \
+            and not (cached_set & active_set), \
+            "free / cached-free / active sets overlap"
+        assert free_set | cached_set | active_set \
+            == set(range(1, self.num_blocks)), \
+            "free / cached-free / active sets do not cover the pool"
+        for h, b in self._hash_to_block.items():
+            assert self._block_hash.get(b) == h, \
+                f"hash index asymmetry at block {b}"
+            assert b in active_set or b in cached_set, \
+                f"hash index points at free-list block {b}"
+        for b, h in self._block_hash.items():
+            assert self._hash_to_block.get(h) == b, \
+                f"block-hash asymmetry at block {b}"
+        for b in cached_set:
+            assert a.refcount[b] == 0, f"cached-free block {b} has owners"
+            assert b in self._block_hash, \
+                f"cached-free block {b} is not hash-indexed"
+        if lens is not None and active is not None:
+            lens = np.asarray(lens)
+            for slot in np.flatnonzero(np.asarray(active)):
+                need = self.blocks_needed(int(lens[slot]))
+                assert need <= len(self.seq_blocks[int(slot)]), \
+                    (f"active slot {int(slot)} length "
+                     f"{int(lens[slot])} not covered by its "
+                     f"{len(self.seq_blocks[int(slot)])} block(s)")
+        if deep:
+            frozen = {b for b in range(1, self.num_blocks)
+                      if a.refcount[b] >= 2 or b in self._block_hash
+                      or b in cached_set}
+            for b in list(self._audit_fp):
+                if b not in frozen:
+                    del self._audit_fp[b]
+            if frozen:
+                # ONE device->host pull per pool, shared by every
+                # fingerprint (not one whole-pool copy per block)
+                arrs = [np.asarray(p.numpy()) for p in self.pools]
+                for b in frozen:
+                    fp = self._fingerprint(b, arrs)
+                    old = self._audit_fp.get(b)
+                    assert old is None or old == fp, \
+                        (f"immutable block {b} was written in place "
+                         f"({self._describe_block(b)})")
+                    self._audit_fp[b] = fp
+        return True
 
     def bt_tensor(self) -> Tensor:
         """Device copy of the block tables; rebuilt only after a
@@ -652,6 +823,24 @@ class PagedKVCache:
             self.block_tables[slot, :] = 0
             self._tables_dirty()
 
+    def quarantine_seq(self, slot: int) -> None:
+        """Free a slot's pages with NO cached-free second chance: used
+        when the slot's pool content is suspect (numeric failure — a
+        NaN/Inf reached its hidden, so its K/V pages may be poisoned).
+        Solely-owned blocks lose their hash-index entry and return to
+        the true free list (never resurrectable); blocks shared with
+        other slots only drop this owner — a sharer's copy predates
+        the corruption (shared pages are never written in place, so
+        any poisoned append went to a COW-split private block)."""
+        for b in self.seq_blocks[slot]:
+            b = int(b)
+            if self.allocator.refcount[b] == 1:
+                self._on_reclaim(b)   # drop index entry + audit print
+            self.allocator.free([b], to_cache=b in self._block_hash)
+        self.seq_blocks[slot] = []
+        self.block_tables[slot, :] = 0
+        self._tables_dirty()
+
     def fork(self, src: int, dst: int, length: int) -> None:
         """Share src's first ``blocks_needed(length)`` blocks with dst
         (refcounted, including a partial last block — the first
@@ -660,6 +849,8 @@ class PagedKVCache:
             raise ValueError(f"dst slot {dst} already allocated")
         shared = self.seq_blocks[src][:self.blocks_needed(length)]
         self.allocator.ref(shared)
+        for b in shared:   # fresh share epoch for the content audit
+            self._audit_fp.pop(int(b), None)
         self.seq_blocks[dst] = list(shared)
         self.block_tables[dst, :len(shared)] = shared
         self._tables_dirty()
@@ -688,6 +879,8 @@ class PagedKVCache:
         h = self._block_hash.pop(block, None)
         if h is not None and self._hash_to_block.get(h) == block:
             del self._hash_to_block[h]
+        # content legitimately changes from here: new audit epoch
+        self._audit_fp.pop(block, None)
 
     def release_to_cache(self, blocks) -> None:
         """Drop ownership of ``blocks``; indexed blocks reaching
@@ -725,6 +918,8 @@ class PagedKVCache:
         for b in matched:
             if self.allocator.refcount[b] > 0:
                 self.allocator.ref([b])
+                # new sharer: fresh epoch for the content audit
+                self._audit_fp.pop(int(b), None)
             else:
                 self.allocator.resurrect(b)
         if matched:

@@ -1,0 +1,253 @@
+"""Resilience layer for the paged serving stack: request-level failure
+isolation, deterministic fault injection, and terminal outcomes.
+
+The serving engines built in the earlier serving PRs had all-or-nothing
+failure semantics: a ``BlockOOM`` that survived preemption raised
+``RuntimeError`` out of ``PagedServingEngine.step()`` and killed every
+in-flight request, a NaN in one slot's hidden silently corrupted that
+request forever, and a preempted request could thrash through
+re-prefill with no retry bound. Serving systems in the
+vLLM/Ragged-Paged-Attention lineage treat eviction and readmission as
+routine events; this module makes FAILURE routine too — a per-request
+outcome, never an engine crash:
+
+* ``RequestOutcome`` — the terminal record of one request:
+  ``FINISHED`` (caller release / capacity retire) or one of the
+  failure statuses ``FAILED_OOM`` (pool dry even after preempting
+  every other request, or the re-prefill retry budget exhausted),
+  ``FAILED_NUMERIC`` (non-finite hidden detected by the per-slot
+  guard), ``FAILED_DEADLINE`` (per-request step or wall-clock budget
+  blown, admitted or still queued). Engines append these to an
+  ``outcomes`` event list the caller drains, exactly like
+  ``admitted``/``finished``/``preempted``.
+
+* ``FaultInjector`` — deterministic, schedule-driven fault injection
+  with hook points wired into ``BlockAllocator.alloc`` (forced
+  ``BlockOOM``), the fused model call (NaN planted in chosen slots'
+  output rows), and the speculative engine's draft roll (forced
+  draft-pool OOM mid-roll, corrupted draft logits to storm the
+  rollback path). Hooks are consulted ONLY when an injector was passed
+  to the engine — the no-injector hot path carries zero overhead.
+  Schedules are keyed by the engine step counter, so a storm replays
+  identically run after run; the headline guarantee (asserted in
+  tests/test_resilience.py) is that under a storm of injected OOMs and
+  NaNs, surviving requests' decoded tokens are BIT-IDENTICAL to a
+  fault-free run and no exception escapes ``step()``/``step_multi()``.
+
+Pool invariant auditing lives on ``PagedKVCache.check_invariants``
+(paged_cache.py) and is surfaced per engine via
+``PagedServingEngine.check_invariants`` / ``SpeculativeEngine.
+check_invariants``; the ``--audit-invariants`` pytest flag
+(tests/conftest.py) runs it after every engine step.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+from .paged_cache import BlockOOM
+
+__all__ = ["RequestOutcome", "FaultInjector"]
+
+
+class RequestOutcome:
+    """Terminal record of one serving request. ``status`` is one of
+    the four class constants; anything but FINISHED means the engine
+    shed the request (its pages are freed, its slot re-usable) while
+    every other request kept stepping."""
+
+    FINISHED = "finished"
+    FAILED_OOM = "failed_oom"            # pool dry / retry budget blown
+    FAILED_NUMERIC = "failed_numeric"    # non-finite hidden in the slot
+    FAILED_DEADLINE = "failed_deadline"  # step / wall-clock budget blown
+
+    STATUSES = (FINISHED, FAILED_OOM, FAILED_NUMERIC, FAILED_DEADLINE)
+
+    __slots__ = ("rid", "status", "reason", "tokens", "preemptions",
+                 "step")
+
+    def __init__(self, rid: int, status: str, reason: str = "",
+                 tokens: int = 0, preemptions: int = 0, step: int = 0):
+        if status not in self.STATUSES:
+            raise ValueError(f"unknown outcome status {status!r}")
+        self.rid = int(rid)
+        self.status = status
+        self.reason = reason
+        self.tokens = int(tokens)        # consumed rows at termination
+        self.preemptions = int(preemptions)
+        self.step = int(step)            # engine step of the verdict
+
+    @property
+    def failed(self) -> bool:
+        return self.status != self.FINISHED
+
+    def as_dict(self) -> dict:
+        return {"rid": self.rid, "status": self.status,
+                "reason": self.reason, "tokens": self.tokens,
+                "preemptions": self.preemptions, "step": self.step}
+
+    def __repr__(self):
+        tail = f", reason={self.reason!r}" if self.reason else ""
+        return (f"RequestOutcome(rid={self.rid}, status={self.status}, "
+                f"tokens={self.tokens}, step={self.step}{tail})")
+
+
+def _norm_oom(sched) -> Dict[int, int]:
+    """{step: count} with count < 0 meaning every alloc that step; a
+    bare iterable of steps means 'every alloc' at each."""
+    if sched is None:
+        return {}
+    if isinstance(sched, dict):
+        return {int(s): int(n) for s, n in sched.items()}
+    return {int(s): FaultInjector.ALL for s in sched}
+
+
+def _norm_nan(sched) -> Dict[int, tuple]:
+    if sched is None:
+        return {}
+    return {int(s): tuple(int(x) for x in np.atleast_1d(slots))
+            for s, slots in sched.items()}
+
+
+class FaultInjector:
+    """Deterministic fault schedules, keyed by the serving engine's
+    step counter (1-indexed; ``begin_step`` is called by the engine at
+    the top of every ``step``/``step_multi``, and by the speculative
+    engine at the top of every round with the upcoming verify step's
+    index, so draft-phase faults share the same clock).
+
+      oom_at        {step: n}: the first n ``BlockAllocator.alloc``
+                    calls of the TARGET pool at that step raise
+                    BlockOOM. n < 0 (``FaultInjector.ALL``, also what
+                    a bare list of steps means) fails EVERY alloc that
+                    step — preemption then cannot help, which forces a
+                    SHED (the engine fails the growing request instead
+                    of raising). n = 1 exercises the preempt-retry
+                    path without shedding.
+      nan_at        {step: [slots]}: after the fused model call at
+                    that step, those slots' output rows are replaced
+                    with NaN — the per-slot numeric guard then fails
+                    the occupying request (FAILED_NUMERIC), never the
+                    engine. Rows of other slots round-trip bitwise
+                    untouched.
+      draft_oom_at  same shape as oom_at, wired to the DRAFT pool's
+                    allocator (SpeculativeEngine): a mid-roll hit
+                    rolls the partial draft roll back page-wise and
+                    serves the round without speculation.
+      draft_nan_at  {step: [slots]}: corrupt those slots' DRAFT logits
+                    during the roll — proposals turn to noise and the
+                    verify step rejects them, storming the
+                    truncate/rollback path (greedy bit-identity is
+                    unaffected: every emitted token is target-derived).
+
+    ``seed`` drives the ``storm`` constructor (random schedules that
+    replay identically for a given seed) and is kept for schedule
+    authoring; the injector itself is pure schedule playback.
+    Counters (``injected_oom`` etc.) record what actually fired.
+    """
+
+    ALL = -1
+
+    def __init__(self, seed: int = 0,
+                 oom_at: Union[Dict[int, int], Iterable[int], None] = None,
+                 nan_at: Optional[Dict[int, Iterable[int]]] = None,
+                 draft_oom_at: Union[Dict[int, int], Iterable[int],
+                                     None] = None,
+                 draft_nan_at: Optional[Dict[int, Iterable[int]]] = None):
+        self.seed = int(seed)
+        self._oom = {"target": _norm_oom(oom_at),
+                     "draft": _norm_oom(draft_oom_at)}
+        self.nan_at = _norm_nan(nan_at)
+        self.draft_nan_at = _norm_nan(draft_nan_at)
+        self.step = 0
+        self.injected_oom = 0
+        self.injected_draft_oom = 0
+        self.injected_nan = 0
+        self.injected_draft_nan = 0
+
+    @classmethod
+    def storm(cls, seed: int, steps: int, *, oom_sheds: int = 3,
+              nan_events: int = 2, max_batch: int = 4,
+              first_step: int = 2) -> "FaultInjector":
+        """A seed-driven random storm: ``oom_sheds`` whole-step forced
+        OOMs (guaranteed shed pressure) and ``nan_events`` single-slot
+        NaN plantings, at distinct steps in [first_step, steps). Same
+        seed -> same schedule -> same storm, run after run."""
+        rng = np.random.RandomState(seed)
+        n = oom_sheds + nan_events
+        if steps - first_step < n:
+            raise ValueError("not enough steps for the requested storm")
+        picks = rng.choice(np.arange(first_step, steps), size=n,
+                           replace=False)
+        oom_at = {int(s): cls.ALL for s in picks[:oom_sheds]}
+        nan_at = {int(s): [int(rng.randint(max_batch))]
+                  for s in picks[oom_sheds:]}
+        return cls(seed=seed, oom_at=oom_at, nan_at=nan_at)
+
+    # -- engine-facing hooks ------------------------------------------
+    def begin_step(self, step: int) -> None:
+        self.step = int(step)
+
+    def on_alloc(self, pool: str, n: int = 1) -> None:
+        """BlockAllocator.alloc hook: raise BlockOOM when the schedule
+        says so (consuming one scheduled failure unless unbounded)."""
+        sched = self._oom[pool]
+        rem = sched.get(self.step)
+        if rem is None or rem == 0:
+            return
+        if rem > 0:
+            sched[self.step] = rem - 1
+        if pool == "draft":
+            self.injected_draft_oom += 1
+        else:
+            self.injected_oom += 1
+        raise BlockOOM(f"injected fault: forced {pool}-pool OOM at "
+                       f"step {self.step}")
+
+    def _corrupt(self, out, slots) -> object:
+        """Replace ``slots``' rows of a [B, ...] Tensor with NaN; all
+        other rows round-trip bitwise unchanged (float32 numpy
+        round-trips are exact)."""
+        from ..framework.tensor import Tensor
+        arr = np.array(np.asarray(out.numpy()), np.float32, copy=True)
+        hit = 0
+        for s in slots:
+            if 0 <= s < arr.shape[0]:
+                arr[s] = np.nan
+                hit += 1
+        return Tensor(arr), hit
+
+    def corrupt_hidden(self, out):
+        """Plant scheduled NaNs into the fused step's output rows.
+        Returns ``out`` untouched (same object) on steps with nothing
+        scheduled."""
+        slots = self.nan_at.get(self.step)
+        if not slots:
+            return out
+        out, hit = self._corrupt(out, slots)
+        self.injected_nan += hit
+        return out
+
+    def corrupt_draft_logits(self, logits):
+        """Plant scheduled NaNs into draft sampling logits (rollback
+        storm: the corrupted proposals verify-fail)."""
+        slots = self.draft_nan_at.get(self.step)
+        if not slots:
+            return logits
+        logits, hit = self._corrupt(logits, slots)
+        self.injected_draft_nan += hit
+        return logits
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed, "step": self.step,
+                "injected_oom": self.injected_oom,
+                "injected_draft_oom": self.injected_draft_oom,
+                "injected_nan": self.injected_nan,
+                "injected_draft_nan": self.injected_draft_nan}
+
+    def __repr__(self):
+        return (f"FaultInjector(seed={self.seed}, "
+                f"oom={self.injected_oom}, nan={self.injected_nan}, "
+                f"draft_oom={self.injected_draft_oom}, "
+                f"draft_nan={self.injected_draft_nan})")

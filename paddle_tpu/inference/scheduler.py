@@ -44,12 +44,25 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     behavior as the old scratch path, still scratchless inside.
     Chunk accounting rides in ``prefill_stats`` (PrefillStats).
 
-Events are surfaced in ``admitted`` / ``finished`` / ``preempted``
-lists the caller drains between steps (prefill outputs ride along so
-the caller can seed the next input row).
+  * failure isolation (inference/resilience.py): requests end in a
+    terminal ``RequestOutcome`` — FINISHED, or FAILED_OOM /
+    FAILED_NUMERIC / FAILED_DEADLINE — surfaced in ``outcomes``;
+    a BlockOOM that survives preemption sheds ONE request instead of
+    raising, ``max_preemptions`` bounds the re-prefill retry budget,
+    per-request deadlines (steps or wall clock) are enforced each
+    step, and an optional numeric guard fails a slot whose hidden
+    goes non-finite (its pages are quarantined). A ``FaultInjector``
+    can drive all of it deterministically; ``check_invariants``
+    audits the pool bookkeeping. Counters ride in
+    ``resilience_stats`` (ResilienceStats).
+
+Events are surfaced in ``admitted`` / ``finished`` / ``preempted`` /
+``outcomes`` lists the caller drains between steps (prefill outputs
+ride along so the caller can seed the next input row).
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -58,7 +71,8 @@ import numpy as np
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
-from .serving import PrefillStats, PrefixCacheStats
+from .resilience import RequestOutcome
+from .serving import PrefillStats, PrefixCacheStats, ResilienceStats
 
 __all__ = ["PagedRequest", "PagedServingEngine", "chunked_prefill",
            "MIN_PREFILL_SUFFIX_ROWS"]
@@ -162,6 +176,12 @@ class PagedRequest:
         self.slot: Optional[int] = None
         self.admit_seq = -1
         self.preemptions = 0
+        # resilience knobs (set by the engine at submit): re-prefill
+        # retry budget and per-request deadlines — None = unbounded
+        self.max_preemptions: Optional[int] = None
+        self.deadline_steps: Optional[int] = None
+        self.deadline_time: Optional[float] = None   # monotonic clock
+        self.submit_step = 0
 
     @property
     def history(self) -> np.ndarray:
@@ -211,7 +231,9 @@ class PagedServingEngine:
                  dtype: str = "float32", watermark_blocks: int = 0,
                  prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 injector=None, max_preemptions: Optional[int] = None,
+                 numeric_guard: Optional[bool] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
@@ -219,10 +241,28 @@ class PagedServingEngine:
         self.prefix_cache = bool(prefix_cache)
         self.prefix_stats = PrefixCacheStats()
         self.prefill_stats = PrefillStats()
+        # resilience layer (inference/resilience.py): per-request
+        # terminal outcomes instead of engine crashes, bounded retry,
+        # optional deterministic fault injection + numeric guard. The
+        # guard (one [B]-bool device->host read per step) defaults ON
+        # only when an injector is present; pass numeric_guard=True to
+        # run it in production serving too.
+        self.injector = injector
+        self.max_preemptions = max_preemptions
+        self.numeric_guard = (injector is not None
+                              if numeric_guard is None
+                              else bool(numeric_guard))
+        self.resilience_stats = ResilienceStats()
+        self.outcomes: List[RequestOutcome] = []
+        self._step_count = 0
+        self._has_deadlines = False
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
             prefix_cache=prefix_cache)
+        if injector is not None:
+            self.cache.allocator.fault_hook = \
+                lambda n: injector.on_alloc("target", n)
         self.max_len = self.cache.capacity_per_seq
         # prompt chunk size (chunked_prefill): a multiple of the block
         # size by default so most chunk boundaries land on page edges;
@@ -290,13 +330,25 @@ class PagedServingEngine:
         return self.prefix_stats.hit_rate
 
     # -- admission ----------------------------------------------------
-    def submit(self, prompt) -> int:
+    def submit(self, prompt, *, max_preemptions: Optional[int] = None,
+               deadline_steps: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue a prompt ([T, d_model] embeddings) and try to admit.
         Returns the request id; if admission succeeded an
         ``(rid, slot, last_hidden)`` event is in ``admitted``. With
         ``prefill_token_budget`` set, admission only grants a slot —
         the prompt streams during subsequent ``step`` calls and the
-        admitted event fires when the last chunk lands."""
+        admitted event fires when the last chunk lands.
+
+        Resilience knobs (all optional, None = unbounded):
+        ``max_preemptions`` caps the re-prefill retry budget for THIS
+        request (overriding the engine default) — exceeding it fails
+        the request with FAILED_OOM instead of requeueing, so two long
+        prompts can never livelock each other through eviction.
+        ``deadline_steps`` / ``deadline_s`` fail the request
+        (FAILED_DEADLINE) once that many engine steps / seconds have
+        passed since submission, whether it is running, mid-prefill or
+        still queued. Terminal outcomes surface in ``outcomes``."""
         arr = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
                          else prompt, np.float32)
         if arr.shape[0] == 0:
@@ -307,6 +359,16 @@ class PagedServingEngine:
                 f"{self.max_len}")
         req = PagedRequest(self._next_rid, arr)
         self._next_rid += 1
+        req.max_preemptions = (self.max_preemptions
+                               if max_preemptions is None
+                               else int(max_preemptions))
+        req.submit_step = self._step_count
+        if deadline_steps is not None:
+            req.deadline_steps = int(deadline_steps)
+        if deadline_s is not None:
+            req.deadline_time = time.monotonic() + float(deadline_s)
+        if deadline_steps is not None or deadline_s is not None:
+            self._has_deadlines = True
         self.queue.append(req)
         self._try_admit()
         return req.rid
@@ -342,7 +404,25 @@ class PagedServingEngine:
                 return  # head-of-line blocks; keep FIFO fairness
             self.queue.popleft()
             if self.prefill_token_budget is None:
-                self._prefill(req)
+                try:
+                    self._prefill(req)
+                except BlockOOM as e:
+                    # the budget check above said the prompt fits, so
+                    # this is an injected fault (or a raced reclaim):
+                    # un-admit — drop the partial pages and retry on a
+                    # later admission pass, against the retry budget
+                    if req.slot is not None:
+                        self._drop(req.slot)
+                        req.slot = None
+                    if self._over_retry_budget(req):
+                        self._fail(req, RequestOutcome.FAILED_OOM,
+                                   f"admission prefill OOM and retry "
+                                   f"budget exhausted: {e}")
+                    else:
+                        req.preemptions += 1
+                        self._requeue_preempted(req)
+                        self.preempted.append(req.rid)
+                    return
             else:
                 # grant the slot only; step() streams the chunks
                 self._start_prefill(req)
@@ -373,6 +453,8 @@ class PagedServingEngine:
         req.slot = slot
         req.admit_seq = self._next_admit_seq
         self._next_admit_seq += 1
+        if req.preemptions > 0:
+            self.resilience_stats.retried += 1
         return slot
 
     def _complete_prefill(self, slot: int, last_hidden) -> None:
@@ -437,20 +519,10 @@ class PagedServingEngine:
             T = len(req)
             c = _chunk_len(T, st["pos"], self.chunk_tokens,
                            budget=budget)
-            while self.prefilling[slot]:
-                try:
-                    self.cache.ensure(slot, st["pos"] + c,
+            if not self._grow_or_shed(slot, req, st["pos"] + c,
                                       start_block=st["n_cached"],
-                                      write_from=st["pos"])
-                    break
-                except BlockOOM:
-                    if self.num_active + self.num_prefilling == 1:
-                        raise RuntimeError(
-                            "pool too small: one sequence cannot grow "
-                            "even with every other request evicted")
-                    self._preempt_youngest()
-            if not self.prefilling[slot]:
-                continue  # the slot itself was the eviction victim
+                                      write_from=st["pos"]):
+                continue  # the slot was evicted (or shed) growing
             pos, h = chunked_prefill(
                 self.model, self.cache, slot, req.history,
                 pos=st["pos"], target=st["pos"] + c,
@@ -468,11 +540,91 @@ class PagedServingEngine:
             self.prefill_stats.prefill_steps += 1
         return ran, fresh
 
-    # -- release / preemption -----------------------------------------
+    # -- release / preemption / failure -------------------------------
     def release(self, slot: int) -> None:
-        """Caller-side finish (e.g. EOS): free the pages, refill."""
+        """Caller-side finish (e.g. EOS): free the pages, refill. The
+        request's terminal RequestOutcome (FINISHED) lands in
+        ``outcomes``."""
+        req = self._requests[slot]
         self._drop(slot)
+        if req is not None:
+            self._record(req, RequestOutcome.FINISHED, "released")
         self._try_admit()
+
+    def _record(self, req: PagedRequest, status: str,
+                reason: str) -> None:
+        self.outcomes.append(RequestOutcome(
+            req.rid, status, reason=reason, tokens=len(req),
+            preemptions=req.preemptions, step=self._step_count))
+        st = self.resilience_stats
+        if status == RequestOutcome.FAILED_OOM:
+            st.shed += 1
+        elif status == RequestOutcome.FAILED_NUMERIC:
+            st.nan_failed += 1
+        elif status == RequestOutcome.FAILED_DEADLINE:
+            st.deadline_failed += 1
+
+    def _fail(self, req: PagedRequest, status: str,
+              reason: str) -> None:
+        """Terminal failure of ONE request: free its pages (numeric
+        failures quarantine them — no cached-free second chance, the
+        content is suspect), detach it from slot/queue, record the
+        outcome. The engine, and every other request, keeps going."""
+        if req.slot is not None:
+            self._drop(req.slot,
+                       quarantine=status == RequestOutcome.FAILED_NUMERIC)
+            req.slot = None
+        else:
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                pass
+        self._record(req, status, reason)
+
+    def _over_retry_budget(self, req: PagedRequest) -> bool:
+        return req.max_preemptions is not None and \
+            req.preemptions >= req.max_preemptions
+
+    def _requeue_preempted(self, req: PagedRequest) -> None:
+        """Readmission fairness: preempted requests re-enter the queue
+        AHEAD of never-admitted ones (they carry sunk prefill/decode
+        compute), ordered among themselves by original submission age
+        — NOT plain appendleft, which reverses the order of two
+        requests preempted in different engine passes (a re-admitted
+        old request holds a fresh admit_seq, so it is evicted first
+        and appendleft would then queue it BEHIND its younger peer)."""
+        i = 0
+        for r in self.queue:
+            if r.preemptions > 0 and r.rid < req.rid:
+                i += 1
+            else:
+                break
+        self.queue.insert(i, req)
+
+    def _check_deadlines(self) -> None:
+        """Fail every request (active, mid-prefill or queued) whose
+        per-request deadline has passed. Zero overhead unless some
+        submit() actually set a deadline."""
+        if not self._has_deadlines:
+            return
+        now = None
+        held = [self._requests[int(s)] for s in
+                np.flatnonzero(self.active | self.prefilling)]
+        for req in held + list(self.queue):
+            if req is None:
+                continue
+            expired = ""
+            if req.deadline_steps is not None and \
+                    self._step_count - req.submit_step > \
+                    req.deadline_steps:
+                expired = (f"deadline of {req.deadline_steps} steps "
+                           f"exceeded")
+            elif req.deadline_time is not None:
+                now = time.monotonic() if now is None else now
+                if now >= req.deadline_time:
+                    expired = "wall-clock deadline exceeded"
+            if expired:
+                self._fail(req, RequestOutcome.FAILED_DEADLINE, expired)
 
     def _flush_history(self) -> None:
         """Attribute buffered decode inputs to their requests'
@@ -491,9 +643,12 @@ class PagedServingEngine:
                     for row in xv[int(slot)]:
                         req.append_history(row)
 
-    def _drop(self, slot: int) -> None:
+    def _drop(self, slot: int, quarantine: bool = False) -> None:
         self._flush_history()
-        self.cache.free_seq(slot)
+        if quarantine:
+            self.cache.quarantine_seq(slot)
+        else:
+            self.cache.free_seq(slot)
         self.active[slot] = False
         self.prefilling[slot] = False
         self._prefills.pop(slot, None)
@@ -502,16 +657,24 @@ class PagedServingEngine:
 
     def preempt(self, slot: int) -> None:
         """Evict a running (or mid-prefill) request: free ALL its
-        pages and requeue it at the front for re-prefill from its
-        history (a mid-prefill victim restarts its prompt stream on
-        re-admission)."""
+        pages and requeue it ahead of never-admitted requests for
+        re-prefill from its history (a mid-prefill victim restarts its
+        prompt stream on re-admission). A request past its
+        ``max_preemptions`` retry budget FAILS (FAILED_OOM outcome)
+        instead of requeueing — bounded retry, no re-prefill
+        livelock."""
         req = self._requests[slot]
         if req is None:
             raise ValueError(f"slot {slot} not active")
+        if self._over_retry_budget(req):
+            self._fail(req, RequestOutcome.FAILED_OOM,
+                       f"preemption retry budget "
+                       f"({req.max_preemptions}) exhausted")
+            return
         self._drop(slot)
         req.slot = None
         req.preemptions += 1
-        self.queue.appendleft(req)
+        self._requeue_preempted(req)
         self.preempted.append(req.rid)
 
     def _preempt_youngest(self) -> int:
@@ -535,10 +698,20 @@ class PagedServingEngine:
         active slots while prompts are still streaming (returns
         None). Returns hidden [max_batch, 1, d_model] (only rows
         active during this step are meaningful), or None if every
-        slot finished before the step could run."""
+        slot finished before the step could run.
+
+        FAILURE ISOLATION: a request that cannot be served — pool dry
+        even after preempting every other request, retry budget or
+        deadline blown, non-finite hidden in its row — is failed
+        individually (RequestOutcome in ``outcomes``, pages freed) and
+        the step completes for everyone else; no BlockOOM or fault
+        ever escapes this call. Rows of failed/preempted slots in the
+        returned hidden are garbage — drain the event lists."""
+        idle = self._begin_step()
         ran_prefill, fresh = self._advance_prefills()
         if self.num_active == 0:
-            if ran_prefill or self.num_prefilling > 0:
+            if ran_prefill or self.num_prefilling > 0 or self.queue \
+                    or not idle:
                 self._try_admit()
                 return None
             raise RuntimeError("step() with no active slots")
@@ -549,6 +722,8 @@ class PagedServingEngine:
             self.finished.append((req.rid, int(slot),
                                   int(self.lens[slot])))
             self._drop(int(slot))
+            self._record(req, RequestOutcome.FINISHED,
+                         "page capacity reached")
         # slots whose prefill completed within THIS step sit the
         # decode out: the caller has not drained their admitted event
         # yet, so their row of x is garbage — they stay masked and
@@ -565,19 +740,8 @@ class PagedServingEngine:
                        key=lambda s: self._requests[s].admit_seq)
         for slot in order:
             slot = int(slot)
-            while self.active[slot]:
-                try:
-                    self.cache.ensure(slot, int(self.lens[slot]) + 1)
-                    break
-                except BlockOOM:
-                    # victim = youngest active request — possibly this
-                    # row itself (then the while condition ends its
-                    # growth attempt and it re-queues for re-prefill)
-                    if self.num_active + self.num_prefilling == 1:
-                        raise RuntimeError(
-                            "pool too small: one sequence cannot grow "
-                            "even with every other request evicted")
-                    self._preempt_youngest()
+            self._grow_or_shed(slot, self._requests[slot],
+                               int(self.lens[slot]) + 1)
         stepping &= self.active     # growth may have evicted some
         if not stepping.any():
             self._try_admit()
@@ -589,6 +753,15 @@ class PagedServingEngine:
         #    unbounded window of input buffers)
         if len(self._pending_history) >= 32:
             self._flush_history()
+        # 3.5 sanitize: non-stepping rows may carry ANY caller values —
+        #     including the NaN row of a previously failed slot fed
+        #     back verbatim. They scatter k/v into the SHARED trash
+        #     block, and a NaN there would poison every sequence's
+        #     masked attention tail (an additive -1e30 mask cannot
+        #     cancel NaN), so they are zeroed on-device first —
+        #     unconditionally, to keep the "inactive rows: any
+        #     values" contract sound (bitwise no-op for stepping rows)
+        x = self._sanitize_masked_rows(x, stepping)
         self._pending_history.append((x, stepping.copy()))
         # 4. fused ragged step over the paged views; mid-prefill and
         #    freshly admitted slots present all-trash tables so the
@@ -598,6 +771,8 @@ class PagedServingEngine:
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        if self.injector is not None:
+            out = self.injector.corrupt_hidden(out)
         self.lens[stepping] += 1
         self.prefill_stats.decode_steps += 1
         if ran_prefill:
@@ -606,6 +781,8 @@ class PagedServingEngine:
         # mark too, not just prefill chunks
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
+        if self.numeric_guard:
+            self._guard_numeric(out, stepping)
         # 5. continuous refill
         self._try_admit()
         return out
@@ -634,7 +811,14 @@ class PagedServingEngine:
                 "step_multi() does not support prefill_token_budget "
                 "mode; use synchronous admission (the default) for "
                 "multi-token verification")
+        idle = self._begin_step()
         if self.num_active == 0:
+            if self.queue or self.num_prefilling > 0 or not idle:
+                # deadline failures can empty the batch mid-stream;
+                # the caller sees None + the outcome events, never an
+                # exception
+                self._try_admit()
+                return None
             raise RuntimeError("step_multi() with no active slots")
         over = self.active & (self.lens + L > self.max_len)
         if over.any():
@@ -647,29 +831,33 @@ class PagedServingEngine:
                        key=lambda s: self._requests[s].admit_seq)
         for slot in order:
             slot = int(slot)
-            while self.active[slot]:
-                try:
-                    self.cache.ensure(slot, int(self.lens[slot]) + L,
-                                      write_from=int(self.lens[slot]))
-                    break
-                except BlockOOM:
-                    if self.num_active == 1:
-                        raise RuntimeError(
-                            "pool too small: one sequence cannot grow "
-                            "even with every other request evicted")
-                    self._preempt_youngest()
+            self._grow_or_shed(slot, self._requests[slot],
+                               int(self.lens[slot]) + L,
+                               write_from=int(self.lens[slot]))
+        if not self.active.any():
+            self._try_admit()
+            return None
         if len(self._pending_history) >= 32:
             self._flush_history()
-        self._pending_history.append((x, self.active.copy()))
+        stepping = self.active.copy()
+        # see step(): a NaN fed for an inactive row must not reach the
+        # shared trash block (zeroed unconditionally, bitwise no-op
+        # for active rows)
+        x = self._sanitize_masked_rows(x, stepping)
+        self._pending_history.append((x, stepping))
         self.cache.set_decode_mask(
             self.prefilling if self.prefilling.any() else None)
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        if self.injector is not None:
+            out = self.injector.corrupt_hidden(out)
         self.lens[self.active] += L
         self.prefill_stats.decode_steps += 1
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
+        if self.numeric_guard:
+            self._guard_numeric(out, stepping)
         self._try_admit()
         return out
 
@@ -693,3 +881,100 @@ class PagedServingEngine:
                                               self.cache.block_size)
         self.cache.truncate(slot, new_len)
         self.lens[slot] = new_len
+
+    # -- resilience ---------------------------------------------------
+    def _begin_step(self) -> bool:
+        """Step-top bookkeeping shared by step()/step_multi():
+        advance the step counter (the fault injector's clock) and
+        enforce per-request deadlines. Returns whether the engine was
+        ALREADY empty on entry — that is caller misuse and still
+        raises, while an engine emptied by this step's own failures
+        returns None to the caller."""
+        self._step_count += 1
+        if self.injector is not None:
+            self.injector.begin_step(self._step_count)
+        idle = self.num_active == 0 and self.num_prefilling == 0 \
+            and not self.queue
+        self._check_deadlines()
+        return idle
+
+    def _grow_or_shed(self, slot: int, req: PagedRequest, length: int,
+                      *, start_block: int = 0,
+                      write_from: Optional[int] = None) -> bool:
+        """Cover ``length`` tokens for ``slot`` (allocate-on-write +
+        COW split), preempting the YOUNGEST request on BlockOOM —
+        possibly the grower itself (it then re-queues for re-prefill).
+        When the pool is dry even with every other request evicted,
+        the grower is SHED (FAILED_OOM outcome) instead of the engine
+        raising. The ONE eviction/shed policy behind decode growth,
+        multi-token growth and chunked-prefill growth. Returns True
+        when the slot is still alive (and covered)."""
+        while self.active[slot] or self.prefilling[slot]:
+            try:
+                self.cache.ensure(slot, length, start_block=start_block,
+                                  write_from=write_from)
+                return True
+            except BlockOOM as e:
+                if self.num_active + self.num_prefilling == 1:
+                    self._fail(req, RequestOutcome.FAILED_OOM,
+                               f"pool exhausted even after preempting "
+                               f"every other request: {e}")
+                else:
+                    self._preempt_youngest()
+        return False
+
+    def _sanitize_masked_rows(self, x, stepping: np.ndarray):
+        """Zero the rows of ``x`` that are NOT stepping this call, on
+        device (one fused where, no host sync). Stepping rows pass
+        through BITWISE unchanged; non-stepping rows' (ignored) trash-
+        block writes become finite, so one request's NaN can never
+        leak into another's masked attention tail."""
+        import jax.numpy as jnp
+        mask = jnp.asarray(stepping.reshape(-1, 1, 1))
+        return Tensor(jnp.where(mask, x.data,
+                                jnp.zeros((), x.data.dtype)))
+
+    def _guard_numeric(self, out, stepping: np.ndarray) -> None:
+        """Per-slot numeric guard: one [B]-bool reduction on device,
+        one small host read. A non-finite value in a slot's output row
+        fails THAT request (FAILED_NUMERIC — its K/V pages may be
+        poisoned, so they are quarantined: freed with their prefix
+        index entries dropped, no cached-free second chance) and the
+        step stands for every other slot; attention is per-row, so a
+        NaN cannot cross slots inside the fused call."""
+        import jax.numpy as jnp
+        finite = np.asarray(jnp.isfinite(out.data)
+                            .reshape(out.shape[0], -1).all(axis=1))
+        bad = stepping & ~finite
+        for slot in np.flatnonzero(bad):
+            req = self._requests[int(slot)]
+            if req is None:
+                continue
+            self._fail(req, RequestOutcome.FAILED_NUMERIC,
+                       f"non-finite hidden in slot {int(slot)} at "
+                       f"step {self._step_count}")
+
+    def check_invariants(self) -> bool:
+        """Audit engine + pool bookkeeping (see PagedKVCache.
+        check_invariants for the pool-level list); raises
+        AssertionError on violation. Engine-level: every active or
+        prefilling slot maps to a request that points back at it,
+        queued requests hold no slot, and every active slot's table
+        covers its length. Run it after every step under the test
+        suite's ``--audit-invariants`` flag, or from a serving loop's
+        debug path."""
+        for slot in np.flatnonzero(self.active | self.prefilling):
+            req = self._requests[int(slot)]
+            assert req is not None and req.slot == int(slot), \
+                f"slot {int(slot)} active without a matching request"
+        for req in self.queue:
+            assert req.slot is None, \
+                f"queued request {req.rid} still holds slot {req.slot}"
+        assert not (self.active & self.prefilling).any(), \
+            "slot both active and prefilling"
+        for slot in self._prefills:
+            assert self.prefilling[slot], \
+                f"prefill state for non-prefilling slot {slot}"
+        self.cache.check_invariants(lens=self.lens, active=self.active)
+        self.resilience_stats.audits += 1
+        return True

@@ -27,7 +27,7 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 __all__ = ["ContinuousBatchingEngine", "PrefillStats",
-           "PrefixCacheStats", "SpecDecodeStats"]
+           "PrefixCacheStats", "ResilienceStats", "SpecDecodeStats"]
 
 
 class PrefixCacheStats:
@@ -146,6 +146,57 @@ class PrefillStats:
                 f"peak_blocks={self.peak_blocks})")
 
 
+class ResilienceStats:
+    """Serving-surface accounting for the resilience layer
+    (inference/resilience.py + the per-request failure isolation in
+    scheduler.py), sibling of PrefixCacheStats / PrefillStats /
+    SpecDecodeStats; counters only grow.
+
+      shed             requests FAILED_OOM: pool dry even after
+                       preempting every other request, or the
+                       re-prefill retry budget (max_preemptions)
+                       exhausted — the request is failed and its
+                       blocks freed, the step completes for everyone
+                       else
+      retried          re-admissions of previously preempted requests
+                       (each one replays its history bit-identically)
+      deadline_failed  requests FAILED_DEADLINE (per-request
+                       deadline_steps / deadline_s blown, admitted or
+                       still queued)
+      nan_failed       requests FAILED_NUMERIC (non-finite hidden in
+                       the slot's fused-step output row)
+      audits           check_invariants() passes run through the
+                       engine surface
+    """
+
+    __slots__ = ("shed", "retried", "deadline_failed", "nan_failed",
+                 "audits")
+
+    def __init__(self):
+        self.shed = 0
+        self.retried = 0
+        self.deadline_failed = 0
+        self.nan_failed = 0
+        self.audits = 0
+
+    @property
+    def failed(self) -> int:
+        """Total requests that ended in a failure outcome."""
+        return self.shed + self.deadline_failed + self.nan_failed
+
+    def as_dict(self) -> dict:
+        return {"shed": self.shed, "retried": self.retried,
+                "deadline_failed": self.deadline_failed,
+                "nan_failed": self.nan_failed, "failed": self.failed,
+                "audits": self.audits}
+
+    def __repr__(self):
+        return (f"ResilienceStats(shed={self.shed}, "
+                f"retried={self.retried}, "
+                f"deadline_failed={self.deadline_failed}, "
+                f"nan_failed={self.nan_failed})")
+
+
 class SpecDecodeStats:
     """Serving-surface accounting for speculative decoding
     (inference/speculative.py), the sibling of PrefixCacheStats. One
@@ -160,10 +211,13 @@ class SpecDecodeStats:
       draft_steps       per-slot draft model forward steps
       rolled_back       rejected tokens rolled back via page-table
                         truncation
+      draft_oom_rolls   draft rolls aborted by a draft-pool BlockOOM
+                        (the partial roll is rolled back page-wise and
+                        the round serves without speculation)
     """
 
     __slots__ = ("proposed", "accepted", "emitted", "target_steps",
-                 "draft_steps", "rolled_back")
+                 "draft_steps", "rolled_back", "draft_oom_rolls")
 
     def __init__(self):
         self.proposed = 0
@@ -172,6 +226,7 @@ class SpecDecodeStats:
         self.target_steps = 0
         self.draft_steps = 0
         self.rolled_back = 0
+        self.draft_oom_rolls = 0
 
     @property
     def acceptance_rate(self) -> float:
@@ -195,6 +250,7 @@ class SpecDecodeStats:
                 "target_steps": self.target_steps,
                 "draft_steps": self.draft_steps,
                 "rolled_back": self.rolled_back,
+                "draft_oom_rolls": self.draft_oom_rolls,
                 "acceptance_rate": round(self.acceptance_rate, 4),
                 "tokens_per_target_step":
                     round(self.tokens_per_target_step, 4)}

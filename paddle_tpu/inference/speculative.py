@@ -53,7 +53,8 @@ import numpy as np
 
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
-from .paged_cache import PagedKVCache
+from .paged_cache import BlockOOM, PagedKVCache
+from .resilience import RequestOutcome
 from .scheduler import PagedServingEngine, chunked_prefill
 from .serving import SpecDecodeStats
 
@@ -245,7 +246,10 @@ class SpeculativeEngine:
                  prefix_cache: bool = False, sampling: str = "greedy",
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  watermark_blocks: int = 0,
-                 chunk_tokens: Optional[int] = None, seed: int = 0):
+                 chunk_tokens: Optional[int] = None, seed: int = 0,
+                 injector=None,
+                 max_preemptions: Optional[int] = None,
+                 numeric_guard: Optional[bool] = None):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -256,16 +260,26 @@ class SpeculativeEngine:
         self.temperature = float(temperature)
         self.top_k = top_k
         self._rng = np.random.RandomState(seed)
+        self.injector = injector
         self.engine = PagedServingEngine(
             target.core, max_batch, block_size, num_blocks,
             max_blocks_per_seq=max_blocks_per_seq,
             watermark_blocks=watermark_blocks,
-            prefix_cache=prefix_cache, chunk_tokens=chunk_tokens)
+            prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
+            injector=injector, max_preemptions=max_preemptions,
+            numeric_guard=numeric_guard)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
         self.finished: List[Tuple[int, int]] = []
+        # terminal RequestOutcomes forwarded from the wrapped engine
+        # (FINISHED and every FAILED_*); the caller drains this list
+        self.outcomes: List[RequestOutcome] = []
         self._seqs: Dict[int, _SpecSeq] = {}     # by target slot
         self._by_rid: Dict[int, _SpecSeq] = {}
+        # draft slots whose cache could not be (re)built after a
+        # draft-pool OOM: rounds run unspeculated until a rebuild
+        # lands (the verify path never depends on draft state)
+        self._draft_dirty: set = set()
         if self.k > 0:
             # second, smaller pool: same per-seq page capacity as the
             # target (the draft never runs ahead of the target's
@@ -279,17 +293,29 @@ class SpeculativeEngine:
                 self.draft.core, block_size, draft_num_blocks,
                 max_seqs=self.max_batch, max_blocks_per_seq=mbps)
             self._draft_lens = np.zeros(self.max_batch, np.int32)
+            if injector is not None:
+                self.draft_cache.allocator.fault_hook = \
+                    lambda n: injector.on_alloc("draft", n)
         else:
             self.draft_cache = None
 
     # -- submission / events ------------------------------------------
-    def submit(self, token_ids) -> int:
+    def submit(self, token_ids, *,
+               max_preemptions: Optional[int] = None,
+               deadline_steps: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue a token-ID prompt; admission (now or later) samples
-        the first token on-device and prefills the draft cache."""
+        the first token on-device and prefills the draft cache. The
+        resilience knobs pass straight through to the wrapped
+        PagedServingEngine (see its ``submit``); terminal
+        RequestOutcomes surface in ``outcomes``."""
         toks = [int(t) for t in np.asarray(token_ids).reshape(-1)]
         if not toks:
             raise ValueError("empty prompt")
-        rid = self.engine.submit(self.target.embed(toks))
+        rid = self.engine.submit(self.target.embed(toks),
+                                 max_preemptions=max_preemptions,
+                                 deadline_steps=deadline_steps,
+                                 deadline_s=deadline_s)
         self._by_rid[rid] = _SpecSeq(rid, toks)
         self._handle_events()
         return rid
@@ -325,6 +351,7 @@ class SpeculativeEngine:
         if self.draft_cache is not None:
             self.draft_cache.free_seq(slot)
             self._draft_lens[slot] = 0
+        self._draft_dirty.discard(slot)
 
     def _sample(self, model: TokenServingModel, logits):
         return model.sample(logits, mode=self.sampling,
@@ -347,6 +374,18 @@ class SpeculativeEngine:
             self._clear_draft_slot(seq.slot)
             seq.slot = None
         eng.preempted.clear()
+        for oc in eng.outcomes:
+            # failure outcomes (shed / numeric / deadline): detach the
+            # stream from its slot — the host-side tokens stay
+            # readable via tokens(rid) until the caller releases
+            if oc.failed:
+                seq = self._by_rid.get(oc.rid)
+                if seq is not None and seq.slot is not None:
+                    self._seqs.pop(seq.slot, None)
+                    self._clear_draft_slot(seq.slot)
+                    seq.slot = None
+            self.outcomes.append(oc)
+        eng.outcomes.clear()
         for rid, slot, length in eng.finished:
             # engine-side capacity release (only reachable through
             # engine.step, which this wrapper does not call — but keep
@@ -373,11 +412,43 @@ class SpeculativeEngine:
                 tok, _ = self._sample(self.target, self.logits_of(h))
                 seq.toks.append(int(tok.reshape(-1)[0]))
                 seq.started = True
-            self._draft_prefill(slot, seq)
+            try:
+                self._draft_prefill(slot, seq)
+                self._draft_dirty.discard(slot)
+            except BlockOOM:
+                # injected draft-pool OOM: serve the slot without a
+                # draft until a rebuild lands — never fail the request
+                # over its DRAFT state
+                self._clear_draft_slot(slot)
+                self._draft_dirty.add(slot)
         eng.admitted.clear()
 
     def logits_of(self, hidden) -> Tensor:
         return self.target.logits(hidden)
+
+    @property
+    def resilience_stats(self):
+        return self.engine.resilience_stats
+
+    def check_invariants(self) -> bool:
+        """Audit the wrapped engine + BOTH pools (target and draft).
+        Draft-side extras: slot alignment (every tracked stream's
+        draft table covers its draft length; untracked slots hold no
+        draft pages) — see PagedKVCache.check_invariants for the
+        pool-level list."""
+        self.engine.check_invariants()
+        if self.draft_cache is not None:
+            tracked = np.zeros(self.max_batch, bool)
+            for s in self._seqs:
+                tracked[s] = True
+            self.draft_cache.check_invariants(lens=self._draft_lens,
+                                              active=tracked)
+            for s in range(self.max_batch):
+                if not tracked[s]:
+                    assert not self.draft_cache.seq_blocks[s], \
+                        (f"draft slot {s} holds pages with no tracked "
+                         f"stream")
+        return True
 
     def _draft_prefill(self, slot: int, seq: _SpecSeq) -> None:
         """(Re-)build the draft cache for a slot from the token stream
@@ -405,6 +476,10 @@ class SpeculativeEngine:
         reported in ``finished`` instead."""
         import paddle_tpu as paddle
         eng = self.engine
+        if self.injector is not None:
+            # draft-phase faults share the verify step's clock: label
+            # the round with the upcoming step_multi index
+            self.injector.begin_step(eng._step_count + 1)
         # requests at page capacity cannot take another token: retire.
         # Loop to a fixed point — a release can refill the slot with a
         # queued prompt that is ITSELF at capacity (a full-length
@@ -424,6 +499,17 @@ class SpeculativeEngine:
                 eng.release(slot)
         slots = sorted(self._seqs)
         if not slots:
+            # a fault storm can empty the whole batch mid-round
+            # (everything preempted/shed): kick admission so queued
+            # and preempted requests re-enter, then serve next round.
+            # The kick consumes an engine step of its own — exactly
+            # like an admission-only PagedServingEngine.step — so
+            # step-keyed fault schedules expire even when admission
+            # itself is the faulted path (no injection deadlock)
+            if eng.queue:
+                eng._begin_step()
+                eng._try_admit()
+                self._handle_events()
             return {}
         B = self.max_batch
         # every active slot rides every call, so the speculation depth
@@ -434,50 +520,92 @@ class SpeculativeEngine:
 
         # 1. draft roll: k_eff proposals, then one append-only step so
         #    the draft cache ends the round at the target's length
-        #    (uniform rollback, no per-slot catch-up next round)
+        #    (uniform rollback, no per-slot catch-up next round).
+        #    A draft-pool BlockOOM mid-roll (injected, or a caller-
+        #    sized-down draft pool) rolls the PARTIAL roll back
+        #    page-wise and serves the round without speculation — the
+        #    target pool is never touched by a draft fault, and the
+        #    draft slots rebuild from the token stream after the
+        #    verify (the same known-good path a preemption takes).
+        if self._draft_dirty:
+            # some slot is missing its draft cache: no proposals this
+            # round, but CLEAN slots still lockstep below — only the
+            # dirty ones rebuild (never the whole batch, every round)
+            k_eff = 0
+            L = 1
+        pre_draft = {s: int(self._draft_lens[s]) for s in slots} \
+            if self.draft_cache is not None else {}
+        roll_oom = False      # fresh draft-pool OOM THIS round
         drafts: Dict[int, List[int]] = {s: [] for s in slots}
         dprobs: Dict[int, List[np.ndarray]] = {s: [] for s in slots}
         if self.draft_cache is not None and k_eff > 0:
             cur = {s: self._seqs[s].toks[-1] for s in slots}
             d_d = self.draft.d_model
-            for j in range(k_eff + 1):
-                x = np.zeros((B, 1, d_d), np.float32)
-                for s in slots:
-                    x[s, 0] = self.draft.embed(cur[s])
-                    self.draft_cache.ensure(
-                        s, int(self._draft_lens[s]) + 1)
-                t = Tensor(np.asarray(self._draft_lens, np.int32))
-                with no_grad():
-                    out, _ = self.draft.core(
-                        paddle.to_tensor(x),
-                        caches=self.draft_cache.views, time_step=t)
-                for s in slots:
-                    self._draft_lens[s] += 1
-                self.stats.draft_steps += len(slots)
-                if j < k_eff:
-                    toks, probs = self._sample(self.draft,
-                                               self.draft.logits(
-                                                   out[:, -1]))
+            try:
+                for j in range(k_eff + 1):
+                    x = np.zeros((B, 1, d_d), np.float32)
                     for s in slots:
-                        drafts[s].append(int(toks[s]))
-                        if probs is not None:
-                            dprobs[s].append(probs[s])
-                        cur[s] = int(toks[s])
+                        x[s, 0] = self.draft.embed(cur[s])
+                        self.draft_cache.ensure(
+                            s, int(self._draft_lens[s]) + 1)
+                    t = Tensor(np.asarray(self._draft_lens, np.int32))
+                    with no_grad():
+                        out, _ = self.draft.core(
+                            paddle.to_tensor(x),
+                            caches=self.draft_cache.views, time_step=t)
+                    for s in slots:
+                        self._draft_lens[s] += 1
+                    self.stats.draft_steps += len(slots)
+                    if j < k_eff:
+                        lg = self.draft.logits(out[:, -1])
+                        if self.injector is not None:
+                            lg = self.injector.corrupt_draft_logits(lg)
+                        toks, probs = self._sample(self.draft, lg)
+                        for s in slots:
+                            drafts[s].append(int(toks[s]))
+                            if probs is not None:
+                                dprobs[s].append(probs[s])
+                            cur[s] = int(toks[s])
+            except BlockOOM:
+                # page-level rollback of the partial roll: appended
+                # draft pages fall off the table tails, target state
+                # untouched; this round verifies the pending token only
+                for s in slots:
+                    self.draft_cache.truncate(s, pre_draft[s])
+                    self._draft_lens[s] = pre_draft[s]
+                drafts = {s: [] for s in slots}
+                dprobs = {s: [] for s in slots}
+                k_eff, L = 0, 1
+                roll_oom = True
+                self.stats.draft_oom_rolls += 1
         elif self.draft_cache is not None:
-            # depth clamped to 0: keep the draft cache in lockstep by
-            # consuming the pending token alongside the target
-            x = np.zeros((B, 1, self.draft.d_model), np.float32)
-            for s in slots:
-                x[s, 0] = self.draft.embed(self._seqs[s].toks[-1])
-                self.draft_cache.ensure(s, int(self._draft_lens[s]) + 1)
-            t = Tensor(np.asarray(self._draft_lens, np.int32))
-            with no_grad():
-                self.draft.core(paddle.to_tensor(x),
-                                caches=self.draft_cache.views,
-                                time_step=t)
-            for s in slots:
-                self._draft_lens[s] += 1
-            self.stats.draft_steps += len(slots)
+            # depth clamped to 0 (capacity, or a dirty slot): keep the
+            # CLEAN slots' draft caches in lockstep by consuming the
+            # pending token alongside the target; dirty slots ride as
+            # trash rows and rebuild after the verify
+            live = [s for s in slots if s not in self._draft_dirty]
+            if live:
+                try:
+                    x = np.zeros((B, 1, self.draft.d_model), np.float32)
+                    for s in live:
+                        x[s, 0] = self.draft.embed(
+                            self._seqs[s].toks[-1])
+                        self.draft_cache.ensure(
+                            s, int(self._draft_lens[s]) + 1)
+                    t = Tensor(np.asarray(self._draft_lens, np.int32))
+                    with no_grad():
+                        self.draft.core(paddle.to_tensor(x),
+                                        caches=self.draft_cache.views,
+                                        time_step=t)
+                    for s in live:
+                        self._draft_lens[s] += 1
+                    self.stats.draft_steps += len(live)
+                except BlockOOM:
+                    for s in live:
+                        self.draft_cache.truncate(s, pre_draft[s])
+                        self._draft_lens[s] = pre_draft[s]
+                    roll_oom = True
+                    self.stats.draft_oom_rolls += 1
 
         # 2. verify: ONE target call scores the pending token plus all
         #    k_eff proposals through the paged cache
@@ -488,17 +616,23 @@ class SpeculativeEngine:
             x[s] = self.target.embed([self._seqs[s].toks[-1]]
                                      + drafts[s])
         out = eng.step_multi(paddle.to_tensor(x))
+        if out is None:
+            # every slot fell out mid-step (deadline/shed storm): the
+            # outcomes carry the verdicts; nothing was scored
+            self._handle_events()
+            return {}
         g_toks, g_probs = self._sample(self.target,
                                        self.target.logits(out))
         preempted_mid = {rid for rid in eng.preempted}
+        failed_mid = {oc.rid for oc in eng.outcomes if oc.failed}
 
         # 3. accept + rollback per slot
         emitted_by_rid: Dict[int, List[int]] = {}
         for s in slots:
             seq = self._seqs.get(s)
             if seq is None or seq.rid in preempted_mid or \
-                    not eng.active[s]:
-                continue        # evicted during verification growth
+                    seq.rid in failed_mid or not eng.active[s]:
+                continue        # evicted/failed during verification
             d = drafts[s]
             if self.sampling == "greedy":
                 n = 0
@@ -513,7 +647,11 @@ class SpeculativeEngine:
                 emitted = d[:n] + [bonus]
             new_len = pre_lens[s] + 1 + n
             eng.rollback(s, new_len)
-            if self.draft_cache is not None:
+            if self.draft_cache is not None and not roll_oom \
+                    and s not in self._draft_dirty:
+                # this slot's draft advanced in lockstep: align it to
+                # the accepted length (dirty / OOM-rolled-back slots
+                # are behind and rebuild below instead)
                 self.draft_cache.truncate(s, new_len)
                 self._draft_lens[s] = new_len
             seq.toks.extend(emitted)
@@ -523,6 +661,26 @@ class SpeculativeEngine:
             self.stats.emitted += len(emitted)
             self.stats.target_steps += 1
             emitted_by_rid[seq.rid] = emitted
+        if self.draft_cache is not None and \
+                (roll_oom or self._draft_dirty):
+            # rebuild draft caches from the token streams (the path a
+            # preemption takes — deterministic replay): after a fresh
+            # mid-roll OOM every slot's roll was rolled back, so all
+            # rebuild once; otherwise only the DIRTY slots do (clean
+            # ones stayed in lockstep above). A slot that OOMs again
+            # stays dirty and serves unspeculated until the pool
+            # clears.
+            targets = list(self._seqs) if roll_oom \
+                else list(self._draft_dirty)
+            for s in targets:
+                if s not in self._seqs or not eng.active[s]:
+                    continue
+                try:
+                    self._draft_prefill(s, self._seqs[s])
+                    self._draft_dirty.discard(s)
+                except BlockOOM:
+                    self._clear_draft_slot(s)
+                    self._draft_dirty.add(s)
         self._handle_events()
         return emitted_by_rid
 

@@ -28,6 +28,47 @@ def _seed_everything():
     yield
 
 
+# --- pool invariant auditing (inference/resilience.py) ---------------
+# `pytest --audit-invariants` wraps every paged-engine step so
+# PagedKVCache/engine bookkeeping is audited after EACH step across
+# the paged / prefix / speculative / resilience suites (slower:
+# the deep audit fingerprints shared pages; off by default).
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--audit-invariants", action="store_true", default=False,
+        help="run check_invariants() after every PagedServingEngine/"
+             "SpeculativeEngine step (deep pool audit; slow)")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _audit_invariants(request):
+    if not request.config.getoption("--audit-invariants"):
+        yield
+        return
+    from paddle_tpu.inference import (PagedServingEngine,
+                                      SpeculativeEngine)
+    patched = []
+
+    def wrap(cls, name):
+        fn = getattr(cls, name)
+
+        def wrapped(self, *a, **kw):
+            try:
+                return fn(self, *a, **kw)
+            finally:
+                self.check_invariants()
+        patched.append((cls, name, fn))
+        setattr(cls, name, wrapped)
+
+    wrap(PagedServingEngine, "step")
+    wrap(PagedServingEngine, "step_multi")
+    wrap(SpeculativeEngine, "step")
+    yield
+    for cls, name, fn in patched:
+        setattr(cls, name, fn)
+
+
 # --- speculative-decode per-test budget (tools/spec_budget.py) -------
 # The spec subsystem's tests drive whole serving loops; an accidental
 # blowup there would eat the tier-1 timeout. Any ``spec``-marked test

@@ -48,6 +48,25 @@ def test_serving_longprompt_smoke_leg():
     assert res["scratch"]["tokens_per_sec"] > 0
 
 
+def test_serving_faults_smoke_leg():
+    res = bench_extra.bench_serving_faults(smoke=True)
+    assert res["metric"] == "serving_fault_storm_isolation"
+    storm = res["fault_storm"]
+    # the seeded schedule really fired: three forced OOM-sheds, two
+    # NaN-failed requests, every failure a per-request outcome
+    assert storm["shed"] == 3
+    assert storm["nan_failed"] == 2
+    assert storm["completed"] == res["requests"] - 5
+    assert storm["shed_rate_pct"] == round(300 / res["requests"], 1)
+    # the headline guarantee rode the bench too: survivors'
+    # streams are bit-identical to the fault-free run
+    assert res["survivor_streams_bit_identical"] is True
+    # both runs actually served tokens
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert storm["tokens_per_sec"] > 0
+    assert res["baseline"]["completed"] == res["requests"]
+
+
 def test_serving_spec_smoke_leg():
     res = bench_extra.bench_serving_spec(smoke=True)
     assert res["metric"] == "serving_speculative_vs_plain_token_decode"

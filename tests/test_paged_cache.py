@@ -419,16 +419,36 @@ class TestPreemption:
             np.testing.assert_array_equal(op[slot], od[db])
             xp, xd = op[:, :1].copy(), od[:, :1].copy()
 
-    def test_pool_too_small_raises(self):
+    def test_pool_too_small_sheds_request_not_engine(self):
+        """A sequence that cannot grow even with every other request
+        evicted is SHED — a FAILED_OOM RequestOutcome, pages freed —
+        instead of raising out of step() (resilience layer): the
+        engine survives and serves the next request."""
+        from paddle_tpu.inference import RequestOutcome
         model = _model()
         rng = np.random.RandomState(3)
         eng = PagedServingEngine(model, max_batch=1, block_size=8,
                                  num_blocks=2, max_blocks_per_seq=4)
-        _admit(eng, _prompt(rng, 7))
+        rid, _ = _admit(eng, _prompt(rng, 7)), None
         x = paddle.to_tensor(np.zeros((1, 1, D), np.float32))
         eng.step(x)  # 7 -> 8 still fits the single page
-        with pytest.raises(RuntimeError, match="pool too small"):
-            eng.step(x)  # needs a 2nd page, no victim available
+        out = eng.step(x)  # needs a 2nd page, no victim available
+        assert out is None                  # shed, not crashed
+        (oc,) = eng.outcomes
+        assert oc.status == RequestOutcome.FAILED_OOM
+        assert "pool exhausted" in oc.reason
+        assert eng.resilience_stats.shed == 1
+        assert eng.num_active == 0 and not eng.queue
+        assert eng.cache.seq_blocks[0] == []    # pages freed
+        eng.check_invariants()
+        # the engine is still serviceable for a pool-sized request
+        eng.outcomes.clear()
+        _admit(eng, _prompt(rng, 5))
+        assert eng.step(x) is not None
+        # a truly empty engine still flags caller misuse
+        eng.release(0)
+        with pytest.raises(RuntimeError, match="no active slots"):
+            eng.step(x)
 
 
 class TestSchedulerPolicy:
