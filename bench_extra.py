@@ -1061,6 +1061,168 @@ def bench_serving_faults(smoke=False):
     }
 
 
+# ----------------------------------------------------------- crash recovery
+def bench_serving_recovery(smoke=False):
+    """Crash recovery cost on the token-ID paged serving loop
+    (inference/recovery.py): (1) SNAPSHOT OVERHEAD — the same workload
+    runs bare (plain SpeculativeEngine) and through a
+    RecoverableServer journaling every round and checkpointing every
+    ``snap_every`` rounds; the tokens/s ratio is the price of
+    durability. (2) RECOVERY — a CrashInjector kills the server
+    mid-run; the bench times RecoverableServer.recover (snapshot load
+    + pool restore + journal replay) and finishes the workload,
+    asserting every stream is bit-identical to the uninterrupted
+    baseline (the tests/test_recovery.py guarantee riding the
+    bench)."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference import (CrashInjector, EngineCrash,
+                                      RecoverableServer,
+                                      SpeculativeEngine,
+                                      TokenServingModel)
+
+    smoke = smoke or _SMOKE
+    tpu = (not smoke) and _on_tpu()
+    if tpu:
+        dim, heads, ffn, layers = 1024, 16, 4096, 2
+        vocab, n_req, slots, gen = 4096, 12, 4, 32
+    elif smoke:
+        dim, heads, ffn, layers = 64, 4, 128, 2
+        vocab, n_req, slots, gen = 50, 6, 3, 14
+    else:
+        dim, heads, ffn, layers = 256, 8, 1024, 2
+        vocab, n_req, slots, gen = 512, 8, 4, 24
+    block, prompt_len = 4, 12
+    snap_every = 4 if smoke else 8        # the "realistic" interval
+    mbps = -(-(prompt_len + gen + 2) // block)
+    num_blocks = slots * mbps + 2
+    paddle.seed(0)
+    core = FusedMultiTransformer(dim, heads, ffn, num_layers=layers)
+    core.eval()
+    rng = np.random.default_rng(0)
+    target = TokenServingModel(
+        core, rng.standard_normal((vocab, dim)).astype(np.float32))
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_req)]
+    eng_kw = dict(k=0, max_batch=slots, block_size=block,
+                  num_blocks=num_blocks, max_blocks_per_seq=mbps)
+
+    def finish(stepper, submit, release, generated, drain=None):
+        rids = [submit(p) for p in prompts]
+        done = {}
+        for _ in range(4000):
+            if len(done) == n_req:
+                break
+            stepper()
+            if drain is not None:
+                drain()
+            for rid in rids:
+                if rid in done:
+                    continue
+                if len(generated(rid)) >= gen:
+                    done[rid] = generated(rid)[:gen]
+                    release(rid)
+        else:
+            raise AssertionError("recovery bench did not converge")
+        return done
+
+    def run_plain():
+        eng = SpeculativeEngine(target, None, **eng_kw)
+        t0 = time.perf_counter()
+        done = finish(eng.step, eng.submit, eng.release, eng.generated,
+                      eng.outcomes.clear)
+        return time.perf_counter() - t0, done
+
+    def run_journaled(injector=None):
+        d = tempfile.mkdtemp(prefix="pt_recovery_bench_")
+        jp, sp = f"{d}/req.wal", f"{d}/serve.ckpt"
+        eng = SpeculativeEngine(target, None, injector=injector,
+                                **eng_kw)
+        state = {"srv": RecoverableServer(eng, journal_path=jp,
+                                          snapshot_path=sp,
+                                          snapshot_every=snap_every),
+                 "recover_s": 0.0, "replayed": 0, "crashes": 0}
+
+        def stepper():
+            try:
+                state["srv"].step()
+            except EngineCrash:
+                state["crashes"] += 1
+                t0 = time.perf_counter()
+                state["srv"] = RecoverableServer.recover(
+                    target, None, journal_path=jp, snapshot_path=sp,
+                    injector=injector)
+                state["recover_s"] += time.perf_counter() - t0
+                state["replayed"] += state["srv"].replayed_tokens
+
+        t0 = time.perf_counter()
+        done = finish(stepper, lambda p: state["srv"].submit(p),
+                      lambda r: state["srv"].release(r),
+                      lambda r: state["srv"].generated(r),
+                      lambda: state["srv"].drain_outcomes())
+        wall = time.perf_counter() - t0
+        srv = state["srv"]
+        srv.close()     # release the journal fd (crashed incarnations
+                        # were dropped above and close on collection)
+        shutil.rmtree(d, ignore_errors=True)
+        return wall, done, srv, state
+
+    if not smoke:   # warm the executable caches before timing
+        run_plain()
+    reps = 1 if smoke else 3
+    b_wall, b_done = min((run_plain() for _ in range(reps)),
+                         key=lambda r: r[0])
+    j_wall, j_done, j_srv, _ = min(
+        (run_journaled() for _ in range(reps)), key=lambda r: r[0])
+    assert j_done == b_done, "journaled run diverged from baseline"
+
+    # the recovery leg: one mid-run kill halfway between the second
+    # and third snapshots, so replay has half an interval of real work
+    crash_round = 2 * snap_every + max(2, snap_every // 2)
+    c_wall, c_done, c_srv, c_state = run_journaled(
+        CrashInjector(crash_at={crash_round: "begin"}))
+    bit_identical = c_done == b_done
+    total_tokens = n_req * gen
+    base_tps = total_tokens / b_wall
+    snap_tps = total_tokens / j_wall
+    return {
+        "metric": "serving_crash_recovery",
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "block_size": block, "requests": n_req,
+        "prompt_len": prompt_len, "gen_per_request": gen,
+        "snapshot_interval_rounds": snap_every,
+        "baseline": {
+            "wall_s": round(b_wall, 3),
+            "tokens_per_sec": round(base_tps, 1),
+        },
+        "with_snapshots": {
+            "wall_s": round(j_wall, 3),
+            "tokens_per_sec": round(snap_tps, 1),
+            "snapshots": j_srv.snapshots_taken,
+            "snapshot_bytes": j_srv.snapshot_bytes,
+            "journal_records": j_srv.journal.seq,
+        },
+        "snapshot_overhead_pct": round(
+            100 * (1 - snap_tps / base_tps), 1),
+        "recovery": {
+            "crashes": c_state["crashes"],
+            "wall_s": round(c_state["recover_s"], 4),
+            "replayed_tokens": c_state["replayed"],
+            "completed": len(c_done),
+        },
+        "streams_bit_identical_after_recovery": bool(bit_identical),
+        "note": "same engine/model/workload/block budget; journaled "
+                "run WALs every submission/round/outcome and "
+                "checkpoints the full engine every "
+                "snapshot_interval_rounds; recovery = atomic snapshot "
+                "load + deterministic journal replay "
+                "(tests/test_recovery.py proves the storm variant)",
+    }
+
+
 # --------------------------------------------------------- chunked prefill
 def bench_serving_longprompt(smoke=False):
     """Chunked paged prefill vs the retired dense-scratch path on a
@@ -1274,6 +1436,7 @@ BENCHES = {
     "serving_spec": bench_serving_spec,
     "serving_longprompt": bench_serving_longprompt,
     "serving_faults": bench_serving_faults,
+    "serving_recovery": bench_serving_recovery,
     "long_context": bench_long_context,
 }
 

@@ -38,22 +38,31 @@ from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           PagedPrefillView,
                           chain_block_hashes, chain_hash)
-from .resilience import FaultInjector, RequestOutcome  # noqa: F401
+from .resilience import (CrashInjector, EngineCrash,  # noqa: F401
+                         FaultInjector, RequestOutcome)
 from .scheduler import (MIN_PREFILL_SUFFIX_ROWS,  # noqa: F401
                         PagedRequest, PagedServingEngine,
                         chunked_prefill)
 from .speculative import (SpeculativeEngine,  # noqa: F401
                           TokenServingModel)
+from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
+                       RecoverableServer, RecoveryError,
+                       RequestJournal, SnapshotVersionError,
+                       load_snapshot, read_journal, save_snapshot)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
-           "BlockOOM", "FaultInjector", "PagedKVCache",
+           "BlockOOM", "CrashInjector", "EngineCrash", "FaultInjector",
+           "PagedKVCache",
            "PagedLayerCache", "PagedPrefillView", "PagedRequest",
            "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
-           "RequestOutcome", "ResilienceStats",
+           "RecoverableServer", "RecoveryError", "RequestJournal",
+           "RequestOutcome", "ResilienceStats", "SNAPSHOT_VERSION",
+           "SnapshotVersionError",
            "SpecDecodeStats", "SpeculativeEngine", "TokenServingModel",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
-           "chain_block_hashes", "chain_hash"]
+           "chain_block_hashes", "chain_hash", "load_snapshot",
+           "read_journal", "save_snapshot"]
 
 
 class PrecisionType:

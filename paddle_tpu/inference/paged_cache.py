@@ -35,6 +35,20 @@ list: they park in a CACHED-FREE second-chance tier
 reclaimed least-recently-used only when the free list runs dry. Block
 lifecycle: free -> active -> cached-free -> (resurrect -> active |
 reclaim -> free).
+
+CRASH RECOVERY (``snapshot``/``restore``): because every block is
+content-addressed by its chain hash, a pool checkpoint is "serialize
+the live + cached-free pages plus the allocator's exact state"
+(refcounts, free-list order, cached-free LRU order, hash index). A
+same-geometry restore is a perfect round trip — block ids, free-list
+order and LRU order are preserved, so the restored pool allocates
+bit-identically to the uninterrupted one. A restore into a DIFFERENT
+``num_blocks`` pool rehomes the content-addressed blocks under fresh
+ids through the same hash index (cached-free blocks are dropped
+least-recently-used first when the target is smaller; a live set that
+cannot fit raises a precise ``BlockOOM`` with the occupancy
+breakdown). Restore re-runs the deep ``check_invariants`` audit
+before handing the pool back.
 """
 from __future__ import annotations
 
@@ -711,6 +725,139 @@ class PagedKVCache:
                     self._audit_fp[b] = fp
         return True
 
+    # -- checkpoint / restore -----------------------------------------
+    def snapshot(self) -> dict:
+        """Host-side checkpoint of the whole pool: geometry, the
+        allocator's EXACT state (refcounts, free-list order,
+        cached-free LRU order), block tables, the chain-hash index,
+        and the content of every block that is live (refcount > 0) or
+        parked cached-free. Free-list blocks carry no content worth
+        keeping — a quarantined page, for instance, is already free
+        here and therefore never rides a snapshot. ONE device->host
+        pull per layer pool, independent of the live-block count.
+        The result is a plain picklable dict (numpy + ints + bytes);
+        ``restore`` rebuilds an identical pool from it."""
+        a = self.allocator
+        cached_order = [int(b) for b in a._cached]
+        keep = sorted({b for b in range(1, self.num_blocks)
+                       if a.refcount[b] > 0} | set(cached_order))
+        arrs = [np.asarray(p.numpy()) for p in self.pools]
+        if keep:
+            # one fancy-index gather per layer, not a Python loop per
+            # block — snapshots sit on the serving hot path
+            payload = np.stack([arr[keep] for arr in arrs],
+                               axis=1)                 # [n, L, 2, H, bs, D]
+        else:
+            payload = np.zeros((0, self.num_layers, 2, self.num_heads,
+                                self.block_size, self.head_dim),
+                               arrs[0].dtype)
+        return {
+            "kind": "paged_kv_cache",
+            "geometry": {
+                "num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "block_size": self.block_size,
+                "num_blocks": self.num_blocks,
+                "max_seqs": self.max_seqs,
+                "max_blocks_per_seq": self.max_blocks_per_seq,
+                "dtype": self.dtype,
+                "prefix_cache": self.prefix_cache,
+            },
+            "refcount": {int(b): int(a.refcount[b]) for b in keep},
+            "free_order": [int(b) for b in a._free],
+            "cached_order": cached_order,       # oldest (LRU) first
+            "reclaimed": int(a.reclaimed),
+            "hash_index": dict(self._hash_to_block),
+            "seq_blocks": [[int(b) for b in bl]
+                           for bl in self.seq_blocks],
+            "peak_blocks_used": int(self.peak_blocks_used),
+            "blocks": [int(b) for b in keep],
+            "payload": payload,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict, *,
+                num_blocks: Optional[int] = None) -> "PagedKVCache":
+        """Rebuild a pool from a ``snapshot`` dict. With the default
+        (same ``num_blocks``) every block keeps its id and the
+        allocator's free-list and LRU orders round-trip EXACTLY, so
+        post-restore allocation behavior is bit-identical to the
+        uninterrupted pool. ``num_blocks`` rehomes the
+        content-addressed blocks into a larger or smaller pool:
+        live blocks move first (oldest ids first), then cached-free
+        blocks newest-first — the least-recently-used cached-free
+        blocks are DROPPED (their index entries with them) when the
+        target cannot hold everything, exactly the LRU-reclaim policy
+        the live allocator applies. A live set that cannot fit raises
+        ``BlockOOM`` carrying the snapshot's occupancy breakdown.
+        Ends with the deep ``check_invariants`` audit."""
+        g = snap["geometry"]
+        nb = g["num_blocks"] if num_blocks is None else int(num_blocks)
+        cache = cls(g["num_layers"], g["num_heads"], g["head_dim"],
+                    g["block_size"], nb, g["max_seqs"],
+                    max_blocks_per_seq=g["max_blocks_per_seq"],
+                    dtype=g["dtype"], prefix_cache=g["prefix_cache"])
+        refcount = {int(b): int(n) for b, n in snap["refcount"].items()}
+        cached = [int(b) for b in snap["cached_order"]]
+        live = sorted(b for b, n in refcount.items() if n > 0)
+        usable = nb - 1
+        if len(live) > usable:
+            hist = {s: len(bl) for s, bl in
+                    enumerate(snap["seq_blocks"]) if bl}
+            raise BlockOOM(
+                f"restore needs {len(live)} live block(s) but the "
+                f"target pool has only {usable} usable"
+                f"; snapshot pool: {len(live)} active / {len(cached)} "
+                f"cached-free of {g['num_blocks'] - 1} usable; "
+                f"blocks per slot: {hist or '{}'}")
+        # cached-free blocks that fit, newest (most recently released)
+        # kept — dropping the LRU end is the reclaim order the live
+        # allocator uses
+        n_cached = min(len(cached), usable - len(live))
+        dropped, kept_cached = (cached[:len(cached) - n_cached],
+                                cached[len(cached) - n_cached:])
+        a = cache.allocator
+        if nb == g["num_blocks"] and not dropped:
+            remap = {b: b for b in live + kept_cached}
+            a._free = [int(b) for b in snap["free_order"]]
+        else:
+            order = live + kept_cached   # canonical rehoming order
+            remap = {old: new for new, old in enumerate(order, start=1)}
+            # fresh-pool free-list convention: pop() from the end
+            # hands out the lowest remaining id first
+            a._free = list(range(nb - 1, len(order), -1))
+        for old, n in refcount.items():
+            if old in remap:
+                a.refcount[remap[old]] = n
+        a._cached = OrderedDict((remap[b], True) for b in kept_cached)
+        a.reclaimed = int(snap["reclaimed"]) + len(dropped)
+        for slot, blocks in enumerate(snap["seq_blocks"]):
+            mapped = [remap[int(b)] for b in blocks]
+            cache.seq_blocks[slot] = mapped
+            cache.block_tables[slot, :len(mapped)] = mapped
+        for h, b in snap["hash_index"].items():
+            b = remap.get(int(b))
+            if b is not None:     # dropped cached-free: index entry too
+                cache._hash_to_block[h] = b
+                cache._block_hash[b] = h
+        payload = np.asarray(snap["payload"])
+        rows = [i for i, b in enumerate(snap["blocks"])
+                if int(b) in remap]             # dropped blocks: no scatter
+        if rows:
+            ids = jnp.asarray([remap[int(snap["blocks"][i])]
+                               for i in rows], jnp.int32)
+            payload = payload[rows]
+            for i in range(cache.num_layers):
+                seg = jnp.asarray(payload[:, i])
+                cache.pools[i] = Tensor(
+                    cache.pools[i].data.at[ids].set(
+                        seg.astype(cache.pools[i].data.dtype)))
+        cache.peak_blocks_used = int(snap["peak_blocks_used"])
+        cache._tables_dirty()
+        cache.check_invariants(deep=True)
+        return cache
+
     def bt_tensor(self) -> Tensor:
         """Device copy of the block tables; rebuilt only after a
         host-side table mutation. Rows in the decode mask (slots
@@ -928,15 +1075,19 @@ class PagedKVCache:
             self._tables_dirty()
         return len(matched)
 
-    def register_prefix(self, slot, hashes) -> None:
-        """Index the slot's first ``len(hashes)`` blocks under their
+    def register_prefix(self, slot, hashes,
+                        start: int = 0) -> None:
+        """Index the slot's blocks ``[start, len(hashes))`` under their
         chain hashes (first writer wins: a hash already indexed keeps
         its original block — both hold identical content, and 1:1
-        block<->hash bookkeeping is what reclaim relies on)."""
+        block<->hash bookkeeping is what reclaim relies on).
+        ``start`` lets an incremental caller (per-chunk registration)
+        skip the already-indexed prefix instead of re-probing it."""
         if not self.prefix_cache:
             return
-        for h, b in zip(hashes, self.seq_blocks[slot]):
-            b = int(b)
+        blocks = self.seq_blocks[slot]
+        for i in range(start, min(len(hashes), len(blocks))):
+            h, b = hashes[i], int(blocks[i])
             if h in self._hash_to_block or b in self._block_hash:
                 continue
             self._hash_to_block[h] = b

@@ -34,6 +34,19 @@ outcome, never an engine crash:
   NaNs, surviving requests' decoded tokens are BIT-IDENTICAL to a
   fault-free run and no exception escapes ``step()``/``step_multi()``.
 
+* ``CrashInjector`` — the crash-recovery extension (PR 6): on top of
+  the fault schedules it KILLS the engine (raises ``EngineCrash`` out
+  of the current call, simulating process death) at scheduled live
+  rounds and sub-phases — step top (``begin``), after an admission
+  pass (``post_admission``), after a prefill completes
+  (``post_prefill``), between a speculative draft roll and its verify
+  (``mid_spec_round``), and around the recovery host's journal append
+  (``pre_journal``/``post_journal``). Recovery = last snapshot +
+  journal replay (inference/recovery.py); crash points are keyed by a
+  LIVE-round clock and disarmed during replay, while the fault
+  schedules stay keyed by the (restored) engine step clock so a
+  replayed step re-injects the same faults — deterministic replay.
+
 Pool invariant auditing lives on ``PagedKVCache.check_invariants``
 (paged_cache.py) and is surfaced per engine via
 ``PagedServingEngine.check_invariants`` / ``SpeculativeEngine.
@@ -48,7 +61,16 @@ import numpy as np
 
 from .paged_cache import BlockOOM
 
-__all__ = ["RequestOutcome", "FaultInjector"]
+__all__ = ["RequestOutcome", "FaultInjector", "CrashInjector",
+           "EngineCrash"]
+
+
+class EngineCrash(RuntimeError):
+    """Injected process death (CrashInjector): the engine object that
+    raised this is to be ABANDONED — nothing in it may be trusted or
+    reused — and rebuilt from the last snapshot plus journal replay
+    (inference/recovery.py). Deliberately NOT a BlockOOM subclass, so
+    no engine-internal handler can swallow it."""
 
 
 class RequestOutcome:
@@ -189,6 +211,17 @@ class FaultInjector:
     def begin_step(self, step: int) -> None:
         self.step = int(step)
 
+    def begin_round(self) -> None:
+        """Live-round clock tick (crash schedules): no-op in the base
+        injector — CrashInjector overrides. Called by the recovery
+        host at the top of every LIVE round, never during replay."""
+
+    def crash_point(self, phase: str) -> None:
+        """Crash-schedule consultation: no-op in the base injector —
+        CrashInjector overrides and may raise EngineCrash. Engines
+        call this at step boundaries and sub-phases whenever an
+        injector is present."""
+
     def on_alloc(self, pool: str, n: int = 1) -> None:
         """BlockAllocator.alloc hook: raise BlockOOM when the schedule
         says so (consuming one scheduled failure unless unbounded)."""
@@ -251,3 +284,106 @@ class FaultInjector:
                 f"oom={self.injected_oom}, nan={self.injected_nan}, "
                 f"draft_oom={self.injected_draft_oom}, "
                 f"draft_nan={self.injected_draft_nan})")
+
+
+class CrashInjector(FaultInjector):
+    """FaultInjector that additionally KILLS the engine: at scheduled
+    (live round, phase) points it raises ``EngineCrash`` out of
+    whatever call is running, leaving the engine object mid-mutation —
+    exactly what a process death does. The test/recovery harness
+    catches it, abandons the engine, and rebuilds from snapshot +
+    journal (inference/recovery.py).
+
+    ``crash_at``: {round: phase or iterable of phases}. Rounds are the
+    LIVE-round clock — ``begin_round()`` is called by the recovery
+    host at the top of every live round and NOT during journal replay,
+    so a recovered engine re-running journaled rounds cannot re-die at
+    the same schedule entry (the round counter, which lives in this
+    object and survives the "process", has already moved past it).
+    Each scheduled (round, phase) fires at most once. Phases:
+
+      begin           step top, right after the fault clock ticks
+      post_admission  an admission pass completed (every step, and
+                      inside submit())
+      post_prefill    a prefill completed and the admitted event fired
+      mid_spec_round  between the speculative draft roll and the ONE
+                      target verify call (draft advanced, target not)
+      pre_journal     after the engine round, BEFORE the emissions hit
+                      the journal (the round must replay from scratch)
+      post_journal    after the journal append, before the caller sees
+                      the emissions (replay must NOT re-emit)
+
+    ``arm(False)`` disarms crash points during journal replay; the
+    inherited FAULT schedules stay live throughout — they are keyed by
+    the engine step clock, which the snapshot restores, so a replayed
+    step re-injects the same OOM/NaN the live step saw (without that,
+    replay would diverge from the journal). Consumable ``{step: n}``
+    OOM budgets mutate injector state that snapshots do NOT capture —
+    compose crashes only with whole-step (``ALL`` / bare-list)
+    schedules and ``nan_at``, which are pure playback."""
+
+    PHASES = ("begin", "post_admission", "post_prefill",
+              "mid_spec_round", "pre_journal", "post_journal")
+
+    def __init__(self, crash_at=None, seed: int = 0, **fault_kw):
+        super().__init__(seed=seed, **fault_kw)
+        sched: Dict[int, set] = {}
+        for r, p in (crash_at or {}).items():
+            phases = (p,) if isinstance(p, str) else tuple(p)
+            for ph in phases:
+                if ph not in self.PHASES:
+                    raise ValueError(f"unknown crash phase {ph!r} "
+                                     f"(one of {self.PHASES})")
+            sched[int(r)] = set(phases)
+        self.crash_at = sched
+        self.round = 0
+        self.crashes = 0
+        self._armed = True
+
+    @classmethod
+    def storm(cls, seed: int, rounds: int, *, crashes: int = 4,
+              phases=None, first_round: int = 2,
+              **fault_kw) -> "CrashInjector":
+        """Seeded random crash storm: ``crashes`` kills at distinct
+        live rounds in [first_round, rounds), each at a random phase.
+        Defaults to the phases that fire every round (begin /
+        post_admission / pre_journal / post_journal) so the scheduled
+        kill count is exact; pass ``phases`` to aim at conditional
+        ones (post_prefill, mid_spec_round)."""
+        rng = np.random.RandomState(seed)
+        phases = tuple(phases) if phases is not None else \
+            ("begin", "post_admission", "pre_journal", "post_journal")
+        if rounds - first_round < crashes:
+            raise ValueError("not enough rounds for the crash storm")
+        picks = rng.choice(np.arange(first_round, rounds),
+                           size=crashes, replace=False)
+        return cls(crash_at={int(r): phases[rng.randint(len(phases))]
+                             for r in picks},
+                   seed=seed, **fault_kw)
+
+    def begin_round(self) -> None:
+        self.round += 1
+
+    def arm(self, on: bool) -> None:
+        self._armed = bool(on)
+
+    def crash_point(self, phase: str) -> None:
+        if not self._armed:
+            return
+        sched = self.crash_at.get(self.round)
+        if sched and phase in sched:
+            sched.discard(phase)
+            self.crashes += 1
+            raise EngineCrash(f"injected crash at live round "
+                              f"{self.round}, phase {phase!r} "
+                              f"(engine step {self.step})")
+
+    def as_dict(self) -> dict:
+        d = super().as_dict()
+        d.update({"round": self.round, "crashes": self.crashes})
+        return d
+
+    def __repr__(self):
+        return (f"CrashInjector(seed={self.seed}, round={self.round}, "
+                f"crashes={self.crashes}, oom={self.injected_oom}, "
+                f"nan={self.injected_nan})")

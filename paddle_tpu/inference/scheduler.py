@@ -111,7 +111,7 @@ def chunked_prefill(model, cache: PagedKVCache, slot: int, rows,
                     *, pos: int = 0, target: Optional[int] = None,
                     chunk_tokens: int = 64, start_block: int = 0,
                     write_start: int = 0, stats: Optional[PrefillStats]
-                    = None):
+                    = None, on_chunk=None):
     """Stream ``rows[pos:target]`` ([T, d_model] ndarray) into
     ``slot``'s pages in causal chunks: each chunk is one batch-1 model
     call through ``cache.prefill_views`` — K/V append straight into
@@ -126,9 +126,12 @@ def chunked_prefill(model, cache: PagedKVCache, slot: int, rows,
     chunks attend over them but never rewrite (or COW-split) them.
     The caller must ``ensure`` page coverage only when running under
     its own OOM policy; this helper ensures per chunk and lets
-    BlockOOM propagate. Returns ``(new_pos, last_hidden)`` —
-    last_hidden is the final chunk's trailing row ([1, d_model]), or
-    None when no chunk ran."""
+    BlockOOM propagate. ``on_chunk(new_pos)`` fires after every chunk
+    lands — the engine uses it to register completed prefix blocks as
+    the prompt streams, so a preemption (or crash restore) mid-prefill
+    resumes warm instead of recomputing finished pages. Returns
+    ``(new_pos, last_hidden)`` — last_hidden is the final chunk's
+    trailing row ([1, d_model]), or None when no chunk ran."""
     import paddle_tpu as paddle
     T = rows.shape[0] if target is None else int(target)
     out = None
@@ -152,6 +155,8 @@ def chunked_prefill(model, cache: PagedKVCache, slot: int, rows,
             stats.prefill_tokens += c
             stats.peak_blocks = max(stats.peak_blocks,
                                     cache.blocks_in_use)
+        if on_chunk is not None:
+            on_chunk(pos)
     return pos, (out[:, -1] if out is not None else None)
 
 
@@ -374,6 +379,12 @@ class PagedServingEngine:
         return req.rid
 
     def _try_admit(self) -> None:
+        """One admission pass, then the ``post_admission`` crash
+        point (CrashInjector — a no-op without an injector)."""
+        self._admit_pass()
+        self._crash("post_admission")
+
+    def _admit_pass(self) -> None:
         """Admit from the queue head while a slot is free and the
         block budget covers the admission horizon plus the watermark:
         the whole prompt (plus the first decode token's page) in
@@ -471,6 +482,31 @@ class PagedServingEngine:
         self.lens[slot] = T
         self.active[slot] = True
         self.admitted.append((req.rid, slot, last_hidden))
+        self._crash("post_prefill")
+
+    def _chunk_registrar(self, slot: int, st: dict):
+        """``on_chunk`` hook for chunked_prefill: index every COMPLETED
+        prompt block under its chain hash as the stream advances (not
+        only at prefill completion), so a preemption or crash-restore
+        mid-prefill re-adopts its own finished pages on re-admission
+        instead of recomputing them — the pages park cached-free when
+        the victim's slot is dropped and resurrect via adopt_prefix.
+        Only full blocks below the write frontier are registered;
+        their content is final (later chunks write strictly past
+        them), so the immutability audit holds."""
+        if not self.prefix_cache:
+            return None
+        last = [0]      # blocks registered so far by THIS registrar —
+                        # keeps a C-chunk prefill at O(blocks), not
+                        # O(blocks x chunks) re-probes of the prefix
+
+        def register(pos: int) -> None:
+            done = pos // self.cache.block_size
+            if done > last[0]:
+                self.cache.register_prefix(slot, st["hashes"][:done],
+                                           start=last[0])
+                last[0] = done
+        return register
 
     def _prefill(self, req: PagedRequest) -> None:
         """Synchronous admission: stream every chunk now (block budget
@@ -484,7 +520,8 @@ class PagedServingEngine:
             chunk_tokens=self.chunk_tokens,
             start_block=st["n_cached"],
             write_start=st["n_cached"] * self.cache.block_size,
-            stats=self.prefill_stats)
+            stats=self.prefill_stats,
+            on_chunk=self._chunk_registrar(slot, st))
         self._complete_prefill(slot, h)
 
     def _advance_prefills(self) -> Tuple[bool, List[int]]:
@@ -529,7 +566,8 @@ class PagedServingEngine:
                 chunk_tokens=self.chunk_tokens,
                 start_block=st["n_cached"],
                 write_start=st["n_cached"] * self.cache.block_size,
-                stats=self.prefill_stats)
+                stats=self.prefill_stats,
+                on_chunk=self._chunk_registrar(slot, st))
             st["pos"] = pos
             budget -= c
             ran = True
@@ -883,6 +921,15 @@ class PagedServingEngine:
         self.lens[slot] = new_len
 
     # -- resilience ---------------------------------------------------
+    def _crash(self, phase: str) -> None:
+        """Consult the injector's crash schedule (CrashInjector): a
+        scheduled hit raises EngineCrash OUT of the engine, simulating
+        process death mid-step — recovery rebuilds from snapshot +
+        journal (inference/recovery.py). No-op for a plain
+        FaultInjector and zero overhead with no injector at all."""
+        if self.injector is not None:
+            self.injector.crash_point(phase)
+
     def _begin_step(self) -> bool:
         """Step-top bookkeeping shared by step()/step_multi():
         advance the step counter (the fault injector's clock) and
@@ -893,6 +940,7 @@ class PagedServingEngine:
         self._step_count += 1
         if self.injector is not None:
             self.injector.begin_step(self._step_count)
+            self.injector.crash_point("begin")
         idle = self.num_active == 0 and self.num_prefilling == 0 \
             and not self.queue
         self._check_deadlines()
@@ -978,3 +1026,171 @@ class PagedServingEngine:
         self.cache.check_invariants(lens=self.lens, active=self.active)
         self.resilience_stats.audits += 1
         return True
+
+    # -- checkpoint / restore -----------------------------------------
+    @staticmethod
+    def _stats_rec(st) -> dict:
+        return {name: getattr(st, name) for name in st.__slots__}
+
+    @staticmethod
+    def _stats_set(st, rec: dict) -> None:
+        for name, v in rec.items():
+            setattr(st, name, v)
+
+    def _req_rec(self, req: PagedRequest, now: float) -> dict:
+        """Picklable record of one request. Wall-clock deadlines are
+        stored as REMAINING seconds at snapshot time — the monotonic
+        clock does not survive a process, so restore re-bases them."""
+        return {
+            "rid": req.rid,
+            "history": np.array(req.history, np.float32, copy=True),
+            "hashes": list(req._hashes),
+            "slot": req.slot,
+            "admit_seq": req.admit_seq,
+            "preemptions": req.preemptions,
+            "max_preemptions": req.max_preemptions,
+            "deadline_steps": req.deadline_steps,
+            "deadline_remaining": (None if req.deadline_time is None
+                                   else req.deadline_time - now),
+            "submit_step": req.submit_step,
+        }
+
+    def snapshot(self) -> dict:
+        """Checkpoint EVERYTHING a restored engine needs to continue
+        bit-identically: the pool snapshot (PagedKVCache.snapshot),
+        every live request (queued, mid-prefill and running — history,
+        memoized chain hashes, retry/deadline budgets), queue order,
+        per-slot state (lens/active/prefilling + mid-chunk prefill
+        frontiers), the step clock and admission sequencer, all stats
+        siblings, and any undrained event lists. Buffered decode
+        inputs are flushed to histories first, so the snapshot is a
+        pure host-side read of a step-boundary state."""
+        self._flush_history()
+        now = time.monotonic()
+        reqs: Dict[int, PagedRequest] = {
+            r.rid: r for r in list(self.queue)
+            + [q for q in self._requests if q is not None]}
+        return {
+            "kind": "paged_engine",
+            "config": {
+                "max_batch": self.max_batch,
+                "block_size": self.cache.block_size,
+                "num_blocks": self.cache.num_blocks,
+                "max_blocks_per_seq": self.cache.max_blocks_per_seq,
+                "dtype": self.dtype,
+                "watermark_blocks": self.watermark_blocks,
+                "prefix_cache": self.prefix_cache,
+                "chunk_tokens": self.chunk_tokens,
+                "prefill_token_budget": self.prefill_token_budget,
+                "max_preemptions": self.max_preemptions,
+                "numeric_guard": self.numeric_guard,
+            },
+            "cache": self.cache.snapshot(),
+            "requests": [self._req_rec(r, now) for r in reqs.values()],
+            "queue": [r.rid for r in self.queue],
+            "slot_rids": [None if r is None else r.rid
+                          for r in self._requests],
+            "lens": self.lens.copy(),
+            "active": self.active.copy(),
+            "prefilling": self.prefilling.copy(),
+            "prefills": {int(s): {"pos": st["pos"], "start": st["start"],
+                                  "n_cached": st["n_cached"],
+                                  "hashes": list(st["hashes"])}
+                         for s, st in self._prefills.items()},
+            "counters": {"next_rid": self._next_rid,
+                         "next_admit_seq": self._next_admit_seq,
+                         "step_count": self._step_count,
+                         "has_deadlines": self._has_deadlines},
+            "stats": {"prefix": self._stats_rec(self.prefix_stats),
+                      "prefill": self._stats_rec(self.prefill_stats),
+                      "resilience":
+                          self._stats_rec(self.resilience_stats)},
+            "events": {
+                "admitted": [(rid, slot,
+                              None if h is None
+                              else np.asarray(h.numpy()))
+                             for rid, slot, h in self.admitted],
+                "finished": list(self.finished),
+                "preempted": list(self.preempted),
+            },
+            "outcomes": [oc.as_dict() for oc in self.outcomes],
+        }
+
+    @classmethod
+    def restore(cls, model, snap: dict, *, injector=None,
+                num_blocks: Optional[int] = None) -> "PagedServingEngine":
+        """Rebuild an engine from a ``snapshot`` around the caller's
+        model (weights are the caller's problem — a snapshot holds
+        serving state, not parameters). ``num_blocks`` rehomes the
+        pool into a different-size target (PagedKVCache.restore).
+        The injector is wired fresh (fault schedules stay keyed by
+        the RESTORED step clock, so a replayed step re-injects the
+        same faults — required for deterministic replay). Ends with a
+        full engine + deep pool audit."""
+        cfg = snap["config"]
+        nb = cfg["num_blocks"] if num_blocks is None else int(num_blocks)
+        # the constructor's cache is discarded two lines down for the
+        # restored one — build it with a 2-block placeholder pool so
+        # recovery never holds two full pools at once (a production
+        # pool is sized near device memory; 2x there would OOM the
+        # recovery path itself). Geometry that outlives the swap
+        # (max_len) comes from max_blocks_per_seq, which is passed
+        # resolved, and is re-derived from the restored cache below.
+        eng = cls(model, cfg["max_batch"], cfg["block_size"], 2,
+                  max_blocks_per_seq=cfg["max_blocks_per_seq"],
+                  dtype=cfg["dtype"],
+                  watermark_blocks=cfg["watermark_blocks"],
+                  prefix_cache=cfg["prefix_cache"],
+                  chunk_tokens=cfg["chunk_tokens"],
+                  prefill_token_budget=cfg["prefill_token_budget"],
+                  injector=injector,
+                  max_preemptions=cfg["max_preemptions"],
+                  numeric_guard=cfg["numeric_guard"])
+        # nb may differ from the cache snapshot's geometry (a resized
+        # engine config, or the explicit override): the pool restore
+        # rehomes content-addressed blocks either way
+        eng.cache = PagedKVCache.restore(snap["cache"], num_blocks=nb)
+        if injector is not None:
+            eng.cache.allocator.fault_hook = \
+                lambda n: injector.on_alloc("target", n)
+        eng.max_len = eng.cache.capacity_per_seq
+        now = time.monotonic()
+        reqs: Dict[int, PagedRequest] = {}
+        for rec in snap["requests"]:
+            req = PagedRequest(rec["rid"], rec["history"])
+            req._hashes = list(rec["hashes"])
+            req.slot = rec["slot"]
+            req.admit_seq = rec["admit_seq"]
+            req.preemptions = rec["preemptions"]
+            req.max_preemptions = rec["max_preemptions"]
+            req.deadline_steps = rec["deadline_steps"]
+            if rec["deadline_remaining"] is not None:
+                req.deadline_time = now + rec["deadline_remaining"]
+            req.submit_step = rec["submit_step"]
+            reqs[req.rid] = req
+        eng._requests = [None if rid is None else reqs[rid]
+                         for rid in snap["slot_rids"]]
+        eng.queue = deque(reqs[rid] for rid in snap["queue"])
+        eng.lens = np.array(snap["lens"], np.int32)
+        eng.active = np.array(snap["active"], bool)
+        eng.prefilling = np.array(snap["prefilling"], bool)
+        eng._prefills = {int(s): dict(st)
+                         for s, st in snap["prefills"].items()}
+        c = snap["counters"]
+        eng._next_rid = c["next_rid"]
+        eng._next_admit_seq = c["next_admit_seq"]
+        eng._step_count = c["step_count"]
+        eng._has_deadlines = c["has_deadlines"]
+        cls._stats_set(eng.prefix_stats, snap["stats"]["prefix"])
+        cls._stats_set(eng.prefill_stats, snap["stats"]["prefill"])
+        cls._stats_set(eng.resilience_stats,
+                       snap["stats"]["resilience"])
+        ev = snap["events"]
+        eng.admitted = [(rid, slot,
+                         None if h is None else Tensor(h))
+                        for rid, slot, h in ev["admitted"]]
+        eng.finished = list(ev["finished"])
+        eng.preempted = list(ev["preempted"])
+        eng.outcomes = [RequestOutcome(**oc) for oc in snap["outcomes"]]
+        eng.check_invariants()
+        return eng

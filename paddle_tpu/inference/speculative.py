@@ -608,7 +608,15 @@ class SpeculativeEngine:
                     self.stats.draft_oom_rolls += 1
 
         # 2. verify: ONE target call scores the pending token plus all
-        #    k_eff proposals through the paged cache
+        #    k_eff proposals through the paged cache. The
+        #    mid_spec_round crash point sits between the draft roll
+        #    and the verify — the nastiest place to die: the draft
+        #    pool has advanced but the target has verified nothing
+        #    (recovery rebuilds the draft from the token streams, so
+        #    nothing of the half-round survives into the restored
+        #    engine).
+        if self.injector is not None:
+            self.injector.crash_point("mid_spec_round")
         d_t = self.target.d_model
         x = np.zeros((B, L, d_t), np.float32)
         pre_lens = {s: int(eng.lens[s]) for s in slots}
@@ -707,3 +715,121 @@ class SpeculativeEngine:
                                     side="right"))
             return i, min(c, len(p_i) - 1)
         return len(d), -1
+
+    # -- checkpoint / restore -----------------------------------------
+    def snapshot(self) -> dict:
+        """Checkpoint the speculative layer: the wrapped engine's full
+        snapshot (which includes the TARGET pool), every host-side
+        token stream (_SpecSeq: prompt + emitted + pending token), the
+        sampler RNG state (stochastic modes must draw the same
+        sequence after a restore), stats, undrained events, and the
+        dirty-slot set. The DRAFT pool is deliberately NOT serialized:
+        it is a pure function of the token streams and restore
+        rebuilds it through the same chunked-prefill path a
+        preemption uses — half a snapshot's bytes for free."""
+        return {
+            "kind": "speculative_engine",
+            "config": {"k": self.k, "sampling": self.sampling,
+                       "temperature": self.temperature,
+                       "top_k": self.top_k,
+                       "draft_num_blocks":
+                           (None if self.draft_cache is None
+                            else self.draft_cache.num_blocks),
+                       "self_draft": self.draft is self.target},
+            "engine": self.engine.snapshot(),
+            "seqs": [{"rid": s.rid, "toks": list(s.toks),
+                      "prompt_len": s.prompt_len, "slot": s.slot,
+                      "started": s.started}
+                     for s in self._by_rid.values()],
+            "rng": self._rng.get_state(),
+            "stats": PagedServingEngine._stats_rec(self.stats),
+            "finished": list(self.finished),
+            "outcomes": [oc.as_dict() for oc in self.outcomes],
+            "draft_dirty": sorted(self._draft_dirty),
+        }
+
+    @classmethod
+    def restore(cls, target: TokenServingModel,
+                draft: Optional[TokenServingModel], snap: dict, *,
+                injector=None) -> "SpeculativeEngine":
+        """Rebuild a speculative engine from ``snapshot`` around the
+        caller's models. The target engine restores exactly
+        (PagedServingEngine.restore); the draft pool is REBUILT from
+        the token streams slot by slot — chunked prefill of each
+        stream minus its pending token, the same deterministic-replay
+        path a preemption takes, so the rebuilt pages are bit-exact
+        with the crashed pool's. A slot whose rebuild OOMs goes
+        dirty and serves unspeculated until the pool clears (PR 5's
+        machinery); fault hooks stay unwired during the rebuild so a
+        stale injector schedule cannot fire outside a serving step."""
+        cfg = snap["config"]
+        ecfg = snap["engine"]["config"]
+        if cfg["k"] > 0 and cfg.get("self_draft") is not None \
+                and cfg["self_draft"] != (draft is None):
+            # a wrong draft would not fail loudly: greedy streams stay
+            # identical (silently different perf), sampling modes die
+            # mid-replay with an opaque RecoveryError — name the
+            # mismatch here instead
+            raise ValueError(
+                "draft-model mismatch: snapshot was taken with a "
+                + ("self-drafted (draft=None)"
+                   if cfg["self_draft"] else "separate draft")
+                + " engine but restore() was given "
+                + ("draft=None" if draft is None
+                   else "a separate draft model"))
+        # num_blocks=2: the constructor's TARGET engine (and its pool)
+        # is replaced by the restored one just below — a placeholder
+        # pool keeps recovery's peak at ONE target pool, not three
+        # (constructor's + restore's + the one being discarded). The
+        # DRAFT pool built here is real and kept.
+        spec = cls(target, draft, k=cfg["k"],
+                   max_batch=ecfg["max_batch"],
+                   block_size=ecfg["block_size"],
+                   num_blocks=2,
+                   max_blocks_per_seq=ecfg["max_blocks_per_seq"],
+                   draft_num_blocks=cfg["draft_num_blocks"],
+                   prefix_cache=ecfg["prefix_cache"],
+                   sampling=cfg["sampling"],
+                   temperature=cfg["temperature"], top_k=cfg["top_k"],
+                   watermark_blocks=ecfg["watermark_blocks"],
+                   chunk_tokens=ecfg["chunk_tokens"],
+                   injector=injector,
+                   max_preemptions=ecfg["max_preemptions"],
+                   numeric_guard=ecfg["numeric_guard"])
+        spec.engine = PagedServingEngine.restore(
+            target.core, snap["engine"], injector=injector)
+        for rec in snap["seqs"]:
+            seq = _SpecSeq(rec["rid"], rec["toks"])
+            seq.prompt_len = rec["prompt_len"]
+            seq.slot = rec["slot"]
+            seq.started = rec["started"]
+            spec._by_rid[seq.rid] = seq
+            if seq.slot is not None:
+                spec._seqs[seq.slot] = seq
+        spec._rng.set_state(snap["rng"])
+        PagedServingEngine._stats_set(spec.stats, snap["stats"])
+        spec.finished = list(snap["finished"])
+        spec.outcomes = [RequestOutcome(**oc)
+                         for oc in snap["outcomes"]]
+        # slots dirty at snapshot time STAY dirty (they held no draft
+        # pages then, and a restored run must schedule identically to
+        # the uninterrupted one — rebuilding them here would let a
+        # replayed round speculate where the live round did not)
+        dirty = {int(s) for s in snap["draft_dirty"]}
+        if spec.draft_cache is not None:
+            hook = spec.draft_cache.allocator.fault_hook
+            spec.draft_cache.allocator.fault_hook = None
+            try:
+                for slot, seq in spec._seqs.items():
+                    if slot in dirty:
+                        continue
+                    try:
+                        spec._draft_prefill(slot, seq)
+                    except BlockOOM:
+                        spec._clear_draft_slot(slot)
+                        spec._draft_dirty.add(slot)
+            finally:
+                spec.draft_cache.allocator.fault_hook = hook
+        spec._draft_dirty.update(s for s in dirty if s in spec._seqs)
+        spec.check_invariants()
+        return spec

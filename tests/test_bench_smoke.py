@@ -67,6 +67,30 @@ def test_serving_faults_smoke_leg():
     assert res["baseline"]["completed"] == res["requests"]
 
 
+def test_serving_recovery_smoke_leg():
+    res = bench_extra.bench_serving_recovery(smoke=True)
+    assert res["metric"] == "serving_crash_recovery"
+    snap = res["with_snapshots"]
+    # the journaled run really checkpointed (the fresh-start snapshot
+    # plus at least two periodic ones) and journaled every round
+    assert snap["snapshots"] >= 3
+    assert snap["snapshot_bytes"] > 0
+    assert snap["journal_records"] > res["requests"]
+    # the injected kill fired, recovery replayed real work, and the
+    # headline guarantee rode the bench: streams bit-identical
+    rec = res["recovery"]
+    assert rec["crashes"] == 1
+    assert rec["replayed_tokens"] > 0
+    assert rec["completed"] == res["requests"]
+    assert res["streams_bit_identical_after_recovery"] is True
+    # throughput sanity; the <= 10% overhead acceptance is asserted at
+    # bench scale (BENCH_EXTRA_r10.json) — smoke shapes are
+    # jitter-dominated, so only a loose bound rides the tier-1 suite
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert snap["tokens_per_sec"] > 0
+    assert res["snapshot_overhead_pct"] < 50
+
+
 def test_serving_spec_smoke_leg():
     res = bench_extra.bench_serving_spec(smoke=True)
     assert res["metric"] == "serving_speculative_vs_plain_token_decode"

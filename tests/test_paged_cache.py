@@ -910,3 +910,168 @@ class TestSharedPrefixCOW:
             np.testing.assert_array_equal(
                 np.asarray(out.numpy())[0], np.asarray(od.numpy())[0])
             x = np.asarray(out.numpy())[:, :1].copy()
+
+
+class TestSnapshotRestore:
+    """PagedKVCache.snapshot()/restore() round-trip property tests for
+    the allocator edge states PR 6's crash recovery must preserve:
+    exact free-list and cached-free LRU orders (the restored pool must
+    ALLOCATE bit-identically to the uninterrupted one), fork-shared
+    refcounts, the trash block's reserved state, and the quarantine
+    guarantee (suspect pages never ride a snapshot)."""
+
+    def _loaded_cache(self):
+        """A pool exercising every block state at once: slot 0 active
+        with registered prefix pages, slot 1 fork-sharing slot 0's
+        prefix, a retired slot's pages parked cached-free (known LRU
+        order), and a few true-free blocks."""
+        from paddle_tpu.inference import chain_block_hashes
+        cache = PagedKVCache(LAYERS, HEADS, D // HEADS, block_size=4,
+                             num_blocks=16, max_seqs=3,
+                             max_blocks_per_seq=6, prefix_cache=True)
+        rng = np.random.RandomState(7)
+
+        def fill(slot, toks):
+            cache.ensure(slot, toks.shape[0], write_from=0)
+            for layer in range(LAYERS):
+                k = paddle.to_tensor(rng.randn(
+                    1, toks.shape[0], HEADS, D // HEADS)
+                    .astype(np.float32))
+                v = paddle.to_tensor(rng.randn(
+                    1, toks.shape[0], HEADS, D // HEADS)
+                    .astype(np.float32))
+                cache.write_prefill_chunk(slot, layer, k, v, 0)
+
+        t0 = rng.randn(10, D).astype(np.float32)     # 2 full blocks
+        fill(0, t0)
+        cache.register_prefix(0, chain_block_hashes(t0, 4))
+        cache.fork(0, 1, 8)                          # share 2 blocks
+        t2 = rng.randn(12, D).astype(np.float32)     # 3 full blocks
+        fill(2, t2)
+        cache.register_prefix(2, chain_block_hashes(t2, 4))
+        cache.free_seq(2)                            # -> cached-free x3
+        assert cache.allocator.num_cached == 3
+        assert cache.check_invariants()
+        return cache
+
+    @staticmethod
+    def _assert_state_equal(a, b):
+        assert b.seq_blocks == a.seq_blocks
+        np.testing.assert_array_equal(b.block_tables, a.block_tables)
+        np.testing.assert_array_equal(b.allocator.refcount,
+                                      a.allocator.refcount)
+        assert list(b.allocator._free) == list(a.allocator._free)
+        assert list(b.allocator._cached) == list(a.allocator._cached)
+        assert b._hash_to_block == a._hash_to_block
+        assert b._block_hash == a._block_hash
+
+    def test_round_trip_preserves_every_allocator_edge_state(self):
+        cache = self._loaded_cache()
+        out = PagedKVCache.restore(cache.snapshot())
+        self._assert_state_equal(cache, out)
+        # content round-trips bitwise for every live + cached block
+        live = [b for b in range(1, cache.num_blocks)
+                if cache.allocator.refcount[b] > 0
+                or b in cache.allocator._cached]
+        for i in range(LAYERS):
+            src = np.asarray(cache.pools[i].numpy())
+            dst = np.asarray(out.pools[i].numpy())
+            np.testing.assert_array_equal(src[live], dst[live])
+        assert out.check_invariants()
+
+    def test_restored_pool_allocates_bit_identically(self):
+        """The recovery contract on the allocator: after restore, the
+        SAME alloc sequence hands out the SAME block ids — free-list
+        order first, then cached-free LRU reclaim order, with the
+        reclaimed blocks' index entries dropped in both pools."""
+        cache = self._loaded_cache()
+        out = PagedKVCache.restore(cache.snapshot())
+        n = cache.allocator.num_free            # drain BOTH tiers
+        got_a = [cache.allocator.alloc(1)[0] for _ in range(n)]
+        got_b = [out.allocator.alloc(1)[0] for _ in range(n)]
+        assert got_a == got_b
+        assert cache._hash_to_block == out._hash_to_block
+        with pytest.raises(BlockOOM):
+            out.allocator.alloc(1)
+
+    def test_quarantined_blocks_never_ride_a_snapshot(self):
+        """quarantine_seq frees suspect pages to the TRUE free list
+        before any snapshot can see them: the snapshot payload must
+        not contain them and the restored pool must not index them."""
+        cache = self._loaded_cache()
+        suspect = list(cache.seq_blocks[0])
+        solely_owned = [b for b in suspect
+                        if cache.allocator.refcount[b] == 1]
+        cache.quarantine_seq(0)
+        snap = cache.snapshot()
+        for b in solely_owned:
+            assert b not in snap["blocks"]
+            assert b not in snap["refcount"]
+        out = PagedKVCache.restore(snap)
+        for b in solely_owned:
+            assert out.allocator.refcount[b] == 0
+            assert b not in out._block_hash
+            assert b not in out.allocator._cached
+        assert out.check_invariants()
+
+    def test_trash_block_and_fork_shared_refcounts(self):
+        cache = self._loaded_cache()
+        snap = cache.snapshot()
+        assert 0 not in snap["blocks"]          # trash never serialized
+        out = PagedKVCache.restore(snap)
+        assert out.allocator.refcount[0] == 1
+        assert 0 not in out.allocator._free
+        # the fork share survived: slot 0/1's common prefix blocks at
+        # refcount 2, and a post-restore write still COW-splits
+        shared = out.seq_blocks[0][0]
+        assert out.seq_blocks[1][0] == shared
+        assert out.allocator.refcount[shared] == 2
+        before = np.asarray(out.pools[0].numpy())[shared].copy()
+        out.ensure(1, 2, write_from=0)          # write range hits block 0
+        assert out.seq_blocks[1][0] != shared   # split, peer untouched
+        np.testing.assert_array_equal(
+            np.asarray(out.pools[0].numpy())[shared], before)
+        assert out.check_invariants()
+
+    def test_rehome_into_larger_pool(self):
+        """Restore into a bigger num_blocks: content-addressed blocks
+        take fresh ids, tables/refcounts/index remap with them, and
+        the pool serves prefix hits as before."""
+        cache = self._loaded_cache()
+        out = PagedKVCache.restore(cache.snapshot(), num_blocks=32)
+        assert out.num_blocks == 32
+        assert out.check_invariants()
+        assert len(out._hash_to_block) == len(cache._hash_to_block)
+        # same chain hashes still hit (ids remapped, content intact)
+        for h, old_b in cache._hash_to_block.items():
+            new_b = out._hash_to_block[h]
+            for i in range(LAYERS):
+                np.testing.assert_array_equal(
+                    np.asarray(cache.pools[i].numpy())[old_b],
+                    np.asarray(out.pools[i].numpy())[new_b])
+        assert out.allocator.num_free > cache.allocator.num_free
+
+    def test_rehome_into_smaller_pool_drops_lru_cached_first(self):
+        cache = self._loaded_cache()
+        # live set = 5 blocks (slot 0's 3 + slot 1's COW tail... it is
+        # whatever refcount>0 says), cached-free = 3; shrink so only
+        # ONE cached block fits: the two LEAST recently released drop
+        live = int((cache.allocator.refcount[1:] > 0).sum())
+        out = PagedKVCache.restore(cache.snapshot(),
+                                   num_blocks=live + 1 + 1)
+        assert out.allocator.num_cached == 1
+        kept = list(out.allocator._cached)[0]
+        # the survivor is the NEWEST cached-free block's content
+        newest_old = list(cache.allocator._cached)[-1]
+        h = cache._block_hash[newest_old]
+        assert out._hash_to_block[h] == kept
+        assert out.check_invariants()
+
+    def test_rehome_live_overflow_raises_precise_oom(self):
+        cache = self._loaded_cache()
+        live = int((cache.allocator.refcount[1:] > 0).sum())
+        with pytest.raises(BlockOOM) as ei:
+            PagedKVCache.restore(cache.snapshot(), num_blocks=live)
+        msg = str(ei.value)
+        assert f"restore needs {live} live block(s)" in msg
+        assert "cached-free" in msg and "blocks per slot" in msg

@@ -447,3 +447,92 @@ class TestReclaimUnderPressure:
         # and the re-prefilled engine keeps decoding without error
         o = eng.step(paddle.to_tensor(x))
         assert o is not None
+
+
+class TestWarmResumeMidPrefill:
+    """Satellite (PR 6): prefix blocks are registered AS CHUNKS
+    COMPLETE (scheduler._chunk_registrar riding chunked_prefill's
+    on_chunk hook), not only when the whole prompt lands — so a long
+    prefill preempted mid-stream re-adopts its own finished pages on
+    re-admission instead of recomputing them."""
+
+    def test_preempted_mid_prefill_resumes_warm(self):
+        model = _model()
+        rng = np.random.RandomState(21)
+        prompt = rng.randn(3 * BS + 6, D).astype(np.float32)  # 54 rows
+
+        eng = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                 num_blocks=10, max_blocks_per_seq=MB,
+                                 prefix_cache=True, chunk_tokens=BS,
+                                 prefill_token_budget=BS)
+        eng.submit(paddle.to_tensor(prompt))
+        x = paddle.to_tensor(np.zeros((1, 1, D), np.float32))
+        # two budgeted steps stream two chunks = 2 full pages
+        eng.step(x)
+        eng.step(x)
+        assert eng.prefilling[0] and not eng.admitted
+        pos = eng._prefills[0]["pos"]
+        assert pos >= 2 * BS
+        # the completed pages are ALREADY indexed mid-prefill
+        assert len(eng.cache._hash_to_block) == pos // BS
+
+        eng.preempt(0)
+        eng.preempted.clear()
+        # victim's finished pages parked cached-free, resurrectable
+        assert eng.cache.allocator.num_cached == pos // BS
+
+        skipped_before = eng.prefix_stats.tokens_skipped
+        for _ in range(8):
+            eng.step(x)
+            if eng.admitted:
+                break
+        (rid, slot, h), = eng.admitted
+        eng.admitted.clear()
+        st = eng.prefix_stats
+        assert st.tokens_skipped - skipped_before >= 2 * BS, \
+            "re-prefill recomputed pages that were already registered"
+        assert st.hit_blocks >= 2
+
+        # and the warm resume is bit-transparent: the admission hidden
+        # equals a cold engine's (no preemption, no budget)
+        cold = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                  num_blocks=10, max_blocks_per_seq=MB)
+        _, hc = _admit(cold, prompt)
+        np.testing.assert_array_equal(np.asarray(h.numpy()),
+                                      np.asarray(hc.numpy()))
+
+    def test_sync_admission_oom_retry_resumes_warm(self):
+        """The same machinery through SYNCHRONOUS admission: an
+        injected OOM mid-admission-prefill un-admits the request, but
+        the chunks that landed before the fault stay registered — the
+        retry adopts them instead of starting cold."""
+        from paddle_tpu.inference import BlockOOM
+        model = _model()
+        rng = np.random.RandomState(22)
+        prompt = rng.randn(3 * BS + 4, D).astype(np.float32)
+        eng = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                 num_blocks=10, max_blocks_per_seq=MB,
+                                 prefix_cache=True, chunk_tokens=BS)
+        # let two chunks land, then fail the third page's allocation
+        # (the alloc hook is the same entry a FaultInjector drives)
+        calls = {"n": 0}
+
+        def hook(n):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise BlockOOM("forced admission OOM")
+        eng.cache.allocator.fault_hook = hook
+        eng.submit(paddle.to_tensor(prompt))
+        assert eng.preempted == [0] and not eng.admitted
+        assert eng.cache.allocator.num_cached == 2   # landed chunks
+        eng.cache.allocator.fault_hook = None
+
+        eng._try_admit()
+        (rid, slot, h), = eng.admitted
+        eng.admitted.clear()
+        assert eng.prefix_stats.tokens_skipped >= 2 * BS
+        cold = PagedServingEngine(model, max_batch=1, block_size=BS,
+                                  num_blocks=10, max_blocks_per_seq=MB)
+        _, hc = _admit(cold, prompt)
+        np.testing.assert_array_equal(np.asarray(h.numpy()),
+                                      np.asarray(hc.numpy()))

@@ -1,0 +1,153 @@
+"""Offline crash-recovery doctor: load a serving snapshot (+ optional
+journal), rebuild the block pool, run the deep invariant audit, and
+print pool occupancy + request/journal summaries — without needing the
+model weights (a snapshot holds serving state, not parameters).
+
+Usage:
+  python tools/recovery_check.py SNAPSHOT [--journal REQ.WAL]
+                                 [--num-blocks N]
+
+Accepts any snapshot the stack writes: a ``RecoverableServer``
+checkpoint, a bare ``SpeculativeEngine``/``PagedServingEngine``
+snapshot, or a raw ``PagedKVCache`` one — it walks the nesting down to
+the pool either way. ``--num-blocks`` dry-runs the
+restore-into-a-different-pool path (rehoming succeeds or prints the
+precise BlockOOM a real recovery would raise). Exit status: 0 clean,
+1 audit/restore failure, 2 unreadable snapshot.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _unwrap(snap: dict):
+    """(cache_snap, engine_snap or None, spec_snap or None) from any
+    nesting level the stack persists."""
+    kind = snap.get("kind")
+    if kind == "recoverable_server":
+        spec = snap["engine"]
+        return spec["engine"]["cache"], spec["engine"], spec
+    if kind == "speculative_engine":
+        return snap["engine"]["cache"], snap["engine"], snap
+    if kind == "paged_engine":
+        return snap["cache"], snap, None
+    if kind == "paged_kv_cache":
+        return snap, None, None
+    raise ValueError(f"not a serving snapshot (kind={kind!r})")
+
+
+def _engine_summary(eng_snap: dict) -> str:
+    import numpy as np
+    active = np.asarray(eng_snap["active"])
+    prefilling = np.asarray(eng_snap["prefilling"])
+    lens = np.asarray(eng_snap["lens"])
+    lines = [
+        f"  engine step {eng_snap['counters']['step_count']}, "
+        f"next rid {eng_snap['counters']['next_rid']}",
+        f"  slots: {int(active.sum())} active / "
+        f"{int(prefilling.sum())} mid-prefill / "
+        f"{len(active) - int(active.sum()) - int(prefilling.sum())} "
+        f"free of {len(active)}",
+        f"  queued rids: {eng_snap['queue']}",
+    ]
+    for rec in eng_snap["requests"]:
+        slot = rec["slot"]
+        state = ("queued" if slot is None else
+                 f"slot {slot} " +
+                 ("prefilling" if prefilling[slot] else
+                  f"len {int(lens[slot])}"))
+        knobs = []
+        if rec["max_preemptions"] is not None:
+            knobs.append(f"retries {rec['preemptions']}/"
+                         f"{rec['max_preemptions']}")
+        if rec["deadline_steps"] is not None:
+            knobs.append(f"deadline {rec['deadline_steps']} steps")
+        lines.append(f"    rid {rec['rid']}: {state}, history "
+                     f"{rec['history'].shape[0]} rows"
+                     + (f" ({', '.join(knobs)})" if knobs else ""))
+    out = eng_snap.get("outcomes", [])
+    if out:
+        lines.append(f"  undrained outcomes: "
+                     f"{[(o['rid'], o['status']) for o in out]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="audit a serving snapshot (+ journal) offline")
+    ap.add_argument("snapshot")
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="dry-run rehoming the pool into this size")
+    args = ap.parse_args(argv)
+
+    if sys.flags.optimize:
+        # the deep audit is assert-based; under -O / PYTHONOPTIMIZE
+        # the asserts are stripped and a corrupt pool would print
+        # "deep audit: OK" — refuse rather than lie
+        print("UNUSABLE: running with assertions disabled (-O / "
+              "PYTHONOPTIMIZE) strips the deep audit — rerun without "
+              "optimization")
+        return 2
+
+    from paddle_tpu.inference.recovery import (SnapshotVersionError,
+                                               load_snapshot,
+                                               read_journal)
+    try:
+        snap = load_snapshot(args.snapshot)
+        cache_snap, eng_snap, spec_snap = _unwrap(snap)
+    except (SnapshotVersionError, ValueError, OSError) as e:
+        print(f"UNREADABLE: {e}")
+        return 2
+
+    from paddle_tpu.inference.paged_cache import BlockOOM, PagedKVCache
+    g = cache_snap["geometry"]
+    print(f"snapshot {args.snapshot}: kind={snap.get('kind')}, pool "
+          f"{g['num_blocks']} x {g['block_size']}-token blocks, "
+          f"{g['num_layers']} layers, prefix_cache={g['prefix_cache']}")
+    try:
+        cache = PagedKVCache.restore(cache_snap,
+                                     num_blocks=args.num_blocks)
+        print("deep audit: OK (check_invariants(deep=True) passed on "
+              "restore)")
+    except BlockOOM as e:
+        print(f"REHOME FAILED: {e}")
+        return 1
+    except AssertionError as e:
+        print(f"AUDIT FAILED: {e}")
+        return 1
+    print(f"pool occupancy{cache._pool_context()}")
+    print(f"  hash index: {len(cache._hash_to_block)} chained block "
+          f"hash(es)")
+
+    if eng_snap is not None:
+        print(_engine_summary(eng_snap))
+    if spec_snap is not None:
+        st = spec_snap["stats"]
+        print(f"  speculative: k={spec_snap['config']['k']}, "
+              f"{len(spec_snap['seqs'])} tracked stream(s), "
+              f"emitted {st['emitted']}, dirty draft slots "
+              f"{spec_snap['draft_dirty']}")
+
+    if args.journal:
+        recs = read_journal(args.journal)
+        kinds = {}
+        for _, kind, _p in recs:
+            kinds[kind] = kinds.get(kind, 0) + 1
+        covered = snap.get("journal_seq")
+        print(f"journal {args.journal}: {len(recs)} record(s) "
+              f"{kinds or '{}'}, last seq "
+              f"{recs[-1][0] if recs else 0}"
+              + (f", snapshot covers seq <= {covered} "
+                 f"({sum(1 for s, _, _ in recs if s > covered)} to "
+                 f"replay)" if covered is not None else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
