@@ -33,15 +33,16 @@ from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       PrefillStats, PrefixCacheStats, ResilienceStats,
-                      SpecDecodeStats)
+                      SpecDecodeStats, TenantStats)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           PagedPrefillView,
                           chain_block_hashes, chain_hash)
 from .resilience import (CrashInjector, EngineCrash,  # noqa: F401
                          FaultInjector, RequestOutcome)
-from .scheduler import (MIN_PREFILL_SUFFIX_ROWS,  # noqa: F401
-                        PagedRequest, PagedServingEngine,
+from .scheduler import (DEFAULT_TENANT,  # noqa: F401
+                        MIN_PREFILL_SUFFIX_ROWS,
+                        PagedRequest, PagedServingEngine, Tenant,
                         chunked_prefill)
 from .speculative import (SpeculativeEngine,  # noqa: F401
                           TokenServingModel)
@@ -59,7 +60,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "RecoverableServer", "RecoveryError", "RequestJournal",
            "RequestOutcome", "ResilienceStats", "SNAPSHOT_VERSION",
            "SnapshotVersionError",
-           "SpecDecodeStats", "SpeculativeEngine", "TokenServingModel",
+           "SpecDecodeStats", "SpeculativeEngine", "Tenant",
+           "TenantStats", "TokenServingModel", "DEFAULT_TENANT",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
            "chain_block_hashes", "chain_hash", "load_snapshot",
            "read_journal", "save_snapshot"]

@@ -559,6 +559,21 @@ class PagedKVCache:
         # append at lens==0 through them would corrupt position 0)
         self._decode_masked: Optional[np.ndarray] = None
         self.peak_blocks_used = 0
+        # multi-tenant attribution (scheduler.py): which tenant each
+        # slot is serving, and the per-tenant block CHARGE. The charge
+        # policy is ONE CHARGE PER TABLE REFERENCE — a block shared by
+        # k slots charges each sharer's tenant 1 (not 1/k, not
+        # owner-only), so a tenant's charge is a pure function of ITS
+        # OWN slots' tables: no neighbor's adopt/release/preempt can
+        # ever move it (fractional charging would raise your charge
+        # when a sharer releases; owner-pays would transfer a block
+        # onto you when the owner leaves — both are cross-tenant
+        # interference channels). Ground truth audited by
+        # check_invariants: charge[t] == sum of len(seq_blocks[s])
+        # over slots with seq_tenant[s] == t, and the total equals the
+        # allocator's total refcount over usable blocks.
+        self.seq_tenant: List[Optional[str]] = [None] * self.max_seqs
+        self._tenant_charge: Dict[Optional[str], int] = {}
 
     # -- construction -------------------------------------------------
     @classmethod
@@ -588,6 +603,43 @@ class PagedKVCache:
         return sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
                    for p in self.pools)
 
+    # -- tenant accounting --------------------------------------------
+    def _charge(self, slot: int, delta: int) -> None:
+        """Move ``slot``'s tenant's block charge by ``delta`` table
+        references. Called by every table mutation (alloc growth,
+        prefix adoption, fork, truncate, free, quarantine); a COW swap
+        is charge-neutral (one reference out, one in)."""
+        if delta == 0:
+            return
+        t = self.seq_tenant[slot]
+        self._tenant_charge[t] = self._tenant_charge.get(t, 0) + delta
+
+    def set_seq_tenant(self, slot: int, tenant: Optional[str]) -> None:
+        """Attribute ``slot`` to ``tenant`` (None = unattributed). Any
+        blocks the slot already holds move their charge with it."""
+        old = self.seq_tenant[slot]
+        if old == tenant:
+            return
+        held = len(self.seq_blocks[slot])
+        if held:
+            self._tenant_charge[old] = \
+                self._tenant_charge.get(old, 0) - held
+        self.seq_tenant[slot] = tenant
+        if held:
+            self._tenant_charge[tenant] = \
+                self._tenant_charge.get(tenant, 0) + held
+
+    def tenant_charge(self, tenant: Optional[str]) -> int:
+        """Blocks currently charged to ``tenant`` (one per table
+        reference its slots hold — see the policy note in __init__)."""
+        return self._tenant_charge.get(tenant, 0)
+
+    def tenant_blocks_held(self) -> Dict[Optional[str], int]:
+        """{tenant: charged blocks}, nonzero entries only — the
+        per-tenant occupancy histogram OOM messages and the offline
+        doctor print."""
+        return {t: n for t, n in self._tenant_charge.items() if n}
+
     # -- diagnostics ---------------------------------------------------
     def owners_of(self, block: int) -> List[int]:
         """Slots whose table holds ``block`` (error/audit paths only —
@@ -597,14 +649,21 @@ class PagedKVCache:
 
     def _pool_context(self) -> str:
         """Occupancy breakdown appended to BlockOOM messages so an OOM
-        report is actionable: tier counts + owning-slot histogram."""
+        report is actionable: tier counts + owning-slot histogram +
+        (multi-tenant serving) the per-tenant blocks-held histogram,
+        so the message names WHICH TENANT holds the pool."""
         a = self.allocator
         active = self.num_blocks - 1 - a.num_free
         hist = {s: len(bl) for s, bl in enumerate(self.seq_blocks)
                 if bl}
-        return (f"; pool: {active} active / {a.num_cached} cached-free"
-                f" / {len(a._free)} free of {self.num_blocks - 1}"
-                f" usable; blocks per slot: {hist or '{}'}")
+        out = (f"; pool: {active} active / {a.num_cached} cached-free"
+               f" / {len(a._free)} free of {self.num_blocks - 1}"
+               f" usable; blocks per slot: {hist or '{}'}")
+        tenants = {t: n for t, n in self._tenant_charge.items()
+                   if n and t is not None}
+        if tenants:
+            out += f"; blocks per tenant: {tenants}"
+        return out
 
     def _describe_block(self, block: int) -> str:
         owners = self.owners_of(block)
@@ -612,6 +671,10 @@ class PagedKVCache:
                  else f"refcount {int(self.allocator.refcount[block])}")
         tail = ", hash-indexed" if block in self._block_hash else ""
         own = f"owned by slot(s) {owners}" if owners else "no owner"
+        tnts = sorted({self.seq_tenant[s] for s in owners
+                       if self.seq_tenant[s] is not None})
+        if tnts:
+            own += f" of tenant(s) {tnts}"
         return f"{state}, {own}{tail}"
 
     def _fingerprint(self, block: int, pool_arrs) -> bytes:
@@ -650,6 +713,12 @@ class PagedKVCache:
              remain in that state; an in-place write to a shared or
              indexed page trips it. (Writers must COW-split first —
              ensure()'s write-range split.)
+          9. tenant quota bookkeeping: the incremental per-tenant
+             block charges (_tenant_charge) equal the slot tables'
+             ground truth (one charge per reference held by each
+             tenant's slots) and their total equals the allocator's
+             total refcount over usable blocks — a growth path that
+             skipped the charge update cannot survive an audit.
         """
         a = self.allocator
         counts: Dict[int, int] = {}
@@ -697,6 +766,28 @@ class PagedKVCache:
             assert a.refcount[b] == 0, f"cached-free block {b} has owners"
             assert b in self._block_hash, \
                 f"cached-free block {b} is not hash-indexed"
+        # 9. tenant quota bookkeeping vs the allocator's ground truth:
+        #    the incremental per-tenant charge must equal the table
+        #    references actually held by each tenant's slots (one
+        #    charge per reference — the policy note in __init__), and
+        #    the grand total must equal the allocator's total refcount
+        #    over usable blocks (every reference attributed once).
+        truth: Dict[Optional[str], int] = {}
+        for slot in range(self.max_seqs):
+            n = len(self.seq_blocks[slot])
+            if n:
+                t = self.seq_tenant[slot]
+                truth[t] = truth.get(t, 0) + n
+        charged = {t: n for t, n in self._tenant_charge.items() if n}
+        assert charged == truth, \
+            (f"tenant block charges {charged} diverge from the "
+             f"tables' ground truth {truth}")
+        assert all(n >= 0 for n in self._tenant_charge.values()), \
+            f"negative tenant charge: {self._tenant_charge}"
+        total_refs = int(a.refcount[1:].sum())
+        assert sum(truth.values()) == total_refs, \
+            (f"tenant charges cover {sum(truth.values())} references "
+             f"but the allocator counts {total_refs}")
         if lens is not None and active is not None:
             lens = np.asarray(lens)
             for slot in np.flatnonzero(np.asarray(active)):
@@ -771,6 +862,7 @@ class PagedKVCache:
             "hash_index": dict(self._hash_to_block),
             "seq_blocks": [[int(b) for b in bl]
                            for bl in self.seq_blocks],
+            "seq_tenant": list(self.seq_tenant),
             "peak_blocks_used": int(self.peak_blocks_used),
             "blocks": [int(b) for b in keep],
             "payload": payload,
@@ -832,9 +924,15 @@ class PagedKVCache:
                 a.refcount[remap[old]] = n
         a._cached = OrderedDict((remap[b], True) for b in kept_cached)
         a.reclaimed = int(snap["reclaimed"]) + len(dropped)
+        # pre-PR-7 snapshots carry no tenant attribution: version-gate
+        # to an unattributed pool instead of crashing on the old format
+        tenants = snap.get("seq_tenant",
+                           [None] * g["max_seqs"])
         for slot, blocks in enumerate(snap["seq_blocks"]):
             mapped = [remap[int(b)] for b in blocks]
+            cache.seq_tenant[slot] = tenants[slot]
             cache.seq_blocks[slot] = mapped
+            cache._charge(slot, len(mapped))
             cache.block_tables[slot, :len(mapped)] = mapped
         for h, b in snap["hash_index"].items():
             b = remap.get(int(b))
@@ -929,6 +1027,7 @@ class PagedKVCache:
             new = self.allocator.alloc(need - len(have))
             self.block_tables[slot, len(have):need] = new
             have.extend(new)
+            self._charge(slot, len(new))
             self._tables_dirty()
         # COW: every block the write range [write_from, length) lands in
         if write_from is None:
@@ -960,15 +1059,18 @@ class PagedKVCache:
         drop = have[keep:]
         self.release_to_cache(drop)
         del have[keep:]
+        self._charge(slot, -len(drop))
         self.block_tables[slot, keep:] = 0
         self._tables_dirty()
 
     def free_seq(self, slot: int) -> None:
         if self.seq_blocks[slot]:
             self.release_to_cache(self.seq_blocks[slot])
+            self._charge(slot, -len(self.seq_blocks[slot]))
             self.seq_blocks[slot] = []
             self.block_tables[slot, :] = 0
             self._tables_dirty()
+        self.seq_tenant[slot] = None
 
     def quarantine_seq(self, slot: int) -> None:
         """Free a slot's pages with NO cached-free second chance: used
@@ -984,9 +1086,11 @@ class PagedKVCache:
             if self.allocator.refcount[b] == 1:
                 self._on_reclaim(b)   # drop index entry + audit print
             self.allocator.free([b], to_cache=b in self._block_hash)
+        self._charge(slot, -len(self.seq_blocks[slot]))
         self.seq_blocks[slot] = []
         self.block_tables[slot, :] = 0
         self._tables_dirty()
+        self.seq_tenant[slot] = None
 
     def fork(self, src: int, dst: int, length: int) -> None:
         """Share src's first ``blocks_needed(length)`` blocks with dst
@@ -999,6 +1103,7 @@ class PagedKVCache:
         for b in shared:   # fresh share epoch for the content audit
             self._audit_fp.pop(int(b), None)
         self.seq_blocks[dst] = list(shared)
+        self._charge(dst, len(shared))
         self.block_tables[dst, :len(shared)] = shared
         self._tables_dirty()
 
@@ -1071,6 +1176,7 @@ class PagedKVCache:
                 self.allocator.resurrect(b)
         if matched:
             self.seq_blocks[slot] = list(matched)
+            self._charge(slot, len(matched))
             self.block_tables[slot, :len(matched)] = matched
             self._tables_dirty()
         return len(matched)

@@ -18,8 +18,9 @@ replay, the classic WAL recipe:
 
 * **Journal** (``RequestJournal``): an append-only log of everything
   that crosses the serving boundary — submissions (token ids +
-  resilience knobs, written BEFORE the engine sees them), per-round
-  emitted tokens, releases, and drained outcomes. Records are
+  resilience/tenancy knobs, written BEFORE the engine sees them),
+  per-round emitted tokens, releases, tenant reconfigurations
+  (``set_tenant``), and drained outcomes. Records are
   length + CRC framed; a record torn by a crash mid-append is dropped
   on read (the round it described simply replays).
 
@@ -409,6 +410,22 @@ class RecoverableServer:
         self.journal.append("release", {"rid": int(rid)})
         self.engine.release(rid)
 
+    def set_tenant(self, tenant_id: str, **cfg):
+        """Journaled tenant registration/reconfiguration: the record
+        replays after a crash, so quotas/weights/floors changed
+        between snapshots survive recovery (construction-time
+        ``tenants=`` config rides snapshot 0 instead)."""
+        self._flush_drains()
+        self.journal.append("set_tenant", {"tenant_id": str(tenant_id),
+                                           "cfg": dict(cfg)})
+        return self.engine.set_tenant(tenant_id, **cfg)
+
+    def tenant_stats(self):
+        return self.engine.tenant_stats
+
+    def tenant_report(self):
+        return self.engine.tenant_report()
+
     def tokens(self, rid: int) -> List[int]:
         return self.engine.tokens(rid)
 
@@ -526,6 +543,15 @@ class RecoverableServer:
                         # unknown rid: raised live before any
                         # mutation, same determinism argument as the
                         # submit case above
+                        pass
+                elif kind == "set_tenant":
+                    try:
+                        eng.set_tenant(payload["tenant_id"],
+                                       **payload["cfg"])
+                    except ValueError:
+                        # refused live (quota below charge, floors
+                        # over pool) before any mutation: no-op on
+                        # replay too
                         pass
         finally:
             if injector is not None:

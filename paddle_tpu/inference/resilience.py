@@ -17,8 +17,10 @@ outcome, never an engine crash:
   every other request, or the re-prefill retry budget exhausted),
   ``FAILED_NUMERIC`` (non-finite hidden detected by the per-slot
   guard), ``FAILED_DEADLINE`` (per-request step or wall-clock budget
-  blown, admitted or still queued). Engines append these to an
-  ``outcomes`` event list the caller drains, exactly like
+  blown, admitted or still queued), ``REJECTED_ADMISSION``
+  (health-based admission control refused the request at submit —
+  multi-tenant isolation, see scheduler.submit). Engines append these
+  to an ``outcomes`` event list the caller drains, exactly like
   ``admitted``/``finished``/``preempted``.
 
 * ``FaultInjector`` — deterministic, schedule-driven fault injection
@@ -83,8 +85,17 @@ class RequestOutcome:
     FAILED_OOM = "failed_oom"            # pool dry / retry budget blown
     FAILED_NUMERIC = "failed_numeric"    # non-finite hidden in the slot
     FAILED_DEADLINE = "failed_deadline"  # step / wall-clock budget blown
+    # health-based admission control (multi-tenant isolation): the
+    # request was refused AT SUBMIT because it provably can never be
+    # served — its prompt exceeds its tenant's block quota, the pool
+    # minus other tenants' reserved floors, or (prefill-token-budget
+    # mode) its deadline_steps is below the prefill-step lower bound.
+    # Delivered as a terminal outcome, never an exception: submit()
+    # still returns a rid and the verdict rides ``outcomes``.
+    REJECTED_ADMISSION = "rejected_admission"
 
-    STATUSES = (FINISHED, FAILED_OOM, FAILED_NUMERIC, FAILED_DEADLINE)
+    STATUSES = (FINISHED, FAILED_OOM, FAILED_NUMERIC, FAILED_DEADLINE,
+                REJECTED_ADMISSION)
 
     __slots__ = ("rid", "status", "reason", "tokens", "preemptions",
                  "step")

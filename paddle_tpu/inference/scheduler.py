@@ -46,7 +46,8 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
 
   * failure isolation (inference/resilience.py): requests end in a
     terminal ``RequestOutcome`` — FINISHED, or FAILED_OOM /
-    FAILED_NUMERIC / FAILED_DEADLINE — surfaced in ``outcomes``;
+    FAILED_NUMERIC / FAILED_DEADLINE / REJECTED_ADMISSION — surfaced
+    in ``outcomes``;
     a BlockOOM that survives preemption sheds ONE request instead of
     raising, ``max_preemptions`` bounds the re-prefill retry budget,
     per-request deadlines (steps or wall clock) are enforced each
@@ -55,6 +56,35 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     can drive all of it deterministically; ``check_invariants``
     audits the pool bookkeeping. Counters ride in
     ``resilience_stats`` (ResilienceStats).
+
+  * multi-tenant isolation (``tenants=`` / ``set_tenant`` /
+    ``submit(..., tenant_id=...)``): every request belongs to a
+    tenant (the implicit unlimited ``default`` tenant when no id is
+    given — bit-identical to the single-tenant engine). Tenants carry
+    a block QUOTA (hard cap on the blocks their slots' tables may
+    reference — one charge per reference, so a tenant's bill is a
+    pure function of its own tables; see PagedKVCache.__init__), a
+    RESERVED floor (pool headroom other tenants may never dip into
+    while this tenant is below it), and a WEIGHT for admission.
+    Admission is weighted fair queuing over one physical queue:
+    the tenant with the lowest virtual time admits next (vtime
+    advances by 1/weight per admission; start-time bumped to the
+    virtual clock on enqueue-from-idle), age-fair within a tenant and
+    still preempted-ahead-of-new. A tenant whose head request is
+    blocked by its OWN quota (or by others' reserved floors) is
+    skipped — its cap is its problem, never its neighbors' — while
+    true pool pressure stops the pass head-of-line as before.
+    Preemption and shedding are tenant-aware: a quota or floor hit
+    evicts the over-budget tenant's OWN youngest (or sheds its
+    grower), and a physical pool OOM takes victims from the grower's
+    own tenant — a neighbor is only ever preempted when the grower
+    is still under its reserved floor and that neighbor is borrowing
+    above its own. Health-based admission control REJECTS provably
+    unservable requests at submit (quota- or pool-impossible prompt,
+    deadline below the prefill lower bound) with a terminal
+    ``REJECTED_ADMISSION`` outcome — never an exception. Per-tenant
+    accounting (sheds, rejections, quota hits, blocks held, tokens
+    served) rides in ``tenant_stats`` (TenantStats).
 
 Events are surfaced in ``admitted`` / ``finished`` / ``preempted`` /
 ``outcomes`` lists the caller drains between steps (prefill outputs
@@ -72,10 +102,70 @@ from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
 from .resilience import RequestOutcome
-from .serving import PrefillStats, PrefixCacheStats, ResilienceStats
+from .serving import (PrefillStats, PrefixCacheStats, ResilienceStats,
+                      TenantStats)
 
-__all__ = ["PagedRequest", "PagedServingEngine", "chunked_prefill",
+__all__ = ["PagedRequest", "PagedServingEngine", "Tenant",
+           "chunked_prefill", "DEFAULT_TENANT",
            "MIN_PREFILL_SUFFIX_ROWS"]
+
+# the implicit tenant every request without a tenant_id belongs to:
+# unlimited quota, no reserved floor, weight 1 — a single-tenant
+# engine therefore schedules bit-identically to the pre-tenant one
+# (weighted fair queuing over one tenant IS FIFO, and every victim
+# policy degenerates to "youngest first")
+DEFAULT_TENANT = "default"
+
+
+class Tenant:
+    """One tenant's isolation contract + accounting.
+
+      quota_blocks     hard cap on pool blocks charged to the tenant
+                       (one charge per block-table reference its slots
+                       hold); None = unlimited. Growth into the cap
+                       evicts/sheds WITHIN the tenant, admission past
+                       it skips the tenant — neighbors never pay.
+      reserved_blocks  guaranteed floor: while this tenant's charge is
+                       below it, other tenants' admissions and growth
+                       may not dip the free pool below the unmet
+                       remainder, and a pool OOM suffered while below
+                       it may evict an over-floor borrower.
+      weight           weighted-fair-queuing admission share: a
+                       tenant's virtual time advances by 1/weight per
+                       admission, so a weight-2 tenant admits twice as
+                       often under contention.
+      vtime            the WFQ virtual-time tag (scheduler state —
+                       snapshots round-trip it).
+      queued           live count of this tenant's queued requests
+                       (gauge maintained at every queue mutation and
+                       audited by check_invariants; derived state, so
+                       restore recomputes it from the queue instead of
+                       round-tripping it).
+      stats            TenantStats (serving.py).
+    """
+
+    __slots__ = ("tid", "quota_blocks", "reserved_blocks", "weight",
+                 "vtime", "queued", "stats")
+
+    def __init__(self, tid: str, quota_blocks: Optional[int] = None,
+                 reserved_blocks: int = 0, weight: float = 1.0):
+        self.tid = str(tid)
+        if weight <= 0:
+            raise ValueError(f"tenant {tid!r}: weight must be > 0")
+        if reserved_blocks < 0:
+            raise ValueError(
+                f"tenant {tid!r}: reserved_blocks must be >= 0")
+        if quota_blocks is not None and quota_blocks < reserved_blocks:
+            raise ValueError(
+                f"tenant {tid!r}: quota_blocks ({quota_blocks}) < "
+                f"reserved_blocks ({reserved_blocks})")
+        self.quota_blocks = (None if quota_blocks is None
+                             else int(quota_blocks))
+        self.reserved_blocks = int(reserved_blocks)
+        self.weight = float(weight)
+        self.vtime = 0.0
+        self.queued = 0
+        self.stats = TenantStats()
 
 # A partial (suffix-only) prefill must recompute at least this many
 # trailing prompt rows, even when the prefix cache covers more: a
@@ -181,6 +271,10 @@ class PagedRequest:
         self.slot: Optional[int] = None
         self.admit_seq = -1
         self.preemptions = 0
+        # multi-tenant isolation: which tenant's quota/weight/floor
+        # govern this request (set by submit; DEFAULT_TENANT when the
+        # caller gave no tenant_id)
+        self.tenant: str = DEFAULT_TENANT
         # resilience knobs (set by the engine at submit): re-prefill
         # retry budget and per-request deadlines — None = unbounded
         self.max_preemptions: Optional[int] = None
@@ -238,7 +332,8 @@ class PagedServingEngine:
                  chunk_tokens: Optional[int] = None,
                  prefill_token_budget: Optional[int] = None,
                  injector=None, max_preemptions: Optional[int] = None,
-                 numeric_guard: Optional[bool] = None):
+                 numeric_guard: Optional[bool] = None,
+                 tenants: Optional[Dict[str, dict]] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
@@ -246,6 +341,16 @@ class PagedServingEngine:
         self.prefix_cache = bool(prefix_cache)
         self.prefix_stats = PrefixCacheStats()
         self.prefill_stats = PrefillStats()
+        # multi-tenant isolation: registration order is the WFQ
+        # tie-break, so the dict's insertion order is load-bearing
+        # (snapshots preserve it). The implicit default tenant always
+        # exists; ``tenants={"a": {"quota_blocks": 8, "weight": 2}}``
+        # pre-registers more, set_tenant adds/updates at runtime, and
+        # an unknown tenant_id at submit auto-registers with the
+        # unlimited defaults.
+        self.tenants: Dict[str, Tenant] = {
+            DEFAULT_TENANT: Tenant(DEFAULT_TENANT)}
+        self._vclock = 0.0
         # resilience layer (inference/resilience.py): per-request
         # terminal outcomes instead of engine crashes, bounded retry,
         # optional deterministic fault injection + numeric guard. The
@@ -269,6 +374,8 @@ class PagedServingEngine:
             self.cache.allocator.fault_hook = \
                 lambda n: injector.on_alloc("target", n)
         self.max_len = self.cache.capacity_per_seq
+        for tid, cfg in (tenants or {}).items():
+            self.set_tenant(tid, **cfg)
         # prompt chunk size (chunked_prefill): a multiple of the block
         # size by default so most chunk boundaries land on page edges;
         # any value >= MIN_PREFILL_SUFFIX_ROWS is bit-transparent
@@ -334,16 +441,130 @@ class PagedServingEngine:
     def prefix_hit_rate(self) -> float:
         return self.prefix_stats.hit_rate
 
+    # -- tenants ------------------------------------------------------
+    @property
+    def tenant_stats(self) -> Dict[str, TenantStats]:
+        """{tenant_id: TenantStats} — the noisy-neighbor attribution
+        surface (blocks_held gauges refresh at every step top)."""
+        return {tid: t.stats for tid, t in self.tenants.items()}
+
+    def set_tenant(self, tenant_id: str, *,
+                   quota_blocks: Optional[int] = None,
+                   reserved_blocks: int = 0,
+                   weight: float = 1.0) -> Tenant:
+        """Register or reconfigure a tenant. Refused (ValueError) when
+        the quota would fall below the tenant's CURRENT charge (the
+        audit asserts charge <= quota, and enforcement only gates new
+        growth — a silently over-quota tenant would be a lie) or when
+        the reserved floors together exceed the usable pool (an
+        unkeepable promise). Stats and the WFQ virtual time survive
+        reconfiguration."""
+        held = self.cache.tenant_charge(tenant_id)
+        if quota_blocks is not None and quota_blocks < held:
+            raise ValueError(
+                f"tenant {tenant_id!r} already holds {held} block(s); "
+                f"a quota of {quota_blocks} would be violated on "
+                f"arrival — drain the tenant first")
+        existing = self.tenants.get(tenant_id)
+        ten = Tenant(tenant_id, quota_blocks=quota_blocks,
+                     reserved_blocks=reserved_blocks, weight=weight)
+        if existing is not None:
+            ten.vtime = existing.vtime
+            ten.queued = existing.queued
+            ten.stats = existing.stats
+        total_reserved = ten.reserved_blocks + sum(
+            t.reserved_blocks for tid, t in self.tenants.items()
+            if tid != tenant_id)
+        usable = self.cache.num_blocks - 1 - self.watermark_blocks
+        if total_reserved > usable:
+            raise ValueError(
+                f"reserved floors total {total_reserved} block(s) but "
+                f"only {usable} are usable (pool {self.cache.num_blocks}"
+                f" minus trash and watermark) — the guarantee would be "
+                f"unkeepable")
+        self.tenants[tenant_id] = ten
+        return ten
+
+    def _tenant_of(self, req: PagedRequest) -> Tenant:
+        return self.tenants[req.tenant]
+
+    def _dequeue(self, req: PagedRequest) -> None:
+        """The one way OFF the queue (the tenant's queued gauge moves
+        with the request) — raises ValueError if not queued."""
+        self.queue.remove(req)
+        self.tenants[req.tenant].queued -= 1
+
+    def _resolve_tenant(self, tenant_id: Optional[str]) -> Tenant:
+        tid = DEFAULT_TENANT if tenant_id is None else str(tenant_id)
+        ten = self.tenants.get(tid)
+        if ten is None:
+            ten = self.set_tenant(tid)   # unlimited defaults
+        return ten
+
+    def _unmet_floors(self, exclude: str) -> int:
+        """Free-pool headroom reserved for OTHER tenants still below
+        their floors — blocks the ``exclude`` tenant may not touch."""
+        return sum(
+            max(0, t.reserved_blocks - self.cache.tenant_charge(tid))
+            for tid, t in self.tenants.items()
+            if tid != exclude and t.reserved_blocks)
+
+    def _bump_vtime(self, tid: str) -> None:
+        """Start-time fairness: a tenant enqueueing from IDLE (nothing
+        of it queued) starts at the virtual clock instead of replaying
+        service credit it accrued by sitting out."""
+        ten = self.tenants[tid]
+        if ten.queued == 0 and ten.vtime < self._vclock:
+            ten.vtime = self._vclock
+
+    def tenant_report(self) -> Dict[str, dict]:
+        """Operator view: per-tenant config + live occupancy/queue +
+        stats (the doctor and the bench print this)."""
+        active: Dict[str, int] = {}
+        for s in np.flatnonzero(self.active | self.prefilling):
+            req = self._requests[int(s)]
+            if req is not None:
+                active[req.tenant] = active.get(req.tenant, 0) + 1
+        return {tid: {
+            "quota_blocks": t.quota_blocks,
+            "reserved_blocks": t.reserved_blocks,
+            "weight": t.weight,
+            "vtime": round(t.vtime, 6),
+            "blocks_held": self.cache.tenant_charge(tid),
+            "active": active.get(tid, 0),
+            "queued": t.queued,
+            "stats": t.stats.as_dict(),
+        } for tid, t in self.tenants.items()}
+
     # -- admission ----------------------------------------------------
     def submit(self, prompt, *, max_preemptions: Optional[int] = None,
                deadline_steps: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tenant_id: Optional[str] = None) -> int:
         """Queue a prompt ([T, d_model] embeddings) and try to admit.
         Returns the request id; if admission succeeded an
         ``(rid, slot, last_hidden)`` event is in ``admitted``. With
         ``prefill_token_budget`` set, admission only grants a slot —
         the prompt streams during subsequent ``step`` calls and the
         admitted event fires when the last chunk lands.
+
+        ``tenant_id`` attributes the request to a tenant (quota /
+        reserved floor / admission weight — see the class docstring);
+        None maps to the implicit unlimited ``default`` tenant, and an
+        unknown id auto-registers one with unlimited defaults.
+
+        HEALTH-BASED ADMISSION CONTROL: a request that provably can
+        never be served — its prompt needs more blocks than its
+        tenant's quota, or than the pool minus other tenants' reserved
+        floors, or (token-budget mode) its ``deadline_steps`` is below
+        the prefill-step lower bound ceil(T / (budget + 1)) — is
+        REJECTED at submit with a terminal ``REJECTED_ADMISSION``
+        outcome in ``outcomes`` instead of being queued to fail later.
+        Rejection is an outcome, never an exception, and depends only
+        on deterministic scheduler state, so a journaled replay
+        re-rejects identically. (Malformed submissions — empty prompt,
+        prompt past the per-seq page capacity — still raise ValueError
+        before any engine mutation, as before.)
 
         Resilience knobs (all optional, None = unbounded):
         ``max_preemptions`` caps the re-prefill retry budget for THIS
@@ -362,8 +583,10 @@ class PagedServingEngine:
             raise ValueError(
                 f"prompt length {arr.shape[0]} > per-seq page capacity "
                 f"{self.max_len}")
+        ten = self._resolve_tenant(tenant_id)
         req = PagedRequest(self._next_rid, arr)
         self._next_rid += 1
+        req.tenant = ten.tid
         req.max_preemptions = (self.max_preemptions
                                if max_preemptions is None
                                else int(max_preemptions))
@@ -372,11 +595,67 @@ class PagedServingEngine:
             req.deadline_steps = int(deadline_steps)
         if deadline_s is not None:
             req.deadline_time = time.monotonic() + float(deadline_s)
+        reject = self._admission_health(req, ten)
+        if reject:
+            self._record(req, RequestOutcome.REJECTED_ADMISSION,
+                         reject)
+            return req.rid
         if deadline_steps is not None or deadline_s is not None:
             self._has_deadlines = True
+        self._bump_vtime(ten.tid)
         self.queue.append(req)
+        ten.queued += 1
         self._try_admit()
         return req.rid
+
+    def _admission_health(self, req: PagedRequest,
+                          ten: Tenant) -> str:
+        """Reason string when the request provably cannot be served
+        from the current configuration (it would only ever burn pool
+        and queue time before failing), else ''. Every check is a
+        PERMANENT impossibility under the current tenant/pool
+        contracts — transient pressure never rejects, it queues."""
+        # the horizon every serving path must eventually cover: the
+        # prompt PLUS the first decode token's page (the same +1 the
+        # synchronous admission gate uses — a prompt ending on a block
+        # boundary needs one block more than blocks_needed(T), and a
+        # health check one block looser would queue it to stall at the
+        # admission gate forever)
+        need = self.cache.blocks_needed(min(len(req) + 1, self.max_len))
+        if ten.quota_blocks is not None and need > ten.quota_blocks:
+            return (f"prompt needs {need} block(s) through its first "
+                    f"decode token but tenant {ten.tid!r} quota is "
+                    f"{ten.quota_blocks} — can never be admitted")
+        # the permanent bound subtracts other tenants' FULL reserved
+        # floors, not the currently-unmet remainder: free minus unmet
+        # can never exceed usable minus reserved (free <= usable -
+        # charge, unmet = max(0, reserved - charge)), so a check built
+        # on the momentary unmet would queue a request every admission
+        # pass then floor-skips forever once the floor tenant's charge
+        # drops back
+        reserved_others = sum(
+            t.reserved_blocks for tid, t in self.tenants.items()
+            if tid != ten.tid)
+        room = self.cache.num_blocks - 1 - self.watermark_blocks \
+            - reserved_others
+        if need > room:
+            return (f"prompt needs {need} block(s) through its first "
+                    f"decode token but only {room} can ever be "
+                    f"available past other tenants' reserved floors "
+                    f"and the watermark")
+        if req.deadline_steps is not None and \
+                self.prefill_token_budget is not None:
+            # each mixed step advances at most budget + 1 prompt
+            # tokens (the soft cap), so this lower bound is exact
+            floor_steps = -(-len(req) // (self.prefill_token_budget
+                                          + 1))
+            if req.deadline_steps < floor_steps:
+                return (f"prefill alone needs >= {floor_steps} "
+                        f"step(s) at prefill_token_budget="
+                        f"{self.prefill_token_budget} but the "
+                        f"deadline is {req.deadline_steps} — cannot "
+                        f"be met at any pool pressure")
+        return ""
 
     def _try_admit(self) -> None:
         """One admission pass, then the ``post_admission`` crash
@@ -385,14 +664,40 @@ class PagedServingEngine:
         self._crash("post_admission")
 
     def _admit_pass(self) -> None:
-        """Admit from the queue head while a slot is free and the
-        block budget covers the admission horizon plus the watermark:
-        the whole prompt (plus the first decode token's page) in
-        synchronous mode, only the FIRST chunk in token-budget mode —
-        chunked prefill grows the rest page by page under the normal
-        preemption rules."""
+        """Weighted fair admission: while a slot is free, the queued
+        tenant with the LOWEST virtual time (ties broken by
+        registration order) offers its oldest queued request —
+        age-fair within a tenant, and preempted requests still ride
+        ahead of never-admitted ones (the physical queue keeps the
+        PR 5 ordering; tenancy only picks WHICH tenant's head goes
+        next). The block budget must cover the admission horizon plus
+        the watermark: the whole prompt (plus the first decode
+        token's page) in synchronous mode, only the FIRST chunk in
+        token-budget mode — chunked prefill grows the rest page by
+        page under the normal preemption rules.
+
+        Isolation semantics of a blocked head: a tenant blocked by
+        its OWN quota, or by OTHER tenants' unmet reserved floors, is
+        SKIPPED for this pass (its cap must never become its
+        neighbors' head-of-line blocker) and its virtual time does
+        not advance; true pool pressure — the head does not fit the
+        raw free pool — stops the whole pass, the same no-starvation
+        head-of-line rule as before (the blocked tenant keeps the
+        lowest vtime, so it admits first once space frees)."""
+        skipped: set = set()
+        order = {tid: i for i, tid in enumerate(self.tenants)}
         while self.queue and self.free_slots > 0:
-            req = self.queue[0]
+            heads: Dict[str, PagedRequest] = {}
+            for r in self.queue:
+                if r.tenant not in heads:
+                    heads[r.tenant] = r
+            cands = [t for t in heads if t not in skipped]
+            if not cands:
+                return
+            tid = min(cands, key=lambda t: (self.tenants[t].vtime,
+                                            order.get(t, len(order))))
+            ten = self.tenants[tid]
+            req = heads[tid]
             if self.prefill_token_budget is None:
                 # cover the prompt AND the first decode token's page —
                 # admitting with zero headroom would re-preempt a
@@ -402,6 +707,15 @@ class PagedServingEngine:
             else:
                 horizon = min(len(req), self.chunk_tokens)
             need = self.cache.blocks_needed(horizon)
+            # tenant quota gates the FULL reference count (shared
+            # prefix hits are charged per reference — the policy note
+            # in PagedKVCache.__init__), unlike the pool draw below
+            if ten.quota_blocks is not None and \
+                    self.cache.tenant_charge(tid) + need \
+                    > ten.quota_blocks:
+                ten.stats.quota_hits += 1
+                skipped.add(tid)
+                continue
             if self.prefix_cache:
                 # actively shared prefix hits cost no pool draw at all;
                 # cached-free hits come out of free_blocks (a resurrect
@@ -411,9 +725,17 @@ class PagedServingEngine:
                     req.block_hashes(self.cache.block_size))
                 rc = self.cache.allocator.refcount
                 need -= sum(1 for b in matched if rc[b] > 0)
-            if max(need, 0) + self.watermark_blocks > self.free_blocks:
-                return  # head-of-line blocks; keep FIFO fairness
-            self.queue.popleft()
+            draw = max(need, 0) + self.watermark_blocks
+            if draw > self.free_blocks:
+                return  # head-of-line pool pressure blocks the pass
+            if draw > self.free_blocks - self._unmet_floors(tid):
+                # only other tenants' reservations stand in the way:
+                # their entitlement, this tenant's wait
+                skipped.add(tid)
+                continue
+            self._dequeue(req)
+            self._vclock = ten.vtime
+            ten.vtime += 1.0 / ten.weight
             if self.prefill_token_budget is None:
                 try:
                     self._prefill(req)
@@ -431,6 +753,7 @@ class PagedServingEngine:
                                    f"budget exhausted: {e}")
                     else:
                         req.preemptions += 1
+                        self._tenant_of(req).stats.preemptions += 1
                         self._requeue_preempted(req)
                         self.preempted.append(req.rid)
                     return
@@ -445,6 +768,10 @@ class PagedServingEngine:
         constant's comment: 1-row GEMV accumulation breaks
         bit-identity, and the admission event needs a last hidden)."""
         slot = int(np.flatnonzero(~self.active & ~self.prefilling)[0])
+        # attribute the slot BEFORE any page lands in it, so adopted
+        # prefix blocks and the first chunk's pages charge the right
+        # tenant from the first reference
+        self.cache.set_seq_tenant(slot, req.tenant)
         T = len(req)
         bs = self.cache.block_size
         hashes: List[bytes] = []
@@ -464,6 +791,7 @@ class PagedServingEngine:
         req.slot = slot
         req.admit_seq = self._next_admit_seq
         self._next_admit_seq += 1
+        self._tenant_of(req).stats.admitted += 1
         if req.preemptions > 0:
             self.resilience_stats.retried += 1
         return slot
@@ -595,12 +923,19 @@ class PagedServingEngine:
             req.rid, status, reason=reason, tokens=len(req),
             preemptions=req.preemptions, step=self._step_count))
         st = self.resilience_stats
+        ts = self._tenant_of(req).stats
         if status == RequestOutcome.FAILED_OOM:
             st.shed += 1
+            ts.sheds += 1
         elif status == RequestOutcome.FAILED_NUMERIC:
             st.nan_failed += 1
+            ts.nan_failed += 1
         elif status == RequestOutcome.FAILED_DEADLINE:
             st.deadline_failed += 1
+            ts.deadline_failed += 1
+        elif status == RequestOutcome.REJECTED_ADMISSION:
+            st.rejected += 1
+            ts.rejections += 1
 
     def _fail(self, req: PagedRequest, status: str,
               reason: str) -> None:
@@ -614,7 +949,7 @@ class PagedServingEngine:
             req.slot = None
         else:
             try:
-                self.queue.remove(req)
+                self._dequeue(req)
             except ValueError:
                 pass
         self._record(req, status, reason)
@@ -631,6 +966,7 @@ class PagedServingEngine:
         requests preempted in different engine passes (a re-admitted
         old request holds a fresh admit_seq, so it is evicted first
         and appendleft would then queue it BEHIND its younger peer)."""
+        self._bump_vtime(req.tenant)
         i = 0
         for r in self.queue:
             if r.preemptions > 0 and r.rid < req.rid:
@@ -638,6 +974,7 @@ class PagedServingEngine:
             else:
                 break
         self.queue.insert(i, req)
+        self.tenants[req.tenant].queued += 1
 
     def _check_deadlines(self) -> None:
         """Fail every request (active, mid-prefill or queued) whose
@@ -712,12 +1049,41 @@ class PagedServingEngine:
         self._drop(slot)
         req.slot = None
         req.preemptions += 1
+        self._tenant_of(req).stats.preemptions += 1
         self._requeue_preempted(req)
         self.preempted.append(req.rid)
 
-    def _preempt_youngest(self) -> int:
-        cands = [int(s) for s in
-                 np.flatnonzero(self.active | self.prefilling)]
+    def _oom_victims(self, req: PagedRequest) -> List[int]:
+        """Eligible eviction victims for a POOL OOM hit while growing
+        ``req``: the grower's OWN tenant's slots — pool pressure a
+        tenant creates is resolved inside that tenant, never by
+        evicting a within-quota neighbor. The one exception is the
+        reserved-floor guarantee: a grower still BELOW its floor is
+        entitled to the block, so the victims are the slots of tenants
+        borrowing ABOVE their own floors (falling back to the grower's
+        own if no one is over). With a single (default) tenant both
+        branches degenerate to every held slot — the pre-tenant
+        youngest-first policy, bit-identical."""
+        held = [int(s) for s in
+                np.flatnonzero(self.active | self.prefilling)]
+        ten = self._tenant_of(req)
+        if ten.reserved_blocks and \
+                self.cache.tenant_charge(ten.tid) < ten.reserved_blocks:
+            over = [s for s in held
+                    if self._over_floor(self._requests[s].tenant)]
+            if over:
+                return over
+        return [s for s in held
+                if self._requests[s].tenant == ten.tid]
+
+    def _over_floor(self, tid: str) -> bool:
+        t = self.tenants[tid]
+        return self.cache.tenant_charge(tid) > t.reserved_blocks
+
+    def _preempt_youngest(self, cands: Optional[List[int]] = None) -> int:
+        if cands is None:
+            cands = [int(s) for s in
+                     np.flatnonzero(self.active | self.prefilling)]
         victim = max(cands, key=lambda s: self._requests[s].admit_seq)
         self.preempt(victim)
         return victim
@@ -812,6 +1178,7 @@ class PagedServingEngine:
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
         self.lens[stepping] += 1
+        self._count_tokens_served(stepping, 1)
         self.prefill_stats.decode_steps += 1
         if ran_prefill:
             self.prefill_stats.mixed_steps += 1
@@ -891,6 +1258,7 @@ class PagedServingEngine:
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
         self.lens[self.active] += L
+        self._count_tokens_served(self.active, L)
         self.prefill_stats.decode_steps += 1
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
@@ -944,31 +1312,99 @@ class PagedServingEngine:
         idle = self.num_active == 0 and self.num_prefilling == 0 \
             and not self.queue
         self._check_deadlines()
+        for tid, ten in self.tenants.items():
+            ten.stats.blocks_held = self.cache.tenant_charge(tid)
         return idle
+
+    def _count_tokens_served(self, stepping: np.ndarray,
+                             n: int) -> None:
+        """Attribute this fused call's consumed decode tokens to the
+        stepping slots' tenants (the per-tenant throughput signal)."""
+        for slot in np.flatnonzero(stepping):
+            req = self._requests[int(slot)]
+            if req is not None:
+                self._tenant_of(req).stats.tokens_served += n
 
     def _grow_or_shed(self, slot: int, req: PagedRequest, length: int,
                       *, start_block: int = 0,
                       write_from: Optional[int] = None) -> bool:
         """Cover ``length`` tokens for ``slot`` (allocate-on-write +
-        COW split), preempting the YOUNGEST request on BlockOOM —
-        possibly the grower itself (it then re-queues for re-prefill).
-        When the pool is dry even with every other request evicted,
-        the grower is SHED (FAILED_OOM outcome) instead of the engine
-        raising. The ONE eviction/shed policy behind decode growth,
-        multi-token growth and chunked-prefill growth. Returns True
-        when the slot is still alive (and covered)."""
+        COW split), preempting the youngest ELIGIBLE request on
+        pressure — possibly the grower itself (it then re-queues for
+        re-prefill). The ONE eviction/shed policy behind decode
+        growth, multi-token growth and chunked-prefill growth;
+        returns True when the slot is still alive (and covered).
+
+        Tenant-aware pressure handling, checked in order:
+
+          1. TENANT QUOTA: growth past the tenant's block cap evicts
+             the tenant's OWN youngest; with nothing of its own left
+             to evict the grower is SHED (FAILED_OOM naming the
+             quota) — a neighbor never pays for a flooder's cap.
+          2. RESERVED FLOORS: a tenant at-or-over its own floor may
+             not dip the free pool below other tenants' unmet floors;
+             it evicts within itself, or (sole member) self-evicts
+             and waits queued — floor pressure is transient (it
+             clears when the entitled tenant charges up), so the
+             grower is preempted, not shed.
+          3. POOL OOM: victims come from ``_oom_victims`` (the
+             grower's own tenant; over-floor borrowers when the
+             grower is below its floor). Pool dry with no eligible
+             victim but the grower itself -> SHED, as before.
+        """
+        if not (self.active[slot] or self.prefilling[slot]):
+            return False    # already evicted growing an earlier slot
+        ten = self._tenant_of(req)
         while self.active[slot] or self.prefilling[slot]:
+            need_new = self.cache.blocks_needed(length) \
+                - len(self.cache.seq_blocks[slot])
+            if need_new > 0 and ten.quota_blocks is not None and \
+                    self.cache.tenant_charge(ten.tid) + need_new \
+                    > ten.quota_blocks:
+                ten.stats.quota_hits += 1
+                own = [int(s) for s in
+                       np.flatnonzero(self.active | self.prefilling)
+                       if self._requests[int(s)].tenant == ten.tid]
+                if len(own) <= 1:
+                    self._fail(req, RequestOutcome.FAILED_OOM,
+                               f"tenant {ten.tid!r} block quota "
+                               f"({ten.quota_blocks}) exhausted: "
+                               f"{self.cache.tenant_charge(ten.tid)} "
+                               f"held + {need_new} needed")
+                else:
+                    self._preempt_youngest(own)
+                continue
+            if need_new > 0 and \
+                    self.cache.tenant_charge(ten.tid) \
+                    >= ten.reserved_blocks:
+                unmet = self._unmet_floors(exclude=ten.tid)
+                if unmet and self.free_blocks - need_new < unmet:
+                    own = [int(s) for s in
+                           np.flatnonzero(self.active
+                                          | self.prefilling)
+                           if self._requests[int(s)].tenant == ten.tid]
+                    # sole member: self-evict and wait queued (the
+                    # floor clears when its owner charges up); with
+                    # peers, the tenant's youngest yields
+                    self._preempt_youngest(own)
+                    continue
             try:
                 self.cache.ensure(slot, length, start_block=start_block,
                                   write_from=write_from)
                 return True
             except BlockOOM as e:
-                if self.num_active + self.num_prefilling == 1:
+                # shed only when no victim but the grower itself is
+                # left: the below-floor branch of _oom_victims returns
+                # over-floor BORROWERS, a list that never contains the
+                # grower — a single entry there is still an eviction
+                # the floor guarantee promises, not a dead end
+                cands = self._oom_victims(req)
+                if not any(s != slot for s in cands):
                     self._fail(req, RequestOutcome.FAILED_OOM,
                                f"pool exhausted even after preempting "
-                               f"every other request: {e}")
+                               f"every eligible request: {e}")
                 else:
-                    self._preempt_youngest()
+                    self._preempt_youngest(cands)
         return False
 
     def _sanitize_masked_rows(self, x, stepping: np.ndarray):
@@ -1023,6 +1459,35 @@ class PagedServingEngine:
         for slot in self._prefills:
             assert self.prefilling[slot], \
                 f"prefill state for non-prefilling slot {slot}"
+        # tenant layer: every live request's tenant is registered, the
+        # cache's slot attribution mirrors the engine's, and no tenant
+        # sits past its quota (enforcement gates every growth path;
+        # set_tenant refuses quotas below the current charge)
+        for slot in np.flatnonzero(self.active | self.prefilling):
+            req = self._requests[int(slot)]
+            assert req.tenant in self.tenants, \
+                f"slot {int(slot)} request of unknown tenant " \
+                f"{req.tenant!r}"
+            assert self.cache.seq_tenant[int(slot)] == req.tenant, \
+                (f"slot {int(slot)} cache attribution "
+                 f"{self.cache.seq_tenant[int(slot)]!r} != request "
+                 f"tenant {req.tenant!r}")
+        queued_by_tenant: Dict[str, int] = {}
+        for r in self.queue:
+            assert r.tenant in self.tenants, \
+                f"queued request {r.rid} of unknown tenant {r.tenant!r}"
+            queued_by_tenant[r.tenant] = \
+                queued_by_tenant.get(r.tenant, 0) + 1
+        for tid, ten in self.tenants.items():
+            assert ten.queued == queued_by_tenant.get(tid, 0), \
+                (f"tenant {tid!r} queued gauge {ten.queued} != "
+                 f"{queued_by_tenant.get(tid, 0)} request(s) actually "
+                 f"queued")
+            if ten.quota_blocks is not None:
+                held = self.cache.tenant_charge(tid)
+                assert held <= ten.quota_blocks, \
+                    (f"tenant {tid!r} holds {held} block(s) over its "
+                     f"quota {ten.quota_blocks}")
         self.cache.check_invariants(lens=self.lens, active=self.active)
         self.resilience_stats.audits += 1
         return True
@@ -1053,6 +1518,7 @@ class PagedServingEngine:
             "deadline_remaining": (None if req.deadline_time is None
                                    else req.deadline_time - now),
             "submit_step": req.submit_step,
+            "tenant": req.tenant,
         }
 
     def snapshot(self) -> dict:
@@ -1101,6 +1567,18 @@ class PagedServingEngine:
                          "next_admit_seq": self._next_admit_seq,
                          "step_count": self._step_count,
                          "has_deadlines": self._has_deadlines},
+            # tenant isolation state: configs, WFQ virtual times (the
+            # list order IS the registration order — the WFQ
+            # tie-break), and per-tenant stats; restore rebuilds the
+            # registry so quotas/weights/fairness continue exactly
+            "tenants": [{"id": t.tid,
+                         "quota_blocks": t.quota_blocks,
+                         "reserved_blocks": t.reserved_blocks,
+                         "weight": t.weight,
+                         "vtime": t.vtime,
+                         "stats": self._stats_rec(t.stats)}
+                        for t in self.tenants.values()],
+            "vclock": self._vclock,
             "stats": {"prefix": self._stats_rec(self.prefix_stats),
                       "prefill": self._stats_rec(self.prefill_stats),
                       "resilience":
@@ -1155,10 +1633,23 @@ class PagedServingEngine:
                 lambda n: injector.on_alloc("target", n)
         eng.max_len = eng.cache.capacity_per_seq
         now = time.monotonic()
+        # tenant registry (version-gated: pre-tenant snapshots carry
+        # no "tenants" key and restore to the implicit default-only
+        # registry the constructor already built)
+        for trec in snap.get("tenants", []):
+            ten = Tenant(trec["id"],
+                         quota_blocks=trec["quota_blocks"],
+                         reserved_blocks=trec["reserved_blocks"],
+                         weight=trec["weight"])
+            ten.vtime = trec["vtime"]
+            cls._stats_set(ten.stats, trec["stats"])
+            eng.tenants[ten.tid] = ten
+        eng._vclock = snap.get("vclock", 0.0)
         reqs: Dict[int, PagedRequest] = {}
         for rec in snap["requests"]:
             req = PagedRequest(rec["rid"], rec["history"])
             req._hashes = list(rec["hashes"])
+            req.tenant = rec.get("tenant", DEFAULT_TENANT)
             req.slot = rec["slot"]
             req.admit_seq = rec["admit_seq"]
             req.preemptions = rec["preemptions"]
@@ -1170,7 +1661,16 @@ class PagedServingEngine:
             reqs[req.rid] = req
         eng._requests = [None if rid is None else reqs[rid]
                          for rid in snap["slot_rids"]]
+        # reconcile the pool's slot attribution with the requests —
+        # a no-op for tenant-era snapshots, and the version gate that
+        # lifts a pre-tenant snapshot's unattributed slots onto the
+        # implicit default tenant (charge moves with them)
+        for slot, r in enumerate(eng._requests):
+            if r is not None:
+                eng.cache.set_seq_tenant(slot, r.tenant)
         eng.queue = deque(reqs[rid] for rid in snap["queue"])
+        for r in eng.queue:
+            eng._resolve_tenant(r.tenant).queued += 1
         eng.lens = np.array(snap["lens"], np.int32)
         eng.active = np.array(snap["active"], bool)
         eng.prefilling = np.array(snap["prefilling"], bool)

@@ -27,7 +27,8 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 __all__ = ["ContinuousBatchingEngine", "PrefillStats",
-           "PrefixCacheStats", "ResilienceStats", "SpecDecodeStats"]
+           "PrefixCacheStats", "ResilienceStats", "SpecDecodeStats",
+           "TenantStats"]
 
 
 class PrefixCacheStats:
@@ -165,36 +166,106 @@ class ResilienceStats:
                        still queued)
       nan_failed       requests FAILED_NUMERIC (non-finite hidden in
                        the slot's fused-step output row)
+      rejected         requests REJECTED_ADMISSION (health-based
+                       admission control refused them at submit:
+                       quota- or pool-impossible, or the deadline
+                       below the prefill-step lower bound)
       audits           check_invariants() passes run through the
                        engine surface
     """
 
     __slots__ = ("shed", "retried", "deadline_failed", "nan_failed",
-                 "audits")
+                 "rejected", "audits")
 
     def __init__(self):
         self.shed = 0
         self.retried = 0
         self.deadline_failed = 0
         self.nan_failed = 0
+        self.rejected = 0
         self.audits = 0
 
     @property
     def failed(self) -> int:
         """Total requests that ended in a failure outcome."""
-        return self.shed + self.deadline_failed + self.nan_failed
+        return (self.shed + self.deadline_failed + self.nan_failed
+                + self.rejected)
 
     def as_dict(self) -> dict:
         return {"shed": self.shed, "retried": self.retried,
                 "deadline_failed": self.deadline_failed,
-                "nan_failed": self.nan_failed, "failed": self.failed,
+                "nan_failed": self.nan_failed,
+                "rejected": self.rejected, "failed": self.failed,
                 "audits": self.audits}
 
     def __repr__(self):
         return (f"ResilienceStats(shed={self.shed}, "
                 f"retried={self.retried}, "
                 f"deadline_failed={self.deadline_failed}, "
-                f"nan_failed={self.nan_failed})")
+                f"nan_failed={self.nan_failed}, "
+                f"rejected={self.rejected})")
+
+
+class TenantStats:
+    """Per-tenant serving accounting (multi-tenant isolation,
+    scheduler.py): one instance per tenant in
+    ``PagedServingEngine.tenant_stats``, the attribution surface that
+    makes a noisy neighbor VISIBLE — which tenant sheds, which tenant
+    gets rejected, which tenant holds the pool. Counters only grow
+    except ``blocks_held``, a live gauge refreshed at every step top.
+
+      admitted       requests of this tenant granted a slot (including
+                     re-admissions after preemption)
+      sheds          requests FAILED_OOM — pool or tenant quota dry
+      rejections     requests REJECTED_ADMISSION at submit
+      quota_hits     growth/admission attempts that ran into THIS
+                     tenant's block quota (each may preempt or shed
+                     within the tenant, never a neighbor)
+      preemptions    evictions charged to this tenant's requests
+      deadline_failed / nan_failed   per-tenant split of the engine
+                     ResilienceStats counters
+      blocks_held    pool blocks currently charged to the tenant (one
+                     charge per block-table reference its slots hold)
+      tokens_served  decode tokens consumed by this tenant's slots
+                     through fused steps
+    """
+
+    __slots__ = ("admitted", "sheds", "rejections", "quota_hits",
+                 "preemptions", "deadline_failed", "nan_failed",
+                 "blocks_held", "tokens_served")
+
+    def __init__(self):
+        self.admitted = 0
+        self.sheds = 0
+        self.rejections = 0
+        self.quota_hits = 0
+        self.preemptions = 0
+        self.deadline_failed = 0
+        self.nan_failed = 0
+        self.blocks_held = 0
+        self.tokens_served = 0
+
+    @property
+    def failed(self) -> int:
+        return (self.sheds + self.rejections + self.deadline_failed
+                + self.nan_failed)
+
+    def as_dict(self) -> dict:
+        return {"admitted": self.admitted, "sheds": self.sheds,
+                "rejections": self.rejections,
+                "quota_hits": self.quota_hits,
+                "preemptions": self.preemptions,
+                "deadline_failed": self.deadline_failed,
+                "nan_failed": self.nan_failed,
+                "failed": self.failed,
+                "blocks_held": self.blocks_held,
+                "tokens_served": self.tokens_served}
+
+    def __repr__(self):
+        return (f"TenantStats(blocks_held={self.blocks_held}, "
+                f"tokens_served={self.tokens_served}, "
+                f"sheds={self.sheds}, rejections={self.rejections}, "
+                f"quota_hits={self.quota_hits})")
 
 
 class SpecDecodeStats:

@@ -249,7 +249,8 @@ class SpeculativeEngine:
                  chunk_tokens: Optional[int] = None, seed: int = 0,
                  injector=None,
                  max_preemptions: Optional[int] = None,
-                 numeric_guard: Optional[bool] = None):
+                 numeric_guard: Optional[bool] = None,
+                 tenants: Optional[Dict[str, dict]] = None):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -267,7 +268,7 @@ class SpeculativeEngine:
             watermark_blocks=watermark_blocks,
             prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
             injector=injector, max_preemptions=max_preemptions,
-            numeric_guard=numeric_guard)
+            numeric_guard=numeric_guard, tenants=tenants)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
         self.finished: List[Tuple[int, int]] = []
@@ -303,22 +304,38 @@ class SpeculativeEngine:
     def submit(self, token_ids, *,
                max_preemptions: Optional[int] = None,
                deadline_steps: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tenant_id: Optional[str] = None) -> int:
         """Queue a token-ID prompt; admission (now or later) samples
         the first token on-device and prefills the draft cache. The
-        resilience knobs pass straight through to the wrapped
-        PagedServingEngine (see its ``submit``); terminal
-        RequestOutcomes surface in ``outcomes``."""
+        resilience and tenancy knobs pass straight through to the
+        wrapped PagedServingEngine (see its ``submit``); terminal
+        RequestOutcomes — including a health-based
+        ``REJECTED_ADMISSION`` — surface in ``outcomes``."""
         toks = [int(t) for t in np.asarray(token_ids).reshape(-1)]
         if not toks:
             raise ValueError("empty prompt")
         rid = self.engine.submit(self.target.embed(toks),
                                  max_preemptions=max_preemptions,
                                  deadline_steps=deadline_steps,
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s,
+                                 tenant_id=tenant_id)
         self._by_rid[rid] = _SpecSeq(rid, toks)
         self._handle_events()
         return rid
+
+    def set_tenant(self, tenant_id: str, **cfg):
+        """Register/reconfigure a tenant on the wrapped engine (the
+        TARGET pool is the quota domain; the draft pool is fully
+        reservable by construction and carries attribution only)."""
+        return self.engine.set_tenant(tenant_id, **cfg)
+
+    @property
+    def tenant_stats(self):
+        return self.engine.tenant_stats
+
+    def tenant_report(self):
+        return self.engine.tenant_report()
 
     def tokens(self, rid: int) -> List[int]:
         """Full stream (prompt + generated) of a request."""
@@ -344,7 +361,7 @@ class SpeculativeEngine:
         else:
             for req in list(self.engine.queue):
                 if req.rid == rid:
-                    self.engine.queue.remove(req)
+                    self.engine._dequeue(req)
         self._handle_events()
 
     def _clear_draft_slot(self, slot: int) -> None:
@@ -463,6 +480,13 @@ class SpeculativeEngine:
         if len(consumed) > cap:
             raise ValueError("draft capacity exceeded")   # unreachable
         self._clear_draft_slot(slot)
+        # mirror the target slot's tenant onto the draft slot: the
+        # draft pool is not a quota domain (it is fully reservable by
+        # construction), but its OOM messages and charge audit then
+        # attribute draft pages to the right tenant too
+        req = self.engine._requests[slot]
+        if req is not None:
+            self.draft_cache.set_seq_tenant(slot, req.tenant)
         chunked_prefill(self.draft.core, self.draft_cache, slot,
                         self.draft.embed(consumed),
                         chunk_tokens=self.engine.chunk_tokens)

@@ -274,6 +274,132 @@ class TestEngineSnapshotRestore:
 
 
 # ---------------------------------------------------------------------
+# tenant state round trips (multi-tenant isolation, PR 7)
+# ---------------------------------------------------------------------
+
+class TestTenantSnapshotRestore:
+    def _engine(self, model, **kw):
+        base = dict(max_batch=3, block_size=4, num_blocks=40,
+                    max_blocks_per_seq=10,
+                    tenants={"a": {"quota_blocks": 8, "weight": 2.0},
+                             "b": {"reserved_blocks": 6}})
+        base.update(kw)
+        return PagedServingEngine(model, **base)
+
+    def test_quotas_weights_stats_queue_order_survive_restore(self):
+        """Satellite: tenant configs, WFQ virtual times, per-tenant
+        stats, per-tenant block charges and the queue order all
+        round-trip snapshot()/restore(), and the restored engine
+        ADMITS identically (the WFQ state is scheduler state)."""
+        model = _model()
+        rng = np.random.RandomState(41)
+        eng = self._engine(model)
+        # fill the 3 slots and build a mixed queue behind them
+        for t in ("a", "b", None):
+            eng.submit(paddle.to_tensor(
+                rng.randn(8, D).astype(np.float32)), tenant_id=t)
+        queued = [eng.submit(paddle.to_tensor(
+            rng.randn(6, D).astype(np.float32)), tenant_id=t)
+            for t in ("b", "a", "b", None)]
+        x = np.zeros((3, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        for _ in range(3):
+            eng.step(paddle.to_tensor(x))
+        eng.check_invariants()
+
+        out = PagedServingEngine.restore(model, eng.snapshot())
+        assert list(out.tenants) == list(eng.tenants)
+        for tid in eng.tenants:
+            a, b = eng.tenants[tid], out.tenants[tid]
+            assert (a.quota_blocks, a.reserved_blocks, a.weight,
+                    a.vtime) == (b.quota_blocks, b.reserved_blocks,
+                                 b.weight, b.vtime)
+            assert a.stats.as_dict() == b.stats.as_dict()
+            assert eng.cache.tenant_charge(tid) == \
+                out.cache.tenant_charge(tid)
+        assert out._vclock == eng._vclock
+        assert [r.rid for r in out.queue] == [r.rid for r in eng.queue]
+        assert [r.tenant for r in out.queue] == \
+            [r.tenant for r in eng.queue]
+        out.check_invariants()
+        # both engines must now run the SAME weighted-fair admission
+        # sequence as slots free up
+        for e in (eng, out):
+            e.release(0)
+            e.release(1)
+        assert [(r, s) for r, s, _ in eng.admitted] == \
+            [(r, s) for r, s, _ in out.admitted]
+
+    def test_pre_tenant_snapshot_version_gates(self):
+        """A PR 6-era snapshot (no tenants key, no per-request tenant,
+        no seq_tenant in the pool) restores onto the implicit default
+        tenant instead of crashing — and the charge audit holds."""
+        from paddle_tpu.inference import DEFAULT_TENANT
+        model = _model()
+        rng = np.random.RandomState(42)
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=24, max_blocks_per_seq=6)
+        eng.submit(paddle.to_tensor(rng.randn(7, D).astype(np.float32)))
+        eng.submit(paddle.to_tensor(rng.randn(9, D).astype(np.float32)))
+        snap = eng.snapshot()
+        # strip every tenant-era field, as a pre-PR-7 build wrote it
+        del snap["tenants"]
+        del snap["vclock"]
+        for rec in snap["requests"]:
+            del rec["tenant"]
+        del snap["cache"]["seq_tenant"]
+        out = PagedServingEngine.restore(model, snap)
+        assert list(out.tenants) == [DEFAULT_TENANT]
+        held = out.cache.tenant_charge(DEFAULT_TENANT)
+        assert held == out.cache.blocks_in_use > 0
+        out.check_invariants()
+
+    def test_set_tenant_journaled_and_replayed(self, tmp_path):
+        """Runtime set_tenant calls ride the journal: a crash after a
+        mid-run reconfiguration replays it, so the recovered engine
+        enforces the NEW quota (snapshot_every=0 forces the whole
+        journal through replay)."""
+        tsm = _tsm()
+        jp, sp = _paths(tmp_path)
+        rng = np.random.default_rng(43)
+        inj = CrashInjector(crash_at={4: "post_journal"})
+        srv = _server(tsm, None, jp, sp, injector=inj,
+                      snapshot_every=0, max_batch=2)
+        srv.set_tenant("t", quota_blocks=4, weight=2.0)
+        r0 = srv.submit(list(rng.integers(0, VOCAB, 6)),
+                        tenant_id="t")
+        crashes = 0
+        for _ in range(10):
+            try:
+                srv.step()
+            except EngineCrash:
+                crashes += 1
+                srv = RecoverableServer.recover(
+                    tsm, None, journal_path=jp, snapshot_path=sp,
+                    injector=inj)
+                srv.check_invariants()
+        assert crashes == 1
+        ten = srv.engine.engine.tenants["t"]
+        assert ten.quota_blocks == 4 and ten.weight == 2.0
+        kinds = [k for _, k, _ in read_journal(jp)]
+        assert "set_tenant" in kinds
+        # and a rejection against the replayed quota is delivered
+        # exactly once across a second recovery
+        big = list(rng.integers(0, VOCAB, 30))     # 8 blocks > 4
+        rej = srv.submit(big, tenant_id="t")
+        delivered = [oc for oc in srv.drain_outcomes()
+                     if oc.rid == rej]
+        assert len(delivered) == 1
+        assert delivered[0].status == RequestOutcome.REJECTED_ADMISSION
+        srv.step()      # journals the drain record
+        srv2 = RecoverableServer.recover(tsm, None, journal_path=jp,
+                                         snapshot_path=sp)
+        assert all(oc.rid != rej for oc in srv2.drain_outcomes())
+
+
+# ---------------------------------------------------------------------
 # recoverable server: exactly-once outcomes, pool rehoming
 # ---------------------------------------------------------------------
 

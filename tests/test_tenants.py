@@ -1,0 +1,947 @@
+"""Multi-tenant isolation (the tenant layer in scheduler.py +
+per-tenant block accounting in paged_cache.py): per-tenant quotas with
+tenant-aware preemption/shedding, reserved floors, weighted fair
+admission, and health-based REJECTED_ADMISSION outcomes.
+
+The acceptance bar is the NOISY-NEIGHBOR STORM: one tenant floods
+prompts and is fed PR 5 injector faults while two well-behaved tenants
+serve — no exception escapes, the victims' token streams are
+BIT-IDENTICAL to a solo (no-flooder) run, the flooder is contained to
+its quota (audited against the allocator's ground truth after every
+step), and every failure is attributed to the flooder's tenant. The
+scenario composes with prefix caching, speculative serving, and
+RecoverableServer crash/restore."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (DEFAULT_TENANT, CrashInjector,
+                                  EngineCrash, FaultInjector,
+                                  PagedKVCache, PagedServingEngine,
+                                  RecoverableServer, RequestOutcome,
+                                  SpeculativeEngine, Tenant,
+                                  TenantStats, TokenServingModel)
+
+pytestmark = pytest.mark.tenants
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+VOCAB = 50
+
+_RNG = np.random.RandomState(1234)
+_W_OUT = _RNG.randn(D, VOCAB).astype(np.float32)
+_EMBED = _RNG.randn(VOCAB, D).astype(np.float32)
+
+
+def _model():
+    paddle.seed(0)
+    return FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+
+
+def _prompt(rng, n):
+    return np.asarray(rng.randn(n, D), np.float32)
+
+
+def _tok_of(hidden_row) -> int:
+    return int(np.argmax(np.asarray(hidden_row) @ _W_OUT))
+
+
+def _drain(eng, active, pending, streams, outcomes, removed):
+    for rid in eng.preempted:
+        removed.add(rid)
+        active.pop(rid, None)
+    eng.preempted.clear()
+    for oc in eng.outcomes:
+        outcomes[oc.rid] = oc
+        if oc.failed:
+            removed.add(oc.rid)
+            active.pop(oc.rid, None)
+    eng.outcomes.clear()
+    for rid, _slot, _n in eng.finished:
+        removed.add(rid)
+        active.pop(rid, None)
+    eng.finished.clear()
+    for rid, slot, h in eng.admitted:
+        tok = _tok_of(np.asarray(h.numpy())[0])
+        if rid in streams:
+            assert tok == pending[rid], \
+                "re-prefill replay diverged from the recorded stream"
+        else:
+            streams[rid] = [tok]
+            pending[rid] = tok
+        active[rid] = slot
+    eng.admitted.clear()
+
+
+def _drive(model, work, targets, *, injector=None, audit=False,
+           max_steps=400, **eng_kw):
+    """Greedy token-serving loop with per-request tenants. ``work`` is
+    [(prompt, tenant_id)], ``targets`` {index: n_gen or None} — None
+    means 'serve until shed/steps run out' (flooder traffic). Stops
+    when every TARGETED request finished or failed. Returns (streams
+    {rid: tokens}, outcomes, rids, engine)."""
+    eng = PagedServingEngine(model, injector=injector, **eng_kw)
+    rids = [eng.submit(paddle.to_tensor(p), tenant_id=t)
+            for p, t in work]
+    watched = {rids[i]: n for i, n in targets.items() if n is not None}
+    streams, pending, outcomes = {}, {}, {}
+    active, done = {}, set()
+    B = eng.max_batch
+    for _ in range(max_steps):
+        removed = set()
+        _drain(eng, active, pending, streams, outcomes, removed)
+        live = [r for r in watched if r not in done
+                and not (r in outcomes and outcomes[r].failed)]
+        if not live:
+            break
+        x = np.zeros((B, 1, D), np.float32)
+        for rid, slot in active.items():
+            x[slot, 0] = _EMBED[pending[rid]]
+        prev = dict(active)
+        removed = set()
+        out = eng.step(paddle.to_tensor(x))
+        if audit:
+            eng.check_invariants()
+        _drain(eng, active, pending, streams, outcomes, removed)
+        if out is None:
+            continue
+        o = np.asarray(out.numpy())
+        for rid, slot in prev.items():
+            if rid in removed or active.get(rid) != slot:
+                continue
+            tok = _tok_of(o[slot, 0])
+            streams[rid].append(tok)
+            pending[rid] = tok
+            if rid in watched and len(streams[rid]) >= watched[rid]:
+                eng.release(slot)
+                active.pop(rid)
+                done.add(rid)
+    else:
+        raise AssertionError("tenant driver did not converge")
+    return streams, outcomes, rids, eng
+
+
+# ---------------------------------------------------------------------
+# charge policy: one charge per block-table reference
+# ---------------------------------------------------------------------
+
+class TestChargePolicy:
+    def _cache(self):
+        return PagedKVCache(LAYERS, HEADS, D // HEADS, block_size=8,
+                            num_blocks=12, max_seqs=3,
+                            max_blocks_per_seq=4, prefix_cache=True)
+
+    def test_per_reference_charging_is_neighbor_independent(self):
+        """A shared block charges EVERY sharer one reference — and a
+        sharer leaving changes nothing for the one who stays (the
+        isolation property fractional or owner-pays charging would
+        break: your bill must never move because of a neighbor)."""
+        cache = self._cache()
+        cache.set_seq_tenant(0, "a")
+        cache.ensure(0, 16)                     # 2 blocks to tenant a
+        assert cache.tenant_charge("a") == 2
+        cache.set_seq_tenant(1, "b")
+        cache.fork(0, 1, 16)                    # b shares both blocks
+        assert cache.tenant_charge("a") == 2    # unchanged by the fork
+        assert cache.tenant_charge("b") == 2    # full charge per ref
+        cache.free_seq(0)                       # a leaves the share
+        assert cache.tenant_charge("a") == 0
+        assert cache.tenant_charge("b") == 2    # b's bill did not move
+        assert cache.tenant_blocks_held() == {"b": 2}
+        cache.check_invariants()
+
+    def test_truncate_and_quarantine_move_charge(self):
+        cache = self._cache()
+        cache.set_seq_tenant(0, "a")
+        cache.ensure(0, 32)                     # 4 blocks
+        assert cache.tenant_charge("a") == 4
+        cache.truncate(0, 10)                   # back to 2 blocks
+        assert cache.tenant_charge("a") == 2
+        cache.quarantine_seq(0)
+        assert cache.tenant_charge("a") == 0
+        assert cache.seq_tenant[0] is None      # attribution cleared
+        cache.check_invariants()
+
+    def test_set_seq_tenant_moves_existing_charge(self):
+        cache = self._cache()
+        cache.set_seq_tenant(0, "a")
+        cache.ensure(0, 8)
+        cache.set_seq_tenant(0, "b")
+        assert cache.tenant_charge("a") == 0
+        assert cache.tenant_charge("b") == 1
+        cache.check_invariants()
+
+    def test_audit_catches_corrupt_charge(self):
+        """The deep audit compares the incremental charge against the
+        tables' ground truth — a growth path that skipped the charge
+        update cannot survive it."""
+        cache = self._cache()
+        cache.set_seq_tenant(0, "a")
+        cache.ensure(0, 8)
+        cache._tenant_charge["a"] += 1          # corrupt the books
+        with pytest.raises(AssertionError, match="ground truth"):
+            cache.check_invariants()
+
+    def test_oom_message_names_the_hogging_tenant(self):
+        """Satellite: BlockOOM occupancy breakdown carries the
+        per-tenant blocks-held histogram."""
+        from paddle_tpu.inference import BlockOOM
+        cache = PagedKVCache(1, HEADS, D // HEADS, block_size=8,
+                             num_blocks=5, max_seqs=2,
+                             max_blocks_per_seq=4)
+        cache.set_seq_tenant(0, "hog")
+        cache.ensure(0, 24)
+        cache.set_seq_tenant(1, "victim")
+        cache.ensure(1, 8)
+        with pytest.raises(BlockOOM) as ei:
+            cache.ensure(1, 16)
+        msg = str(ei.value)
+        assert "blocks per tenant: {'hog': 3, 'victim': 1}" in msg
+        # and allocator misuse errors name the owning tenant
+        b = cache.seq_blocks[0][0]
+        with pytest.raises(ValueError, match=r"tenant\(s\) \['hog'\]"):
+            cache.allocator.ref([b])
+            cache.allocator.free([b])
+            cache.allocator.free([b])
+            cache.allocator.free([b])
+
+
+# ---------------------------------------------------------------------
+# tenant registry + health-based admission control
+# ---------------------------------------------------------------------
+
+class TestTenantRegistry:
+    def _engine(self, **kw):
+        base = dict(max_batch=2, block_size=4, num_blocks=20,
+                    max_blocks_per_seq=8)
+        base.update(kw)
+        return PagedServingEngine(_model(), **base)
+
+    def test_tenant_validation(self):
+        with pytest.raises(ValueError, match="weight"):
+            Tenant("t", weight=0)
+        with pytest.raises(ValueError, match="reserved_blocks"):
+            Tenant("t", quota_blocks=2, reserved_blocks=4)
+        eng = self._engine()
+        with pytest.raises(ValueError, match="unkeepable"):
+            eng.set_tenant("t", reserved_blocks=100)
+
+    def test_quota_below_current_charge_refused(self):
+        eng = self._engine()
+        rng = np.random.RandomState(0)
+        eng.submit(paddle.to_tensor(_prompt(rng, 8)), tenant_id="t")
+        held = eng.cache.tenant_charge("t")
+        assert held > 0
+        with pytest.raises(ValueError, match="drain the tenant"):
+            eng.set_tenant("t", quota_blocks=held - 1)
+        eng.set_tenant("t", quota_blocks=held)      # exactly: fine
+
+    def test_unknown_tenant_auto_registers_unlimited(self):
+        eng = self._engine()
+        rng = np.random.RandomState(0)
+        eng.submit(paddle.to_tensor(_prompt(rng, 4)), tenant_id="new")
+        assert "new" in eng.tenants
+        assert eng.tenants["new"].quota_blocks is None
+        assert isinstance(eng.tenant_stats["new"], TenantStats)
+
+
+class TestHealthAdmission:
+    def _engine(self, **kw):
+        base = dict(max_batch=2, block_size=4, num_blocks=16,
+                    max_blocks_per_seq=12)
+        base.update(kw)
+        return PagedServingEngine(_model(), **base)
+
+    def test_quota_impossible_prompt_rejected_not_queued(self):
+        eng = self._engine(tenants={"t": {"quota_blocks": 3}})
+        rng = np.random.RandomState(0)
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 20)),
+                         tenant_id="t")          # needs 5 > quota 3
+        (oc,) = eng.outcomes
+        assert oc.rid == rid
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        assert "quota" in oc.reason
+        assert not eng.queue and eng.num_active == 0
+        assert eng.resilience_stats.rejected == 1
+        assert eng.tenant_stats["t"].rejections == 1
+        # a servable prompt from the same tenant still admits
+        eng.outcomes.clear()
+        eng.submit(paddle.to_tensor(_prompt(rng, 8)), tenant_id="t")
+        assert len(eng.admitted) == 1 and not eng.outcomes
+
+    def test_floor_locked_pool_rejects_oversized_prompt(self):
+        """Other tenants' reserved floors permanently shrink what this
+        tenant can ever hold: a prompt past that is rejected up
+        front."""
+        eng = self._engine(tenants={"vip": {"reserved_blocks": 10}})
+        rng = np.random.RandomState(0)
+        # pool 15 usable, 10 reserved for vip -> 5 ever available
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 24)),
+                         tenant_id="other")      # needs 6 > 5
+        (oc,) = eng.outcomes
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        assert "reserved floors" in oc.reason
+        # vip itself may use the whole pool
+        eng.outcomes.clear()
+        eng.submit(paddle.to_tensor(_prompt(rng, 24)),
+                   tenant_id="vip")
+        assert len(eng.admitted) == 1 and not eng.outcomes
+
+    def test_deadline_below_prefill_floor_rejected(self):
+        eng = self._engine(prefill_token_budget=4, chunk_tokens=4)
+        rng = np.random.RandomState(0)
+        # 30-token prompt at 4(+1)-token steps: >= 6 steps of prefill
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 30)),
+                         deadline_steps=3)
+        (oc,) = eng.outcomes
+        assert oc.rid == rid
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        assert "cannot be met" in oc.reason
+        # the same prompt with a feasible deadline queues normally
+        eng.outcomes.clear()
+        eng.submit(paddle.to_tensor(_prompt(rng, 30)),
+                   deadline_steps=30)
+        assert not eng.outcomes
+        assert eng.num_prefilling == 1
+
+    def test_block_boundary_prompt_counts_first_decode_block(self):
+        """Regression: health covers the prompt PLUS the first decode
+        token's page, exactly like the admission gate. A
+        block-multiple prompt at the quota boundary used to pass
+        health (blocks_needed(T) == quota) and then hit the admission
+        quota gate (blocks_needed(T+1) > quota) on every pass —
+        queued unservable forever, the exact class
+        REJECTED_ADMISSION exists to prevent."""
+        eng = self._engine(tenants={"t": {"quota_blocks": 4}})
+        rng = np.random.RandomState(3)
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 16)),
+                         tenant_id="t")      # 4 blocks + decode = 5
+        (oc,) = eng.outcomes
+        assert oc.rid == rid
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        assert not eng.queue
+        assert eng.tenant_stats["t"].quota_hits == 0
+
+    def test_block_boundary_prompt_cannot_stall_the_pool_queue(self):
+        """The same off-by-one on the pool side used to queue a
+        prompt whose first decode block can never fit, turning it
+        into PERMANENT head-of-line pool pressure that stalled every
+        tenant behind it."""
+        eng = self._engine(num_blocks=6, max_blocks_per_seq=5,
+                           watermark_blocks=1)
+        rng = np.random.RandomState(3)
+        # 16 prompt tokens fit the 4 admittable blocks exactly — the
+        # first decode token's 5th block never can
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 16)))
+        (oc,) = eng.outcomes
+        assert oc.rid == rid
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        eng.outcomes.clear()
+        # the queue is NOT stalled: a servable request still admits
+        eng.submit(paddle.to_tensor(_prompt(rng, 8)))
+        assert len(eng.admitted) == 1 and not eng.outcomes
+
+    def test_floor_room_uses_full_reservation_not_current_unmet(self):
+        """Regression: the permanent pool bound subtracts other
+        tenants' FULL reserved floors. While the floor tenant holds
+        some blocks its unmet remainder is smaller than the
+        reservation — a health check built on that moment used to
+        queue a request that every admission pass then floor-skips
+        forever, since free - unmet can never exceed
+        usable - reserved."""
+        eng = self._engine(num_blocks=11, max_blocks_per_seq=8,
+                           tenants={"vip": {"reserved_blocks": 8}})
+        rng = np.random.RandomState(4)
+        eng.submit(paddle.to_tensor(_prompt(rng, 8)), tenant_id="vip")
+        assert len(eng.admitted) == 1   # vip holds 3, unmet floor 5
+        eng.admitted.clear()
+        rid = eng.submit(paddle.to_tensor(_prompt(rng, 12)),
+                         tenant_id="b")  # 4 blocks > 10 - 8 = 2 ever
+        (oc,) = eng.outcomes
+        assert oc.rid == rid
+        assert oc.status == RequestOutcome.REJECTED_ADMISSION
+        assert "reserved floors" in oc.reason
+        assert not eng.queue
+
+    def test_rejection_never_raises_and_is_deterministic(self):
+        """Same submissions -> same rejections, and the rid sequence
+        still advances (journal replay relies on both)."""
+        def run():
+            eng = self._engine(tenants={"t": {"quota_blocks": 2}})
+            rng = np.random.RandomState(7)
+            out = []
+            for n in (20, 6, 20, 8):
+                rid = eng.submit(paddle.to_tensor(_prompt(rng, n)),
+                                 tenant_id="t")
+                out.append((rid, [
+                    (oc.rid, oc.status) for oc in eng.outcomes]))
+            return out
+        assert run() == run()
+
+
+# ---------------------------------------------------------------------
+# weighted fair admission
+# ---------------------------------------------------------------------
+
+class TestWeightedFairAdmission:
+    def test_two_to_one_weighting(self):
+        """Weight-2 tenant admits twice per weight-1 admission under
+        contention, age-fair within each tenant."""
+        eng = PagedServingEngine(_model(), max_batch=1, block_size=4,
+                                 num_blocks=30, max_blocks_per_seq=4,
+                                 tenants={"a": {"weight": 2.0},
+                                          "b": {"weight": 1.0}})
+        rng = np.random.RandomState(0)
+        rids = {}
+        for i in range(6):
+            rids[eng.submit(paddle.to_tensor(_prompt(rng, 4)),
+                            tenant_id="a")] = "a"
+        for i in range(3):
+            rids[eng.submit(paddle.to_tensor(_prompt(rng, 4)),
+                            tenant_id="b")] = "b"
+        order = []
+        for _ in range(9):
+            (rid, slot, _h), = eng.admitted
+            eng.admitted.clear()
+            order.append(rid)
+            eng.release(slot)
+        tenants_order = [rids[r] for r in order]
+        assert tenants_order.count("a") == 6
+        assert tenants_order.count("b") == 3
+        # 2:1 interleave, not a 6-then-3 starvation burst: every
+        # prefix of the order holds at most 2 more a's than 2x b's
+        for i in range(1, 10):
+            a = tenants_order[:i].count("a")
+            b = tenants_order[:i].count("b")
+            assert a <= 2 * (b + 1), f"burst at prefix {i}: {tenants_order}"
+        # age-fair within each tenant: rids ascend per tenant
+        for t in ("a", "b"):
+            own = [r for r in order if rids[r] == t]
+            assert own == sorted(own)
+
+    def test_single_tenant_is_fifo(self):
+        """Backward compatibility: one (default) tenant admits in
+        exact submission order — WFQ over one tenant IS the old
+        FIFO."""
+        eng = PagedServingEngine(_model(), max_batch=1, block_size=4,
+                                 num_blocks=30, max_blocks_per_seq=4)
+        rng = np.random.RandomState(0)
+        rids = [eng.submit(paddle.to_tensor(_prompt(rng, 4)))
+                for _ in range(5)]
+        order = []
+        for _ in range(5):
+            (rid, slot, _h), = eng.admitted
+            eng.admitted.clear()
+            order.append(rid)
+            eng.release(slot)
+        assert order == rids
+
+    def test_quota_blocked_tenant_does_not_block_neighbors(self):
+        """A tenant head-of-line blocked by its OWN quota is skipped;
+        the neighbor behind it admits the same pass."""
+        eng = PagedServingEngine(_model(), max_batch=2, block_size=4,
+                                 num_blocks=30, max_blocks_per_seq=6,
+                                 tenants={"capped": {"quota_blocks": 4}})
+        rng = np.random.RandomState(0)
+        r1 = eng.submit(paddle.to_tensor(_prompt(rng, 12)),
+                        tenant_id="capped")      # 4 blocks: at quota
+        eng.admitted.clear()
+        r2 = eng.submit(paddle.to_tensor(_prompt(rng, 12)),
+                        tenant_id="capped")      # quota-blocked
+        assert not eng.admitted
+        r3 = eng.submit(paddle.to_tensor(_prompt(rng, 8)),
+                        tenant_id="free")        # must NOT wait on r2
+        (rid, _s, _h), = eng.admitted
+        assert rid == r3
+        assert [r.rid for r in eng.queue] == [r2]
+        assert eng.tenant_stats["capped"].quota_hits >= 1
+
+    def test_idle_tenant_cannot_hoard_credit(self):
+        """A tenant enqueueing from idle starts at the virtual clock:
+        sitting out does not bank admission credit for a later
+        burst."""
+        eng = PagedServingEngine(_model(), max_batch=1, block_size=4,
+                                 num_blocks=40, max_blocks_per_seq=4,
+                                 tenants={"a": {}, "b": {}})
+        rng = np.random.RandomState(0)
+        # a admits 4 times while b idles
+        for _ in range(4):
+            eng.submit(paddle.to_tensor(_prompt(rng, 4)),
+                       tenant_id="a")
+            (rid, slot, _h), = eng.admitted
+            eng.admitted.clear()
+            eng.release(slot)
+        assert eng.tenants["a"].vtime == 4.0
+        # b wakes up: its vtime bumps to the clock, so it alternates
+        # with a rather than draining a 4-admission burst
+        ra = [eng.submit(paddle.to_tensor(_prompt(rng, 4)),
+                         tenant_id="a") for _ in range(2)]
+        rb = [eng.submit(paddle.to_tensor(_prompt(rng, 4)),
+                         tenant_id="b") for _ in range(2)]
+        order = []
+        for _ in range(4):
+            (rid, slot, _h), = eng.admitted
+            eng.admitted.clear()
+            order.append(rid)
+            eng.release(slot)
+        assert order != rb + ra, "idle tenant drained a hoarded burst"
+        assert set(order[:2]) != set(rb), \
+            f"burst: {order} vs b={rb}"
+
+
+# ---------------------------------------------------------------------
+# quota containment + floors: tenant-aware victim selection
+# ---------------------------------------------------------------------
+
+class TestQuotaContainment:
+    def test_quota_hit_preempts_own_youngest_never_neighbor(self):
+        model = _model()
+        rng = np.random.RandomState(3)
+        eng = PagedServingEngine(model, max_batch=3, block_size=4,
+                                 num_blocks=40, max_blocks_per_seq=8,
+                                 tenants={"t": {"quota_blocks": 5}})
+        # two requests of t (2 blocks each) + one neighbor
+        r_old = eng.submit(paddle.to_tensor(_prompt(rng, 8)),
+                           tenant_id="t")
+        r_new = eng.submit(paddle.to_tensor(_prompt(rng, 8)),
+                           tenant_id="t")
+        r_n = eng.submit(paddle.to_tensor(_prompt(rng, 8)),
+                         tenant_id="n")
+        x = np.zeros((3, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        # decode until t needs a 5th then 6th block: the 6th trips the
+        # quota and must evict t's YOUNGEST (r_new), not the neighbor
+        preempted = []
+        for _ in range(10):
+            out = eng.step(paddle.to_tensor(x))
+            eng.check_invariants()
+            preempted += eng.preempted
+            eng.preempted.clear()
+            if out is not None:
+                x = np.asarray(out.numpy())[:, :1].copy()
+            if preempted:
+                break
+        assert preempted == [r_new]
+        assert eng.tenant_stats["t"].quota_hits >= 1
+        assert eng.cache.tenant_charge("t") <= 5
+        # neighbor untouched, still active
+        assert any(r is not None and r.rid == r_n
+                   for r in eng._requests)
+
+    def test_sole_request_quota_hit_sheds_with_named_reason(self):
+        model = _model()
+        rng = np.random.RandomState(4)
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=40, max_blocks_per_seq=8,
+                                 tenants={"t": {"quota_blocks": 3}})
+        rt = eng.submit(paddle.to_tensor(_prompt(rng, 10)),
+                        tenant_id="t")           # 3 blocks: at quota
+        rn = eng.submit(paddle.to_tensor(_prompt(rng, 10)),
+                        tenant_id="n")
+        x = np.zeros((2, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        shed = None
+        for _ in range(6):
+            out = eng.step(paddle.to_tensor(x))
+            eng.check_invariants()
+            for oc in eng.outcomes:
+                if oc.failed:
+                    shed = oc
+            eng.outcomes.clear()
+            if shed:
+                break
+            if out is not None:
+                x = np.asarray(out.numpy())[:, :1].copy()
+        assert shed is not None and shed.rid == rt
+        assert shed.status == RequestOutcome.FAILED_OOM
+        assert "quota" in shed.reason and "'t'" in shed.reason
+        assert eng.tenant_stats["t"].sheds == 1
+        assert eng.tenant_stats["n"].sheds == 0
+
+
+class TestReservedFloor:
+    def test_floor_tenant_admits_through_a_full_pool(self):
+        """A hog cannot eat into another tenant's unmet reserved
+        floor: the floor tenant's request admits while the hog waits
+        (skipped, not head-of-line blocking)."""
+        model = _model()
+        rng = np.random.RandomState(5)
+        eng = PagedServingEngine(model, max_batch=3, block_size=4,
+                                 num_blocks=13, max_blocks_per_seq=8,
+                                 tenants={"vip": {"reserved_blocks": 6}})
+        # 12 usable, 6 reserved for vip -> the hog can hold 6
+        h1 = eng.submit(paddle.to_tensor(_prompt(rng, 20)),
+                        tenant_id="hog")         # 5 blocks + headroom
+        assert len(eng.admitted) == 1
+        eng.admitted.clear()
+        h2 = eng.submit(paddle.to_tensor(_prompt(rng, 20)),
+                        tenant_id="hog")         # would dip the floor
+        assert not eng.admitted                  # hog waits...
+        v = eng.submit(paddle.to_tensor(_prompt(rng, 20)),
+                       tenant_id="vip")          # ...vip does not
+        (rid, _s, _h), = eng.admitted
+        assert rid == v
+        eng.admitted.clear()
+        assert [r.rid for r in eng.queue] == [h2]
+        eng.check_invariants()
+
+    def test_hog_growth_self_evicts_instead_of_dipping_floor(self):
+        """An over-floor tenant's GROWTH may not take reserved
+        headroom either: with no same-tenant peer it self-evicts and
+        waits queued (floor pressure is transient, not a shed)."""
+        model = _model()
+        rng = np.random.RandomState(6)
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=11, max_blocks_per_seq=10,
+                                 tenants={"vip": {"reserved_blocks": 4}})
+        # 10 usable, 4 reserved -> hog may hold 6
+        rh = eng.submit(paddle.to_tensor(_prompt(rng, 22)),
+                        tenant_id="hog")         # 6 blocks at 23 tok
+        (_, slot, h), = eng.admitted
+        eng.admitted.clear()
+        x = np.zeros((2, 1, D), np.float32)
+        x[slot, 0] = np.asarray(h.numpy())[0]
+        preempted = []
+        for _ in range(6):
+            out = eng.step(paddle.to_tensor(x))
+            eng.check_invariants()
+            preempted += eng.preempted
+            eng.preempted.clear()
+            if preempted:
+                break
+            if out is not None:
+                x = np.asarray(out.numpy())[:, :1].copy()
+        # growth to the 7th block would leave free < unmet floor (4):
+        # the hog was preempted, nothing was shed, vip's floor intact
+        assert preempted == [rh]
+        assert not any(oc.failed for oc in eng.outcomes)
+        assert eng.free_blocks >= 4
+
+    def test_below_floor_growth_evicts_sole_borrower_not_itself(self):
+        """Regression: ONE over-floor borrower is still a victim. A
+        below-floor tenant's growth OOM with exactly one borrower
+        slot used to shed the GROWER ('<= 1 candidates' misread as
+        'nobody left but me'), handing FAILED_OOM to the very tenant
+        the floor guarantee protects."""
+        model = _model()
+        rng = np.random.RandomState(8)
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=13, max_blocks_per_seq=10)
+        # the borrower fills 10 of the 12 usable blocks in ONE slot
+        rh = eng.submit(paddle.to_tensor(_prompt(rng, 37)),
+                        tenant_id="hog")
+        (_, hslot, hh), = eng.admitted
+        eng.admitted.clear()
+        # the floor arrives AFTER the hog loaded up (a floor granted
+        # up front would have capped its admission instead)
+        eng.set_tenant("vip", reserved_blocks=6)
+        rv = eng.submit(paddle.to_tensor(_prompt(rng, 6)),
+                        tenant_id="vip")     # 2 blocks -> free == 0
+        (vrid, vslot, vh), = eng.admitted
+        assert vrid == rv
+        eng.admitted.clear()
+        x = np.zeros((2, 1, D), np.float32)
+        x[hslot, 0] = np.asarray(hh.numpy())[0]
+        x[vslot, 0] = np.asarray(vh.numpy())[0]
+        preempted = []
+        for _ in range(3):
+            out = eng.step(paddle.to_tensor(x))
+            eng.check_invariants()
+            preempted += eng.preempted
+            eng.preempted.clear()
+            if preempted:
+                break
+            x = np.asarray(out.numpy())[:, :1].copy()
+        # vip's below-floor growth evicted the borrower, and vip —
+        # never failed — got the block the floor entitles it to
+        assert preempted == [rh]
+        assert not any(oc.failed for oc in eng.outcomes)
+        assert eng.active[vslot]
+        assert eng.cache.tenant_charge("vip") == 3
+
+
+# ---------------------------------------------------------------------
+# default-tenant backward compatibility (satellite)
+# ---------------------------------------------------------------------
+
+class TestDefaultTenantBackcompat:
+    def _run(self, tenant_id):
+        model = _model()
+        rng = np.random.RandomState(9)
+        prompts = [(_prompt(rng, 9), tenant_id),
+                   (_prompt(rng, 11), tenant_id)]
+        streams, outcomes, rids, eng = _drive(
+            model, prompts, {0: 10, 1: 10}, max_batch=2, block_size=4,
+            num_blocks=30, max_blocks_per_seq=10)
+        return streams, outcomes, eng
+
+    def test_no_tenant_id_is_one_implicit_unlimited_tenant(self):
+        """Satellite: the submit path without tenant_id maps to ONE
+        implicit tenant with an unlimited quota, and produces
+        bit-identical streams and identical stats to the same run
+        naming the default tenant explicitly — the tenant layer is
+        invisible until opted into."""
+        s_none, oc_none, eng = self._run(None)
+        assert list(eng.tenants) == [DEFAULT_TENANT]
+        ten = eng.tenants[DEFAULT_TENANT]
+        assert ten.quota_blocks is None
+        assert ten.reserved_blocks == 0 and ten.weight == 1.0
+        s_expl, oc_expl, eng2 = self._run(DEFAULT_TENANT)
+        assert s_none == s_expl
+        assert {r: oc.status for r, oc in oc_none.items()} == \
+            {r: oc.status for r, oc in oc_expl.items()}
+        assert eng.resilience_stats.as_dict() == \
+            eng2.resilience_stats.as_dict()
+        assert eng.tenant_stats[DEFAULT_TENANT].as_dict() == \
+            eng2.tenant_stats[DEFAULT_TENANT].as_dict()
+        # and no failure counters moved at all
+        assert eng.resilience_stats.failed == 0
+
+
+# ---------------------------------------------------------------------
+# THE ACCEPTANCE: seeded noisy-neighbor storm. One tenant floods
+# prompts and eats injected faults; two well-behaved tenants must
+# stream BIT-IDENTICALLY to a solo (no-flooder) run, with the flooder
+# contained to its quota and every failure attributed to it.
+# ---------------------------------------------------------------------
+
+class TestNoisyNeighborStorm:
+    # 22 generated + 10 prompt tokens = exactly the victims' 8-block
+    # floors, and long enough that the flooder's third incarnation
+    # reaches its quota shed while the victims still serve
+    N_GEN = 22
+
+    def _victims(self):
+        rng = np.random.RandomState(21)
+        return [(_prompt(rng, 10), "v1"), (_prompt(rng, 10), "v2")]
+
+    def _flood(self, n=5):
+        rng = np.random.RandomState(22)
+        return [(_prompt(rng, 12), "flood") for _ in range(n)]
+
+    def _kw(self, prefix=False):
+        # victims need 8 blocks each (10-token prompt + 22 generated
+        # over 4-token pages) — floors of 8 make their whole lifetime
+        # reserved; the flooder's quota of 6 caps it at 24 held
+        # tokens, so it churns against ITS cap forever
+        return dict(max_batch=4, block_size=4, num_blocks=40,
+                    max_blocks_per_seq=10, prefix_cache=prefix,
+                    tenants={"v1": {"reserved_blocks": 8},
+                             "v2": {"reserved_blocks": 8},
+                             "flood": {"quota_blocks": 6}})
+
+    def _assert_contained(self, streams, solo, outcomes, rids, eng,
+                          flood_rids):
+        # victims' surviving streams BIT-IDENTICAL to the solo run
+        for i in (0, 1):
+            assert rids[i] in streams
+            oc = outcomes.get(rids[i])
+            assert oc is None or not oc.failed, \
+                f"victim {rids[i]} failed under the flood: {oc}"
+            assert streams[rids[i]] == solo[i], \
+                f"victim {rids[i]} stream diverged under the flood"
+        # every failure belongs to the flooder's tenant
+        for rid, oc in outcomes.items():
+            if oc.failed:
+                assert rid in flood_rids, \
+                    f"non-flood request {rid} failed: {oc}"
+        ts = eng.tenant_stats
+        assert ts["v1"].failed == 0 and ts["v2"].failed == 0
+        assert ts["flood"].failed >= 3
+        assert ts["flood"].quota_hits >= 1
+        # containment: the flooder never exceeded its quota (also
+        # audited after every step via check_invariants)
+        assert eng.cache.tenant_charge("flood") <= 6
+        # attribution gauges moved
+        assert ts["v1"].tokens_served > 0
+        assert ts["flood"].blocks_held <= 6
+
+    def test_noisy_neighbor_storm(self):
+        """ACCEPTANCE (plain + prefix_cache variants): flooding tenant
+        + injected whole-step OOMs and NaNs aimed at its steps; two
+        victim tenants bit-identical to their solo run; REJECTED /
+        shed outcomes correct and attributed; deep invariants
+        (including the quota-vs-allocator audit) after every step."""
+        model = _model()
+        victims = self._victims()
+        for prefix in (False, True):
+            kw = self._kw(prefix)
+            solo_streams, solo_oc, solo_rids, _ = _drive(
+                model, victims, {0: self.N_GEN, 1: self.N_GEN},
+                audit=True, **kw)
+            solo = [solo_streams[solo_rids[0]],
+                    solo_streams[solo_rids[1]]]
+            assert all(not oc.failed for oc in solo_oc.values())
+
+            # noisy run: victims first (slots 0/1), then the flood;
+            # the last flood prompt is quota-impossible (9 blocks > 6)
+            # and must be REJECTED at submit, not queued to rot
+            rng = np.random.RandomState(23)
+            work = victims + self._flood() + [(_prompt(rng, 34),
+                                              "flood")]
+            inj = FaultInjector(seed=21, oom_at=[4],
+                                nan_at={3: [2]})
+            streams, outcomes, rids, eng = _drive(
+                model, work, {0: self.N_GEN, 1: self.N_GEN},
+                injector=inj, audit=True, **kw)
+            flood_rids = set(rids[2:])
+            self._assert_contained(streams, solo, outcomes, rids, eng,
+                                   flood_rids)
+            # the injected faults really fired, at the flooder
+            assert inj.injected_oom >= 1
+            assert inj.injected_nan >= 1
+            assert eng.resilience_stats.nan_failed >= 1
+            nan_failed = [r for r, oc in outcomes.items()
+                          if oc.status == RequestOutcome.FAILED_NUMERIC]
+            assert nan_failed and set(nan_failed) <= flood_rids
+            # the health rejection fired exactly once, on the flooder
+            rejected = [r for r, oc in outcomes.items()
+                        if oc.status ==
+                        RequestOutcome.REJECTED_ADMISSION]
+            assert rejected == [rids[-1]]
+            assert eng.resilience_stats.rejected == 1
+            # quota sheds carry the tenant-naming reason
+            quota_sheds = [oc for oc in outcomes.values()
+                           if oc.status == RequestOutcome.FAILED_OOM
+                           and "quota" in oc.reason]
+            assert quota_sheds, "no quota shed fired"
+
+    @pytest.mark.spec
+    def test_noisy_neighbor_composes_with_speculative(self):
+        """ACCEPTANCE composition: the same containment through
+        SpeculativeEngine.step — victim token streams bit-identical
+        to the solo speculative run while a quota'd tenant floods."""
+        paddle.seed(0)
+        core = FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+        tsm = TokenServingModel(core, _EMBED)
+        rng = np.random.default_rng(24)
+        v_prompts = [list(rng.integers(0, VOCAB, 9)) for _ in range(2)]
+        f_prompts = [list(rng.integers(0, VOCAB, 9)) for _ in range(4)]
+
+        def run(flood):
+            e = SpeculativeEngine(
+                tsm, None, k=2, max_batch=3, block_size=1,
+                num_blocks=120, max_blocks_per_seq=40,
+                tenants={"v1": {"reserved_blocks": 25},
+                         "v2": {"reserved_blocks": 25},
+                         "flood": {"quota_blocks": 14}})
+            vids = [e.submit(p, tenant_id=t)
+                    for p, t in zip(v_prompts, ("v1", "v2"))]
+            if flood:
+                for p in f_prompts:
+                    e.submit(p, tenant_id="flood")
+            done = {}
+            for _ in range(200):
+                if all(r in done for r in vids):
+                    break
+                e.step()
+                e.check_invariants()
+                e.outcomes.clear()
+                for r in vids:
+                    if r not in done and len(e.generated(r)) >= 12:
+                        done[r] = e.generated(r)[:12]
+                        e.release(r)
+            else:
+                raise AssertionError("speculative tenant driver "
+                                     "stalled")
+            return [done[r] for r in vids], e
+
+        solo, _ = run(flood=False)
+        noisy, e = run(flood=True)
+        assert noisy == solo, \
+            "victim spec streams diverged under the flood"
+        ts = e.tenant_stats
+        assert ts["v1"].failed == 0 and ts["v2"].failed == 0
+        assert ts["flood"].quota_hits >= 1
+        assert e.engine.cache.tenant_charge("flood") <= 14
+
+    def test_noisy_neighbor_composes_with_crash_recovery(self, tmp_path):
+        """ACCEPTANCE composition: the storm through
+        RecoverableServer + CrashInjector — victims bit-identical to
+        the uninterrupted multi-tenant run across crash/restore, the
+        flooder's REJECTED_ADMISSION delivered exactly once, and deep
+        invariants after every restore."""
+        tsm = TokenServingModel(_model(), _EMBED)
+        rng = np.random.default_rng(25)
+        v_prompts = [list(rng.integers(0, VOCAB, 8)) for _ in range(2)]
+        f_prompts = [list(rng.integers(0, VOCAB, 12)) for _ in range(3)]
+        big = list(rng.integers(0, VOCAB, 34))    # 9 blocks > quota 6
+        TEN = {"v1": {"reserved_blocks": 8},
+               "v2": {"reserved_blocks": 8},
+               "flood": {"quota_blocks": 6}}
+        N = 12
+
+        def submit_all(srv_or_eng):
+            vids = [srv_or_eng.submit(p, tenant_id=t)
+                    for p, t in zip(v_prompts, ("v1", "v2"))]
+            fids = [srv_or_eng.submit(p, tenant_id="flood")
+                    for p in f_prompts]
+            rej = srv_or_eng.submit(big, tenant_id="flood")
+            return vids, fids, rej
+
+        # uninterrupted reference: bare engine, same workload
+        ref = SpeculativeEngine(tsm, None, k=0, max_batch=4,
+                                block_size=4, num_blocks=40,
+                                max_blocks_per_seq=10, tenants=TEN)
+        vids, _, rej = submit_all(ref)
+        base = {}
+        for _ in range(60):
+            ref.step()
+            for r in vids:
+                if r not in base and len(ref.generated(r)) >= N:
+                    base[r] = ref.generated(r)[:N]
+            if all(r in base for r in vids):
+                break
+        assert all(r in base for r in vids)
+        (oc_rej,) = [oc for oc in ref.outcomes
+                     if oc.status == RequestOutcome.REJECTED_ADMISSION]
+        assert oc_rej.rid == rej
+
+        # crash-storm run through the recoverable server
+        jp, sp = str(tmp_path / "req.wal"), str(tmp_path / "s.ckpt")
+        inj = CrashInjector.storm(25, 12, crashes=3)
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=4,
+                                block_size=4, num_blocks=40,
+                                max_blocks_per_seq=10, tenants=TEN,
+                                injector=inj)
+        srv = RecoverableServer(eng, journal_path=jp, snapshot_path=sp,
+                                snapshot_every=2)
+        vids2, _, rej2 = submit_all(srv)
+        delivered = []
+        done = {}
+        for _ in range(120):
+            if all(r in done for r in vids2):
+                break
+            try:
+                srv.step()
+                delivered += srv.drain_outcomes()
+                for r in vids2:
+                    if r not in done and len(srv.generated(r)) >= N:
+                        done[r] = srv.generated(r)[:N]
+            except EngineCrash:
+                srv = RecoverableServer.recover(
+                    tsm, None, journal_path=jp, snapshot_path=sp,
+                    injector=inj)
+                srv.check_invariants()
+        else:
+            raise AssertionError("recoverable tenant driver stalled")
+        delivered += srv.drain_outcomes()
+        assert inj.crashes >= 2
+        # victims bit-identical across crash/restore + flood
+        for ra, rb in zip(vids, vids2):
+            assert done[rb] == base[ra], \
+                "victim stream diverged across crash recovery"
+        # the rejection was delivered EXACTLY once despite replays
+        rej_delivered = [oc for oc in delivered
+                         if oc.status ==
+                         RequestOutcome.REJECTED_ADMISSION]
+        assert [oc.rid for oc in rej_delivered] == [rej2]
+        # tenant state survived the restores
+        rep = srv.tenant_report()
+        assert rep["flood"]["quota_blocks"] == 6
+        assert rep["v1"]["reserved_blocks"] == 8
+        assert srv.engine.tenant_stats["flood"].rejections >= 1

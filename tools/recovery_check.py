@@ -77,6 +77,53 @@ def _engine_summary(eng_snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _tenant_summary(eng_snap: dict, cache_snap: dict) -> str:
+    """Per-tenant occupancy/quota/queue lines for snapshots that
+    carry tenant state (PR 7+). Pre-tenant snapshots have no
+    "tenants" key and get no section — version-gated, never a
+    crash."""
+    tenants = eng_snap.get("tenants")
+    if not tenants:
+        return ""
+    # blocks held per tenant from the POOL's ground truth (the
+    # snapshot's seq_tenant + seq_blocks), not a stored gauge
+    seq_tenant = cache_snap.get("seq_tenant", [])
+    held = {}
+    for slot, blocks in enumerate(cache_snap["seq_blocks"]):
+        if blocks and slot < len(seq_tenant):
+            t = seq_tenant[slot]
+            held[t] = held.get(t, 0) + len(blocks)
+    by_tenant = {}
+    for rec in eng_snap["requests"]:
+        t = rec.get("tenant")
+        by_tenant.setdefault(t, []).append(rec["rid"])
+    queued = {}
+    queued_rids = set(eng_snap["queue"])
+    for rec in eng_snap["requests"]:
+        if rec["rid"] in queued_rids:
+            t = rec.get("tenant")
+            queued[t] = queued.get(t, 0) + 1
+    lines = [f"  tenants ({len(tenants)}):"]
+    for trec in tenants:
+        tid = trec["id"]
+        quota = trec["quota_blocks"]
+        st = trec["stats"]
+        lines.append(
+            f"    {tid!r}: {held.get(tid, 0)} block(s) held / "
+            + ("unlimited quota" if quota is None
+               else f"quota {quota}")
+            + (f", floor {trec['reserved_blocks']}"
+               if trec["reserved_blocks"] else "")
+            + f", weight {trec['weight']:g}, "
+            f"{queued.get(tid, 0)} queued, rids "
+            f"{by_tenant.get(tid, [])}, "
+            f"served {st.get('tokens_served', 0)} tok, "
+            f"sheds {st.get('sheds', 0)}, "
+            f"rejections {st.get('rejections', 0)}, "
+            f"quota hits {st.get('quota_hits', 0)}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="audit a serving snapshot (+ journal) offline")
@@ -127,6 +174,9 @@ def main(argv=None) -> int:
 
     if eng_snap is not None:
         print(_engine_summary(eng_snap))
+        tsum = _tenant_summary(eng_snap, cache_snap)
+        if tsum:
+            print(tsum)
     if spec_snap is not None:
         st = spec_snap["stats"]
         print(f"  speculative: k={spec_snap['config']['k']}, "
