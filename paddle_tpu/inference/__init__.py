@@ -34,6 +34,8 @@ from ..framework.tensor import Tensor
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       PrefillStats, PrefixCacheStats, ResilienceStats,
                       SpecDecodeStats, TenantStats)
+from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
+                        TraceCollector)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           PagedPrefillView,
@@ -54,14 +56,15 @@ from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
            "BlockOOM", "CrashInjector", "EngineCrash", "FaultInjector",
-           "PagedKVCache",
+           "MetricsRegistry", "PagedKVCache",
            "PagedLayerCache", "PagedPrefillView", "PagedRequest",
            "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
            "RecoverableServer", "RecoveryError", "RequestJournal",
            "RequestOutcome", "ResilienceStats", "SNAPSHOT_VERSION",
-           "SnapshotVersionError",
-           "SpecDecodeStats", "SpeculativeEngine", "Tenant",
-           "TenantStats", "TokenServingModel", "DEFAULT_TENANT",
+           "SnapshotVersionError", "SpecDecodeStats",
+           "SpeculativeEngine", "StatsBase", "Tenant",
+           "TenantStats", "TokenServingModel", "TraceCollector",
+           "DEFAULT_TENANT",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
            "chain_block_hashes", "chain_hash", "load_snapshot",
            "read_journal", "save_snapshot"]

@@ -91,7 +91,20 @@ def chain_block_hashes(tokens, block_size: int,
 
 
 class BlockOOM(RuntimeError):
-    """No free blocks in the pool (the scheduler preempts on this)."""
+    """No free blocks in the pool (the scheduler preempts on this).
+
+    ``details`` is the STRUCTURED occupancy breakdown the message
+    string is composed from (``PagedKVCache.pool_occupancy()``:
+    tier counts, owning-slot histogram, per-tenant blocks-held
+    histogram) — machine-readable for telemetry (every shed/OOM
+    emits it as an event, inference/telemetry.py) instead of
+    regex-mining the message. Injected faults
+    (``FaultInjector.on_alloc``) carry ``{"injected": True, ...}``;
+    an OOM raised before any pool exists carries ``{}``."""
+
+    def __init__(self, *args, details: Optional[dict] = None):
+        super().__init__(*args)
+        self.details: dict = dict(details) if details else {}
 
 
 class BlockAllocator:
@@ -124,12 +137,16 @@ class BlockAllocator:
         # diagnostics + fault injection, wired by the owning cache:
         #   context()       -> str appended to BlockOOM messages (pool
         #                      occupancy breakdown, owning-slot histogram)
+        #   context_data()  -> dict carried on BlockOOM.details (the
+        #                      same breakdown, machine-readable —
+        #                      telemetry events ride it)
         #   describe(block) -> str appended to ref/free misuse errors
         #                      (who owns the block)
         #   fault_hook(n)   -> may raise BlockOOM (FaultInjector);
         #                      consulted first so a forced OOM fires
         #                      even with free blocks in the pool
         self.context = None
+        self.context_data = None
         self.describe = None
         self.fault_hook = None
 
@@ -154,7 +171,12 @@ class BlockAllocator:
                 f"need {n} block(s), {self.num_free} free "
                 f"({len(self._free)} free-list + {len(self._cached)} "
                 f"cached-free reclaimable)"
-                + (self.context() if self.context is not None else ""))
+                + (self.context() if self.context is not None else ""),
+                details=dict(
+                    self.context_data()
+                    if self.context_data is not None else {},
+                    blocks_needed=int(n),
+                    blocks_free=int(self.num_free)))
         blocks = []
         for _ in range(n):
             if self._free:
@@ -532,8 +554,10 @@ class PagedKVCache:
         self.allocator = BlockAllocator(self.num_blocks,
                                         on_reclaim=self._on_reclaim)
         # actionable allocator errors: BlockOOM carries the occupancy
-        # breakdown, ref/free misuse names the owning slot(s)
+        # breakdown (string AND the structured pool_occupancy dict on
+        # .details), ref/free misuse names the owning slot(s)
         self.allocator.context = self._pool_context
+        self.allocator.context_data = self.pool_occupancy
         self.allocator.describe = self._describe_block
         # content fingerprints for the "never written in place" audit
         # (check_invariants): blocks that must be immutable — shared
@@ -647,22 +671,45 @@ class PagedKVCache:
         return [s for s in range(self.max_seqs)
                 if block in self.seq_blocks[s]]
 
+    def pool_occupancy(self, tiers_only: bool = False) -> dict:
+        """STRUCTURED occupancy breakdown — the single source behind
+        BlockOOM messages (``_pool_context`` renders it), the
+        exception's machine-readable ``details``, the telemetry
+        events every shed/OOM emits, and the engines'
+        MetricsRegistry pool gauges: tier counts, owning-slot
+        histogram, per-tenant blocks-held histogram.
+        ``tiers_only`` skips the two histograms (an O(max_seqs) scan)
+        — the per-step gauge path wants just the O(1) tier scalars."""
+        a = self.allocator
+        out = {
+            "active": self.num_blocks - 1 - a.num_free,
+            "cached_free": a.num_cached,
+            "free": len(a._free),
+            "usable": self.num_blocks - 1,
+        }
+        if not tiers_only:
+            out["blocks_per_slot"] = {
+                s: len(bl) for s, bl in enumerate(self.seq_blocks)
+                if bl}
+            out["blocks_per_tenant"] = {
+                t: n for t, n in self._tenant_charge.items()
+                if n and t is not None}
+        return out
+
     def _pool_context(self) -> str:
         """Occupancy breakdown appended to BlockOOM messages so an OOM
-        report is actionable: tier counts + owning-slot histogram +
-        (multi-tenant serving) the per-tenant blocks-held histogram,
-        so the message names WHICH TENANT holds the pool."""
-        a = self.allocator
-        active = self.num_blocks - 1 - a.num_free
-        hist = {s: len(bl) for s, bl in enumerate(self.seq_blocks)
-                if bl}
-        out = (f"; pool: {active} active / {a.num_cached} cached-free"
-               f" / {len(a._free)} free of {self.num_blocks - 1}"
-               f" usable; blocks per slot: {hist or '{}'}")
-        tenants = {t: n for t, n in self._tenant_charge.items()
-                   if n and t is not None}
-        if tenants:
-            out += f"; blocks per tenant: {tenants}"
+        report is actionable — ``pool_occupancy()`` rendered: tier
+        counts + owning-slot histogram + (multi-tenant serving) the
+        per-tenant blocks-held histogram, so the message names WHICH
+        TENANT holds the pool."""
+        occ = self.pool_occupancy()
+        out = (f"; pool: {occ['active']} active / "
+               f"{occ['cached_free']} cached-free"
+               f" / {occ['free']} free of {occ['usable']}"
+               f" usable; blocks per slot: "
+               f"{occ['blocks_per_slot'] or '{}'}")
+        if occ["blocks_per_tenant"]:
+            out += f"; blocks per tenant: {occ['blocks_per_tenant']}"
         return out
 
     def _describe_block(self, block: int) -> str:
@@ -902,7 +949,12 @@ class PagedKVCache:
                 f"target pool has only {usable} usable"
                 f"; snapshot pool: {len(live)} active / {len(cached)} "
                 f"cached-free of {g['num_blocks'] - 1} usable; "
-                f"blocks per slot: {hist or '{}'}")
+                f"blocks per slot: {hist or '{}'}",
+                details={"active": len(live),
+                         "cached_free": len(cached),
+                         "usable": g["num_blocks"] - 1,
+                         "target_usable": usable,
+                         "blocks_per_slot": hist})
         # cached-free blocks that fit, newest (most recently released)
         # kept — dropping the LRU end is the reclaim order the live
         # allocator uses

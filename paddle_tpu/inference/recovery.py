@@ -371,20 +371,33 @@ class RecoverableServer:
     def step(self) -> Dict[int, List[int]]:
         self._flush_drains()
         inj = self.injector
+        col = self.engine.collector
         if inj is not None:
             inj.begin_round()           # live-round crash clock
         emitted = self.engine.step()
         if inj is not None:
             inj.crash_point("pre_journal")
+        # the durability phases ride the engine timeline as spans —
+        # journal-append and snapshot cost is visible next to the
+        # model/prefill phases it competes with (a crash between the
+        # crash points cannot happen, so the bracket stays balanced)
+        if col is not None:
+            col.span_begin("journal")
         self.journal.append("round", {
             "emitted": {int(r): [int(t) for t in toks]
                         for r, toks in emitted.items()}})
+        if col is not None:
+            col.span_end()
         if inj is not None:
             inj.crash_point("post_journal")
         self.rounds += 1
         if self.snapshot_every and \
                 self.rounds % self.snapshot_every == 0:
+            if col is not None:
+                col.span_begin("snapshot")
             self.save_snapshot()
+            if col is not None:
+                col.span_end(bytes=self.snapshot_bytes)
         return emitted
 
     def drain_outcomes(self) -> List[RequestOutcome]:
@@ -447,7 +460,8 @@ class RecoverableServer:
     # -- recovery -----------------------------------------------------
     @classmethod
     def recover(cls, target, draft=None, *, journal_path: str,
-                snapshot_path: str, injector=None, sync: bool = False,
+                snapshot_path: str, injector=None, collector=None,
+                sync: bool = False,
                 num_blocks: Optional[int] = None) -> "RecoverableServer":
         """Rebuild a server after a crash: restore the last snapshot,
         then deterministically replay the journal suffix. Crash points
@@ -459,7 +473,17 @@ class RecoverableServer:
         record — divergence is a hard ``RecoveryError``. ``num_blocks``
         rehomes the pool during recovery (restore-into-a-different-
         pool); it only composes with ``k=0`` engines, whose draft side
-        is absent."""
+        is absent.
+
+        ``collector`` (TraceCollector) is wired onto the restored
+        engine and flipped to REPLAY mode for the journal replay, the
+        exact mirror of the injector's ``arm(False)``: replayed rounds'
+        timeline spans record flagged ``replay: True`` and request
+        records the dead incarnation already observed stay frozen —
+        tracing a recovery neither diverges the replay nor
+        double-counts a span or a latency. Snapshots carry no
+        collector state (telemetry is observational; its wall-clock
+        stamps must never enter engine-behavioral state)."""
         snap = load_snapshot(snapshot_path)
         if snap.get("kind") != "recoverable_server":
             raise SnapshotVersionError(
@@ -470,10 +494,11 @@ class RecoverableServer:
             eng = SpeculativeEngine.restore(
                 target, draft, _resize_engine_snap(eng_snap,
                                                    num_blocks),
-                injector=injector)
+                injector=injector, collector=collector)
         else:
             eng = SpeculativeEngine.restore(target, draft, eng_snap,
-                                            injector=injector)
+                                            injector=injector,
+                                            collector=collector)
         srv = cls(eng, journal_path=journal_path,
                   snapshot_path=snapshot_path, sync=sync,
                   snapshot_every=snap["snapshot_every"], _fresh=False)
@@ -501,6 +526,8 @@ class RecoverableServer:
         srv._delivered = set(snap["delivered"])
         if injector is not None:
             injector.arm(False)
+        if collector is not None:
+            collector.set_replay(True)
         try:
             for seq, kind, payload in records:
                 if kind == "outcomes":
@@ -556,6 +583,8 @@ class RecoverableServer:
         finally:
             if injector is not None:
                 injector.arm(True)
+            if collector is not None:
+                collector.set_replay(False)
         # outcomes regenerated by the replay that were already drained
         # pre-crash: drop them here, exactly-once stands
         eng.outcomes[:] = [oc for oc in eng.outcomes

@@ -247,7 +247,9 @@ class FaultInjector:
         else:
             self.injected_oom += 1
         raise BlockOOM(f"injected fault: forced {pool}-pool OOM at "
-                       f"step {self.step}")
+                       f"step {self.step}",
+                       details={"injected": True, "pool": pool,
+                                "step": self.step})
 
     def _corrupt(self, out, slots) -> object:
         """Replace ``slots``' rows of a [B, ...] Tensor with NaN; all

@@ -86,6 +86,19 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     accounting (sheds, rejections, quota hits, blocks held, tokens
     served) rides in ``tenant_stats`` (TenantStats).
 
+  * telemetry (``collector=`` — inference/telemetry.py): an opt-in
+    ``TraceCollector`` records every request's lifecycle (submitted /
+    admitted / prefill chunks / first token / preemptions / rollbacks
+    / terminal outcome -> TTFT, TPOT, queue-wait, preemption-stall
+    percentiles per tenant) and brackets each step's phases
+    (admission / prefill / model / bookkeeping) with per-step pool /
+    queue / per-tenant gauges, exportable as Chrome-trace JSON. With
+    no collector (default) every hook site is dark — zero clock
+    reads, zero allocations, bit-identical streams. The always-on
+    ``registry`` (MetricsRegistry) unifies the five stats siblings,
+    ``tenant_report`` and the pool/queue gauges behind one flat
+    ``as_dict()`` with interval deltas.
+
 Events are surfaced in ``admitted`` / ``finished`` / ``preempted`` /
 ``outcomes`` lists the caller drains between steps (prefill outputs
 ride along so the caller can seed the next input row).
@@ -104,6 +117,7 @@ from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
 from .resilience import RequestOutcome
 from .serving import (PrefillStats, PrefixCacheStats, ResilienceStats,
                       TenantStats)
+from .telemetry import MetricsRegistry
 
 __all__ = ["PagedRequest", "PagedServingEngine", "Tenant",
            "chunked_prefill", "DEFAULT_TENANT",
@@ -333,7 +347,8 @@ class PagedServingEngine:
                  prefill_token_budget: Optional[int] = None,
                  injector=None, max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
-                 tenants: Optional[Dict[str, dict]] = None):
+                 tenants: Optional[Dict[str, dict]] = None,
+                 collector=None):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
@@ -366,6 +381,29 @@ class PagedServingEngine:
         self.outcomes: List[RequestOutcome] = []
         self._step_count = 0
         self._has_deadlines = False
+        # telemetry (inference/telemetry.py). collector: the opt-in
+        # TraceCollector — per-request lifecycle + step-phase timeline
+        # + Chrome-trace export; None (default) keeps every hook site
+        # dark (zero clock reads, zero allocations — the FaultInjector
+        # pattern). The collector is PASSIVE (never consulted for
+        # control flow) and deliberately NOT part of snapshot():
+        # wall-clock timestamps stay out of engine-behavioral state;
+        # a restored engine gets the caller's collector wired fresh.
+        self.collector = collector
+        # registry: the always-on unified metric surface — the five
+        # stats siblings, tenant_report and the pool/queue gauges
+        # behind ONE as_dict() (flat keys, interval-deltable). Sources
+        # are LIVE (read at snapshot time), so attaching here costs
+        # the hot path nothing.
+        self.registry = MetricsRegistry()
+        self.registry.attach("prefix_cache", self.prefix_stats)
+        self.registry.attach("prefill", self.prefill_stats)
+        self.registry.attach("resilience", self.resilience_stats)
+        self.registry.attach("tenants", self.tenant_report)
+        self.registry.attach(
+            "pool", lambda: dict(self.cache.pool_occupancy(),
+                                 peak=self.cache.peak_blocks_used))
+        self.registry.attach("queue", self._queue_gauges)
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
@@ -595,6 +633,8 @@ class PagedServingEngine:
             req.deadline_steps = int(deadline_steps)
         if deadline_s is not None:
             req.deadline_time = time.monotonic() + float(deadline_s)
+        if self.collector is not None:
+            self.collector.on_submit(req.rid, ten.tid, arr.shape[0])
         reject = self._admission_health(req, ten)
         if reject:
             self._record(req, RequestOutcome.REJECTED_ADMISSION,
@@ -740,6 +780,10 @@ class PagedServingEngine:
                 try:
                     self._prefill(req)
                 except BlockOOM as e:
+                    if self.collector is not None:
+                        self.collector.on_event("block_oom", dict(
+                            e.details, rid=req.rid, tenant=req.tenant,
+                            step=self._step_count))
                     # the budget check above said the prompt fits, so
                     # this is an injected fault (or a raced reclaim):
                     # un-admit — drop the partial pages and retry on a
@@ -794,6 +838,9 @@ class PagedServingEngine:
         self._tenant_of(req).stats.admitted += 1
         if req.preemptions > 0:
             self.resilience_stats.retried += 1
+        if self.collector is not None:
+            self.collector.on_admitted(req.rid, slot,
+                                       retry=req.preemptions > 0)
         return slot
 
     def _complete_prefill(self, slot: int, last_hidden) -> None:
@@ -810,6 +857,10 @@ class PagedServingEngine:
         self.lens[slot] = T
         self.active[slot] = True
         self.admitted.append((req.rid, slot, last_hidden))
+        if self.collector is not None:
+            # the admitted event's last hidden is what the caller
+            # samples the FIRST TOKEN from — TTFT's defining moment
+            self.collector.on_first_token(req.rid)
         self._crash("post_prefill")
 
     def _chunk_registrar(self, slot: int, st: dict):
@@ -836,21 +887,54 @@ class PagedServingEngine:
                 last[0] = done
         return register
 
+    def _chunk_hook(self, slot: int, st: dict, req: PagedRequest):
+        """``on_chunk`` for engine prefills: the prefix registrar
+        (above) composed with the telemetry chunk event — one
+        callback, built only when either consumer exists."""
+        reg = self._chunk_registrar(slot, st)
+        col = self.collector
+        if col is None:
+            return reg
+        rid = req.rid
+
+        def hook(pos: int) -> None:
+            if reg is not None:
+                reg(pos)
+            col.on_prefill_chunk(rid, pos)
+        return hook
+
     def _prefill(self, req: PagedRequest) -> None:
         """Synchronous admission: stream every chunk now (block budget
         for the whole prompt was checked by _try_admit, so the chunk
-        ensures cannot OOM)."""
+        ensures cannot OOM). Runs outside the step-phase timeline
+        (submit-time admission), so it records its own ``prefill``
+        span — admission prefill cost stays visible either way."""
         slot = self._start_prefill(req)
         st = self._prefills[slot]
-        _, h = chunked_prefill(
-            self.model, self.cache, slot, req.history,
-            pos=st["pos"], target=len(req),
-            chunk_tokens=self.chunk_tokens,
-            start_block=st["n_cached"],
-            write_start=st["n_cached"] * self.cache.block_size,
-            stats=self.prefill_stats,
-            on_chunk=self._chunk_registrar(slot, st))
-        self._complete_prefill(slot, h)
+        col = self.collector
+        depth = col.span_depth if col is not None else 0
+        if col is not None:
+            col.span_begin("prefill", rid=req.rid,
+                           tokens=len(req) - st["pos"])
+        try:
+            _, h = chunked_prefill(
+                self.model, self.cache, slot, req.history,
+                pos=st["pos"], target=len(req),
+                chunk_tokens=self.chunk_tokens,
+                start_block=st["n_cached"],
+                write_start=st["n_cached"] * self.cache.block_size,
+                stats=self.prefill_stats,
+                on_chunk=self._chunk_hook(slot, st, req))
+            self._complete_prefill(slot, h)
+        except BaseException:
+            # an injected BlockOOM or EngineCrash mid-prefill unwinds
+            # through here (the admission pass un-admits): close the
+            # span flagged so the trace shows the tear-down
+            if col is not None:
+                col.span_unwind(depth, aborted=True)
+            raise
+        if col is not None:
+            col.span_unwind(depth)
 
     def _advance_prefills(self) -> Tuple[bool, List[int]]:
         """Token-budget mode: spend ``prefill_token_budget`` prompt
@@ -895,7 +979,7 @@ class PagedServingEngine:
                 start_block=st["n_cached"],
                 write_start=st["n_cached"] * self.cache.block_size,
                 stats=self.prefill_stats,
-                on_chunk=self._chunk_registrar(slot, st))
+                on_chunk=self._chunk_hook(slot, st, req))
             st["pos"] = pos
             budget -= c
             ran = True
@@ -936,6 +1020,16 @@ class PagedServingEngine:
         elif status == RequestOutcome.REJECTED_ADMISSION:
             st.rejected += 1
             ts.rejections += 1
+        col = self.collector
+        if col is not None:
+            col.on_outcome(req.rid, status, self._step_count,
+                           reason=reason)
+            if status == RequestOutcome.FAILED_OOM:
+                # the structured BlockOOM breakdown as an event: every
+                # shed carries WHO held the pool when it fired
+                col.on_event("oom_shed", dict(
+                    self.cache.pool_occupancy(), rid=req.rid,
+                    tenant=req.tenant, step=self._step_count))
 
     def _fail(self, req: PagedRequest, status: str,
               reason: str) -> None:
@@ -1052,6 +1146,8 @@ class PagedServingEngine:
         self._tenant_of(req).stats.preemptions += 1
         self._requeue_preempted(req)
         self.preempted.append(req.rid)
+        if self.collector is not None:
+            self.collector.on_preempted(req.rid)
 
     def _oom_victims(self, req: PagedRequest) -> List[int]:
         """Eligible eviction victims for a POOL OOM hit while growing
@@ -1112,7 +1208,20 @@ class PagedServingEngine:
         ever escapes this call. Rows of failed/preempted slots in the
         returned hidden are garbage — drain the event lists."""
         idle = self._begin_step()
+        try:
+            return self._step_impl(idle, x)
+        finally:
+            # balanced even when an injected EngineCrash unwinds the
+            # step; a no-op (no clock read) without a collector
+            self._end_step_telemetry()
+
+    def _step_impl(self, idle: bool, x: Tensor):
+        col = self.collector
+        if col is not None:
+            col.phase("prefill")
         ran_prefill, fresh = self._advance_prefills()
+        if col is not None:
+            col.phase("bookkeeping")
         if self.num_active == 0:
             if ran_prefill or self.num_prefilling > 0 or self.queue \
                     or not idle:
@@ -1172,13 +1281,21 @@ class PagedServingEngine:
         #    decode append cannot touch their pages
         masked = self.prefilling | (self.active & ~stepping)
         self.cache.set_decode_mask(masked if masked.any() else None)
+        if col is not None:
+            col.phase("model")
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
+        if col is not None:
+            col.phase("bookkeeping")
         self.lens[stepping] += 1
         self._count_tokens_served(stepping, 1)
+        if col is not None:
+            col.on_decode([self._requests[int(s)].rid
+                           for s in np.flatnonzero(stepping)
+                           if self._requests[int(s)] is not None], 1)
         self.prefill_stats.decode_steps += 1
         if ran_prefill:
             self.prefill_stats.mixed_steps += 1
@@ -1189,6 +1306,8 @@ class PagedServingEngine:
         if self.numeric_guard:
             self._guard_numeric(out, stepping)
         # 5. continuous refill
+        if col is not None:
+            col.phase("admission")
         self._try_admit()
         return out
 
@@ -1216,7 +1335,14 @@ class PagedServingEngine:
                 "step_multi() does not support prefill_token_budget "
                 "mode; use synchronous admission (the default) for "
                 "multi-token verification")
-        idle = self._begin_step()
+        idle = self._begin_step(kind="verify")
+        try:
+            return self._step_multi_impl(idle, x, L)
+        finally:
+            self._end_step_telemetry()
+
+    def _step_multi_impl(self, idle: bool, x: Tensor, L: int):
+        col = self.collector
         if self.num_active == 0:
             if self.queue or self.num_prefilling > 0 or not idle:
                 # deadline failures can empty the batch mid-stream;
@@ -1252,18 +1378,28 @@ class PagedServingEngine:
         self._pending_history.append((x, stepping))
         self.cache.set_decode_mask(
             self.prefilling if self.prefilling.any() else None)
+        if col is not None:
+            col.phase("model")
         t = Tensor(np.asarray(self.lens, np.int32))
         with no_grad():
             out, _ = self.model(x, caches=self.cache.views, time_step=t)
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
+        if col is not None:
+            col.phase("bookkeeping")
         self.lens[self.active] += L
         self._count_tokens_served(self.active, L)
+        if col is not None:
+            col.on_decode([self._requests[int(s)].rid
+                           for s in np.flatnonzero(self.active)
+                           if self._requests[int(s)] is not None], L)
         self.prefill_stats.decode_steps += 1
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
         if self.numeric_guard:
             self._guard_numeric(out, stepping)
+        if col is not None:
+            col.phase("admission")
         self._try_admit()
         return out
 
@@ -1281,12 +1417,16 @@ class PagedServingEngine:
             raise ValueError(
                 f"rollback of slot {slot} to {new_len} outside "
                 f"[1, {int(self.lens[slot])}]")
+        rejected = int(self.lens[slot]) - new_len
         # buffered inputs must reach the history BEFORE trimming it
         self._flush_history()
         self._requests[slot].truncate_history(new_len,
                                               self.cache.block_size)
         self.cache.truncate(slot, new_len)
         self.lens[slot] = new_len
+        if self.collector is not None and rejected > 0:
+            self.collector.on_rollback(self._requests[slot].rid,
+                                       rejected)
 
     # -- resilience ---------------------------------------------------
     def _crash(self, phase: str) -> None:
@@ -1298,13 +1438,15 @@ class PagedServingEngine:
         if self.injector is not None:
             self.injector.crash_point(phase)
 
-    def _begin_step(self) -> bool:
+    def _begin_step(self, kind: str = "step") -> bool:
         """Step-top bookkeeping shared by step()/step_multi():
         advance the step counter (the fault injector's clock) and
         enforce per-request deadlines. Returns whether the engine was
         ALREADY empty on entry — that is caller misuse and still
         raises, while an engine emptied by this step's own failures
-        returns None to the caller."""
+        returns None to the caller. Opens the telemetry step span
+        LAST (after the ``begin`` crash point), so a step that dies
+        at its top never leaves a dangling span."""
         self._step_count += 1
         if self.injector is not None:
             self.injector.begin_step(self._step_count)
@@ -1314,7 +1456,40 @@ class PagedServingEngine:
         self._check_deadlines()
         for tid, ten in self.tenants.items():
             ten.stats.blocks_held = self.cache.tenant_charge(tid)
+        if self.collector is not None:
+            self.collector.begin_step(self._step_count, kind)
         return idle
+
+    def _queue_gauges(self) -> dict:
+        """Queue/slot depths — the ONE source feeding both the
+        registry's ``queue`` namespace and the per-step gauge track."""
+        return {"depth": len(self.queue),
+                "active": self.num_active,
+                "prefilling": self.num_prefilling}
+
+    def _end_step_telemetry(self) -> None:
+        """Close the step span and sample the per-step gauges from
+        ground truth (pool tiers, queue/slot depths, per-tenant
+        charge). One call, in the step's ``finally`` — the timeline
+        stays balanced even when a fault or injected crash unwinds
+        the step early."""
+        col = self.collector
+        if col is None:
+            return
+        # the ONE tier source, O(1) scalars only — per-step gauges
+        # must not pay the occupancy histograms' O(max_seqs) scan
+        occ = self.cache.pool_occupancy(tiers_only=True)
+        col.end_step({
+            "pool": {"active": occ["active"],
+                     "cached_free": occ["cached_free"],
+                     "free": occ["free"]},
+            "queue": self._queue_gauges(),
+            # unlike the occupancy blocks-per-tenant histogram (which
+            # drops zeros), the gauge reports every REGISTERED tenant
+            # — a charge falling to 0 must emit a 0, not vanish
+            "tenant_blocks": {tid: self.cache.tenant_charge(tid)
+                              for tid in self.tenants},
+        })
 
     def _count_tokens_served(self, stepping: np.ndarray,
                              n: int) -> None:
@@ -1393,6 +1568,13 @@ class PagedServingEngine:
                                   write_from=write_from)
                 return True
             except BlockOOM as e:
+                if self.collector is not None:
+                    # every pool OOM is a telemetry instant carrying
+                    # the structured occupancy breakdown (who held
+                    # the pool when it fired)
+                    self.collector.on_event("block_oom", dict(
+                        e.details, rid=req.rid, tenant=req.tenant,
+                        step=self._step_count))
                 # shed only when no victim but the grower itself is
                 # left: the below-floor branch of _oom_victims returns
                 # over-floor BORROWERS, a list that never contains the
@@ -1530,7 +1712,11 @@ class PagedServingEngine:
         frontiers), the step clock and admission sequencer, all stats
         siblings, and any undrained event lists. Buffered decode
         inputs are flushed to histories first, so the snapshot is a
-        pure host-side read of a step-boundary state."""
+        pure host-side read of a step-boundary state. Telemetry
+        (``collector``) is deliberately EXCLUDED: its wall-clock
+        timestamps are observational, never behavioral, so restore
+        wires the caller's collector fresh instead of replaying
+        stale clocks into a new process."""
         self._flush_history()
         now = time.monotonic()
         reqs: Dict[int, PagedRequest] = {
@@ -1596,6 +1782,7 @@ class PagedServingEngine:
 
     @classmethod
     def restore(cls, model, snap: dict, *, injector=None,
+                collector=None,
                 num_blocks: Optional[int] = None) -> "PagedServingEngine":
         """Rebuild an engine from a ``snapshot`` around the caller's
         model (weights are the caller's problem — a snapshot holds
@@ -1603,8 +1790,11 @@ class PagedServingEngine:
         pool into a different-size target (PagedKVCache.restore).
         The injector is wired fresh (fault schedules stay keyed by
         the RESTORED step clock, so a replayed step re-injects the
-        same faults — required for deterministic replay). Ends with a
-        full engine + deep pool audit."""
+        same faults — required for deterministic replay), and so is
+        the collector: snapshots carry NO telemetry state (wall-clock
+        timestamps never enter engine-behavioral state), the caller's
+        collector simply keeps observing the restored engine. Ends
+        with a full engine + deep pool audit."""
         cfg = snap["config"]
         nb = cfg["num_blocks"] if num_blocks is None else int(num_blocks)
         # the constructor's cache is discarded two lines down for the
@@ -1621,7 +1811,7 @@ class PagedServingEngine:
                   prefix_cache=cfg["prefix_cache"],
                   chunk_tokens=cfg["chunk_tokens"],
                   prefill_token_budget=cfg["prefill_token_budget"],
-                  injector=injector,
+                  injector=injector, collector=collector,
                   max_preemptions=cfg["max_preemptions"],
                   numeric_guard=cfg["numeric_guard"])
         # nb may differ from the cache snapshot's geometry (a resized

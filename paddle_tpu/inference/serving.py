@@ -25,27 +25,37 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..framework.tensor import Tensor
+from .telemetry import StatsBase
 
 __all__ = ["ContinuousBatchingEngine", "PrefillStats",
            "PrefixCacheStats", "ResilienceStats", "SpecDecodeStats",
            "TenantStats"]
 
+# The five stats siblings below share ONE declarative base
+# (telemetry.StatsBase): each lists its counter FIELDS, the DERIVED
+# properties to export next to them (with rounding), and the REPR
+# headline subset — as_dict()/__repr__ are generated, so every stat a
+# class declares is export-visible by construction and the engines'
+# MetricsRegistry can attach them wholesale.
 
-class PrefixCacheStats:
+
+class PrefixCacheStats(StatsBase):
     """Serving-surface accounting for the cross-request prefix cache
     (PagedServingEngine(prefix_cache=True)): block-level hit rate and
     the prefill work the cache saved. One instance per engine, read by
-    benches/dashboards; counters only ever grow."""
+    benches/dashboards; counters only ever grow.
 
-    __slots__ = ("lookups", "lookup_blocks", "hit_blocks",
-                 "tokens_skipped", "tokens_computed")
+      lookups         admissions that probed the index
+      lookup_blocks   full prompt blocks eligible to hit
+      hit_blocks      blocks shared instead of allocated
+      tokens_skipped  prompt tokens whose prefill was skipped
+      tokens_computed prompt tokens actually prefilled
+    """
 
-    def __init__(self):
-        self.lookups = 0         # admissions that probed the index
-        self.lookup_blocks = 0   # full prompt blocks eligible to hit
-        self.hit_blocks = 0      # blocks shared instead of allocated
-        self.tokens_skipped = 0  # prompt tokens whose prefill was skipped
-        self.tokens_computed = 0  # prompt tokens actually prefilled
+    __slots__ = FIELDS = ("lookups", "lookup_blocks", "hit_blocks",
+                          "tokens_skipped", "tokens_computed")
+    DERIVED = {"blocks_saved": None, "hit_rate": 4}
+    REPR = ("hit_rate", "blocks_saved", "tokens_skipped")
 
     @property
     def blocks_saved(self) -> int:
@@ -58,22 +68,8 @@ class PrefixCacheStats:
             return 0.0
         return self.hit_blocks / self.lookup_blocks
 
-    def as_dict(self) -> dict:
-        return {"lookups": self.lookups,
-                "lookup_blocks": self.lookup_blocks,
-                "hit_blocks": self.hit_blocks,
-                "blocks_saved": self.blocks_saved,
-                "hit_rate": round(self.hit_rate, 4),
-                "tokens_skipped": self.tokens_skipped,
-                "tokens_computed": self.tokens_computed}
 
-    def __repr__(self):
-        return (f"PrefixCacheStats(hit_rate={self.hit_rate:.2%}, "
-                f"blocks_saved={self.blocks_saved}, "
-                f"tokens_skipped={self.tokens_skipped})")
-
-
-class PrefillStats:
+class PrefillStats(StatsBase):
     """Serving-surface accounting for CHUNKED PAGED PREFILL
     (scheduler.chunked_prefill / PagedServingEngine), sibling of
     PrefixCacheStats and SpecDecodeStats; counters only grow.
@@ -93,16 +89,12 @@ class PrefillStats:
                       KV footprint
     """
 
-    __slots__ = ("chunks", "prefill_tokens", "prefill_steps",
-                 "decode_steps", "mixed_steps", "peak_blocks")
-
-    def __init__(self):
-        self.chunks = 0
-        self.prefill_tokens = 0
-        self.prefill_steps = 0
-        self.decode_steps = 0
-        self.mixed_steps = 0
-        self.peak_blocks = 0
+    __slots__ = FIELDS = ("chunks", "prefill_tokens", "prefill_steps",
+                          "decode_steps", "mixed_steps", "peak_blocks")
+    DERIVED = {"tokens_per_chunk": 2, "mixed_step_rate": 4,
+               "prefill_tokens_per_step": 2}
+    REPR = ("chunks", "prefill_tokens", "mixed_step_rate",
+            "peak_blocks")
 
     @property
     def tokens_per_chunk(self) -> float:
@@ -128,26 +120,8 @@ class PrefillStats:
             return 0.0
         return self.mixed_steps / total
 
-    def as_dict(self) -> dict:
-        return {"chunks": self.chunks,
-                "prefill_tokens": self.prefill_tokens,
-                "tokens_per_chunk": round(self.tokens_per_chunk, 2),
-                "prefill_steps": self.prefill_steps,
-                "decode_steps": self.decode_steps,
-                "mixed_steps": self.mixed_steps,
-                "mixed_step_rate": round(self.mixed_step_rate, 4),
-                "prefill_tokens_per_step":
-                    round(self.prefill_tokens_per_step, 2),
-                "peak_blocks": self.peak_blocks}
 
-    def __repr__(self):
-        return (f"PrefillStats(chunks={self.chunks}, "
-                f"prefill_tokens={self.prefill_tokens}, "
-                f"mixed_step_rate={self.mixed_step_rate:.2%}, "
-                f"peak_blocks={self.peak_blocks})")
-
-
-class ResilienceStats:
+class ResilienceStats(StatsBase):
     """Serving-surface accounting for the resilience layer
     (inference/resilience.py + the per-request failure isolation in
     scheduler.py), sibling of PrefixCacheStats / PrefillStats /
@@ -174,16 +148,11 @@ class ResilienceStats:
                        engine surface
     """
 
-    __slots__ = ("shed", "retried", "deadline_failed", "nan_failed",
-                 "rejected", "audits")
-
-    def __init__(self):
-        self.shed = 0
-        self.retried = 0
-        self.deadline_failed = 0
-        self.nan_failed = 0
-        self.rejected = 0
-        self.audits = 0
+    __slots__ = FIELDS = ("shed", "retried", "deadline_failed",
+                          "nan_failed", "rejected", "audits")
+    DERIVED = {"failed": None}
+    REPR = ("shed", "retried", "deadline_failed", "nan_failed",
+            "rejected")
 
     @property
     def failed(self) -> int:
@@ -191,22 +160,8 @@ class ResilienceStats:
         return (self.shed + self.deadline_failed + self.nan_failed
                 + self.rejected)
 
-    def as_dict(self) -> dict:
-        return {"shed": self.shed, "retried": self.retried,
-                "deadline_failed": self.deadline_failed,
-                "nan_failed": self.nan_failed,
-                "rejected": self.rejected, "failed": self.failed,
-                "audits": self.audits}
 
-    def __repr__(self):
-        return (f"ResilienceStats(shed={self.shed}, "
-                f"retried={self.retried}, "
-                f"deadline_failed={self.deadline_failed}, "
-                f"nan_failed={self.nan_failed}, "
-                f"rejected={self.rejected})")
-
-
-class TenantStats:
+class TenantStats(StatsBase):
     """Per-tenant serving accounting (multi-tenant isolation,
     scheduler.py): one instance per tenant in
     ``PagedServingEngine.tenant_stats``, the attribution surface that
@@ -230,45 +185,21 @@ class TenantStats:
                      through fused steps
     """
 
-    __slots__ = ("admitted", "sheds", "rejections", "quota_hits",
-                 "preemptions", "deadline_failed", "nan_failed",
-                 "blocks_held", "tokens_served")
-
-    def __init__(self):
-        self.admitted = 0
-        self.sheds = 0
-        self.rejections = 0
-        self.quota_hits = 0
-        self.preemptions = 0
-        self.deadline_failed = 0
-        self.nan_failed = 0
-        self.blocks_held = 0
-        self.tokens_served = 0
+    __slots__ = FIELDS = ("admitted", "sheds", "rejections",
+                          "quota_hits", "preemptions",
+                          "deadline_failed", "nan_failed",
+                          "blocks_held", "tokens_served")
+    DERIVED = {"failed": None}
+    REPR = ("blocks_held", "tokens_served", "sheds", "rejections",
+            "quota_hits")
 
     @property
     def failed(self) -> int:
         return (self.sheds + self.rejections + self.deadline_failed
                 + self.nan_failed)
 
-    def as_dict(self) -> dict:
-        return {"admitted": self.admitted, "sheds": self.sheds,
-                "rejections": self.rejections,
-                "quota_hits": self.quota_hits,
-                "preemptions": self.preemptions,
-                "deadline_failed": self.deadline_failed,
-                "nan_failed": self.nan_failed,
-                "failed": self.failed,
-                "blocks_held": self.blocks_held,
-                "tokens_served": self.tokens_served}
 
-    def __repr__(self):
-        return (f"TenantStats(blocks_held={self.blocks_held}, "
-                f"tokens_served={self.tokens_served}, "
-                f"sheds={self.sheds}, rejections={self.rejections}, "
-                f"quota_hits={self.quota_hits})")
-
-
-class SpecDecodeStats:
+class SpecDecodeStats(StatsBase):
     """Serving-surface accounting for speculative decoding
     (inference/speculative.py), the sibling of PrefixCacheStats. One
     counter bump per (slot, verification step); counters only grow.
@@ -287,17 +218,11 @@ class SpecDecodeStats:
                         the round serves without speculation)
     """
 
-    __slots__ = ("proposed", "accepted", "emitted", "target_steps",
-                 "draft_steps", "rolled_back", "draft_oom_rolls")
-
-    def __init__(self):
-        self.proposed = 0
-        self.accepted = 0
-        self.emitted = 0
-        self.target_steps = 0
-        self.draft_steps = 0
-        self.rolled_back = 0
-        self.draft_oom_rolls = 0
+    __slots__ = FIELDS = ("proposed", "accepted", "emitted",
+                          "target_steps", "draft_steps", "rolled_back",
+                          "draft_oom_rolls")
+    DERIVED = {"acceptance_rate": 4, "tokens_per_target_step": 4}
+    REPR = ("acceptance_rate", "tokens_per_target_step", "emitted")
 
     @property
     def acceptance_rate(self) -> float:
@@ -313,24 +238,6 @@ class SpecDecodeStats:
         if self.target_steps == 0:
             return 0.0
         return self.emitted / self.target_steps
-
-    def as_dict(self) -> dict:
-        return {"proposed": self.proposed,
-                "accepted": self.accepted,
-                "emitted": self.emitted,
-                "target_steps": self.target_steps,
-                "draft_steps": self.draft_steps,
-                "rolled_back": self.rolled_back,
-                "draft_oom_rolls": self.draft_oom_rolls,
-                "acceptance_rate": round(self.acceptance_rate, 4),
-                "tokens_per_target_step":
-                    round(self.tokens_per_target_step, 4)}
-
-    def __repr__(self):
-        return (f"SpecDecodeStats(acceptance_rate="
-                f"{self.acceptance_rate:.2%}, tokens_per_target_step="
-                f"{self.tokens_per_target_step:.2f}, "
-                f"emitted={self.emitted})")
 
 
 class ContinuousBatchingEngine:

@@ -250,7 +250,8 @@ class SpeculativeEngine:
                  injector=None,
                  max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
-                 tenants: Optional[Dict[str, dict]] = None):
+                 tenants: Optional[Dict[str, dict]] = None,
+                 collector=None):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -268,9 +269,13 @@ class SpeculativeEngine:
             watermark_blocks=watermark_blocks,
             prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
             injector=injector, max_preemptions=max_preemptions,
-            numeric_guard=numeric_guard, tenants=tenants)
+            numeric_guard=numeric_guard, tenants=tenants,
+            collector=collector)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
+        # the speculative layer's stats export through the SAME
+        # unified registry as the engine's siblings
+        self.engine.registry.attach("spec", self.stats)
         self.finished: List[Tuple[int, int]] = []
         # terminal RequestOutcomes forwarded from the wrapped engine
         # (FINISHED and every FAILED_*); the caller drains this list
@@ -447,6 +452,18 @@ class SpeculativeEngine:
     def resilience_stats(self):
         return self.engine.resilience_stats
 
+    @property
+    def collector(self):
+        """The wrapped engine's TraceCollector (None when tracing is
+        off) — the speculative layer records its round spans there."""
+        return self.engine.collector
+
+    @property
+    def registry(self):
+        """The unified MetricsRegistry (wrapped engine's, with this
+        layer's SpecDecodeStats attached under ``spec``)."""
+        return self.engine.registry
+
     def check_invariants(self) -> bool:
         """Audit the wrapped engine + BOTH pools (target and draft).
         Draft-side extras: slot alignment (every tracked stream's
@@ -497,7 +514,31 @@ class SpeculativeEngine:
         """One draft/verify/rollback round over every active slot.
         Returns {rid: tokens emitted this round} (>= 1 token per
         active request). Capacity-finished requests are released and
-        reported in ``finished`` instead."""
+        reported in ``finished`` instead.
+
+        With a collector installed the round records a ``spec_round``
+        span wrapping ``draft_roll`` (the k-token roll), the verify
+        step span (``step_multi``'s own bracket) and
+        ``sample_verify`` (target sampling + accept/rollback + draft
+        rebuilds); the span stack unwinds cleanly even when an
+        injected ``EngineCrash`` tears the round down mid-flight."""
+        col = self.engine.collector
+        depth = col.span_depth if col is not None else 0
+        if col is not None:
+            col.span_begin("spec_round")
+        try:
+            out = self._step_impl(col)
+        except BaseException:
+            # an EngineCrash mid-round: close the open spans flagged
+            # aborted so the trace shows where the round died
+            if col is not None:
+                col.span_unwind(depth, aborted=True)
+            raise
+        if col is not None:
+            col.span_unwind(depth)      # closes spec_round normally
+        return out
+
+    def _step_impl(self, col) -> Dict[int, List[int]]:
         import paddle_tpu as paddle
         eng = self.engine
         if self.injector is not None:
@@ -531,8 +572,13 @@ class SpeculativeEngine:
             # step-keyed fault schedules expire even when admission
             # itself is the faulted path (no injection deadlock)
             if eng.queue:
-                eng._begin_step()
-                eng._try_admit()
+                eng._begin_step(kind="admission_kick")
+                try:
+                    eng._try_admit()
+                finally:
+                    # the kick consumes an engine step of its own —
+                    # close its telemetry span like any other step
+                    eng._end_step_telemetry()
                 self._handle_events()
             return {}
         B = self.max_batch
@@ -551,6 +597,8 @@ class SpeculativeEngine:
         #    target pool is never touched by a draft fault, and the
         #    draft slots rebuild from the token stream after the
         #    verify (the same known-good path a preemption takes).
+        if col is not None:
+            col.span_begin("draft_roll")
         if self._draft_dirty:
             # some slot is missing its draft cache: no proposals this
             # round, but CLEAN slots still lockstep below — only the
@@ -631,6 +679,8 @@ class SpeculativeEngine:
                     roll_oom = True
                     self.stats.draft_oom_rolls += 1
 
+        if col is not None:
+            col.span_end(k=k_eff, oom_rolled=roll_oom)
         # 2. verify: ONE target call scores the pending token plus all
         #    k_eff proposals through the paged cache. The
         #    mid_spec_round crash point sits between the draft roll
@@ -653,6 +703,8 @@ class SpeculativeEngine:
             # outcomes carry the verdicts; nothing was scored
             self._handle_events()
             return {}
+        if col is not None:
+            col.span_begin("sample_verify")
         g_toks, g_probs = self._sample(self.target,
                                        self.target.logits(out))
         preempted_mid = {rid for rid in eng.preempted}
@@ -713,6 +765,8 @@ class SpeculativeEngine:
                 except BlockOOM:
                     self._clear_draft_slot(s)
                     self._draft_dirty.add(s)
+        if col is not None:
+            col.span_end()
         self._handle_events()
         return emitted_by_rid
 
@@ -775,7 +829,7 @@ class SpeculativeEngine:
     @classmethod
     def restore(cls, target: TokenServingModel,
                 draft: Optional[TokenServingModel], snap: dict, *,
-                injector=None) -> "SpeculativeEngine":
+                injector=None, collector=None) -> "SpeculativeEngine":
         """Rebuild a speculative engine from ``snapshot`` around the
         caller's models. The target engine restores exactly
         (PagedServingEngine.restore); the draft pool is REBUILT from
@@ -817,11 +871,13 @@ class SpeculativeEngine:
                    temperature=cfg["temperature"], top_k=cfg["top_k"],
                    watermark_blocks=ecfg["watermark_blocks"],
                    chunk_tokens=ecfg["chunk_tokens"],
-                   injector=injector,
+                   injector=injector, collector=collector,
                    max_preemptions=ecfg["max_preemptions"],
                    numeric_guard=ecfg["numeric_guard"])
         spec.engine = PagedServingEngine.restore(
-            target.core, snap["engine"], injector=injector)
+            target.core, snap["engine"], injector=injector,
+            collector=collector)
+        spec.engine.registry.attach("spec", spec.stats)
         for rec in snap["seqs"]:
             seq = _SpecSeq(rec["rid"], rec["toks"])
             seq.prompt_len = rec["prompt_len"]

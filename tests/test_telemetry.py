@@ -1,0 +1,828 @@
+"""Serving telemetry subsystem (inference/telemetry.py + the
+collector wiring in scheduler.py / speculative.py / recovery.py and
+the BlockOOM.details satellite in paged_cache.py).
+
+The acceptance bars:
+
+* PASSIVE — token streams and terminal outcomes are BIT-IDENTICAL
+  with a TraceCollector installed vs absent, across plain /
+  prefix-cached / speculative / recoverable serving, including under
+  a seeded fault storm (PR 5) and a crash/recover cycle (PR 6).
+* ZERO OVERHEAD OFF — with no collector the engines perform zero
+  clock reads (counting-clock test).
+* RECOVERY-SAFE — engine snapshots carry no collector state; journal
+  replay with tracing on neither diverges nor double-counts (replayed
+  spans flagged, live-observed records frozen).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (BlockOOM, CrashInjector, EngineCrash,
+                                  FaultInjector, MetricsRegistry,
+                                  PagedKVCache, PagedServingEngine,
+                                  RecoverableServer, SpeculativeEngine,
+                                  StatsBase, TokenServingModel,
+                                  TraceCollector)
+from paddle_tpu.inference import scheduler as sched_mod
+from paddle_tpu.inference import telemetry as tele_mod
+from paddle_tpu.inference.telemetry import percentiles
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.obs
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+VOCAB = 50
+
+_RNG = np.random.RandomState(1234)
+_EMBED = _RNG.randn(VOCAB, D).astype(np.float32)
+
+
+def _model():
+    paddle.seed(0)
+    return FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+
+
+def _tsm():
+    return TokenServingModel(_model(), _EMBED)
+
+
+def _prompts(seed, n=4, lo=6, hi=10):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, VOCAB, int(L)))
+            for L in rng.integers(lo, hi, n)]
+
+
+def _drive(tsm, prompts, n_gen, *, collector=None, injector=None,
+           max_iters=300, **eng_kw):
+    """Token-ID serving loop over SpeculativeEngine (k=0 == plain
+    paged decode). Returns (streams, outcome (rid, status) pairs,
+    engine)."""
+    kw = dict(k=0, max_batch=2, block_size=4, num_blocks=60,
+              max_blocks_per_seq=10)
+    kw.update(eng_kw)
+    eng = SpeculativeEngine(tsm, None, collector=collector,
+                            injector=injector, **kw)
+    rids = [eng.submit(p) for p in prompts]
+    done, failed, outcomes = {}, set(), []
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        eng.step()
+        for oc in eng.outcomes:
+            outcomes.append((oc.rid, oc.status, oc.step))
+            if oc.failed:
+                failed.add(oc.rid)
+        eng.outcomes.clear()
+        for r in live:
+            if r in failed:
+                continue
+            if len(eng.generated(r)) >= n_gen:
+                done[r] = eng.generated(r)[:n_gen]
+                eng.release(r)
+    else:
+        raise AssertionError("telemetry driver did not converge")
+    # drain the release outcomes too
+    for oc in eng.outcomes:
+        outcomes.append((oc.rid, oc.status, oc.step))
+    eng.outcomes.clear()
+    return done, outcomes, eng
+
+
+# ---------------------------------------------------------------------
+# satellite: the declarative stats base
+# ---------------------------------------------------------------------
+
+class TestStatsBase:
+    def test_fields_derived_and_repr_are_generated(self):
+        class Demo(StatsBase):
+            __slots__ = FIELDS = ("hits", "misses")
+            DERIVED = {"rate": 4}
+            REPR = ("rate", "hits")
+
+            @property
+            def rate(self):
+                total = self.hits + self.misses
+                return self.hits / total if total else 0.0
+
+        st = Demo()
+        assert st.hits == 0 and st.misses == 0
+        st.hits, st.misses = 2, 1
+        assert st.as_dict() == {"hits": 2, "misses": 1,
+                                "rate": round(2 / 3, 4)}
+        assert repr(st) == "Demo(rate=0.6667, hits=2)"
+
+    def test_every_declared_stat_is_export_visible(self):
+        """The satellite guarantee: the five serving siblings export
+        every slot AND every derived property through the generated
+        as_dict — nothing can be added without becoming visible."""
+        from paddle_tpu.inference import (PrefillStats,
+                                          PrefixCacheStats,
+                                          ResilienceStats,
+                                          SpecDecodeStats, TenantStats)
+        for cls in (PrefixCacheStats, PrefillStats, ResilienceStats,
+                    TenantStats, SpecDecodeStats):
+            st = cls()
+            d = st.as_dict()
+            for f in cls.FIELDS:
+                assert f in d, f"{cls.__name__}.{f} not exported"
+            for p in cls.DERIVED:
+                assert p in d, f"{cls.__name__}.{p} not exported"
+            assert tuple(cls.__slots__) == tuple(cls.FIELDS)
+            assert repr(st).startswith(cls.__name__ + "(")
+
+    def test_sibling_dicts_keep_their_keys(self):
+        """Pre-refactor key sets survive (snapshots, benches and the
+        doctor read them)."""
+        from paddle_tpu.inference import PrefixCacheStats, TenantStats
+        p = PrefixCacheStats()
+        p.lookup_blocks, p.hit_blocks = 8, 6
+        d = p.as_dict()
+        assert d["hit_rate"] == 0.75 and d["blocks_saved"] == 6
+        t = TenantStats()
+        t.sheds, t.rejections = 1, 2
+        assert t.as_dict()["failed"] == 3
+
+
+# ---------------------------------------------------------------------
+# the unified registry
+# ---------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        reg.count("served")
+        reg.count("served", 4)
+        reg.gauge("depth", 7)
+        for v in (1.0, 2.0, 3.0, 10.0):
+            reg.observe("lat", v)
+        d = reg.as_dict()
+        assert d["served"] == 5 and d["depth"] == 7
+        assert d["lat.count"] == 4 and d["lat.max"] == 10.0
+        h = reg.histogram("lat")
+        assert h["p50"] == 2.5 and h["count"] == 4
+        assert reg.histogram("nope") == {"count": 0}
+
+    def test_attach_stats_and_callable_flatten(self):
+        from paddle_tpu.inference import ResilienceStats
+        reg = MetricsRegistry()
+        st = ResilienceStats()
+        st.shed = 3
+        reg.attach("resilience", st)
+        reg.attach("tenants", lambda: {"a": {"queued": 2,
+                                             "stats": {"sheds": 1}}})
+        d = reg.as_dict()
+        assert d["resilience.shed"] == 3          # live object
+        st.shed = 4
+        assert reg.as_dict()["resilience.shed"] == 4
+        assert d["tenants.a.queued"] == 2
+        assert d["tenants.a.stats.sheds"] == 1
+
+    def test_delta_since_is_the_sampling_loop(self):
+        reg = MetricsRegistry()
+        reg.count("tok", 10)
+        reg.gauge("cfg", "str-valued")            # non-numeric: skipped
+        prev = reg.as_dict()
+        reg.count("tok", 7)
+        reg.count("fresh", 2)
+        delta = reg.delta_since(prev)
+        assert delta["tok"] == 7
+        assert delta["fresh"] == 2                # absent before -> 0
+        assert "cfg" not in delta
+
+    def test_percentiles_helper(self):
+        assert percentiles([]) == {"count": 0}
+        assert percentiles([None, None]) == {"count": 0}
+        p = percentiles([1.0, 3.0, None])
+        assert p["count"] == 2 and p["p50"] == 2.0
+
+    def test_engine_registry_unifies_the_stats_siblings(self):
+        tsm = _tsm()
+        col = TraceCollector()
+        done, _, eng = _drive(tsm, _prompts(11, n=3), 6,
+                              collector=col, k=0)
+        d = eng.registry.as_dict()
+        # the five siblings + tenant report + pool/queue gauges, one
+        # flat namespace
+        for key in ("prefix_cache.hit_rate", "prefill.decode_steps",
+                    "resilience.shed", "spec.proposed",
+                    "tenants.default.stats.tokens_served",
+                    "pool.active", "pool.free", "queue.depth"):
+            assert key in d, f"missing {key}"
+        assert d["prefill.decode_steps"] > 0
+        assert d["tenants.default.stats.tokens_served"] > 0
+        # interval deltas: another request's worth of serving moves
+        # only the moving parts
+        prev = eng.registry.as_dict()
+        rid = eng.submit(_prompts(12, n=1)[0])
+        for _ in range(6):
+            eng.step()
+        delta = eng.registry.delta_since(prev)
+        assert delta["prefill.decode_steps"] > 0
+        assert delta["tenants.default.stats.tokens_served"] > 0
+        # collector's own registry tracked the step/token counters
+        cd = col.registry.as_dict()
+        assert cd["steps.live"] == col.steps
+        assert cd["tokens.decoded"] > 0
+        assert cd["outcomes.finished"] == len(done)
+
+
+# ---------------------------------------------------------------------
+# satellite: structured BlockOOM
+# ---------------------------------------------------------------------
+
+class TestBlockOOMDetails:
+    def test_alloc_oom_carries_pool_occupancy_dict(self):
+        cache = PagedKVCache(LAYERS, HEADS, D // HEADS, 4, 6,
+                             max_seqs=2, max_blocks_per_seq=4)
+        cache.ensure(0, 12)            # 3 blocks
+        cache.set_seq_tenant(1, "greedy")
+        cache.ensure(1, 8)             # 2 blocks -> pool (5 usable) dry
+        with pytest.raises(BlockOOM) as ei:
+            cache.allocator.alloc(2)
+        det = ei.value.details
+        assert det["blocks_needed"] == 2 and det["blocks_free"] == 0
+        assert det["active"] == 5 and det["usable"] == 5
+        assert det["blocks_per_slot"] == {0: 3, 1: 2}
+        assert det["blocks_per_tenant"] == {"greedy": 2}
+        # the dict IS the message's source: they agree
+        assert "blocks per slot: {0: 3, 1: 2}" in str(ei.value)
+        assert det == dict(cache.pool_occupancy(), blocks_needed=2,
+                           blocks_free=0)
+
+    def test_injected_oom_is_flagged(self):
+        inj = FaultInjector(oom_at=[1])
+        inj.begin_step(1)
+        with pytest.raises(BlockOOM) as ei:
+            inj.on_alloc("target")
+        assert ei.value.details == {"injected": True, "pool": "target",
+                                    "step": 1}
+
+    def test_shed_emits_the_occupancy_event(self):
+        """Every shed/OOM surfaces the structured dict as a telemetry
+        event: a whole-step forced OOM sheds one request and the
+        collector holds both the ``block_oom`` instant (injected
+        details) and the ``oom_shed`` occupancy dump."""
+        tsm = _tsm()
+        col = TraceCollector()
+        # ALL allocs fail over a 4-step window: with 4-token blocks
+        # every slot crosses a page boundary inside it, so at least
+        # one growth hits the forced OOM and preemption cannot help
+        inj = FaultInjector(oom_at=[3, 4, 5, 6])
+        done, outcomes, eng = _drive(
+            tsm, _prompts(21, n=3, lo=8, hi=12), 8, collector=col,
+            injector=inj, k=0, num_blocks=9, max_blocks_per_seq=6,
+            max_batch=2)
+        assert any(s == "failed_oom" for _, s, _ in outcomes)
+        names = [ev["name"] for ev in col.events if ev.get("ph") == "i"]
+        assert "block_oom" in names and "oom_shed" in names
+        shed_ev = next(ev for ev in col.events
+                       if ev["name"] == "oom_shed")
+        for key in ("active", "cached_free", "free", "usable",
+                    "blocks_per_slot", "rid", "tenant", "step"):
+            assert key in shed_ev["args"]
+        oom_ev = next(ev for ev in col.events
+                      if ev["name"] == "block_oom")
+        assert oom_ev["args"]["injected"] is True
+        assert col.registry.as_dict()["events.oom_shed"] >= 1
+
+
+# ---------------------------------------------------------------------
+# zero overhead when off: the counting-clock test
+# ---------------------------------------------------------------------
+
+class _CountingTime:
+    """time-module stand-in that counts every clock read."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def perf_counter(self):
+        self.calls += 1
+        return time.perf_counter()
+
+    def monotonic(self):
+        self.calls += 1
+        return time.monotonic()
+
+
+class TestZeroOverheadWhenOff:
+    def _serve(self, collector):
+        model = _model()
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=20, max_blocks_per_seq=5,
+                                 collector=collector)
+        rng = np.random.RandomState(3)
+        for _ in range(2):
+            eng.submit(paddle.to_tensor(
+                rng.randn(6, D).astype(np.float32)))
+        x = np.zeros((2, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        for _ in range(4):
+            out = eng.step(paddle.to_tensor(x))
+            x = np.asarray(out.numpy())[:, :1].copy()
+        eng.release(0)
+        return eng
+
+    def test_no_collector_means_zero_clock_reads(self, monkeypatch):
+        """The acceptance clause: with no collector installed the
+        serving hot path performs NO clock reads — submit, prefill,
+        steps, release. (Deadline-carrying submits still read the
+        monotonic clock, as before this PR — that is behavioral
+        state, not telemetry.)"""
+        fake = _CountingTime()
+        monkeypatch.setattr(sched_mod, "time", fake)
+        monkeypatch.setattr(tele_mod, "time", fake)
+        self._serve(collector=None)
+        assert fake.calls == 0
+
+    def test_collector_reads_the_injected_clock_only(self, monkeypatch):
+        """Sanity for the counter itself, and for clock injection: a
+        collector built AFTER the patch reads only through the
+        patched module / its injected clock."""
+        fake = _CountingTime()
+        monkeypatch.setattr(sched_mod, "time", fake)
+        monkeypatch.setattr(tele_mod, "time", fake)
+        self._serve(collector=TraceCollector())
+        assert fake.calls > 0
+
+    def test_deterministic_injected_clock(self):
+        """A fake clock makes every latency exact: TTFT/TPOT/queue
+        wait derive purely from the recorded stamps."""
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        col = TraceCollector(clock=clock)
+        col.on_submit(0, "a", 5)       # t=2 (t=1 was construction)
+        col.on_admitted(0, 0, retry=False)   # t=3
+        col.on_first_token(0)          # t=4
+        col.on_decode([0], 1)          # t=5
+        col.on_decode([0], 1)          # t=6
+        col.on_outcome(0, "finished", 2)
+        rec = col.requests[0]
+        assert rec.queue_wait_s == 1.0
+        assert rec.ttft_s == 2.0
+        assert rec.tpot_s == 2.0       # (6 - 4) / (2 - 1)
+        s = col.request_summary()
+        assert s["overall"]["requests"] == 1
+        assert s["per_tenant"]["a"]["ttft_s"]["p50"] == 2.0
+
+
+# ---------------------------------------------------------------------
+# passivity: bit-identity with tracing on vs off, all four modes
+# ---------------------------------------------------------------------
+
+class TestPassiveBitIdentity:
+    N_GEN = 8
+
+    def _both(self, seed, **eng_kw):
+        tsm = _tsm()
+        prompts = _prompts(seed)
+        base, base_oc, _ = _drive(tsm, prompts, self.N_GEN, **eng_kw)
+        col = TraceCollector()
+        traced, traced_oc, eng = _drive(tsm, prompts, self.N_GEN,
+                                        collector=col, **eng_kw)
+        assert traced == base, "tracing changed a token stream"
+        assert traced_oc == base_oc, "tracing changed an outcome"
+        return col, eng
+
+    def test_plain_paged(self):
+        col, eng = self._both(41, k=0)
+        assert col.steps > 0 and len(col.requests) == 4
+        assert all(r.outcome == "finished"
+                   for r in col.requests.values())
+
+    def test_prefix_cached(self):
+        col, eng = self._both(42, k=0, prefix_cache=True)
+        assert eng.engine.prefix_cache
+
+    @pytest.mark.spec
+    def test_speculative(self):
+        col, eng = self._both(43, k=2)
+        # spec rounds recorded their spans and rollback accounting
+        names = {ev["name"] for ev in col.events}
+        assert {"spec_round", "draft_roll", "sample_verify",
+                "verify"} <= names
+        # emitted tokens (rollback-adjusted) match the streams
+        for rid, rec in col.requests.items():
+            gen = len(eng.generated(rid)) if rid in eng._by_rid \
+                else None
+            if gen is not None:
+                # tokens = consumed decode rows minus rejected; the
+                # stream holds prompt-independent generated tokens
+                # (first token comes from prefill, not a decode row)
+                assert rec.tokens == gen - 1 or rec.tokens == gen
+
+    @pytest.mark.faults
+    def test_under_fault_storm(self):
+        """PR 5 composition: a seeded storm (forced OOM sheds + NaN
+        slots) with tracing on — same outcomes, same survivor
+        streams, and the failures are visible in the trace."""
+        kw = dict(k=0, num_blocks=16, max_blocks_per_seq=8,
+                  max_batch=2)
+        tsm = _tsm()
+        prompts = _prompts(44, n=4, lo=8, hi=12)
+        runs = {}
+        for tag, col in (("off", None), ("on", TraceCollector())):
+            inj = FaultInjector(oom_at=[4], nan_at={6: [1]})
+            runs[tag] = _drive(tsm, prompts, self.N_GEN,
+                               collector=col, injector=inj, **kw)
+        base, base_oc, _ = runs["off"]
+        traced, traced_oc, eng = runs["on"]
+        assert traced == base and traced_oc == base_oc
+        col = eng.collector
+        statuses = {r.outcome for r in col.requests.values()}
+        assert "failed_numeric" in statuses or \
+            "failed_oom" in statuses
+        # every terminal outcome in the engine is in the trace, once
+        assert sorted((r.rid, r.outcome)
+                      for r in col.requests.values()
+                      if r.outcome is not None) == \
+            sorted(set((rid, s) for rid, s, _ in traced_oc))
+
+
+# ---------------------------------------------------------------------
+# recovery safety: crash/recover with tracing on
+# ---------------------------------------------------------------------
+
+def _drive_recoverable(tsm, prompts, n_gen, jp, sp, injector,
+                       collector, max_iters=300):
+    eng = SpeculativeEngine(tsm, None, k=0, max_batch=2, block_size=4,
+                            num_blocks=60, max_blocks_per_seq=10,
+                            injector=injector, collector=collector)
+    srv = RecoverableServer(eng, journal_path=jp, snapshot_path=sp,
+                            snapshot_every=4)
+    rids = [srv.submit(p) for p in prompts]
+    done, failed = {}, set()
+    restores = 0
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        try:
+            srv.step()
+            for oc in srv.drain_outcomes():
+                if oc.failed:
+                    failed.add(oc.rid)
+            for r in live:
+                if r in failed:
+                    continue
+                if len(srv.generated(r)) >= n_gen:
+                    done[r] = srv.generated(r)[:n_gen]
+                    srv.release(r)
+        except EngineCrash:
+            srv = RecoverableServer.recover(
+                tsm, None, journal_path=jp, snapshot_path=sp,
+                injector=injector, collector=collector)
+            srv.check_invariants()
+            restores += 1
+    else:
+        raise AssertionError("recoverable driver did not converge")
+    srv.close()
+    return done, restores, srv
+
+
+class TestRecoverySafety:
+    N_GEN = 8
+
+    @pytest.mark.recovery
+    def test_crash_recover_cycle_is_traced_not_diverged(self, tmp_path):
+        """PR 6 composition: an injected crash + snapshot/replay
+        recovery with the collector riding through ``recover`` — the
+        streams stay bit-identical to the no-collector crash run,
+        replayed steps are FLAGGED, and no request's terminal outcome
+        or latency is double-counted."""
+        tsm = _tsm()
+        prompts = _prompts(51)
+        runs = {}
+        for tag, col in (("off", None), ("on", TraceCollector())):
+            # post_journal first: the round IS journaled but the death
+            # lands before the caller sees it, so recovery must replay
+            # real rounds (snapshot_every=4 keeps the snapshot behind)
+            inj = CrashInjector(crash_at={3: "post_journal",
+                                          6: "pre_journal"})
+            jp = str(tmp_path / f"{tag}.wal")
+            sp = str(tmp_path / f"{tag}.ckpt")
+            runs[tag] = (*_drive_recoverable(
+                tsm, prompts, self.N_GEN, jp, sp, inj, col), col, inj)
+        base, base_restores, _, _, _ = runs["off"]
+        traced, restores, srv, col, inj = runs["on"]
+        assert inj.crashes == 2 and restores == 2
+        assert traced == base, \
+            "tracing changed streams across the crash storm"
+        # replayed work is flagged, not double-counted
+        assert col.replayed_steps > 0
+        flagged = [ev for ev in col.events
+                   if (ev.get("args") or {}).get("replay")]
+        assert flagged, "replayed spans must carry the replay flag"
+        # each request: exactly one terminal outcome in the trace
+        finished = [r for r in col.requests.values()
+                    if r.outcome is not None]
+        assert len(finished) == len(prompts)
+        assert col.registry.as_dict()["outcomes.finished"] == \
+            len(prompts)
+        # latency histograms saw each request at most once
+        assert col.registry.histogram(
+            "latency.ttft_s")["count"] <= len(prompts)
+        # summary excludes nothing live (no replay-born requests here:
+        # every rid was submitted before the first crash)
+        assert col.request_summary()["overall"]["requests"] == \
+            len(prompts)
+
+    def test_snapshot_carries_no_collector_state(self):
+        """Recovery-safe clause: wall-clock telemetry never enters
+        engine-behavioral state — a traced engine's snapshot equals
+        the untraced engine's snapshot, bit for bit."""
+        import pickle
+        tsm = _tsm()
+        prompts = _prompts(52, n=2)
+        snaps = {}
+        for tag, col in (("off", None), ("on", TraceCollector())):
+            eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                    block_size=4, num_blocks=30,
+                                    max_blocks_per_seq=8,
+                                    collector=col)
+            for p in prompts:
+                eng.submit(p)
+            for _ in range(3):
+                eng.step()
+            snaps[tag] = pickle.dumps(eng.snapshot())
+        assert snaps["on"] == snaps["off"]
+
+    def test_restore_wires_the_callers_collector(self):
+        tsm = _tsm()
+        col = TraceCollector()
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                block_size=4, num_blocks=30,
+                                max_blocks_per_seq=8)
+        eng.submit(_prompts(53, n=1)[0])
+        eng.step()
+        restored = SpeculativeEngine.restore(tsm, None, eng.snapshot(),
+                                             collector=col)
+        assert restored.collector is col
+        assert restored.engine.collector is col
+        restored.step()
+        assert col.steps > 0
+        # the restored engine's registry re-attached the spec stats
+        assert "spec.proposed" in restored.registry.as_dict()
+
+
+# ---------------------------------------------------------------------
+# the step timeline + request lifecycle detail
+# ---------------------------------------------------------------------
+
+class TestTimelineAndLifecycle:
+    def test_step_phases_and_gauges(self):
+        tsm = _tsm()
+        col = TraceCollector()
+        _drive(tsm, _prompts(61, n=3), 6, collector=col, k=0)
+        phases = {}
+        for ev in col.events:
+            if ev.get("ph") == "X":
+                phases[ev["name"]] = phases.get(ev["name"], 0) + 1
+        # every step bracketed with its phases (the k=0 spec host
+        # serves through step_multi, whose step kind is "verify")
+        assert phases["verify"] == col.steps
+        for name in ("model", "bookkeeping", "admission"):
+            assert phases.get(name, 0) >= col.steps, \
+                f"phase {name} missing from some step"
+        # prefill ran as its own span (synchronous admission)
+        assert phases.get("prefill", 0) >= 3
+        # a healthy run tears nothing down: no span flagged aborted
+        assert not any((ev.get("args") or {}).get("aborted")
+                       for ev in col.events)
+        # per-step gauges: pool tiers + queue depths + tenant charge
+        gauges = [ev for ev in col.events if ev.get("ph") == "C"]
+        tracks = {ev["name"] for ev in gauges}
+        assert tracks == {"pool", "queue", "tenant_blocks"}
+        pool = next(ev for ev in gauges if ev["name"] == "pool")
+        assert {"active", "cached_free", "free"} <= set(pool["args"])
+        # spans nest sanely: phases sit inside their step's interval
+        steps = [(ev["ts"], ev["ts"] + ev["dur"]) for ev in col.events
+                 if ev.get("ph") == "X" and ev["name"] == "verify"]
+        for ev in col.events:
+            if ev.get("ph") == "X" and ev["name"] == "model":
+                t0, t1 = ev["ts"], ev["ts"] + ev["dur"]
+                assert any(s0 - 1e-9 <= t0 and t1 <= s1 + 1e-9
+                           for s0, s1 in steps), \
+                    "model phase outside any step span"
+
+    def test_chunked_prefill_and_preemption_lifecycle(self):
+        """Token-budget (Sarathi) mode + a pool small enough to force
+        preemption: the request records show prefill chunks, the
+        preempted -> readmitted arc with a positive stall, and the
+        'prefill' step phase."""
+        model = _model()
+        col = TraceCollector()
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=11, max_blocks_per_seq=8,
+                                 chunk_tokens=4,
+                                 prefill_token_budget=8,
+                                 collector=col)
+        rng = np.random.RandomState(5)
+        for T in (16, 14):
+            eng.submit(paddle.to_tensor(
+                rng.randn(T, D).astype(np.float32)))
+        x = np.zeros((2, 1, D), np.float32)
+        for _ in range(80):
+            if eng.num_active == 0 and eng.num_prefilling == 0 \
+                    and not eng.queue:
+                break       # both capacity-finished and auto-released
+            out = eng.step(paddle.to_tensor(x))
+            for _, slot, h in eng.admitted:
+                x[slot, 0] = np.asarray(h.numpy())[0]
+            eng.admitted.clear()
+            if out is not None:
+                x = np.asarray(out.numpy())[:, :1].copy()
+        recs = list(col.requests.values())
+        assert all(r.chunks > 0 for r in recs)
+        chunk_events = [e for r in recs for e in r.events
+                        if e[1] == "prefill_chunk"]
+        assert chunk_events
+        preempted = [r for r in recs if r.preemptions > 0]
+        assert preempted, "workload failed to force a preemption"
+        for r in preempted:
+            names = [name for _, name, _ in r.events]
+            assert "preempted" in names and "readmitted" in names
+            assert names.index("preempted") < names.index("readmitted")
+            assert r.stall_s > 0
+        # the mixed-step prefill phase is on the timeline
+        assert any(ev.get("ph") == "X" and ev["name"] == "prefill"
+                   for ev in col.events)
+
+    @pytest.mark.spec
+    def test_rollback_events_ride_the_spec_engine(self):
+        """An adversarial draft (noise logits) forces rejections:
+        rolled_back lifecycle events appear and token counts stay
+        rollback-adjusted."""
+        tsm = _tsm()
+        col = TraceCollector()
+        inj = FaultInjector(draft_nan_at={2: [0, 1], 3: [0, 1]})
+        done, _, eng = _drive(tsm, _prompts(62, n=2), 6,
+                              collector=col, injector=inj, k=2)
+        rolled = [e for r in col.requests.values() for e in r.events
+                  if e[1] == "rolled_back"]
+        assert rolled and all(a["rejected"] > 0 for _, _, a in rolled)
+
+    def test_unknown_rids_are_not_synthesized(self):
+        """A collector wired onto a restored engine with in-flight
+        requests it never saw submitted: lifecycle hooks for those
+        rids are no-ops — no tenant-less half-records, no negative
+        token tallies from rollbacks (a request is traced from its
+        submit or not at all)."""
+        col = TraceCollector()
+        col.on_decode([7], 3)
+        col.on_rollback(7, 2)
+        col.on_admitted(7, 0, retry=False)
+        col.on_outcome(7, "finished", 4)
+        assert col.requests == {}
+        s = col.request_summary()
+        assert s["overall"]["requests"] == 0
+        assert s["overall"]["tokens"] == 0
+        assert None not in s["per_tenant"]
+
+    def test_replay_flag_stays_off_counter_events(self):
+        """During replay, gauge ('C') events must NOT gain a bogus
+        'replay' series — their args IS the series->value map."""
+        col = TraceCollector()
+        col.set_replay(True)
+        col.begin_step(1)
+        col.end_step({"pool": {"active": 4}})
+        col.on_event("marker")
+        col.set_replay(False)
+        counter = next(ev for ev in col.events if ev["ph"] == "C")
+        assert counter["args"] == {"active": 4}
+        span = next(ev for ev in col.events if ev["ph"] == "X")
+        assert span["args"]["replay"] is True
+        inst = next(ev for ev in col.events if ev["ph"] == "i")
+        assert inst["args"]["replay"] is True
+
+    def test_event_buffer_bound(self):
+        col = TraceCollector(max_events=3)
+        for i in range(10):
+            col.on_event(f"e{i}")
+        assert len(col.events) == 3 and col.dropped == 7
+        assert col.as_dict()["dropped_events"] == 7
+
+    def test_long_lived_memory_bounds(self):
+        """A long-lived traced server stays bounded: terminal request
+        records evict oldest-first past ``max_requests``, per-record
+        event logs cap (keeping the terminal verdict), and latency
+        histograms keep a window, not O(total requests)."""
+        col = TraceCollector(max_requests=4)
+        for rid in range(10):
+            col.on_submit(rid, "t", 5)
+            col.on_admitted(rid, 0, retry=False)
+            col.on_first_token(rid)
+            col.on_outcome(rid, "finished", rid)
+        assert len(col.requests) == 4
+        assert col.evicted_requests == 6
+        # oldest terminal evicted first; newest survive
+        assert sorted(col.requests) == [6, 7, 8, 9]
+        # live records are never evicted
+        col2 = TraceCollector(max_requests=2)
+        for rid in range(4):
+            col2.on_submit(rid, "t", 5)      # all live, no outcome
+        assert len(col2.requests) == 4 and col2.evicted_requests == 0
+        # per-record event log caps but keeps the terminal event
+        col3 = TraceCollector()
+        col3.on_submit(0, "t", 5)
+        rec = col3.requests[0]
+        for i in range(2 * col3.MAX_REQ_EVENTS):
+            col3.on_prefill_chunk(0, i)
+        assert len(rec.events) == col3.MAX_REQ_EVENTS
+        col3.on_outcome(0, "finished", 1)
+        assert len(rec.events) == col3.MAX_REQ_EVENTS
+        assert rec.events[-1][1] == "finished"
+        # histogram window
+        reg = MetricsRegistry()
+        for i in range(5 * reg.HIST_WINDOW):
+            reg.observe("lat", float(i))
+        assert len(reg._hists["lat"]) <= 2 * reg.HIST_WINDOW
+        assert reg.histogram("lat")["max"] == 5 * reg.HIST_WINDOW - 1
+
+
+# ---------------------------------------------------------------------
+# chrome trace export + the offline doctor
+# ---------------------------------------------------------------------
+
+class TestChromeTraceAndReport:
+    def _trace_file(self, tmp_path):
+        tsm = _tsm()
+        col = TraceCollector()
+        _drive(tsm, _prompts(71, n=3), 6, collector=col, k=0)
+        path = str(tmp_path / "serve.trace.json")
+        n = col.save_chrome_trace(path)
+        assert os.path.getsize(path) == n
+        return path, col
+
+    def test_trace_is_valid_trace_events_json(self, tmp_path):
+        path, col = self._trace_file(tmp_path)
+        with open(path) as f:
+            trace = json.load(f)
+        evs = trace["traceEvents"]
+        assert isinstance(evs, list) and evs
+        for ev in evs:
+            assert "ph" in ev and "name" in ev
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+            if ev["ph"] != "M":
+                assert "ts" in ev
+        # both tracks present: engine timeline + request async events
+        assert {ev.get("pid") for ev in evs if ev["ph"] != "M"} == \
+            {1, 2}
+        reqs = [ev for ev in evs if ev.get("cat") == "request"]
+        assert {ev["ph"] for ev in reqs} == {"b", "n", "e"}
+        # metadata carries the machine-readable side
+        md = trace["metadata"]
+        assert md["summary"]["overall"]["requests"] == 3
+        assert str(0) in set(str(k) for k in md["requests"])
+
+    def test_trace_report_exit_codes(self, tmp_path, capsys):
+        from tools import trace_report
+        path, _ = self._trace_file(tmp_path)
+        # 0: clean — prints spans + percentiles
+        assert trace_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "valid trace_events JSON" in out
+        assert "model" in out and "ttft_s" in out
+        assert trace_report.main([path, "--requests"]) == 0
+        out = capsys.readouterr().out
+        assert "submitted" in out and "first_token" in out
+        # 2: unreadable — not JSON / missing file
+        bad = str(tmp_path / "not.json")
+        with open(bad, "w") as f:
+            f.write("{truncated")
+        assert trace_report.main([bad]) == 2
+        assert trace_report.main([str(tmp_path / "missing.json")]) == 2
+        # 1: structurally invalid traces
+        for blob in ({"notTraceEvents": []},
+                     {"traceEvents": [{"ph": "X", "name": "x",
+                                       "ts": 1.0, "dur": -5.0}]},
+                     {"traceEvents": [{"ph": "X", "ts": 0.0}]}):
+            p = str(tmp_path / "bad.json")
+            with open(p, "w") as f:
+                json.dump(blob, f)
+            assert trace_report.main([p]) == 1, blob
+
+    def test_report_validate_rejects_foreign_shapes(self):
+        from tools import trace_report
+        assert trace_report.validate({"traceEvents": "nope"})
+        assert trace_report.validate({}) != []
+        assert trace_report.validate(
+            {"traceEvents": [{"ph": "X", "name": "a", "ts": 0,
+                              "dur": 1}]}) == []

@@ -1,0 +1,206 @@
+"""Offline trace summarizer: load a Chrome-trace JSON written by
+``TraceCollector.save_chrome_trace`` (inference/telemetry.py),
+validate the ``trace_events`` structure, and print the serving story
+— span durations by phase, gauge tracks, per-request lifecycles and
+per-tenant TTFT / TPOT / queue-wait percentiles — without needing the
+engine, the model, or a live process. Sibling of
+tools/recovery_check.py (the snapshot doctor); this is the timeline
+doctor.
+
+Usage:
+  python tools/trace_report.py TRACE.json [--tenant TID] [--requests]
+
+Accepts any file whose top level carries a ``traceEvents`` list (the
+Perfetto/chrome://tracing interchange format); the request/summary
+sections need the ``metadata`` block our collector writes and are
+skipped (with a note) for foreign traces. Exit status: 0 clean,
+1 structurally invalid trace (not trace_events, malformed or
+negative-duration events), 2 unreadable file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# span names that belong to one engine step (phases) vs wrappers
+_PHASES = ("admission", "prefill", "model", "bookkeeping")
+
+
+def _fmt_s(us: float) -> str:
+    s = us / 1e6
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    return f"{s * 1e3:.2f}ms"
+
+
+def _pct_line(name: str, p: dict) -> str:
+    if not p or p.get("count", 0) == 0:
+        return f"    {name}: (no samples)"
+    ms = {k: v * 1e3 for k, v in p.items() if k != "count"}
+    return (f"    {name}: n={p['count']}"
+            + "".join(f", {k}={ms[k]:.2f}ms"
+                      for k in ("p50", "p90", "p99", "max")
+                      if k in ms))
+
+
+def validate(trace: dict) -> list:
+    """Structural problems with a would-be Chrome trace ([], or a
+    list of human-readable complaints)."""
+    bad = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["top-level 'traceEvents' missing or not a list — "
+                "not a Chrome trace"]
+    if not evs:
+        bad.append("traceEvents is empty")
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            bad.append(f"event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph is None or "name" not in ev:
+            bad.append(f"event {i} lacks 'ph'/'name'")
+            continue
+        if ph != "M" and "ts" not in ev:
+            bad.append(f"event {i} ({ev.get('name')!r}) lacks 'ts'")
+        if ph == "X":
+            dur = ev.get("dur")
+            if dur is None:
+                bad.append(f"event {i} ({ev.get('name')!r}): complete "
+                           f"event without 'dur'")
+            elif dur < 0:
+                bad.append(f"event {i} ({ev.get('name')!r}): negative "
+                           f"duration {dur}")
+        if len(bad) >= 20:
+            bad.append("... (further problems suppressed)")
+            break
+    return bad
+
+
+def summarize(trace: dict, tenant: str = None,
+              show_requests: bool = False) -> str:
+    evs = trace["traceEvents"]
+    lines = []
+    # -- span rollup --------------------------------------------------
+    spans = {}
+    counters = set()
+    insts = {}
+    replayed = 0
+    for ev in evs:
+        ph = ev.get("ph")
+        if ph == "X":
+            name = ev["name"]
+            tot, n, mx = spans.get(name, (0.0, 0, 0.0))
+            d = float(ev.get("dur", 0))
+            spans[name] = (tot + d, n + 1, max(mx, d))
+            if (ev.get("args") or {}).get("replay"):
+                replayed += 1
+        elif ph == "C":
+            counters.add(ev["name"])
+        elif ph == "i":
+            insts[ev["name"]] = insts.get(ev["name"], 0) + 1
+    lines.append(f"timeline: {len(evs)} event(s), "
+                 f"{sum(n for _, n, _ in spans.values())} span(s)"
+                 + (f" ({replayed} replay-flagged)" if replayed
+                    else ""))
+    order = sorted(spans, key=lambda n: -spans[n][0])
+    phase_names = [n for n in order if n in _PHASES]
+    other_names = [n for n in order if n not in _PHASES]
+    for title, names in (("step phases", phase_names),
+                         ("spans", other_names)):
+        if not names:
+            continue
+        lines.append(f"  {title}:")
+        for name in names:
+            tot, n, mx = spans[name]
+            lines.append(f"    {name}: {n} x, total {_fmt_s(tot)}, "
+                         f"mean {_fmt_s(tot / n)}, max {_fmt_s(mx)}")
+    if counters:
+        lines.append(f"  gauge tracks: {sorted(counters)}")
+    if insts:
+        lines.append(f"  instants: "
+                     + ", ".join(f"{k} x{v}"
+                                 for k, v in sorted(insts.items())))
+    # -- request summary (our metadata block) -------------------------
+    meta = trace.get("metadata")
+    if not isinstance(meta, dict) or "summary" not in meta:
+        lines.append("no collector metadata (foreign trace?) — "
+                     "request summary skipped")
+        return "\n".join(lines)
+    summ = meta["summary"]
+    lines.append(f"engine: {meta.get('steps', '?')} step(s) traced"
+                 + (f", {meta['replayed_steps']} replayed"
+                    if meta.get("replayed_steps") else "")
+                 + (f", {meta['dropped_events']} event(s) DROPPED "
+                    f"(buffer full)"
+                    if meta.get("dropped_events") else ""))
+    sections = [("overall", summ.get("overall", {}))]
+    per_tenant = summ.get("per_tenant", {})
+    if tenant is not None:
+        if tenant not in per_tenant:
+            lines.append(f"  tenant {tenant!r}: no terminal requests")
+        else:
+            sections.append((f"tenant {tenant!r}", per_tenant[tenant]))
+    else:
+        sections.extend((f"tenant {t!r}", s)
+                        for t, s in sorted(per_tenant.items(),
+                                           key=lambda kv: str(kv[0])))
+    for title, s in sections:
+        lines.append(f"  {title}: {s.get('requests', 0)} terminal "
+                     f"request(s), {s.get('tokens', 0)} token(s), "
+                     f"{s.get('preemptions', 0)} preemption(s)")
+        for metric in ("ttft_s", "tpot_s", "queue_wait_s", "stall_s"):
+            lines.append(_pct_line(metric, s.get(metric, {})))
+    if show_requests:
+        lines.append("requests:")
+        for rid, rec in sorted(meta.get("requests", {}).items(),
+                               key=lambda kv: int(kv[0])):
+            lines.append(
+                f"  rid {rid} [{rec.get('tenant')}]: "
+                f"{rec.get('outcome') or 'live'} @ step "
+                f"{rec.get('outcome_step')}, {rec.get('tokens')} tok, "
+                f"{rec.get('chunks')} chunk(s), "
+                f"{rec.get('preemptions')} preemption(s)"
+                + (" [replayed]" if rec.get("replayed") else ""))
+            for ts, name, args in rec.get("events", []):
+                lines.append(f"      {ts * 1e3:10.3f}ms  {name}"
+                             + (f"  {args}" if args else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a serving Chrome-trace JSON offline")
+    ap.add_argument("trace")
+    ap.add_argument("--tenant", default=None,
+                    help="show only this tenant's latency section")
+    ap.add_argument("--requests", action="store_true",
+                    help="print every request's full event log")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"UNREADABLE: {e}")
+        return 2
+    if not isinstance(trace, dict):
+        print("UNREADABLE: top level is not a JSON object")
+        return 2
+
+    problems = validate(trace)
+    if problems:
+        print(f"INVALID trace ({len(problems)} problem(s)):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+
+    print(f"trace {args.trace}: valid trace_events JSON")
+    print(summarize(trace, tenant=args.tenant,
+                    show_requests=args.requests))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
