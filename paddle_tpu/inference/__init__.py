@@ -36,6 +36,9 @@ from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       SpecDecodeStats, TenantStats)
 from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
                         TraceCollector)
+from .monitor import (Alert, HealthMonitor,  # noqa: F401
+                      HealthReport, SeriesBuffer, SloPolicy,
+                      SloTracker)
 from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedKVCache, PagedLayerCache,
                           PagedPrefillView,
@@ -54,8 +57,11 @@ from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
                        load_snapshot, read_journal, save_snapshot)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "ContinuousBatchingEngine", "BlockAllocator",
+           "PlaceType", "Alert", "ContinuousBatchingEngine",
+           "BlockAllocator",
            "BlockOOM", "CrashInjector", "EngineCrash", "FaultInjector",
+           "HealthMonitor", "HealthReport", "SeriesBuffer",
+           "SloPolicy", "SloTracker",
            "MetricsRegistry", "PagedKVCache",
            "PagedLayerCache", "PagedPrefillView", "PagedRequest",
            "PagedServingEngine", "PrefillStats", "PrefixCacheStats",

@@ -195,6 +195,9 @@ class RequestJournal:
         self.path = path
         self.sync = sync
         self.seq = 0
+        # intact journal bytes on disk (header + body per record) —
+        # the ``journal.bytes`` durability gauge's ground truth
+        self.bytes_written = 0
         # intact records found on open (append mode) — recovery reads
         # them from here instead of re-scanning the file
         self.startup_records: List[tuple] = []
@@ -213,6 +216,7 @@ class RequestJournal:
             if recs:
                 self.seq = recs[-1][0]
             self.startup_records = recs
+            self.bytes_written = 0 if valid is None else int(valid)
         self._f = open(path, "wb" if fresh else "ab")
 
     def append(self, kind: str, payload: dict) -> int:
@@ -221,6 +225,7 @@ class RequestJournal:
         self._f.write(self._HDR.pack(len(blob),
                                      zlib.crc32(blob) & 0xFFFFFFFF))
         self._f.write(blob)
+        self.bytes_written += self._HDR.size + len(blob)
         self._f.flush()
         if self.sync:
             os.fsync(self._f.fileno())
@@ -323,10 +328,33 @@ class RecoverableServer:
         # that would have held them died with the process, so
         # re-delivery is what exactly-once means post-recovery
         self._pending_drain: List[list] = []
+        # durability ground truth in the ALWAYS-ON registry (the
+        # journal-lag health alert's source — previously these existed
+        # only as trace spans): records appended since the last
+        # snapshot, intact journal bytes, and engine steps since the
+        # last snapshot. Live sources — read at scrape time, zero
+        # hot-path cost.
+        self._snap_seq = 0          # journal.seq at the last snapshot
+        self._snap_step = 0         # engine step at the last snapshot
+        engine.registry.attach("journal", self._journal_gauges)
+        engine.registry.attach("snapshot", self._snapshot_gauges)
         if _fresh:
             self.journal = RequestJournal(journal_path, fresh=True,
                                           sync=self.sync)
             self.save_snapshot()
+
+    def _engine_step(self) -> int:
+        return self.engine.engine._step_count
+
+    def _journal_gauges(self) -> dict:
+        j = getattr(self, "journal", None)   # recover() wires it late
+        if j is None:
+            return {"lag_records": 0, "bytes": 0}
+        return {"lag_records": j.seq - self._snap_seq,
+                "bytes": j.bytes_written}
+
+    def _snapshot_gauges(self) -> dict:
+        return {"age_steps": self._engine_step() - self._snap_step}
 
     # -- persistence --------------------------------------------------
     def _flush_drains(self) -> None:
@@ -349,6 +377,8 @@ class RecoverableServer:
             "delivered": sorted(self._delivered),
         })
         self.snapshots_taken += 1
+        self._snap_seq = self.journal.seq
+        self._snap_step = self._engine_step()
 
     # -- serving surface ----------------------------------------------
     def submit(self, token_ids, **kw) -> int:
@@ -461,7 +491,7 @@ class RecoverableServer:
     @classmethod
     def recover(cls, target, draft=None, *, journal_path: str,
                 snapshot_path: str, injector=None, collector=None,
-                sync: bool = False,
+                monitor=None, sync: bool = False,
                 num_blocks: Optional[int] = None) -> "RecoverableServer":
         """Rebuild a server after a crash: restore the last snapshot,
         then deterministically replay the journal suffix. Crash points
@@ -483,7 +513,14 @@ class RecoverableServer:
         tracing a recovery neither diverges the replay nor
         double-counts a span or a latency. Snapshots carry no
         collector state (telemetry is observational; its wall-clock
-        stamps must never enter engine-behavioral state)."""
+        stamps must never enter engine-behavioral state).
+
+        ``monitor`` (HealthMonitor) rides the same bracket: monitor
+        state is DERIVED, never snapshotted — a fresh monitor rebuilds
+        its series by resampling the replayed steps (alerts re-derived
+        there are flagged ``replayed`` and kept out of the live
+        counts), while a monitor that lived through the crash keeps
+        its live samples frozen and nothing double-counts."""
         snap = load_snapshot(snapshot_path)
         if snap.get("kind") != "recoverable_server":
             raise SnapshotVersionError(
@@ -494,11 +531,13 @@ class RecoverableServer:
             eng = SpeculativeEngine.restore(
                 target, draft, _resize_engine_snap(eng_snap,
                                                    num_blocks),
-                injector=injector, collector=collector)
+                injector=injector, collector=collector,
+                monitor=monitor)
         else:
             eng = SpeculativeEngine.restore(target, draft, eng_snap,
                                             injector=injector,
-                                            collector=collector)
+                                            collector=collector,
+                                            monitor=monitor)
         srv = cls(eng, journal_path=journal_path,
                   snapshot_path=snapshot_path, sync=sync,
                   snapshot_every=snap["snapshot_every"], _fresh=False)
@@ -524,10 +563,18 @@ class RecoverableServer:
         journal.startup_records = []        # `records` is held here
         srv.rounds = snap["rounds"]
         srv._delivered = set(snap["delivered"])
+        # the durability gauges resume from the RECOVERED lineage: lag
+        # counts from the snapshot being restored, age from the
+        # restored step clock — exactly what a live server that just
+        # snapshotted would report
+        srv._snap_seq = snap["journal_seq"]
+        srv._snap_step = srv._engine_step()
         if injector is not None:
             injector.arm(False)
         if collector is not None:
             collector.set_replay(True)
+        if monitor is not None:
+            monitor.set_replay(True)
         try:
             for seq, kind, payload in records:
                 if kind == "outcomes":
@@ -585,6 +632,8 @@ class RecoverableServer:
                 injector.arm(True)
             if collector is not None:
                 collector.set_replay(False)
+            if monitor is not None:
+                monitor.set_replay(False)
         # outcomes regenerated by the replay that were already drained
         # pre-crash: drop them here, exactly-once stands
         eng.outcomes[:] = [oc for oc in eng.outcomes

@@ -99,6 +99,16 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     ``tenant_report`` and the pool/queue gauges behind one flat
     ``as_dict()`` with interval deltas.
 
+  * health monitoring (``monitor=`` — inference/monitor.py): an
+    opt-in ``HealthMonitor`` sampled at the end of every completed
+    step — windowed time-series over the registry (tokens/step, shed
+    rate, pool tiers, queue depth, per-tenant charge, spec
+    acceptance), per-tenant SLO tracking off the collector's latency
+    histograms, and deterministic threshold-crossing ``Alert`` events
+    (pool-pressure-high, shed-spike, queue-growth, ...) keyed to the
+    step clock. Same contracts as the collector: zero overhead off,
+    passive, derived-not-snapshotted.
+
 Events are surfaced in ``admitted`` / ``finished`` / ``preempted`` /
 ``outcomes`` lists the caller drains between steps (prefill outputs
 ride along so the caller can seed the next input row).
@@ -348,7 +358,7 @@ class PagedServingEngine:
                  injector=None, max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
                  tenants: Optional[Dict[str, dict]] = None,
-                 collector=None):
+                 collector=None, monitor=None):
         self.model = model
         self.max_batch = int(max_batch)
         self.dtype = dtype
@@ -390,6 +400,14 @@ class PagedServingEngine:
         # wall-clock timestamps stay out of engine-behavioral state;
         # a restored engine gets the caller's collector wired fresh.
         self.collector = collector
+        # monitor (inference/monitor.py): the opt-in HealthMonitor —
+        # windowed time-series over the registry, per-tenant SLO
+        # tracking, deterministic threshold alerting. Sampled at the
+        # end of every COMPLETED step (_end_step_telemetry); None
+        # (default) keeps the hook dark, and like the collector it is
+        # PASSIVE (reads only) and never part of snapshot() — monitor
+        # state is derived, rebuilt by resampling after a restore.
+        self.monitor = monitor
         # registry: the always-on unified metric surface — the five
         # stats siblings, tenant_report and the pool/queue gauges
         # behind ONE as_dict() (flat keys, interval-deltable). Sources
@@ -400,9 +418,15 @@ class PagedServingEngine:
         self.registry.attach("prefill", self.prefill_stats)
         self.registry.attach("resilience", self.resilience_stats)
         self.registry.attach("tenants", self.tenant_report)
+        # tiers_only: the registry's pool namespace is the per-step /
+        # per-sample scrape surface (router, HealthMonitor) and must
+        # stay O(1) — the per-slot / per-tenant occupancy HISTOGRAMS
+        # are an explicit-diagnosis surface (cache.pool_occupancy(),
+        # BlockOOM.details, the oom_shed event), not a gauge
         self.registry.attach(
-            "pool", lambda: dict(self.cache.pool_occupancy(),
-                                 peak=self.cache.peak_blocks_used))
+            "pool",
+            lambda: dict(self.cache.pool_occupancy(tiers_only=True),
+                         peak=self.cache.peak_blocks_used))
         self.registry.attach("queue", self._queue_gauges)
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
@@ -455,6 +479,14 @@ class PagedServingEngine:
         self.admitted: List[Tuple[int, int, Tensor]] = []
         self.finished: List[Tuple[int, int, int]] = []
         self.preempted: List[int] = []
+        # wire the monitor LAST (its baseline snapshot reads the live
+        # registry sources, which need the engine fully built); the
+        # rebase pins the interval-delta baseline at the current step
+        # so the first sampled step computes a one-interval delta —
+        # the same contract PagedServingEngine.restore re-establishes
+        if monitor is not None:
+            monitor.bind(self.registry, collector=collector)
+            monitor.rebase(self._step_count)
 
     # -- introspection ------------------------------------------------
     @property
@@ -1208,12 +1240,19 @@ class PagedServingEngine:
         ever escapes this call. Rows of failed/preempted slots in the
         returned hidden are garbage — drain the event lists."""
         idle = self._begin_step()
+        ok = False
         try:
-            return self._step_impl(idle, x)
+            out = self._step_impl(idle, x)
+            ok = True
+            return out
         finally:
             # balanced even when an injected EngineCrash unwinds the
-            # step; a no-op (no clock read) without a collector
-            self._end_step_telemetry()
+            # step; a no-op (no clock read) without a collector. The
+            # monitor only samples COMPLETED steps (aborted flag): a
+            # torn step's mid-crash state is not a step-boundary
+            # sample — it either replays after recovery (sampled
+            # then) or the engine is abandoned
+            self._end_step_telemetry(aborted=not ok)
 
     def _step_impl(self, idle: bool, x: Tensor):
         col = self.collector
@@ -1336,10 +1375,13 @@ class PagedServingEngine:
                 "mode; use synchronous admission (the default) for "
                 "multi-token verification")
         idle = self._begin_step(kind="verify")
+        ok = False
         try:
-            return self._step_multi_impl(idle, x, L)
+            out = self._step_multi_impl(idle, x, L)
+            ok = True
+            return out
         finally:
-            self._end_step_telemetry()
+            self._end_step_telemetry(aborted=not ok)
 
     def _step_multi_impl(self, idle: bool, x: Tensor, L: int):
         col = self.collector
@@ -1467,29 +1509,42 @@ class PagedServingEngine:
                 "active": self.num_active,
                 "prefilling": self.num_prefilling}
 
-    def _end_step_telemetry(self) -> None:
+    def _end_step_telemetry(self, aborted: bool = False) -> None:
         """Close the step span and sample the per-step gauges from
         ground truth (pool tiers, queue/slot depths, per-tenant
-        charge). One call, in the step's ``finally`` — the timeline
-        stays balanced even when a fault or injected crash unwinds
-        the step early."""
+        charge), then hand the step to the health monitor. One call,
+        in the step's ``finally`` — the timeline stays balanced even
+        when a fault or injected crash unwinds the step early
+        (``aborted``); the MONITOR skips aborted steps (a torn step
+        is not a step-boundary state — it replays after recovery or
+        the engine is abandoned, so sampling it would diverge the
+        series from an uninterrupted run's)."""
         col = self.collector
-        if col is None:
-            return
-        # the ONE tier source, O(1) scalars only — per-step gauges
-        # must not pay the occupancy histograms' O(max_seqs) scan
-        occ = self.cache.pool_occupancy(tiers_only=True)
-        col.end_step({
-            "pool": {"active": occ["active"],
-                     "cached_free": occ["cached_free"],
-                     "free": occ["free"]},
-            "queue": self._queue_gauges(),
-            # unlike the occupancy blocks-per-tenant histogram (which
-            # drops zeros), the gauge reports every REGISTERED tenant
-            # — a charge falling to 0 must emit a 0, not vanish
-            "tenant_blocks": {tid: self.cache.tenant_charge(tid)
-                              for tid in self.tenants},
-        })
+        if col is not None:
+            if aborted:
+                # close the torn step's span flagged; no gauges — the
+                # mid-crash state is not a step-boundary sample
+                col.end_step(aborted=True)
+            else:
+                # the ONE tier source, O(1) scalars only — per-step
+                # gauges must not pay the occupancy histograms'
+                # O(max_seqs) scan
+                occ = self.cache.pool_occupancy(tiers_only=True)
+                col.end_step({
+                    "pool": {"active": occ["active"],
+                             "cached_free": occ["cached_free"],
+                             "free": occ["free"]},
+                    "queue": self._queue_gauges(),
+                    # unlike the occupancy blocks-per-tenant histogram
+                    # (which drops zeros), the gauge reports every
+                    # REGISTERED tenant — a charge falling to 0 must
+                    # emit a 0, not vanish
+                    "tenant_blocks": {
+                        tid: self.cache.tenant_charge(tid)
+                        for tid in self.tenants},
+                })
+        if self.monitor is not None and not aborted:
+            self.monitor.on_step(self._step_count)
 
     def _count_tokens_served(self, stepping: np.ndarray,
                              n: int) -> None:
@@ -1782,7 +1837,7 @@ class PagedServingEngine:
 
     @classmethod
     def restore(cls, model, snap: dict, *, injector=None,
-                collector=None,
+                collector=None, monitor=None,
                 num_blocks: Optional[int] = None) -> "PagedServingEngine":
         """Rebuild an engine from a ``snapshot`` around the caller's
         model (weights are the caller's problem — a snapshot holds
@@ -1812,6 +1867,7 @@ class PagedServingEngine:
                   chunk_tokens=cfg["chunk_tokens"],
                   prefill_token_budget=cfg["prefill_token_budget"],
                   injector=injector, collector=collector,
+                  monitor=monitor,
                   max_preemptions=cfg["max_preemptions"],
                   numeric_guard=cfg["numeric_guard"])
         # nb may differ from the cache snapshot's geometry (a resized
@@ -1883,4 +1939,12 @@ class PagedServingEngine:
         eng.preempted = list(ev["preempted"])
         eng.outcomes = [RequestOutcome(**oc) for oc in snap["outcomes"]]
         eng.check_invariants()
+        if monitor is not None:
+            # monitor state is DERIVED, never snapshotted: a fresh
+            # monitor re-baselines its interval-delta snapshot at the
+            # restored step (counters restore exactly, so resampling
+            # the replay reproduces the dead incarnation's samples);
+            # a monitor that lived through the crash keeps its live
+            # history — rebase is a no-op for it
+            monitor.rebase(eng._step_count)
         return eng

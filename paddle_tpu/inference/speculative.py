@@ -251,7 +251,7 @@ class SpeculativeEngine:
                  max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
                  tenants: Optional[Dict[str, dict]] = None,
-                 collector=None):
+                 collector=None, monitor=None):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -270,7 +270,7 @@ class SpeculativeEngine:
             prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
             injector=injector, max_preemptions=max_preemptions,
             numeric_guard=numeric_guard, tenants=tenants,
-            collector=collector)
+            collector=collector, monitor=monitor)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
         # the speculative layer's stats export through the SAME
@@ -464,6 +464,13 @@ class SpeculativeEngine:
         layer's SpecDecodeStats attached under ``spec``)."""
         return self.engine.registry
 
+    @property
+    def monitor(self):
+        """The wrapped engine's HealthMonitor (None when monitoring
+        is off) — it samples the unified registry, ``spec.*``
+        included, at the end of every engine step."""
+        return self.engine.monitor
+
     def check_invariants(self) -> bool:
         """Audit the wrapped engine + BOTH pools (target and draft).
         Draft-side extras: slot alignment (every tracked stream's
@@ -573,12 +580,16 @@ class SpeculativeEngine:
             # itself is the faulted path (no injection deadlock)
             if eng.queue:
                 eng._begin_step(kind="admission_kick")
+                ok = False
                 try:
                     eng._try_admit()
+                    ok = True
                 finally:
                     # the kick consumes an engine step of its own —
                     # close its telemetry span like any other step
-                    eng._end_step_telemetry()
+                    # (aborted when an injected crash tears the kick,
+                    # so the monitor never samples torn state)
+                    eng._end_step_telemetry(aborted=not ok)
                 self._handle_events()
             return {}
         B = self.max_batch
@@ -829,7 +840,8 @@ class SpeculativeEngine:
     @classmethod
     def restore(cls, target: TokenServingModel,
                 draft: Optional[TokenServingModel], snap: dict, *,
-                injector=None, collector=None) -> "SpeculativeEngine":
+                injector=None, collector=None,
+                monitor=None) -> "SpeculativeEngine":
         """Rebuild a speculative engine from ``snapshot`` around the
         caller's models. The target engine restores exactly
         (PagedServingEngine.restore); the draft pool is REBUILT from
@@ -876,7 +888,7 @@ class SpeculativeEngine:
                    numeric_guard=ecfg["numeric_guard"])
         spec.engine = PagedServingEngine.restore(
             target.core, snap["engine"], injector=injector,
-            collector=collector)
+            collector=collector, monitor=monitor)
         spec.engine.registry.attach("spec", spec.stats)
         for rec in snap["seqs"]:
             seq = _SpecSeq(rec["rid"], rec["toks"])
@@ -912,4 +924,12 @@ class SpeculativeEngine:
                 spec.draft_cache.allocator.fault_hook = hook
         spec._draft_dirty.update(s for s in dirty if s in spec._seqs)
         spec.check_invariants()
+        if monitor is not None:
+            # re-baseline AFTER the spec stats re-attached above: the
+            # engine-level rebase ran before ``spec.*`` existed in the
+            # registry, so a fresh monitor's first delta would see the
+            # restored spec counters as a step-one jump. Refreshing at
+            # the same step folds them into the baseline (a no-op for
+            # a monitor that lived through the crash).
+            monitor.rebase(spec.engine._step_count)
         return spec

@@ -156,6 +156,10 @@ class MetricsRegistry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self._hists: Dict[str, List[float]] = {}
+        # observations trimmed off each series so far: the absolute
+        # index of _hists[name][0] — what lets values_since address a
+        # window by TOTAL observation count across trims
+        self._hist_dropped: Dict[str, int] = {}
         self._sources: Dict[str, Any] = {}
 
     # -- writes -------------------------------------------------------
@@ -175,6 +179,8 @@ class MetricsRegistry:
         lst = self._hists.setdefault(name, [])
         if len(lst) >= 2 * self.HIST_WINDOW:
             del lst[:self.HIST_WINDOW]
+            self._hist_dropped[name] = \
+                self._hist_dropped.get(name, 0) + self.HIST_WINDOW
         lst.append(float(value))
 
     def attach(self, prefix: str, source) -> None:
@@ -186,6 +192,51 @@ class MetricsRegistry:
     # -- reads --------------------------------------------------------
     def histogram(self, name: str) -> dict:
         return percentiles(self._hists.get(name, ()))
+
+    # -- windowed histogram views -------------------------------------
+    # ``as_dict``/``histogram`` report percentiles since boot (well,
+    # since the retention window) — useless to an SLO tracker or a
+    # router scrape that wants "the last interval". These views
+    # address observations by their TOTAL count, the histogram
+    # equivalent of ``delta_since``: mark now, serve, then ask for
+    # everything after the mark.
+
+    def hist_names(self) -> List[str]:
+        return list(self._hists)
+
+    def hist_total(self, name: str) -> int:
+        """Observations EVER made on ``name`` (monotonic across the
+        retention trim — the mark currency of values_since)."""
+        return self._hist_dropped.get(name, 0) + \
+            len(self._hists.get(name, ()))
+
+    def hist_marks(self) -> Dict[str, int]:
+        """{name: hist_total} for every histogram — snapshot before an
+        interval, pass to ``percentiles_since`` after it."""
+        return {name: self.hist_total(name) for name in self._hists}
+
+    def values_since(self, name: str, start: int) -> List[float]:
+        """Observations on ``name`` from absolute index ``start``
+        (a previous ``hist_total``). Observations already trimmed by
+        the retention window are gone — the view clamps to what is
+        retained rather than failing."""
+        lst = self._hists.get(name)
+        if not lst:
+            return []
+        i = max(0, int(start) - self._hist_dropped.get(name, 0))
+        return lst[i:]
+
+    def percentiles_since(self, prev: Optional[Dict[str, int]] = None,
+                          qs=(50, 90, 99)) -> Dict[str, dict]:
+        """Windowed percentiles: for every histogram, the percentile
+        dict over observations made AFTER the ``prev`` marks (a
+        ``hist_marks()`` snapshot; names absent there count from 0).
+        The interval view ``SloTracker`` and a router scrape consume —
+        p50/p90/p99 over the last window, not since boot."""
+        prev = prev or {}
+        return {name: percentiles(
+                    self.values_since(name, prev.get(name, 0)), qs)
+                for name in self._hists}
 
     def as_dict(self) -> dict:
         out: Dict[str, Any] = {}
@@ -334,6 +385,13 @@ class TraceCollector:
         if args:
             ev["args"] = dict(args)
         self._emit(ev)
+        # every span duration also lands in a windowed registry
+        # histogram (``span.<name>``): percentiles_since over these is
+        # the windowed per-phase step-timing view the health monitor
+        # samples (and kernel tile sizing reads). Replayed spans are
+        # replay-time, not serving-time — timeline-flagged only.
+        if not self._replay:
+            self.registry.observe(f"span.{name}", t1 - t0)
 
     # -- step timeline ------------------------------------------------
     def begin_step(self, step: int, kind: str = "step") -> None:
@@ -356,14 +414,20 @@ class TraceCollector:
                              {"step": self._step[1]})
         self._phase = (t, name)
 
-    def end_step(self, gauges: Optional[dict] = None) -> None:
+    def end_step(self, gauges: Optional[dict] = None,
+                 aborted: bool = False) -> None:
         """Close the step span; ``gauges`` ({track: {series: value}})
         are emitted as Chrome counter events and mirrored into the
-        registry."""
+        registry. ``aborted`` closes a step a crash tore down: the
+        span is flagged, counted separately (``steps.aborted``), and
+        its gauges are NOT emitted — mid-crash state is not a
+        step-boundary sample."""
         if self._step is None:
             return
         t = self.now()
-        self._close_step(t)
+        self._close_step(t, aborted=aborted)
+        if aborted:
+            return
         if gauges:
             for track, series in gauges.items():
                 self._emit({"name": track, "ph": "C", "ts": t,
@@ -382,12 +446,16 @@ class TraceCollector:
             args["aborted"] = True
         self._span_event(kind, t0, t, args)
         self._step = None
-        if self._replay:
+        if aborted:
+            # a torn step is not a completed step: it either replays
+            # after recovery (counted then) or the engine is abandoned
+            self.registry.count("steps.aborted")
+        elif self._replay:
             self.replayed_steps += 1
+            self.registry.count("steps.replayed")
         else:
             self.steps += 1
-        self.registry.count("steps.replayed" if self._replay
-                            else "steps.live")
+            self.registry.count("steps.live")
 
     # -- free-form spans (spec rounds, journal, snapshots) ------------
     @property
@@ -554,6 +622,10 @@ class TraceCollector:
                 v = getattr(rec, name)
                 if v is not None:
                     self.registry.observe(f"latency.{name}", v)
+                    # the per-tenant split the SLO tracker windows
+                    # over (values_since / percentiles_since)
+                    self.registry.observe(
+                        f"latency.{name}.tenant.{rec.tenant}", v)
 
     # -- replay mode --------------------------------------------------
     def set_replay(self, on: bool) -> None:

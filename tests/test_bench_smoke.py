@@ -155,3 +155,35 @@ def test_serving_obs_smoke_leg():
     # cold baseline, so no timing assert rides the tier-1 suite)
     assert res["baseline"]["tokens_per_sec"] > 0
     assert res["traced"]["tokens_per_sec"] > 0
+
+
+def test_serving_monitor_smoke_leg():
+    res = bench_extra.bench_serving_monitor(smoke=True)
+    assert res["metric"] == "serving_health_monitoring"
+    # the headline guarantees rode the bench: monitoring is PASSIVE
+    # (streams bit-identical) and DETERMINISTIC (two monitored runs
+    # fired the exact same ordered alert sequence)
+    assert res["streams_bit_identical"] is True
+    assert res["alerts_deterministic"] is True
+    # the seeded overload burst really overloaded: pool pressure
+    # pinned at/over the high mark, requests shed, and the expected
+    # alerts fired (at recorded steps)
+    storm = res["overload"]
+    assert storm["shed"] > 0
+    assert storm["pool_pressure_max"] >= 0.9
+    ff = storm["alert_first_fire_step"]
+    assert ff.get("pool-pressure-high", 0) > 0
+    assert ff.get("shed-spike", 0) > 0
+    fired = storm["alerts_fired"]
+    assert fired["pool-pressure-high"] >= 1
+    assert fired["shed-spike"] >= 1
+    # the monitor really sampled (every completed step at cadence 1)
+    assert res["monitored"]["samples"] > 0
+    assert res["monitored"]["series"] > 5
+    # SLO tracking produced per-tenant windows for both tenants
+    assert set(res["slo"]) >= {"alice", "bob"}
+    # both runs actually served tokens; the <= 3% overhead bound is
+    # ENFORCED inside the leg at bench scale only (smoke shapes are
+    # jit/jitter-dominated, so no timing assert rides tier-1)
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["monitored"]["tokens_per_sec"] > 0

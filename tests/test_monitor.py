@@ -1,0 +1,1036 @@
+"""Continuous health monitoring (inference/monitor.py + the monitor
+wiring in scheduler.py / speculative.py / recovery.py, the windowed
+histogram views in telemetry.py, and the RecoverableServer durability
+gauges).
+
+The acceptance bars:
+
+* PASSIVE — token streams and terminal outcomes are BIT-IDENTICAL
+  with full monitoring (HealthMonitor + SLO tracking + alerting)
+  enabled vs off, across plain / prefix-cached / speculative /
+  recoverable serving, including under the PR 5 seeded fault storm.
+* ZERO OVERHEAD OFF — with ``monitor=None`` the engines perform zero
+  clock reads (counting-clock test); the monitor itself never reads a
+  clock even when on (step-clock driven — the module does not import
+  ``time``).
+* DETERMINISTIC — the seeded overload scenario produces the exact
+  same ordered ``Alert`` sequence on every run, and ``HealthReport``
+  is a pure function of the sampled step sequence.
+* RECOVERY-DERIVED — engine snapshots carry no monitor state; across
+  a crash/recover cycle the alert sequence matches the uninterrupted
+  run's (replay-frozen, nothing double-counted), and a FRESH monitor
+  rebuilds its series by resampling the replay with its alerts
+  flagged ``replayed``.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (CrashInjector, EngineCrash,
+                                  FaultInjector, HealthMonitor,
+                                  MetricsRegistry, PagedServingEngine,
+                                  RecoverableServer, SeriesBuffer,
+                                  SloPolicy, SloTracker,
+                                  SpeculativeEngine, TokenServingModel,
+                                  TraceCollector)
+from paddle_tpu.inference import monitor as mon_mod
+from paddle_tpu.inference import scheduler as sched_mod
+from paddle_tpu.inference import telemetry as tele_mod
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.monitor
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+VOCAB = 50
+
+_RNG = np.random.RandomState(1234)
+_EMBED = _RNG.randn(VOCAB, D).astype(np.float32)
+
+
+def _model():
+    paddle.seed(0)
+    return FusedMultiTransformer(D, HEADS, FFN, num_layers=LAYERS)
+
+
+def _tsm():
+    return TokenServingModel(_model(), _EMBED)
+
+
+def _prompts(seed, n=4, lo=6, hi=10):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, VOCAB, int(L)))
+            for L in rng.integers(lo, hi, n)]
+
+
+def _drive(tsm, prompts, n_gen, *, monitor=None, collector=None,
+           injector=None, max_iters=300, **eng_kw):
+    """Token-ID serving loop over SpeculativeEngine (k=0 == plain
+    paged decode). Returns (streams, (rid, status, step) outcomes,
+    engine)."""
+    kw = dict(k=0, max_batch=2, block_size=4, num_blocks=60,
+              max_blocks_per_seq=10)
+    kw.update(eng_kw)
+    eng = SpeculativeEngine(tsm, None, monitor=monitor,
+                            collector=collector, injector=injector,
+                            **kw)
+    rids = [eng.submit(p) for p in prompts]
+    done, failed, outcomes = {}, set(), []
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        eng.step()
+        for oc in eng.outcomes:
+            outcomes.append((oc.rid, oc.status, oc.step))
+            if oc.failed:
+                failed.add(oc.rid)
+        eng.outcomes.clear()
+        for r in live:
+            if r in failed:
+                continue
+            if len(eng.generated(r)) >= n_gen:
+                done[r] = eng.generated(r)[:n_gen]
+                eng.release(r)
+    else:
+        raise AssertionError("monitor driver did not converge")
+    for oc in eng.outcomes:
+        outcomes.append((oc.rid, oc.status, oc.step))
+    eng.outcomes.clear()
+    return done, outcomes, eng
+
+
+# ---------------------------------------------------------------------
+# the ring buffer
+# ---------------------------------------------------------------------
+
+class TestSeriesBuffer:
+    def test_windowed_queries(self):
+        sb = SeriesBuffer("s", capacity=8)
+        assert sb.last() is None and sb.mean() is None
+        assert sb.sum() == 0.0
+        for i in range(5):
+            sb.append(i + 1, float(i))
+        assert len(sb) == 5 and sb.total == 5
+        assert sb.last() == 4.0 and sb.last_step() == 5
+        assert sb.mean() == 2.0 and sb.max() == 4.0 and sb.min() == 0.0
+        assert sb.mean(2) == 3.5 and sb.sum(3) == 9.0
+        steps, vals = sb.window(3)
+        assert steps.tolist() == [3, 4, 5]
+        assert vals.tolist() == [2.0, 3.0, 4.0]
+
+    def test_ring_wrap_keeps_newest(self):
+        sb = SeriesBuffer("s", capacity=4)
+        for i in range(10):
+            sb.append(i, float(i))
+        assert len(sb) == 4 and sb.total == 10
+        steps, vals = sb.window()
+        assert steps.tolist() == [6, 7, 8, 9]
+        assert sb.min() == 6.0 and sb.last() == 9.0
+
+    def test_rate_is_per_step_slope(self):
+        sb = SeriesBuffer("s", capacity=8)
+        sb.append(2, 1.0)
+        assert sb.rate() is None
+        sb.append(6, 9.0)
+        assert sb.rate() == 2.0      # (9 - 1) / (6 - 2)
+
+    def test_as_dict_rounding(self):
+        sb = SeriesBuffer("s")
+        sb.append(1, 1 / 3)
+        d = sb.as_dict()
+        assert d["samples"] == 1 and d["last"] == round(1 / 3, 6)
+
+
+# ---------------------------------------------------------------------
+# satellite: windowed histogram views on the registry
+# ---------------------------------------------------------------------
+
+class TestWindowedHistograms:
+    def test_values_since_and_marks(self):
+        reg = MetricsRegistry()
+        for v in (1.0, 2.0):
+            reg.observe("lat", v)
+        marks = reg.hist_marks()
+        assert marks == {"lat": 2}
+        for v in (3.0, 4.0, 5.0):
+            reg.observe("lat", v)
+        assert reg.values_since("lat", marks["lat"]) == [3.0, 4.0, 5.0]
+        assert reg.values_since("lat", 0) == [1, 2, 3, 4, 5]
+        assert reg.values_since("nope", 0) == []
+        assert reg.hist_total("lat") == 5
+
+    def test_percentiles_since_is_the_interval_view(self):
+        """The satellite clause: p50/p90/p99 over the LAST WINDOW, not
+        since boot — end-of-run percentiles masked regressions."""
+        reg = MetricsRegistry()
+        for _ in range(100):
+            reg.observe("lat", 0.01)        # a long healthy history
+        marks = reg.hist_marks()
+        for _ in range(10):
+            reg.observe("lat", 1.0)         # the regression window
+        since = reg.percentiles_since(marks)
+        assert since["lat"]["count"] == 10
+        assert since["lat"]["p50"] == 1.0
+        # the boot-relative view still dilutes it
+        assert reg.histogram("lat")["p50"] == 0.01
+        # no marks = everything retained
+        assert reg.percentiles_since()["lat"]["count"] == 110
+
+    def test_marks_survive_the_retention_trim(self):
+        reg = MetricsRegistry()
+        n = 2 * reg.HIST_WINDOW
+        for i in range(n):
+            reg.observe("lat", float(i))
+        marks = reg.hist_marks()
+        assert marks["lat"] == n
+        reg.observe("lat", 999.0)           # triggers the trim
+        assert reg.hist_total("lat") == n + 1
+        assert reg.values_since("lat", marks["lat"]) == [999.0]
+        # a mark pointing into the trimmed-away past clamps to what
+        # is retained instead of failing
+        old = reg.values_since("lat", 0)
+        assert len(old) == n + 1 - reg.HIST_WINDOW
+
+
+# ---------------------------------------------------------------------
+# SLO policy + tracker
+# ---------------------------------------------------------------------
+
+class TestSlo:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            SloPolicy(ttft_s=0.1, objective=1.0)   # no budget to burn
+        with pytest.raises(ValueError):
+            SloPolicy(ttft_s=0.1, objective=0.0)
+        with pytest.raises(ValueError):
+            SloPolicy(objective=0.9)               # no targets at all
+        with pytest.raises(ValueError):
+            SloPolicy(ttft_s=-1.0)
+        p = SloPolicy(ttft_s=0.5, objective=0.9)
+        assert p.as_dict() == {"ttft_s": 0.5, "objective": 0.9}
+
+    def _collector_with_latencies(self, ttfts_by_tenant):
+        """Deterministic injected clock: each request's TTFT is chosen
+        exactly (submit at t, first token at t + ttft)."""
+        t = [0.0]
+        clock = lambda: t[0]                        # noqa: E731
+        col = TraceCollector(clock=clock)
+        rid = 0
+        for tenant, ttfts in ttfts_by_tenant.items():
+            for ttft in ttfts:
+                col.on_submit(rid, tenant, 4)
+                col.on_admitted(rid, 0, retry=False)
+                t[0] += ttft
+                col.on_first_token(rid)
+                col.on_outcome(rid, "finished", rid)
+                rid += 1
+        return col
+
+    def test_tracker_compliance_and_burn(self):
+        col = self._collector_with_latencies({
+            "a": [0.1] * 8 + [1.0] * 2,    # 80% within 0.5s
+            "b": [0.1] * 10,               # 100%
+        })
+        tr = SloTracker({"*": SloPolicy(ttft_s=0.5, objective=0.9)},
+                        window=64)
+        tr.update(col.registry)
+        st = tr.status()
+        assert st["a"]["ttft_s"]["compliance"] == 0.8
+        assert st["a"]["ttft_s"]["burn"] == 2.0    # 20% miss / 10% budget
+        assert st["a"]["ttft_s"]["ok"] is False
+        assert st["b"]["ttft_s"]["compliance"] == 1.0
+        assert st["b"]["ttft_s"]["burn"] == 0.0
+        assert st["b"]["ttft_s"]["ok"] is True
+        # update is incremental: nothing new -> status unchanged
+        tr.update(col.registry)
+        assert tr.status() == st
+
+    def test_tracker_windows_roll(self):
+        col = self._collector_with_latencies({"a": [1.0] * 4})
+        tr = SloTracker(SloPolicy(ttft_s=0.5, objective=0.9), window=4)
+        tr.update(col.registry)
+        assert tr.status()["a"]["ttft_s"]["compliance"] == 0.0
+        # four healthy requests push the misses out of the window
+        t = [100.0]
+        col._clock = lambda: t[0]
+        for rid in range(100, 104):
+            col.on_submit(rid, "a", 4)
+            t[0] += 0.1
+            col.on_first_token(rid)
+            col.on_outcome(rid, "finished", rid)
+        tr.update(col.registry)
+        st = tr.status()["a"]["ttft_s"]
+        assert st["window"] == 4 and st["compliance"] == 1.0
+
+    def test_per_tenant_policy_overrides_default(self):
+        col = self._collector_with_latencies({"a": [0.3], "b": [0.3]})
+        tr = SloTracker({"*": SloPolicy(ttft_s=0.5, objective=0.5),
+                         "b": SloPolicy(ttft_s=0.1, objective=0.5)})
+        tr.update(col.registry)
+        st = tr.status()
+        assert st["a"]["ttft_s"]["ok"] is True
+        assert st["b"]["ttft_s"]["ok"] is False
+
+    def test_untracked_tenant_without_default_is_skipped(self):
+        col = self._collector_with_latencies({"a": [0.3], "b": [0.3]})
+        tr = SloTracker({"a": SloPolicy(ttft_s=0.5)})
+        tr.update(col.registry)
+        assert "b" not in tr.status()
+
+
+# ---------------------------------------------------------------------
+# zero overhead when off; the monitor never reads a clock
+# ---------------------------------------------------------------------
+
+class _CountingTime:
+    def __init__(self):
+        self.calls = 0
+
+    def perf_counter(self):
+        self.calls += 1
+        return time.perf_counter()
+
+    def monotonic(self):
+        self.calls += 1
+        return time.monotonic()
+
+
+class TestZeroOverheadWhenOff:
+    def _serve(self, monitor, collector=None):
+        model = _model()
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=20, max_blocks_per_seq=5,
+                                 collector=collector, monitor=monitor)
+        rng = np.random.RandomState(3)
+        for _ in range(2):
+            eng.submit(paddle.to_tensor(
+                rng.randn(6, D).astype(np.float32)))
+        x = np.zeros((2, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        for _ in range(4):
+            out = eng.step(paddle.to_tensor(x))
+            x = np.asarray(out.numpy())[:, :1].copy()
+        eng.release(0)
+        return eng
+
+    def test_monitor_none_means_zero_clock_reads(self, monkeypatch):
+        fake = _CountingTime()
+        monkeypatch.setattr(sched_mod, "time", fake)
+        monkeypatch.setattr(tele_mod, "time", fake)
+        self._serve(monitor=None)
+        assert fake.calls == 0
+
+    def test_monitor_on_is_still_clockless(self, monkeypatch):
+        """The stronger clause: FULL monitoring (no collector) is
+        step-clock driven — zero wall-clock reads even when ON."""
+        fake = _CountingTime()
+        monkeypatch.setattr(sched_mod, "time", fake)
+        monkeypatch.setattr(tele_mod, "time", fake)
+        mon = HealthMonitor()
+        eng = self._serve(monitor=mon)
+        assert fake.calls == 0
+        assert mon.samples > 0
+        assert eng.monitor is mon
+
+    def test_monitor_module_never_imports_time(self):
+        """Belt and braces for 'never wall-clock in hot paths': the
+        module has no clock to read."""
+        assert not hasattr(mon_mod, "time")
+        src = open(mon_mod.__file__).read()
+        assert "import time" not in src
+
+
+# ---------------------------------------------------------------------
+# passivity: bit-identity with full monitoring on vs off
+# ---------------------------------------------------------------------
+
+def _full_monitor():
+    return HealthMonitor(slo={"*": SloPolicy(ttft_s=0.5, tpot_s=0.5,
+                                             queue_wait_s=1.0,
+                                             objective=0.9)})
+
+
+class TestPassiveBitIdentity:
+    N_GEN = 8
+
+    def _both(self, seed, **eng_kw):
+        tsm = _tsm()
+        prompts = _prompts(seed)
+        base, base_oc, _ = _drive(tsm, prompts, self.N_GEN, **eng_kw)
+        mon = _full_monitor()
+        moned, moned_oc, eng = _drive(tsm, prompts, self.N_GEN,
+                                      monitor=mon,
+                                      collector=TraceCollector(),
+                                      **eng_kw)
+        assert moned == base, "monitoring changed a token stream"
+        assert moned_oc == base_oc, "monitoring changed an outcome"
+        assert mon.samples > 0
+        return mon, eng
+
+    def test_plain_paged(self):
+        mon, eng = self._both(81, k=0)
+        # the signal catalog materialized
+        for name in ("tokens_per_step", "shed_rate", "pool.pressure",
+                     "queue.depth", "tenant.default.charge",
+                     "span.model"):
+            assert mon.series(name) is not None, f"missing {name}"
+        # SLO tracking saw terminal requests (the tracker pulls at
+        # sample time, so outcomes after the LAST step are pending
+        # until the next one — only the final releases can lag)
+        assert mon.slo.status()["default"]["ttft_s"]["window"] >= 2
+
+    def test_prefix_cached(self):
+        self._both(82, k=0, prefix_cache=True)
+
+    @pytest.mark.spec
+    def test_speculative(self):
+        mon, eng = self._both(83, k=2)
+        # the acceptance series rode the spec counters
+        sb = mon.series("spec.acceptance")
+        assert sb is not None and sb.total > 0
+        assert 0.0 <= sb.mean() <= 1.0
+
+    @pytest.mark.faults
+    def test_under_fault_storm(self):
+        """PR 5 composition: same streams/outcomes under the seeded
+        storm, and the monitor SAW the storm (shed series, alerts)."""
+        kw = dict(k=0, num_blocks=9, max_blocks_per_seq=6,
+                  max_batch=2)
+        tsm = _tsm()
+        prompts = _prompts(84, n=4, lo=8, hi=12)
+        runs = {}
+        for tag, mon in (("off", None), ("on", _full_monitor())):
+            # a 4-step whole-step OOM window defeats preemption (at
+            # 4-token blocks every slot crosses a boundary inside it)
+            # so at least one growth is forced to SHED
+            inj = FaultInjector(oom_at=[3, 4, 5, 6], nan_at={8: [1]})
+            runs[tag] = _drive(tsm, prompts, self.N_GEN, monitor=mon,
+                               collector=TraceCollector() if mon
+                               else None, injector=inj, **kw)
+        base, base_oc, _ = runs["off"]
+        moned, moned_oc, eng = runs["on"]
+        assert moned == base and moned_oc == base_oc
+        mon = eng.monitor
+        assert mon.series("shed_rate").sum() > 0
+
+
+# ---------------------------------------------------------------------
+# deterministic alerting
+# ---------------------------------------------------------------------
+
+def _overload_run(monitor):
+    """The seeded overload scenario: a tight pool, zero retry budget
+    and a mid-run submission burst — pool pressure pins high, the
+    queue grows monotonically, and growth OOMs shed requests."""
+    tsm = _tsm()
+    eng = SpeculativeEngine(tsm, None, k=0, max_batch=3, block_size=4,
+                            num_blocks=13, max_blocks_per_seq=8,
+                            max_preemptions=0, monitor=monitor)
+    prng = np.random.default_rng(7)
+    prompts = [list(prng.integers(0, VOCAB, 10)) for _ in range(10)]
+    rids = [eng.submit(p) for p in prompts[:4]]
+    burst = prompts[4:]
+    done, failed = {}, set()
+    for it in range(200):
+        if it in (4, 5, 6):
+            rids += [eng.submit(burst.pop()) for _ in range(2)]
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live and not burst:
+            break
+        eng.step()
+        for oc in eng.outcomes:
+            if oc.failed:
+                failed.add(oc.rid)
+        eng.outcomes.clear()
+        for r in live:
+            if r in failed:
+                continue
+            if len(eng.generated(r)) >= 12:
+                done[r] = eng.generated(r)[:12]
+                eng.release(r)
+    else:
+        raise AssertionError("overload run did not converge")
+    return done, failed
+
+
+class TestAlertDeterminism:
+    def test_overload_fires_the_same_ordered_alerts_every_run(self):
+        """The acceptance clause: same seeded step sequence -> same
+        ordered alert sequence, and the expected kinds fire."""
+        mons = [HealthMonitor(), HealthMonitor()]
+        runs = [_overload_run(m) for m in mons]
+        assert runs[0] == runs[1]
+        a, b = ([x.sig() for x in m.alerts] for m in mons)
+        assert a == b and a, "alert sequences must match and be non-empty"
+        kinds = [k for _, k, *_ in a]
+        assert "pool-pressure-high" in kinds
+        assert "shed-spike" in kinds
+        assert "queue-growth" in kinds
+        assert mons[0].alert_counts == mons[1].alert_counts
+        assert not any(x.replayed for x in mons[0].alerts)
+        # ...and HealthReport is a pure function of the sampled step
+        # sequence: both runs produce the identical report
+        r0, r1 = (m.report().as_dict() for m in mons)
+        assert r0 == r1
+        assert r0["verdict"] in ("warn", "critical")
+        assert 0.0 <= r0["score"] <= 1.0
+        assert r0["signals"]["pool.pressure"]["max"] >= 0.9
+        assert r0["tenants"]["default"]["charge"] is not None
+
+    # -- per-detector unit tests over a synthetic registry ------------
+
+    def _bound(self, reg):
+        mon = HealthMonitor()
+        mon.bind(reg)
+        return mon
+
+    def test_pool_pressure_edge_and_hysteresis(self):
+        reg = MetricsRegistry()
+        mon = self._bound(reg)
+        reg.gauge("pool.usable", 10)
+
+        def step(n, active):
+            reg.gauge("pool.active", active)
+            mon.on_step(n)
+
+        step(1, 5)
+        assert mon.alerts == []
+        step(2, 9)                     # 0.9 crosses -> fires once
+        step(3, 10)                    # still high -> no re-fire
+        assert [a.kind for a in mon.alerts] == ["pool-pressure-high"]
+        step(4, 85 / 10)               # 0.85: above clear -> still active
+        step(5, 9)                     # back over high: NOT a new edge
+        assert len(mon.alerts) == 1
+        step(6, 7)                     # 0.7 < clear -> re-arms
+        step(7, 9)                     # second genuine crossing
+        assert [a.kind for a in mon.alerts] == ["pool-pressure-high"] * 2
+        assert [a.step for a in mon.alerts] == [2, 7]
+
+    def test_shed_spike_ewma_baseline(self):
+        reg = MetricsRegistry()
+        mon = self._bound(reg)
+        shed = [0]
+
+        def step(n, sheds=0):
+            shed[0] += sheds
+            reg.count("resilience.shed", 0)   # ensure the key exists
+            reg.counters["resilience.shed"] = shed[0]
+            mon.on_step(n)
+
+        for n in range(1, 6):
+            step(n)                    # calm baseline
+        assert mon.alerts == []
+        step(6, sheds=2)               # first shed after calm = spike
+        assert [a.kind for a in mon.alerts] == ["shed-spike"]
+        step(7)                        # rate 0 -> clears
+        # a steady drizzle establishes a baseline...
+        for n in range(8, 16):
+            step(n, sheds=1)
+        drizzle_alerts = len(mon.alerts)
+        # ...so one more drizzle-rate sample is NOT a spike
+        step(16, sheds=1)
+        assert len(mon.alerts) == drizzle_alerts
+
+    def test_queue_growth_needs_monotone_growth(self):
+        reg = MetricsRegistry()
+        mon = self._bound(reg)
+
+        def step(n, depth):
+            reg.gauge("queue.depth", depth)
+            mon.on_step(n)
+
+        for n, d in enumerate([0, 1, 0, 2, 1, 3], 1):
+            step(n, d)                 # sawtooth: never monotone
+        assert mon.alerts == []
+        for n, d in enumerate([1, 2, 4, 5], 7):
+            step(n, d)                 # +4 across 4 samples
+        assert [a.kind for a in mon.alerts] == ["queue-growth"]
+
+    def test_journal_lag_alert(self):
+        reg = MetricsRegistry()
+        mon = HealthMonitor(thresholds={"journal_lag_high": 8})
+        mon.bind(reg)
+
+        def step(n, lag):
+            reg.gauge("journal.lag_records", lag)
+            reg.gauge("journal.bytes", lag * 100)
+            mon.on_step(n)
+
+        step(1, 2)
+        step(2, 8)                     # crosses
+        step(3, 12)
+        step(4, 5)                     # >= high/2: still active
+        step(5, 3)                     # clears below half
+        step(6, 9)                     # second crossing
+        assert [(a.kind, a.step) for a in mon.alerts] == \
+            [("journal-lag", 2), ("journal-lag", 6)]
+
+    def test_slo_burn_alert_per_tenant(self):
+        t = [0.0]
+        col = TraceCollector(clock=lambda: t[0])
+        reg = MetricsRegistry()
+        mon = HealthMonitor(
+            slo={"*": SloPolicy(ttft_s=0.5, objective=0.9)},
+            thresholds={"slo_min_samples": 4})
+        mon.bind(reg, collector=col)
+        for rid in range(8):           # tenant "hot" misses every TTFT
+            col.on_submit(rid, "hot", 4)
+            t[0] += 2.0
+            col.on_first_token(rid)
+            col.on_outcome(rid, "finished", rid)
+        for rid in range(8, 16):       # tenant "cold" is healthy
+            col.on_submit(rid, "cold", 4)
+            t[0] += 0.1
+            col.on_first_token(rid)
+            col.on_outcome(rid, "finished", rid)
+        mon.on_step(1)
+        assert [(a.kind, a.tenant) for a in mon.alerts] == \
+            [("slo-burn", "hot")]
+        a = mon.alerts[0]
+        assert a.signal == "ttft_s" and a.value >= 2.0
+        rep = mon.report()
+        assert rep.tenants["hot"]["slo"]["verdict"] == "critical"
+        assert rep.tenants["cold"]["slo"]["verdict"] == "ok"
+        assert rep.verdict == "critical"
+
+    def test_acceptance_collapse(self):
+        reg = MetricsRegistry()
+        mon = self._bound(reg)
+        prop = [0]
+        acc = [0]
+
+        def step(n, p, a):
+            prop[0] += p
+            acc[0] += a
+            reg.counters["spec.proposed"] = prop[0]
+            reg.counters["spec.accepted"] = acc[0]
+            mon.on_step(n)
+
+        for n in range(1, 5):
+            step(n, 4, 4)              # healthy acceptance
+        assert mon.alerts == []
+        for n in range(5, 30):
+            step(n, 4, 0)              # total collapse
+        kinds = [a.kind for a in mon.alerts]
+        assert kinds == ["acceptance-collapse"]
+
+    def test_unknown_threshold_is_refused(self):
+        with pytest.raises(ValueError):
+            HealthMonitor(thresholds={"no_such_knob": 1})
+
+    def test_bounded_alert_stream(self):
+        reg = MetricsRegistry()
+        mon = HealthMonitor(max_alerts=2)
+        mon.bind(reg)
+        reg.gauge("pool.usable", 10)
+        fired = 0
+        for n in range(1, 20):
+            # alternate below-clear / above-high: a fresh edge each time
+            reg.gauge("pool.active", 10 if n % 2 else 1)
+            mon.on_step(n)
+            fired += n % 2 == 1
+        assert len(mon.alerts) == 2
+        assert mon.alerts_dropped > 0
+        assert mon.alert_counts["pool-pressure-high"] == \
+            len(mon.alerts) + mon.alerts_dropped
+
+    def test_sampling_cadence(self):
+        reg = MetricsRegistry()
+        mon = HealthMonitor(sample_every=4)
+        mon.bind(reg)
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 1)
+        for n in range(1, 13):
+            mon.on_step(n)
+        assert mon.samples == 3        # steps 4, 8, 12
+        assert mon.series("pool.pressure").window()[0].tolist() == \
+            [4, 8, 12]
+
+
+# ---------------------------------------------------------------------
+# recovery: derived state, frozen replay, resampled rebuild
+# ---------------------------------------------------------------------
+
+def _drive_recoverable(tsm, prompts, n_gen, jp, sp, injector, monitor,
+                       recover_monitor="same", snapshot_every=4,
+                       max_iters=300):
+    """Recoverable serving loop; on EngineCrash, recover with either
+    the SAME monitor object or a FRESH one per crash
+    (recover_monitor="fresh"). Returns (streams, monitors) where
+    monitors[0] is the original and monitors[-1] the final one."""
+    eng = SpeculativeEngine(tsm, None, k=0, max_batch=2, block_size=4,
+                            num_blocks=60, max_blocks_per_seq=10,
+                            injector=injector, monitor=monitor)
+    srv = RecoverableServer(eng, journal_path=jp, snapshot_path=sp,
+                            snapshot_every=snapshot_every)
+    monitors = [monitor]
+    rids = [srv.submit(p) for p in prompts]
+    done, failed = {}, set()
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        try:
+            srv.step()
+            for oc in srv.drain_outcomes():
+                if oc.failed:
+                    failed.add(oc.rid)
+            for r in live:
+                if r in failed:
+                    continue
+                if len(srv.generated(r)) >= n_gen:
+                    done[r] = srv.generated(r)[:n_gen]
+                    srv.release(r)
+        except EngineCrash:
+            mon = monitors[-1] if recover_monitor == "same" \
+                else HealthMonitor()
+            if mon is not monitors[-1]:
+                monitors.append(mon)
+            srv = RecoverableServer.recover(
+                tsm, None, journal_path=jp, snapshot_path=sp,
+                injector=injector, monitor=mon)
+            srv.check_invariants()
+    else:
+        raise AssertionError("recoverable driver did not converge")
+    srv.close()
+    return done, monitors
+
+
+class TestRecoveryDerived:
+    N_GEN = 8
+
+    def test_snapshot_carries_no_monitor_state(self):
+        """Monitor state is derived, never snapshotted: a monitored
+        engine's snapshot equals the bare engine's, bit for bit."""
+        import pickle
+        tsm = _tsm()
+        prompts = _prompts(91, n=2)
+        snaps = {}
+        for tag, mon in (("off", None), ("on", _full_monitor())):
+            eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                    block_size=4, num_blocks=30,
+                                    max_blocks_per_seq=8,
+                                    monitor=mon,
+                                    collector=TraceCollector()
+                                    if mon else None)
+            for p in prompts:
+                eng.submit(p)
+            for _ in range(3):
+                eng.step()
+            snaps[tag] = pickle.dumps(eng.snapshot())
+        assert snaps["on"] == snaps["off"]
+
+    def test_restore_wires_and_rebases_the_monitor(self):
+        tsm = _tsm()
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                block_size=4, num_blocks=30,
+                                max_blocks_per_seq=8)
+        eng.submit(_prompts(92, n=1)[0])
+        for _ in range(3):
+            eng.step()
+        mon = HealthMonitor()
+        restored = SpeculativeEngine.restore(tsm, None, eng.snapshot(),
+                                             monitor=mon)
+        assert restored.monitor is mon
+        # rebased, not sampled: the restored step is the baseline
+        assert mon.samples == 0 and mon._last_step == 3
+        restored.step()
+        assert mon.samples == 1
+        # the post-restore delta spans ONE step, not life-since-boot
+        assert mon.series("tokens_per_step").last() <= restored.max_batch
+
+    @pytest.mark.recovery
+    def test_crash_recover_same_monitor_matches_uninterrupted(
+            self, tmp_path):
+        """The monitor rides THROUGH two crash/recover cycles: steps it
+        sampled live are frozen during replay, so the alert sequence
+        and the report equal the uninterrupted run's — nothing double
+        counts."""
+        tsm = _tsm()
+        prompts = _prompts(93)
+        runs = {}
+        for tag, inj in (
+                ("clean", None),
+                ("storm", CrashInjector(crash_at={3: "post_journal",
+                                                  6: "pre_journal"}))):
+            jp = str(tmp_path / f"{tag}.wal")
+            sp = str(tmp_path / f"{tag}.ckpt")
+            runs[tag] = _drive_recoverable(
+                tsm, prompts, self.N_GEN, jp, sp, inj,
+                HealthMonitor())
+        clean_done, (clean_mon,) = runs["clean"]
+        storm_done, (storm_mon,) = runs["storm"]
+        assert storm_done == clean_done
+        assert [a.sig() for a in storm_mon.alerts] == \
+            [a.sig() for a in clean_mon.alerts]
+        assert storm_mon.alert_counts == clean_mon.alert_counts
+        assert not any(a.replayed for a in storm_mon.alerts)
+        # every step sampled exactly once across crash + replay
+        assert storm_mon.samples == clean_mon.samples
+        steps = storm_mon.series("pool.active").window()[0]
+        assert len(set(steps.tolist())) == len(steps)
+        assert storm_mon.report().as_dict() == \
+            clean_mon.report().as_dict()
+
+    @pytest.mark.recovery
+    def test_fresh_monitor_rebuilds_by_resampling(self, tmp_path):
+        """A FRESH monitor handed to recover() rebuilds the series by
+        resampling the replayed steps: samples match the dead
+        incarnation's monitor, replay-derived alerts are flagged and
+        kept out of the live counts, and no (kind, step) fires
+        twice."""
+        tsm = _tsm()
+        # tight pool so the overload alerts fire BEFORE the crash;
+        # snapshot_every=0 -> only snapshot 0 exists, the whole run
+        # replays
+        prompts = _prompts(94, n=6, lo=8, hi=12)
+        kw = dict(recover_monitor="fresh", snapshot_every=0)
+        jp, sp = str(tmp_path / "f.wal"), str(tmp_path / "f.ckpt")
+
+        def drive(inj, monitor, jp, sp, recover_monitor):
+            eng = SpeculativeEngine(
+                tsm, None, k=0, max_batch=2, block_size=4,
+                num_blocks=11, max_blocks_per_seq=8,
+                max_preemptions=0, injector=inj, monitor=monitor)
+            srv = RecoverableServer(eng, journal_path=jp,
+                                    snapshot_path=sp, snapshot_every=0)
+            monitors = [monitor]
+            rids = [srv.submit(p) for p in prompts]
+            done, failed = {}, set()
+            for _ in range(300):
+                live = [r for r in rids
+                        if r not in done and r not in failed]
+                if not live:
+                    break
+                try:
+                    srv.step()
+                    for oc in srv.drain_outcomes():
+                        if oc.failed:
+                            failed.add(oc.rid)
+                    for r in live:
+                        if r in failed:
+                            continue
+                        if len(srv.generated(r)) >= self.N_GEN:
+                            done[r] = srv.generated(r)[:self.N_GEN]
+                            srv.release(r)
+                except EngineCrash:
+                    mon = HealthMonitor() if recover_monitor == "fresh" \
+                        else monitors[-1]
+                    if mon is not monitors[-1]:
+                        monitors.append(mon)
+                    srv = RecoverableServer.recover(
+                        tsm, None, journal_path=jp, snapshot_path=sp,
+                        injector=inj, monitor=mon)
+            else:
+                raise AssertionError("did not converge")
+            srv.close()
+            return done, failed, monitors
+
+        base_done, base_failed, (base_mon,) = drive(
+            None, HealthMonitor(), str(tmp_path / "b.wal"),
+            str(tmp_path / "b.ckpt"), "same")
+        # crash late enough that alerts fired before the death (the
+        # first pool-pressure crossing lands at step 13 in this
+        # seeded scenario)
+        crash_round = 16
+        inj = CrashInjector(crash_at={crash_round: "post_journal"})
+        done, failed, monitors = drive(inj, HealthMonitor(), jp, sp,
+                                       "fresh")
+        assert done == base_done and failed == base_failed
+        assert len(monitors) == 2
+        dead, fresh = monitors
+        # the fresh monitor resampled the replayed prefix: its
+        # replay-era samples equal the dead monitor's live ones
+        d_steps, d_vals = dead.series("pool.active").window()
+        f_steps, f_vals = fresh.series("pool.active").window()
+        overlap = min(len(d_steps), len(f_steps))
+        assert f_steps[:overlap].tolist() == \
+            d_steps[:overlap].tolist()
+        assert f_vals[:overlap].tolist() == d_vals[:overlap].tolist()
+        # replay-derived alerts are flagged and excluded from counts
+        replayed = [a for a in fresh.alerts if a.replayed]
+        live = [a for a in fresh.alerts if not a.replayed]
+        assert replayed, "the pre-crash alerts must re-derive flagged"
+        assert [a.sig() for a in replayed] == \
+            [a.sig() for a in dead.alerts]
+        counted = sum(fresh.alert_counts.values())
+        assert counted == len(live)
+        # no (kind, step, tenant) fires twice within a monitor
+        sigs = [(a.kind, a.step, a.tenant) for a in fresh.alerts]
+        assert len(sigs) == len(set(sigs))
+        # and the union (dead live alerts + fresh post-crash alerts)
+        # matches the uninterrupted run's sequence
+        combined = [a.sig() for a in dead.alerts] + \
+            [a.sig() for a in live]
+        assert combined == [a.sig() for a in base_mon.alerts]
+
+    @pytest.mark.recovery
+    def test_journal_durability_gauges(self, tmp_path):
+        """Satellite: journal.lag_records / journal.bytes /
+        snapshot.age_steps live in the ALWAYS-ON registry, reset at
+        snapshot boundaries, and feed the monitor's journal series."""
+        tsm = _tsm()
+        mon = HealthMonitor(thresholds={"journal_lag_high": 4})
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                block_size=4, num_blocks=40,
+                                max_blocks_per_seq=10, monitor=mon)
+        srv = RecoverableServer(eng,
+                                journal_path=str(tmp_path / "j.wal"),
+                                snapshot_path=str(tmp_path / "j.ckpt"),
+                                snapshot_every=6)
+        d = eng.registry.as_dict()
+        assert d["journal.lag_records"] == 0       # snapshot 0 is fresh
+        assert d["journal.bytes"] == 0             # nothing appended yet
+        assert d["snapshot.age_steps"] == 0
+        rids = [srv.submit(p) for p in _prompts(95, n=3)]
+        assert eng.registry.as_dict()["journal.bytes"] > 0
+        lags = []
+        for _ in range(6):
+            srv.step()
+            d = eng.registry.as_dict()
+            lags.append(d["journal.lag_records"])
+            assert d["snapshot.age_steps"] >= 0
+        # lag grew round by round then RESET at the periodic snapshot
+        assert lags[0] > 0 and max(lags) >= 4
+        assert lags[-1] == 0, "snapshot must reset the lag gauge"
+        assert eng.registry.as_dict()["journal.bytes"] == \
+            srv.journal.bytes_written
+        # the monitor tracked them as series and fired journal-lag
+        assert mon.series("journal.lag").max() >= 4
+        assert mon.series("snapshot.age") is not None
+        assert "journal-lag" in [a.kind for a in mon.alerts]
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# the offline doctors
+# ---------------------------------------------------------------------
+
+class TestHealthReportTool:
+    def _dump(self, tmp_path, monitor):
+        path = str(tmp_path / "health.json")
+        n = monitor.save(path)
+        assert os.path.getsize(path) == n
+        return path
+
+    def test_healthy_dump_renders_exit_0(self, tmp_path, capsys):
+        from tools import health_report
+        tsm = _tsm()
+        mon = _full_monitor()
+        # n=4 over 2 slots: the first pair's outcomes are pulled into
+        # the SLO windows while the second pair still serves
+        _drive(tsm, _prompts(96, n=4), 6, monitor=mon,
+               collector=TraceCollector())
+        path = self._dump(tmp_path, mon)
+        rc = health_report.main([path])
+        out = capsys.readouterr().out
+        assert "health @ step" in out and "signals" in out
+        assert "tenant 'default'" in out and "SLO" in out
+        assert rc == (1 if mon.report().verdict == "critical" else 0)
+
+    def test_critical_dump_exits_1(self, tmp_path, capsys):
+        from tools import health_report
+        # force a critical verdict deterministically: pressure active
+        reg = MetricsRegistry()
+        mon2 = HealthMonitor()
+        mon2.bind(reg)
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 10)
+        mon2.on_step(1)
+        path = self._dump(tmp_path, mon2)
+        assert mon2.report().verdict == "critical"
+        assert health_report.main([path, "--alerts"]) == 1
+        out = capsys.readouterr().out
+        assert "CRITICAL" in out and "pool-pressure-high" in out
+
+    def test_unreadable_exits_2(self, tmp_path, capsys):
+        from tools import health_report
+        assert health_report.main(
+            [str(tmp_path / "missing.json")]) == 2
+        p = str(tmp_path / "foreign.json")
+        with open(p, "w") as f:
+            json.dump({"kind": "something_else"}, f)
+        assert health_report.main([p]) == 2
+        p2 = str(tmp_path / "not.json")
+        with open(p2, "w") as f:
+            f.write("{nope")
+        assert health_report.main([p2]) == 2
+
+
+class TestTraceReportSlo:
+    def _trace(self, tmp_path):
+        """A trace with EXACT latencies via the injected clock: tenant
+        'a' TTFTs 0.1/0.1/0.9, tenant 'b' TTFTs 0.1/0.1."""
+        t = [0.0]
+        col = TraceCollector(clock=lambda: t[0])
+        ttfts = [("a", 0.1), ("a", 0.1), ("a", 0.9),
+                 ("b", 0.1), ("b", 0.1)]
+        for rid, (tenant, ttft) in enumerate(ttfts):
+            col.on_submit(rid, tenant, 4)
+            col.on_admitted(rid, 0, retry=False)
+            t[0] += ttft
+            col.on_first_token(rid)
+            col.on_decode([rid], 1)
+            t[0] += 0.01
+            col.on_decode([rid], 1)
+            col.on_outcome(rid, "finished", rid)
+        path = str(tmp_path / "slo.trace.json")
+        col.save_chrome_trace(path)
+        return path
+
+    def _targets(self, tmp_path, payload):
+        p = str(tmp_path / "targets.json")
+        with open(p, "w") as f:
+            json.dump(payload, f)
+        return p
+
+    def test_pass_and_fail_gates(self, tmp_path, capsys):
+        from tools import trace_report
+        trace = self._trace(tmp_path)
+        # loose targets at a 60% objective: both tenants pass
+        ok = self._targets(tmp_path, {
+            "objective": 0.6, "targets": {"ttft_s": 0.5}})
+        assert trace_report.main([trace, "--slo", ok]) == 0
+        out = capsys.readouterr().out
+        assert "SLO: PASS" in out and "tenant 'a'" in out
+        # a 90% objective fails tenant 'a' (2/3 compliant)
+        strict = self._targets(tmp_path, {
+            "objective": 0.9, "targets": {"ttft_s": 0.5}})
+        assert trace_report.main([trace, "--slo", strict]) == 1
+        out = capsys.readouterr().out
+        assert "FAIL" in out
+
+    def test_per_tenant_override(self, tmp_path, capsys):
+        from tools import trace_report
+        trace = self._trace(tmp_path)
+        # default would fail 'a'; the per-tenant override exempts it
+        tg = self._targets(tmp_path, {
+            "objective": 0.9, "targets": {"ttft_s": 0.5},
+            "tenants": {"a": {"objective": 0.6}}})
+        assert trace_report.main([trace, "--slo", tg]) == 0
+        # tpot evaluated too when targeted
+        tg2 = self._targets(tmp_path, {
+            "objective": 0.9, "targets": {"tpot_s": 0.5}})
+        assert trace_report.main([trace, "--slo", tg2]) == 0
+        capsys.readouterr()
+
+    def test_unreadable_targets_exit_2(self, tmp_path, capsys):
+        from tools import trace_report
+        trace = self._trace(tmp_path)
+        assert trace_report.main(
+            [trace, "--slo", str(tmp_path / "missing.json")]) == 2
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write("[1, 2")
+        assert trace_report.main([trace, "--slo", bad]) == 2
+        capsys.readouterr()

@@ -1,0 +1,126 @@
+"""Offline health-report renderer: load a ``HealthMonitor.save``
+JSON dump (inference/monitor.py) and print the serving health story —
+overall verdict + score, per-signal windowed stats with verdicts, the
+alert log by taxonomy, and per-tenant SLO compliance/burn — without
+the engine, the model, or a live process. Sibling of
+tools/recovery_check.py (the snapshot doctor) and
+tools/trace_report.py (the timeline doctor); this is the control-plane
+doctor, and its exit code is CI-gateable.
+
+Usage:
+  python tools/health_report.py MONITOR.json [--alerts] [--tenant TID]
+
+Exit status: 0 healthy or degraded-but-warning, 1 the report's
+overall verdict is CRITICAL (gate on it), 2 unreadable / not a
+health-monitor dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_MARK = {"ok": " ", "warn": "!", "critical": "X"}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(dump: dict, tenant: str = None,
+           show_alerts: bool = False) -> str:
+    rep = dump["report"]
+    lines = [f"health @ step {rep.get('step')}: "
+             f"{rep['verdict'].upper()} (score {rep['score']}, "
+             f"{rep['samples']} sample(s), cadence "
+             f"{dump.get('sample_every', 1)})"]
+
+    signals = rep.get("signals", {})
+    if signals:
+        lines.append("signals (windowed):")
+        w = max(len(n) for n in signals)
+        for name, s in signals.items():
+            lines.append(
+                f"  [{_MARK.get(s.get('verdict', 'ok'), '?')}] "
+                f"{name:<{w}}  last={_fmt(s.get('last')):>10} "
+                f"mean={_fmt(s.get('mean')):>10} "
+                f"max={_fmt(s.get('max')):>10}  "
+                f"({s.get('samples', 0)} sample(s))")
+
+    tenants = rep.get("tenants", {})
+    items = sorted(tenants.items())
+    if tenant is not None:
+        items = [(t, s) for t, s in items if t == tenant]
+        if not items:
+            lines.append(f"tenant {tenant!r}: not monitored")
+    for tid, sec in items:
+        lines.append(f"tenant {tid!r}: charge="
+                     f"{_fmt(sec.get('charge'))}")
+        slo = sec.get("slo")
+        if slo:
+            lines.append(f"  SLO [{slo.get('verdict', '?')}]:")
+            for metric, r in sorted(slo.items()):
+                if not isinstance(r, dict):
+                    continue
+                lines.append(
+                    f"    {metric}: target {r['target_s']}s @ "
+                    f"{r['objective']:.0%} — compliance "
+                    f"{r['compliance']:.1%} over {r['window']} "
+                    f"request(s), burn {r['burn']:.2f}x "
+                    f"({'OK' if r['ok'] else 'VIOLATED'})")
+
+    al = rep.get("alerts", {})
+    counts = al.get("counts", {})
+    lines.append("alerts: "
+                 + (", ".join(f"{k} x{v}"
+                              for k, v in sorted(counts.items()))
+                    if counts else "none fired"))
+    if al.get("active"):
+        lines.append(f"  ACTIVE now: {', '.join(al['active'])}")
+    if al.get("dropped"):
+        lines.append(f"  {al['dropped']} alert(s) DROPPED "
+                     f"(stream bound reached)")
+    if show_alerts:
+        for a in dump.get("alerts", []):
+            t = f" tenant={a['tenant']}" if a.get("tenant") else ""
+            r = " [replayed]" if a.get("replayed") else ""
+            lines.append(f"  step {a['step']:>6}  {a['kind']}: "
+                         f"{a['signal']}={_fmt(a['value'])} vs "
+                         f"{_fmt(a['threshold'])}{t}{r}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a HealthMonitor JSON dump offline")
+    ap.add_argument("report")
+    ap.add_argument("--tenant", default=None,
+                    help="show only this tenant's section")
+    ap.add_argument("--alerts", action="store_true",
+                    help="print every alert in the stream")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.report) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"UNREADABLE: {e}")
+        return 2
+    if not isinstance(dump, dict) or \
+            dump.get("kind") != "health_monitor" or \
+            not isinstance(dump.get("report"), dict):
+        print("UNREADABLE: not a HealthMonitor dump "
+              "(expected kind='health_monitor' with a 'report')")
+        return 2
+
+    print(render(dump, tenant=args.tenant,
+                 show_alerts=args.alerts))
+    return 1 if dump["report"].get("verdict") == "critical" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

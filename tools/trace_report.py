@@ -9,13 +9,27 @@ doctor.
 
 Usage:
   python tools/trace_report.py TRACE.json [--tenant TID] [--requests]
+                                          [--slo TARGETS.json]
+
+``--slo`` evaluates per-tenant SLO compliance against the trace's
+request records (the offline twin of the live ``SloTracker``) so CI
+can gate on latency regressions from a saved artifact. TARGETS.json:
+
+  {"objective": 0.95,                      # default compliance bar
+   "targets": {"ttft_s": 0.5, "tpot_s": 0.1, "queue_wait_s": 1.0},
+   "tenants": {"alice": {"objective": 0.99,
+                         "targets": {"ttft_s": 0.2}}}}
+
+Top-level targets/objective apply to every tenant; a ``tenants`` entry
+overrides both for that tenant. Replayed request records are excluded
+(their stamps are replay times, not serving latencies).
 
 Accepts any file whose top level carries a ``traceEvents`` list (the
 Perfetto/chrome://tracing interchange format); the request/summary
 sections need the ``metadata`` block our collector writes and are
 skipped (with a note) for foreign traces. Exit status: 0 clean,
-1 structurally invalid trace (not trace_events, malformed or
-negative-duration events), 2 unreadable file.
+1 structurally invalid trace OR an SLO violation under ``--slo``,
+2 unreadable file (trace or targets).
 """
 from __future__ import annotations
 
@@ -169,6 +183,59 @@ def summarize(trace: dict, tenant: str = None,
     return "\n".join(lines)
 
 
+_SLO_METRICS = ("ttft_s", "tpot_s", "queue_wait_s")
+
+
+def slo_check(trace: dict, targets: dict):
+    """Evaluate per-tenant SLO compliance over the trace's request
+    records. Returns (report lines, ok). A tenant passes a metric
+    when the fraction of its terminal, non-replayed requests meeting
+    the target is >= the objective; tenants with no applicable target
+    (or no measurable requests) are skipped, not failed."""
+    meta = trace.get("metadata")
+    if not isinstance(meta, dict) or "requests" not in meta:
+        return (["no collector metadata — cannot evaluate SLOs "
+                 "against a foreign trace"], False)
+    default_obj = float(targets.get("objective", 0.99))
+    default_tg = dict(targets.get("targets", {}))
+    per_tenant_cfg = targets.get("tenants", {})
+
+    by_tenant = {}
+    for rec in meta["requests"].values():
+        if rec.get("replayed") or rec.get("outcome") is None:
+            continue
+        by_tenant.setdefault(rec.get("tenant"), []).append(rec)
+
+    lines, ok = [], True
+    for tid in sorted(by_tenant, key=str):
+        cfg = per_tenant_cfg.get(tid, {})
+        obj = float(cfg.get("objective", default_obj))
+        tg = dict(default_tg, **cfg.get("targets", {}))
+        recs = by_tenant[tid]
+        lines.append(f"tenant {tid!r}: {len(recs)} terminal "
+                     f"request(s), objective {obj:.0%}")
+        for metric in _SLO_METRICS:
+            if tg.get(metric) is None:
+                continue
+            vals = [rec[metric] for rec in recs
+                    if rec.get(metric) is not None]
+            if not vals:
+                lines.append(f"    {metric} <= {tg[metric]}s: "
+                             f"(no samples)")
+                continue
+            good = sum(1 for v in vals if v <= tg[metric])
+            comp = good / len(vals)
+            passed = comp >= obj
+            ok = ok and passed
+            lines.append(
+                f"    {metric} <= {tg[metric]}s: {comp:.1%} of "
+                f"{len(vals)} ({'PASS' if passed else 'FAIL'})")
+    if not by_tenant:
+        lines.append("no terminal (non-replayed) requests to judge")
+    lines.append(f"SLO: {'PASS' if ok else 'FAIL'}")
+    return lines, ok
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize a serving Chrome-trace JSON offline")
@@ -177,6 +244,9 @@ def main(argv=None) -> int:
                     help="show only this tenant's latency section")
     ap.add_argument("--requests", action="store_true",
                     help="print every request's full event log")
+    ap.add_argument("--slo", default=None, metavar="TARGETS.json",
+                    help="evaluate per-tenant SLO compliance against "
+                         "the trace (exit 1 on violation)")
     args = ap.parse_args(argv)
 
     try:
@@ -199,6 +269,22 @@ def main(argv=None) -> int:
     print(f"trace {args.trace}: valid trace_events JSON")
     print(summarize(trace, tenant=args.tenant,
                     show_requests=args.requests))
+    if args.slo is not None:
+        try:
+            with open(args.slo) as f:
+                targets = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"UNREADABLE targets: {e}")
+            return 2
+        if not isinstance(targets, dict):
+            print("UNREADABLE targets: top level is not a JSON object")
+            return 2
+        lines, ok = slo_check(trace, targets)
+        print("SLO evaluation:")
+        for ln in lines:
+            print(f"  {ln}")
+        if not ok:
+            return 1
     return 0
 
 
