@@ -123,9 +123,69 @@ def bench_decode(B=8, S=2048, nh=32, nkv=8, hd=128):
             "speedup": round(t_ref / t_fused, 3)}
 
 
+def bench_paged_ragged(nh=32, nkv=8, hd=128, bs=16, MB=32, NB=512,
+                       n_dec=8, K=4, n_ver=2, n_pre=2, C=128):
+    """ONE ragged launch vs the 3-kernel dispatch pattern at EQUAL
+    work: a mixed serving batch (n_dec decode rows + n_ver speculative
+    verifies of K+1 rows + n_pre prefill chunks of C rows) scored by
+    one ``paged_attention_ragged`` call vs one per-phase call each
+    (the pre-unification pattern: decode + multi + prefill = 3
+    dispatches; a real mixed step paid one per CHUNK, so 3 is the
+    baseline's best case). Reports tokens/s and the dispatch counts."""
+    import importlib
+    # the pallas package re-exports the function under the module's
+    # name, so attribute-style import would shadow the module
+    pa = importlib.import_module("paddle_tpu.ops.pallas.paged_attention")
+    rng = np.random.default_rng(0)
+    pool = jnp.asarray(rng.standard_normal((NB, 2, nkv, bs, hd)),
+                       jnp.bfloat16)
+    n_seq = n_dec + n_ver + n_pre
+    bt = jnp.asarray(rng.integers(1, NB, (n_seq, MB)), jnp.int32)
+    q_lens = (1,) * n_dec + (K + 1,) * n_ver + (C,) * n_pre
+    kv_lens = np.concatenate([
+        rng.integers(MB * bs // 2, MB * bs, n_dec),
+        rng.integers(K + 1, MB * bs, n_ver),
+        rng.integers(C, MB * bs, n_pre)]).astype(np.int32)
+    R = sum(q_lens)
+    q = jnp.asarray(rng.standard_normal((R, nh, hd)), jnp.bfloat16)
+    lens = jnp.asarray(kv_lens)
+
+    ragged = jax.jit(functools.partial(
+        pa.paged_attention_ragged, q_lens=q_lens, tile_q=None))
+
+    def one_launch(q, pool, bt, lens):
+        return ragged(q, pool, bt, kv_lens=lens)
+
+    d_hi = n_dec + n_ver * (K + 1)
+
+    @jax.jit
+    def three_launches(q, pool, bt, lens):
+        dec = pa.paged_attention(q[:n_dec], pool, bt[:n_dec],
+                                 lens[:n_dec])
+        ver = pa.paged_attention_multi(
+            q[n_dec:d_hi].reshape(n_ver, K + 1, nh, hd), pool,
+            bt[n_dec:n_dec + n_ver], lens[n_dec:n_dec + n_ver])
+        pre = pa.paged_attention_prefill(
+            q[d_hi:].reshape(n_pre, C, nh, hd), pool,
+            bt[n_dec + n_ver:], lens[n_dec + n_ver:] - C)
+        return dec, ver, pre
+
+    t_three = _timeit(three_launches, q, pool, bt, lens)
+    t_one = _timeit(one_launch, q, pool, bt, lens)
+    return {"kernel": "paged_attention_ragged",
+            "mixed_batch": {"decode_rows": n_dec,
+                            "verify_rows": n_ver * (K + 1),
+                            "prefill_rows": n_pre * C},
+            "dispatches": {"ragged": 1, "three_kernel": 3},
+            "three_kernel_ms": round(t_three * 1e3, 4),
+            "ragged_ms": round(t_one * 1e3, 4),
+            "tokens_per_sec_ragged": round(R / t_one, 1),
+            "speedup": round(t_three / t_one, 3)}
+
+
 if __name__ == "__main__":
     for bench in (bench_fused_rms, bench_fused_adamw, bench_gmm,
-                  bench_decode):
+                  bench_decode, bench_paged_ragged):
         try:
             print(json.dumps(bench()))
         except Exception as e:  # pragma: no cover

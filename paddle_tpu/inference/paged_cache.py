@@ -63,8 +63,8 @@ from ..framework.op import apply
 from ..framework.tensor import Tensor
 
 __all__ = ["BlockOOM", "BlockAllocator", "PagedKVCache",
-           "PagedLayerCache", "PagedPrefillView", "chain_hash",
-           "chain_block_hashes"]
+           "PagedLayerCache", "PagedPrefillView", "PagedRaggedView",
+           "chain_hash", "chain_block_hashes"]
 
 
 def chain_hash(parent: bytes, block_tokens) -> bytes:
@@ -522,6 +522,204 @@ class PagedPrefillView:
                       .astype(jnp.float32))
         return F.scaled_dot_product_attention(q, k_full, v_full,
                                               attn_mask=mask)
+
+
+def _ragged_append(pool, k, v, blk, off):
+    # packed mixed-batch append: row r of k/v [1, R, H, D] lands at
+    # pool[blk[r], :, :, off[r], :] — every segment's writes (prefill
+    # chunks through their slots' tables, decode rows through the
+    # masked batch table) in ONE scatter. Rows routed to the trash
+    # block (adopted-prefix positions, masked decode rows) may collide
+    # there; nothing reads it unmasked.
+    pool = pool.at[blk, 0, :, off, :].set(k[0].astype(pool.dtype))
+    return pool.at[blk, 1, :, off, :].set(v[0].astype(pool.dtype))
+
+
+class _RaggedLayout:
+    """Host-side descriptors for ONE mixed ragged model call, shared
+    by every layer's PagedRaggedView: the packed append routing
+    (blk/off per row), the per-sequence (q_len, kv_len, block-table
+    row) descriptors the kernel consumes, and the segment spans the
+    CPU path decomposes along. Built once per launch from the cache's
+    CURRENT tables — the caller must have ensure()d coverage and set
+    the decode mask first."""
+
+    __slots__ = ("segs", "q_lens", "blk", "off", "kv_lens", "bt_all",
+                 "tile_q", "tile_kv", "total_rows")
+
+    def __init__(self, cache: "PagedKVCache", segments, tile_q=None,
+                 tile_kv=None):
+        bs = cache.block_size
+        tbl = cache.block_tables
+        masked_tbl = tbl
+        if cache._decode_masked is not None and \
+                cache._decode_masked.any():
+            masked_tbl = tbl.copy()
+            masked_tbl[cache._decode_masked] = 0
+        self.segs: List[tuple] = []
+        q_lens: List[int] = []
+        kv_lens: List[int] = []
+        bt_rows: List[np.ndarray] = []
+        blk: List[np.ndarray] = []
+        off: List[np.ndarray] = []
+        lo = 0
+        for seg in segments:
+            kind = seg[0]
+            if kind == "prefill":
+                _, slot, start, length, write_start = seg
+                pos = np.arange(start, start + length)
+                b = tbl[slot][pos // bs]
+                # adopted shared-prefix positions route to trash: the
+                # pages already hold these exact values and may be
+                # shared (same rule as _make_append_chunk)
+                blk.append(np.where(pos >= write_start, b, 0))
+                off.append(pos % bs)
+                q_lens.append(int(length))
+                kv_lens.append(int(start) + int(length))
+                bt_rows.append(tbl[slot])
+                self.segs.append(("prefill", lo, lo + length, slot,
+                                  int(start)))
+                lo += length
+            elif kind == "decode":
+                _, lens, L = seg
+                if L != 1:
+                    raise ValueError(
+                        "ragged decode segments carry one query row "
+                        "per slot (the multi-query verify path rides "
+                        "paged_attention_multi)")
+                lens = np.asarray(lens, np.int64)
+                B = lens.shape[0]
+                b = masked_tbl[np.arange(B), lens // bs]
+                blk.append(b)
+                off.append(lens % bs)
+                q_lens.extend([1] * B)
+                kv_lens.extend((lens + 1).tolist())
+                bt_rows.extend(masked_tbl)
+                self.segs.append(("decode", lo, lo + B,
+                                  lens.astype(np.int32)))
+                lo += B
+            else:
+                raise ValueError(f"unknown ragged segment kind {kind!r}")
+        self.total_rows = lo
+        self.q_lens = tuple(q_lens)
+        self.blk = Tensor(jnp.asarray(np.concatenate(blk), jnp.int32))
+        self.off = Tensor(jnp.asarray(np.concatenate(off), jnp.int32))
+        self.kv_lens = Tensor(jnp.asarray(kv_lens, jnp.int32))
+        self.bt_all = Tensor(jnp.asarray(np.stack(bt_rows), jnp.int32))
+        self.tile_q = tile_q
+        self.tile_kv = tile_kv
+
+
+class PagedRaggedView:
+    """One layer's MIXED-BATCH view: the object that rides in
+    ``caches=`` for the scheduler's ragged step — prefill chunks of
+    several slots AND the fused decode rows packed into one
+    [1, total_rows, d] model call. Same duck-typed protocol as
+    PagedLayerCache (``is_paged`` + ``decode``): the packed K/V append
+    is ONE scatter through the precomputed routing, and the attention
+    is ONE ``paged_attention_ragged`` launch on the kernel path — the
+    dispatch-count collapse this view exists for.
+
+    Numerics contract (CPU bit-identity — the folding rules hoisted
+    from PagedLayerCache/PagedPrefillView): on the CPU fallback the
+    packed batch DECOMPOSES back into exactly the executables the
+    per-phase paths run — each prefill segment one multi-row masked
+    sdpa over its slot's gathered pages (never folded to 1-row calls:
+    the GEMV trap of scheduler.MIN_PREFILL_SUFFIX_ROWS), the decode
+    rows one batch-of-1-row sdpa over the masked batch table (the
+    plain decode executable) — so a ragged step's streams are
+    BIT-IDENTICAL to the per-phase launches. The non-attention ops
+    (LN/QKV/FFN) ride the packed row batch, which is safe by the same
+    established invariance chunked prefill rests on: per-row results
+    of multi-row calls do not depend on how many rows share the
+    call."""
+
+    is_paged = True
+
+    def __init__(self, cache: "PagedKVCache", layer: int,
+                 layout: _RaggedLayout):
+        self._cache = cache
+        self._layer = layer
+        self._layout = layout
+
+    @property
+    def pool(self) -> Tensor:
+        return self._cache.pools[self._layer]
+
+    @property
+    def shape(self):
+        return self.pool.shape
+
+    def decode(self, q, k, v, t, use_kernel: bool = False):
+        """q/k/v: [1, R, H, D] — the packed mixed batch. ``t`` is
+        ignored: the layout carries every row's absolute position.
+        PRECONDITION: every segment's write range is covered and
+        COW-split (the scheduler's planning pass ensure()s chunk by
+        chunk) and the decode mask is set."""
+        c = self._cache
+        lay = self._layout
+        if q.shape[0] != 1 or q.shape[1] != lay.total_rows:
+            raise ValueError(
+                f"ragged call expects [1, {lay.total_rows}, H, D], "
+                f"got {tuple(q.shape)}")
+        new_pool = apply(_ragged_append,
+                         (self.pool, k, v, lay.blk, lay.off),
+                         op_name="paged_ragged_append")
+        c.pools[self._layer] = new_pool
+
+        if use_kernel:
+            q_lens, tile_q, tile_kv = (lay.q_lens, lay.tile_q,
+                                       lay.tile_kv)
+
+            def att(p, q_, kvl, bts):
+                from ..ops.pallas.paged_attention import \
+                    paged_attention_ragged
+                return paged_attention_ragged(
+                    q_[0], p, bts, q_lens, kvl, tile_q=tile_q,
+                    tile_kv=tile_kv)[None]
+            return apply(att, (new_pool, q, lay.kv_lens, lay.bt_all),
+                         op_name="paged_attention_ragged")
+
+        # CPU / fallback: decompose into the per-phase executables
+        # (see class docstring) and re-pack the outputs in row order
+        from ..nn import functional as F
+        from ..ops.pallas.paged_attention import gather_pages
+        outs = []
+        for seg in lay.segs:
+            kind, lo, hi = seg[0], seg[1], seg[2]
+            if kind == "prefill":
+                slot, start = seg[3], seg[4]
+                C = hi - lo
+                qs = Tensor(q.data[:, lo:hi])
+                bt = c.bt_row_tensor(slot)
+                k_full, v_full = apply(gather_pages, (new_pool, bt),
+                                       op_name="paged_gather")
+                S = k_full.shape[1]
+                qpos = start + jnp.arange(C)[:, None]
+                kpos = jnp.arange(S)[None, :]
+                mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                              .astype(jnp.float32))
+                out = F.scaled_dot_product_attention(
+                    qs, k_full, v_full, attn_mask=mask)
+                outs.append(out.data[0])
+            else:
+                lens = seg[3]
+                B = hi - lo
+                qd = Tensor(q.data[0, lo:hi][:, None])   # [B, 1, H, D]
+                bt = c.bt_tensor()
+                k_full, v_full = apply(gather_pages, (new_pool, bt),
+                                       op_name="paged_gather")
+                S = k_full.shape[1]
+                tj = jnp.asarray(lens, jnp.int32)
+                qpos = (tj[:, None, None, None]
+                        + jnp.arange(1)[None, None, :, None])
+                kpos = jnp.arange(S)[None, None, None, :]
+                mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                              .astype(jnp.float32))
+                out = F.scaled_dot_product_attention(
+                    qd, k_full, v_full, attn_mask=mask)
+                outs.append(out.data[:, 0])
+        return Tensor(jnp.concatenate(outs, axis=0)[None])
 
 
 class PagedKVCache:
@@ -1250,6 +1448,33 @@ class PagedKVCache:
                 continue
             self._hash_to_block[h] = b
             self._block_hash[b] = h
+
+    # -- mixed ragged step --------------------------------------------
+    def ragged_views(self, segments, tile_q=None,
+                     tile_kv=None) -> List["PagedRaggedView"]:
+        """Per-layer views for ONE mixed ragged model call (the
+        scheduler's token-budget step): ``segments`` is an ordered
+        list of descriptors —
+
+          ("prefill", slot, start, length, write_start)
+              one prompt chunk: rows [start, start+length) of ``slot``
+              append through its table (positions below write_start —
+              an adopted shared prefix — route to trash) and attend
+              causally at their absolute positions;
+          ("decode", lens, 1)
+              the fused decode rows: one query per batch slot at
+              position lens[b], through the DECODE-MASKED batch table
+              (mid-prefill/fresh slots write trash), exactly the plain
+              fused step.
+
+        The packed input x is [1, sum(rows), d] in segment order; on
+        the kernel path each layer is ONE ``paged_attention_ragged``
+        launch. Build AFTER ensure()ing coverage and setting the
+        decode mask — the layout snapshots the current tables."""
+        layout = _RaggedLayout(self, segments, tile_q=tile_q,
+                               tile_kv=tile_kv)
+        return [PagedRaggedView(self, i, layout)
+                for i in range(self.num_layers)]
 
     # -- prefill ------------------------------------------------------
     def prefill_views(self, slot: int,

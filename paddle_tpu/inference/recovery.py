@@ -221,15 +221,57 @@ class RequestJournal:
 
     def append(self, kind: str, payload: dict) -> int:
         self.seq += 1
-        blob = pickle.dumps((self.seq, kind, payload), protocol=4)
-        self._f.write(self._HDR.pack(len(blob),
-                                     zlib.crc32(blob) & 0xFFFFFFFF))
-        self._f.write(blob)
-        self.bytes_written += self._HDR.size + len(blob)
+        data = self._frame((self.seq, kind, payload))
+        self._f.write(data)
+        self.bytes_written += len(data)
         self._f.flush()
         if self.sync:
             os.fsync(self._f.fileno())
         return self.seq
+
+    @staticmethod
+    def _frame(record: tuple) -> bytes:
+        blob = pickle.dumps(record, protocol=4)
+        return RequestJournal._HDR.pack(
+            len(blob), zlib.crc32(blob) & 0xFFFFFFFF) + blob
+
+    def compact(self, upto_seq: int) -> int:
+        """Drop every record at or below ``upto_seq`` — a snapshot now
+        covers them, so replaying them is impossible (recovery skips
+        seq <= the snapshot's journal_seq) and keeping them only grows
+        the file without bound on a long-running server. The
+        survivors are rewritten behind a COMPACT MARKER record that
+        REUSES seq == upto_seq: the marker keeps the file's last seq
+        at/past the snapshot's journal_seq, so the recovery lineage
+        check ("this journal belongs to this snapshot") still holds on
+        an otherwise-empty journal, seq numbering continues unchanged,
+        and replay skips it like any other covered record. Atomic
+        (write temp + fsync + rename, same recipe as save_snapshot);
+        the append handle reopens on the new file. No-op when there is
+        nothing to drop. Returns the bytes reclaimed."""
+        upto_seq = int(upto_seq)
+        recs, _ = _scan_journal(self.path)
+        old = [r for r in recs if r[0] <= upto_seq]
+        if not old or (len(old) == 1 and old[0][1] == "compact"
+                       and old[0][0] == upto_seq):
+            return 0
+        before = self.bytes_written
+        frames = [self._frame((upto_seq, "compact",
+                               {"upto": upto_seq}))]
+        frames += [self._frame(r) for r in recs if r[0] > upto_seq]
+        data = b"".join(frames)
+        tmp = f"{self.path}.compact.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self.bytes_written = len(data)
+        # the marker frame can outweigh a single tiny dropped record:
+        # "reclaimed" never reports negative
+        return max(0, before - len(data))
 
     def close(self) -> None:
         self._f.close()
@@ -307,7 +349,8 @@ class RecoverableServer:
 
     def __init__(self, engine: SpeculativeEngine, *, journal_path: str,
                  snapshot_path: str, snapshot_every: int = 0,
-                 sync: bool = False, _fresh: bool = True):
+                 sync: bool = False, compact_journal: bool = True,
+                 _fresh: bool = True):
         self.engine = engine
         self.injector = engine.injector
         self.journal_path = journal_path
@@ -315,6 +358,11 @@ class RecoverableServer:
         self.snapshot_every = int(snapshot_every)
         self.sync = bool(sync)      # fsync journal appends (host-death
                                     # durability; see RequestJournal)
+        # drop journal records a successful snapshot covers (they can
+        # never replay again — recovery skips seq <= the snapshot's):
+        # bounds the journal on a long-running server. False keeps the
+        # full history on disk (debugging/forensics).
+        self.compact_journal = bool(compact_journal)
         self.rounds = 0                 # rounds served, live + replayed
         self.replayed_rounds = 0
         self.replayed_tokens = 0
@@ -379,6 +427,14 @@ class RecoverableServer:
         self.snapshots_taken += 1
         self._snap_seq = self.journal.seq
         self._snap_step = self._engine_step()
+        if self.compact_journal:
+            # the snapshot is durable (atomic rename happened): every
+            # record at/below its journal_seq is dead weight now. The
+            # lag gauge is already 0 (seq == _snap_seq) and the bytes
+            # gauge shrinks to the surviving suffix. A crash between
+            # the rename and this rewrite only leaves extra covered
+            # records, which replay skips.
+            self.journal.compact(self._snap_seq)
 
     # -- serving surface ----------------------------------------------
     def submit(self, token_ids, **kw) -> int:
@@ -492,6 +548,7 @@ class RecoverableServer:
     def recover(cls, target, draft=None, *, journal_path: str,
                 snapshot_path: str, injector=None, collector=None,
                 monitor=None, sync: bool = False,
+                compact_journal: bool = True,
                 num_blocks: Optional[int] = None) -> "RecoverableServer":
         """Rebuild a server after a crash: restore the last snapshot,
         then deterministically replay the journal suffix. Crash points
@@ -540,6 +597,7 @@ class RecoverableServer:
                                             monitor=monitor)
         srv = cls(eng, journal_path=journal_path,
                   snapshot_path=snapshot_path, sync=sync,
+                  compact_journal=compact_journal,
                   snapshot_every=snap["snapshot_every"], _fresh=False)
         # scan READ-ONLY first: the lineage check must reject a
         # foreign journal before RequestJournal's open truncates its
@@ -627,6 +685,12 @@ class RecoverableServer:
                         # over pool) before any mutation: no-op on
                         # replay too
                         pass
+                elif kind == "compact":
+                    # a compaction marker reuses the covered seq, so
+                    # the seq-gate above already skips it; belt and
+                    # braces for a marker that somehow outran its
+                    # snapshot
+                    pass
         finally:
             if injector is not None:
                 injector.arm(True)

@@ -358,8 +358,31 @@ class PagedServingEngine:
                  injector=None, max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
                  tenants: Optional[Dict[str, dict]] = None,
-                 collector=None, monitor=None):
+                 collector=None, monitor=None,
+                 ragged_step: bool = True,
+                 tile_q: Optional[int] = None,
+                 tile_kv: Optional[int] = None):
         self.model = model
+        # ragged mixed step (token-budget mode): plan the step's
+        # prefill chunks, then launch them PACKED with the decode rows
+        # as one model call — ONE paged-attention dispatch per layer —
+        # instead of one launch per chunk plus one for the decode.
+        # Packing engages ON THE KERNEL PATH (TPU / forced kernels),
+        # where dispatch count is the cost being collapsed; the CPU
+        # jnp fallback keeps the per-phase calls because CPU
+        # bit-identity is strict and XLA CPU matmul row results are
+        # only row-count-invariant at small shapes (a packed
+        # [R, d] projection can differ from the [B, 1, d] call by a
+        # ulp at serving widths). ragged_step="force" packs on the
+        # CPU fallback too (tests/benches of the packing machinery —
+        # bit-identical at test dims, token-identical at bench dims);
+        # False keeps the legacy per-chunk launches everywhere.
+        # tile_q/tile_kv pass through to paged_attention_ragged
+        # (kernel tuning knobs; None = the kernel's default table).
+        self.ragged_step = ragged_step
+        self.tile_q = tile_q
+        self.tile_kv = tile_kv
+        self._ragged_plan: Optional[List[dict]] = None
         self.max_batch = int(max_batch)
         self.dtype = dtype
         self.watermark_blocks = int(watermark_blocks)
@@ -1022,6 +1045,138 @@ class PagedServingEngine:
             self.prefill_stats.prefill_steps += 1
         return ran, fresh
 
+    def _plan_prefills(self) -> Tuple[bool, List[int]]:
+        """RAGGED token-budget mode: spend the prefill budget exactly
+        like ``_advance_prefills`` — identical chunk lengths, growth/
+        preemption sequence and stats — but RECORD the chunks in
+        ``self._ragged_plan`` instead of launching each as its own
+        model call; the step's single packed launch
+        (``_flush_ragged_plan``) runs them with the decode rows.
+        Completed prefills transition slot state HERE (so the step's
+        masks and capacity checks match the eager path exactly); the
+        admitted event and prefix registration fire post-launch, when
+        the pages exist. A drop of a planned slot flushes the pending
+        segments first (``_drop``) — in the eager path those chunks
+        had already run before any later preemption could fire, so
+        registration/warm-resume semantics are unchanged."""
+        if self.prefill_token_budget is None or \
+                self.num_prefilling == 0:
+            return False, []
+        plan = self._ragged_plan
+        budget = self.prefill_token_budget
+        ran = False
+        fresh: List[int] = []
+        while budget >= MIN_PREFILL_SUFFIX_ROWS:
+            slots = np.flatnonzero(self.prefilling)
+            if slots.size == 0:
+                break
+            slot = int(min(slots,
+                           key=lambda s: self._requests[s].admit_seq))
+            req = self._requests[slot]
+            st = self._prefills[slot]
+            T = len(req)
+            c = _chunk_len(T, st["pos"], self.chunk_tokens,
+                           budget=budget)
+            if not self._grow_or_shed(slot, req, st["pos"] + c,
+                                      start_block=st["n_cached"],
+                                      write_from=st["pos"]):
+                continue  # the slot was evicted (or shed) growing
+            seg = plan[-1] if plan and plan[-1]["slot"] == slot \
+                else None
+            if seg is None:
+                seg = {"slot": slot, "req": req, "from": st["pos"],
+                       "to": st["pos"],
+                       "ws": st["n_cached"] * self.cache.block_size,
+                       "bounds": [],
+                       "hook": self._chunk_hook(slot, st, req),
+                       "complete": False}
+                plan.append(seg)
+            st["pos"] += c
+            seg["to"] = st["pos"]
+            seg["bounds"].append(st["pos"])
+            # chunk accounting at the same points chunked_prefill hits
+            self.prefill_stats.chunks += 1
+            self.prefill_stats.prefill_tokens += c
+            self.prefill_stats.peak_blocks = max(
+                self.prefill_stats.peak_blocks,
+                self.cache.blocks_in_use)
+            budget -= c
+            ran = True
+            if st["pos"] >= T:
+                seg["complete"] = True
+                self.prefilling[slot] = False
+                self.lens[slot] = T
+                self.active[slot] = True
+                fresh.append(slot)
+        if ran:
+            self.prefill_stats.prefill_steps += 1
+        return ran, fresh
+
+    def _flush_ragged_plan(self, x: Optional[Tensor] = None):
+        """Run the pending planned prefill segments — plus, at the
+        step's model point, the fused decode rows — as ONE ragged
+        model call through ``PagedKVCache.ragged_views``. CPU streams
+        stay bit-identical to the per-chunk launches (the view
+        decomposes back into the per-phase executables; the packed
+        non-attention ops are per-row invariant — the same contract
+        chunked prefill rests on), and the kernel path collapses the
+        step to one paged-attention dispatch per layer. Returns the
+        decode hidden [max_batch, 1, d] when ``x`` rode along, else
+        None."""
+        plan = self._ragged_plan
+        segs = [s for s in plan if s["to"] > s["from"]]
+        del plan[:]
+        if not segs and x is None:
+            return None
+        desc: List[tuple] = [
+            ("prefill", s["slot"], s["from"], s["to"] - s["from"],
+             s["ws"]) for s in segs]
+        if x is not None:
+            desc.append(("decode", self.lens.copy(), 1))
+        views = self.cache.ragged_views(desc, tile_q=self.tile_q,
+                                        tile_kv=self.tile_kv)
+        import jax.numpy as jnp
+        parts = [jnp.asarray(np.ascontiguousarray(
+            s["req"].history[s["from"]:s["to"]], np.float32))
+            for s in segs]
+        if x is not None:
+            parts.append(x.data.reshape(self.max_batch, x.shape[-1]))
+        xp = Tensor(jnp.concatenate(parts, axis=0)[None])
+        with no_grad():
+            out, _ = self.model(xp, caches=views,
+                                time_step=Tensor(np.int32(0)))
+        hv = out.data
+        lo = 0
+        for s in segs:
+            n = s["to"] - s["from"]
+            if s["hook"] is not None:
+                for b in s["bounds"]:
+                    s["hook"](b)
+            if s["complete"]:
+                self._finish_planned_prefill(
+                    s["slot"], Tensor(hv[0, lo + n - 1:lo + n]))
+            lo += n
+        if x is not None:
+            return Tensor(hv[0, lo:lo + self.max_batch][:, None])
+        return None
+
+    def _finish_planned_prefill(self, slot: int, last_hidden) -> None:
+        """Post-launch half of prefill completion for the ragged step
+        (the state transition already ran at plan time): the pages now
+        exist, so register the prefix blocks and fire the admitted
+        event — the same sequence ``_complete_prefill`` runs eagerly."""
+        st = self._prefills.pop(slot)
+        req = self._requests[slot]
+        T = len(req)
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, st["hashes"])
+            self.prefix_stats.tokens_computed += T - st["start"]
+            self.prefix_stats.tokens_skipped += st["start"]
+        self.admitted.append((req.rid, slot, last_hidden))
+        if self.collector is not None:
+            self.collector.on_first_token(req.rid)
+        self._crash("post_prefill")
+
     # -- release / preemption / failure -------------------------------
     def release(self, slot: int) -> None:
         """Caller-side finish (e.g. EOS): free the pages, refill. The
@@ -1145,6 +1300,13 @@ class PagedServingEngine:
                         req.append_history(row)
 
     def _drop(self, slot: int, quarantine: bool = False) -> None:
+        plan = self._ragged_plan
+        if plan and any(s["slot"] == slot for s in plan):
+            # ragged step: the eager path had already RUN this slot's
+            # chunks before any later preemption could fire — flush
+            # the pending segments so its pages are written (and its
+            # completed blocks registered) before they are freed
+            self._flush_ragged_plan()
         self._flush_history()
         if quarantine:
             self.cache.quarantine_seq(slot)
@@ -1254,16 +1416,44 @@ class PagedServingEngine:
             # then) or the engine is abandoned
             self._end_step_telemetry(aborted=not ok)
 
+    def _ragged_active(self) -> bool:
+        """Pack this step? — ragged_step on, token-budget mode, and
+        the kernel path live (or packing forced; see __init__)."""
+        if not self.ragged_step or self.prefill_token_budget is None:
+            return False
+        if self.ragged_step == "force":
+            return True
+        from ..incubate.nn.fused_transformer import _use_decode_kernel
+        return _use_decode_kernel()
+
     def _step_impl(self, idle: bool, x: Tensor):
+        if not self._ragged_active():
+            return self._step_body(idle, x)
+        # ragged mixed step: collect the step's prefill chunks into
+        # self._ragged_plan and launch them packed with the decode
+        # (_flush_ragged_plan) — cleared even when a crash unwinds
+        self._ragged_plan = []
+        try:
+            return self._step_body(idle, x)
+        finally:
+            self._ragged_plan = None
+
+    def _step_body(self, idle: bool, x: Tensor):
+        plan = self._ragged_plan
         col = self.collector
         if col is not None:
             col.phase("prefill")
-        ran_prefill, fresh = self._advance_prefills()
+        if plan is None:
+            ran_prefill, fresh = self._advance_prefills()
+        else:
+            ran_prefill, fresh = self._plan_prefills()
         if col is not None:
             col.phase("bookkeeping")
         if self.num_active == 0:
             if ran_prefill or self.num_prefilling > 0 or self.queue \
                     or not idle:
+                if plan:
+                    self._flush_ragged_plan()
                 self._try_admit()
                 return None
             raise RuntimeError("step() with no active slots")
@@ -1284,6 +1474,8 @@ class PagedServingEngine:
         for slot in fresh:
             stepping[slot] = False
         if not stepping.any():
+            if plan:
+                self._flush_ragged_plan()
             self._try_admit()
             return None
         # 2. grow pages (allocate-on-write), preempting on OOM.
@@ -1296,6 +1488,8 @@ class PagedServingEngine:
                                int(self.lens[slot]) + 1)
         stepping &= self.active     # growth may have evicted some
         if not stepping.any():
+            if plan:
+                self._flush_ragged_plan()
             self._try_admit()
             return None
         # 3. record the inputs being consumed (re-prefill history) —
@@ -1322,9 +1516,16 @@ class PagedServingEngine:
         self.cache.set_decode_mask(masked if masked.any() else None)
         if col is not None:
             col.phase("model")
-        t = Tensor(np.asarray(self.lens, np.int32))
-        with no_grad():
-            out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        if plan:
+            # the step's planned prefill chunks and the fused decode
+            # rows in ONE packed model call — one paged-attention
+            # launch per layer on the kernel path
+            out = self._flush_ragged_plan(x=x)
+        else:
+            t = Tensor(np.asarray(self.lens, np.int32))
+            with no_grad():
+                out, _ = self.model(x, caches=self.cache.views,
+                                    time_step=t)
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
         if col is not None:
@@ -1791,6 +1992,9 @@ class PagedServingEngine:
                 "prefill_token_budget": self.prefill_token_budget,
                 "max_preemptions": self.max_preemptions,
                 "numeric_guard": self.numeric_guard,
+                "ragged_step": self.ragged_step,
+                "tile_q": self.tile_q,
+                "tile_kv": self.tile_kv,
             },
             "cache": self.cache.snapshot(),
             "requests": [self._req_rec(r, now) for r in reqs.values()],
@@ -1869,7 +2073,12 @@ class PagedServingEngine:
                   injector=injector, collector=collector,
                   monitor=monitor,
                   max_preemptions=cfg["max_preemptions"],
-                  numeric_guard=cfg["numeric_guard"])
+                  numeric_guard=cfg["numeric_guard"],
+                  # pre-ragged snapshots restore onto the (equivalent)
+                  # ragged default; the knobs are scheduling-neutral
+                  ragged_step=cfg.get("ragged_step", True),
+                  tile_q=cfg.get("tile_q"),
+                  tile_kv=cfg.get("tile_kv"))
         # nb may differ from the cache snapshot's geometry (a resized
         # engine config, or the explicit override): the pool restore
         # rehomes content-addressed blocks either way

@@ -1,28 +1,58 @@
-"""Ragged paged-attention decode kernel over a block-paged KV cache.
+"""ONE ragged paged-attention kernel over a block-paged KV cache.
 
 TPU analog of vLLM's PagedAttention in the layout of PAPERS.md "Ragged
 Paged Attention" (arxiv 2604.15464): instead of one dense
 [B, max_len, H, D] cache per batch, K/V live in a shared pool of
 fixed-size blocks [num_blocks, 2, nkv, block_size, hd]; each sequence
 owns an int32 row of block ids (its block table) and a valid length.
-One query step per sequence attends over its pages with an online
-softmax, exactly like decode_attention but with the cache axis
-INDIRECTED through the block table. Three entry points share the
-layout: ``paged_attention`` (one decode query per row),
-``paged_attention_multi`` (K+1 speculative-verification queries per
-row), and ``paged_attention_prefill`` (a prompt CHUNK per row, tiled
-over a query-tile grid axis with causal page skipping — the kernel
-that lets prefill stream straight into pages with no dense scratch).
 
-On real TPU the block table rides as a SCALAR-PREFETCH argument
-(pltpu.PrefetchScalarGridSpec): the BlockSpec index_map reads
-``bt[seq, step]`` so each page is DMA'd HBM->VMEM directly from its
-pool row — the gathered [B, S, H, D] view never materializes. On CPU
-the same kernel body runs in interpret mode over pre-gathered pages
-(interpret mode has no scalar-prefetch index maps, same trade as
+Where earlier rounds carried THREE kernels for the three serving
+phases — decode (1 query row/seq), multi-query verify (K+1 rows/seq)
+and chunked prefill (C rows/seq, query-tiled) — there is now ONE:
+``paged_attention_ragged`` takes a PACKED query batch
+[total_rows, nh, hd] plus per-sequence descriptors (static ``q_lens``,
+traced ``kv_lens``) and processes a MIXED prefill+decode+verify batch
+in a single launch over the shared block table. Query i of sequence s
+sits at absolute position ``kv_lens[s] - q_lens[s] + i`` and attends
+causally over s's pages (positions <= its own), whose K/V — including
+the new rows themselves — must already sit in the pool (the
+paged-cache protocol appends before attending). The three old entry
+points survive as thin wrappers:
+
+  * ``paged_attention``          q_lens = (1,)*B,   tile_q = 1
+  * ``paged_attention_multi``    q_lens = (K+1,)*B, tile_q = K+1
+  * ``paged_attention_prefill``  q_lens = (C,)*B,   tile_q = min(C,64)
+
+so one body owns the online softmax + page-skip logic that used to be
+triplicated, and a mixed engine step costs ONE dispatch per layer
+instead of one per phase per slot (inference/paged_cache.py
+``ragged_views`` builds the batch; inference/scheduler.py launches it).
+
+Grid layout: each sequence's queries are cut into tiles of ``tile_q``
+rows; the grid is (total_tiles * nkv_heads, kv_steps) and a page whose
+first position lies past a tile's LAST query is skipped outright (the
+causal frontier — prefill work is O(tokens written), not O(page
+capacity); a decode tile skips everything past its one position).
+On real TPU the block table, the tile->sequence map and the per-tile
+base positions ride as SCALAR-PREFETCH arguments
+(pltpu.PrefetchScalarGridSpec): the pool BlockSpec index_map reads
+``bt[tile_seq[t], j]`` so each page is DMA'd HBM->VMEM directly from
+its pool row — the gathered [B, S, H, D] view never materializes. On
+CPU the same kernel body runs in interpret mode over pre-gathered
+pages (interpret mode has no scalar-prefetch index maps, same trade as
 grouped_gemm); the model-level CPU fallback in
 inference/paged_cache.py uses a pure-jnp gather instead so tier-1
 serving tests exercise the full protocol without Mosaic.
+
+Tile knobs (the README "Ragged paged attention" section carries the
+default table): ``tile_q`` is the query rows per grid step — more rows
+amortize each page DMA across queries but pad decode segments;
+``tile_kv`` is the PAGES per kv grid step — honored on the
+pre-gathered (interpret / jnp-reference) layout, clamped to 1 on the
+scalar-prefetch path because pool pages are non-contiguous (one DMA
+per page is the indirection's price; tile over q to amortize it).
+``tools/tile_report.py`` sizes both from recorded ``span.model``
+step-phase timings (PR 8/9) so real-TPU tuning is data-driven.
 """
 from __future__ import annotations
 
@@ -31,6 +61,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:
@@ -39,6 +70,28 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+
+# default tile table (README carries the rationale): decode segments
+# want tile_q == 1 (no padding rows), verify wants the whole K+1 block
+# (one page sweep scores every position), prefill wants wide tiles up
+# to this cap so a long chunk never holds every row in VMEM at once.
+DEFAULT_TILE_Q_CAP = 64
+
+# launch accounting for the dispatch-count acceptance tests and the
+# kernel microbench: every ``paged_attention_ragged`` entry (kernel,
+# interpret or delegated wrapper) bumps the counter ONCE — i.e. once
+# per attention launch when the eager op-jit cache is off
+# (FLAGS_eager_op_jit=False; with it on, a cached executable replays
+# without re-entering this module, so tests disable it to count).
+_DISPATCH = {"count": 0}
+
+
+def dispatch_count() -> int:
+    return _DISPATCH["count"]
+
+
+def reset_dispatch_count() -> None:
+    _DISPATCH["count"] = 0
 
 
 def _interpret():
@@ -54,14 +107,25 @@ def _require_pltpu():
             "shapes) — use the jnp path instead")
 
 
-def _paged_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                block_s, n_blocks, sm_scale):
-    """Online-softmax update for one (sequence*kv-head, page) grid step.
-
-    kv_ref holds one page of this row's K and V — (1, 2, 1, bs, hd) on
-    the prefetch path, (1, 1, 2, bs, hd) pre-gathered in interpret mode;
-    both reshape to (2, bs, hd). `length` is this row's valid length
-    (already read out of SMEM by the wrapper)."""
+def _ragged_body(pos0, pos_last, k, v, q_ref, o_ref, m_scr, l_scr,
+                 acc_scr, *, block_s, n_blocks, sm_scale, tile_q, g):
+    """Online-softmax update for one (tile*kv-head, kv-step) grid step —
+    THE paged-attention body, shared by every phase. ``pos0`` is this
+    tile's first query's absolute position and ``pos_last`` its LAST
+    REAL query's (both read out of SMEM/prefetch by the wrapper; a
+    partial tail tile's pos_last excludes the padding rows, so a
+    decode row padded into a wide mixed-batch tile still skips
+    everything past its single position). Row r of the q block is
+    query r // g of the tile, at position pos0 + r // g, masked
+    causally per row. k/v hold this step's kv tile as (block_s, hd)
+    float32 — one pool page on the scalar-prefetch path, ``tile_kv``
+    pages pre-gathered in interpret mode. A kv step whose first
+    position lies past pos_last is fully masked for every real row
+    and skipped outright (the causal frontier: decode pages above a
+    prefill chunk don't exist yet — this is both the old prefill
+    kernel's page skip and the old decode kernel's length skip,
+    unified; padding rows lose those pages too, but their outputs are
+    dropped on unpack)."""
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -70,94 +134,25 @@ def _paged_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
-    k = kv[0].astype(jnp.float32)               # [block_s, hd]
-    v = kv[1].astype(jnp.float32)
-    q = q_ref[0].astype(jnp.float32)            # [g, hd]
+    q = q_ref[0, 0].astype(jnp.float32)         # [tile_q * g, hd]
 
-    # pages at or past the valid length are pure padding (their block
-    # table entries point at the reserved trash block) — skip the FLOPs,
-    # the running stats already ignore them
-    @pl.when(j * block_s < length)
-    def _update():
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # [g, block_s]
-        pos = j * block_s + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        scores = jnp.where(pos < length, scores, NEG_INF)
-
-        m_prev = m_scr[...]                     # [g, 1]
-        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)
-        # mask the probabilities too: a fully-masked row would otherwise
-        # turn exp(NEG_INF - NEG_INF) into ones
-        p = jnp.exp(scores - m_new) * (pos < length)
-        l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = m_new
-
-    @pl.when(j == n_blocks - 1)
-    def _done():
-        l = l_scr[...]
-        # length-0 rows emit zeros, not NaN
-        o_ref[0] = (acc_scr[...] /
-                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
-
-
-def _kernel_prefetch(bt_ref, lens_ref, q_ref, pool_ref, o_ref, m_scr,
-                     l_scr, acc_scr, *, nkv, **kw):
-    # bt_ref feeds the index maps only; lens is a prefetched [B] vector
-    del bt_ref
-    _paged_body(lens_ref[pl.program_id(0) // nkv], q_ref, pool_ref,
-                o_ref, m_scr, l_scr, acc_scr, **kw)
-
-
-def _kernel_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr, l_scr,
-                      acc_scr, **kw):
-    _paged_body(lens_ref[pl.program_id(0), 0], q_ref, pg_ref, o_ref,
-                m_scr, l_scr, acc_scr, **kw)
-
-
-def _paged_multi_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr,
-                      acc_scr, *, block_s, n_blocks, sm_scale, n_q, g):
-    """Multi-query variant of ``_paged_body`` for speculative-decode
-    verification: the q block holds this sequence*kv-head's n_q query
-    tokens folded with the group axis as (n_q * g) rows. Row r is
-    query index r // g at absolute position length - n_q + (r // g),
-    masked causally per row — so one grid sweep over the pages scores
-    all n_q positions with the same online softmax."""
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
-    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
-    k = kv[0].astype(jnp.float32)               # [block_s, hd]
-    v = kv[1].astype(jnp.float32)
-    q = q_ref[0].astype(jnp.float32)            # [n_q * g, hd]
-
-    @pl.when(j * block_s < length)
+    @pl.when(j * block_s <= pos_last)
     def _update():
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         kpos = j * block_s + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        # per-row causal horizon: query r//g sits at length-n_q+r//g
-        qpos = (length - n_q) + jax.lax.broadcasted_iota(
+        qpos = pos0 + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 0) // g
-        valid = kpos <= qpos                    # implies kpos < length
+        valid = kpos <= qpos                    # implies kpos < kv_len
         scores = jnp.where(valid, scores, NEG_INF)
 
-        m_prev = m_scr[...]
+        m_prev = m_scr[...]                     # [tile_q * g, 1]
         m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
+        # mask the probabilities too: a fully-masked row would
+        # otherwise turn exp(NEG_INF - NEG_INF) into ones
         p = jnp.exp(scores - m_new) * valid
         l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
@@ -168,95 +163,253 @@ def _paged_multi_body(length, q_ref, kv_ref, o_ref, m_scr, l_scr,
     @pl.when(j == n_blocks - 1)
     def _done():
         l = l_scr[...]
-        o_ref[0] = (acc_scr[...] /
-                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        # rows with no valid key (length-0 sequences) emit zeros
+        o_ref[0, 0] = (acc_scr[...] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
-def _kernel_multi_prefetch(bt_ref, lens_ref, q_ref, pool_ref, o_ref,
-                           m_scr, l_scr, acc_scr, *, nkv, **kw):
-    del bt_ref
-    _paged_multi_body(lens_ref[pl.program_id(0) // nkv], q_ref,
-                      pool_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
+def _kernel_ragged_prefetch(bt_ref, tseq_ref, pos_ref, q_ref,
+                            pool_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                            nkv, **kw):
+    # bt/tseq feed the index maps only; pos is a prefetched [T, 2]
+    # (first, last) query-position table
+    del bt_ref, tseq_ref
+    hd = q_ref.shape[-1]
+    t = pl.program_id(0) // nkv
+    kv = pool_ref[...].reshape(2, kw["block_s"], hd)
+    _ragged_body(pos_ref[t, 0], pos_ref[t, 1],
+                 kv[0].astype(jnp.float32), kv[1].astype(jnp.float32),
+                 q_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
 
 
-def _kernel_multi_interpret(lens_ref, q_ref, pg_ref, o_ref, m_scr,
-                            l_scr, acc_scr, **kw):
-    _paged_multi_body(lens_ref[pl.program_id(0), 0], q_ref, pg_ref,
-                      o_ref, m_scr, l_scr, acc_scr, **kw)
+def _kernel_ragged_interpret(pos_ref, q_ref, pg_ref, o_ref, m_scr,
+                             l_scr, acc_scr, *, tile_kv, **kw):
+    hd = q_ref.shape[-1]
+    i = pl.program_id(0)
+    # pg block: (1, tile_kv, 2, bs, hd) -> (2, tile_kv * bs, hd)
+    kv = jnp.swapaxes(pg_ref[...][0], 0, 1).reshape(
+        2, kw["block_s"], hd)
+    _ragged_body(pos_ref[i, 0], pos_ref[i, 1],
+                 kv[0].astype(jnp.float32), kv[1].astype(jnp.float32),
+                 q_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
 
 
-def _paged_prefill_body(start, q_ref, kv_ref, o_ref, m_scr, l_scr,
-                        acc_scr, *, block_s, n_blocks, sm_scale,
-                        tile_q, g):
-    """Chunked-prefill variant: the grid adds a QUERY-TILE axis, so a
-    long prompt chunk streams through VMEM tile_q queries at a time
-    instead of holding every row at once (the multi body's shape). The
-    q block holds tile qt's tile_q*g folded rows; row r is query
-    qt*tile_q + r//g at absolute position start + qt*tile_q + r//g.
-    Unlike decode there is no valid-length horizon ABOVE the queries —
-    the chunk's own K/V are the newest entries in the pool — so the
-    causal mask alone bounds the reduction, and pages that start past
-    a tile's last query are skipped outright (the FLOPs a prefill
-    saves over the decode-shaped sweep)."""
-    qt = pl.program_id(1)
-    j = pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
-    kv = kv_ref[...].reshape(2, block_s, q_ref.shape[-1])
-    k = kv[0].astype(jnp.float32)               # [block_s, hd]
-    v = kv[1].astype(jnp.float32)
-    q = q_ref[0].astype(jnp.float32)            # [tile_q * g, hd]
-    base = start + qt * tile_q                  # tile's first position
-
-    # a page whose first position lies past the tile's LAST query is
-    # fully masked: skip it (decode pages above the chunk don't exist
-    # yet, so this bounds work by the causal frontier, not max_len)
-    @pl.when(j * block_s <= base + tile_q - 1)
-    def _update():
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        kpos = j * block_s + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        qpos = base + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0) // g
-        valid = kpos <= qpos
-        scores = jnp.where(valid, scores, NEG_INF)
-
-        m_prev = m_scr[...]
-        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new) * valid
-        l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = m_new
-
-    @pl.when(j == n_blocks - 1)
-    def _done():
-        l = l_scr[...]
-        o_ref[0] = (acc_scr[...] /
-                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+def _tile_layout(q_lens, tile_q):
+    """Host-side tile descriptors for a packed ragged batch: returns
+    (tile_seq [T], tile_off [T], tile_n [T], pad_idx [T*tile_q],
+    out_idx [R]) — which sequence each tile serves, its query offset
+    within that sequence, its REAL row count (a partial tail tile's
+    causal frontier stops at its last real query, not at tile_q), the
+    packed-row index feeding each padded-tile row (pad rows point at
+    row 0, their outputs are dropped), and where each packed row's
+    output lives in the padded layout."""
+    tile_seq, tile_off, tile_n, pad_idx = [], [], [], []
+    out_idx = np.empty(sum(q_lens), np.int32)
+    r0 = 0
+    for s, ql in enumerate(q_lens):
+        for off in range(0, ql, tile_q):
+            t = len(tile_seq)
+            tile_seq.append(s)
+            tile_off.append(off)
+            n = min(tile_q, ql - off)
+            tile_n.append(n)
+            pad_idx.extend(range(r0 + off, r0 + off + n))
+            pad_idx.extend([0] * (tile_q - n))
+            out_idx[r0 + off:r0 + off + n] = \
+                np.arange(t * tile_q, t * tile_q + n)
+        r0 += ql
+    return (np.asarray(tile_seq, np.int32),
+            np.asarray(tile_off, np.int32),
+            np.asarray(tile_n, np.int32),
+            np.asarray(pad_idx, np.int32), out_idx)
 
 
-def _kernel_prefill_prefetch(bt_ref, start_ref, q_ref, pool_ref, o_ref,
-                             m_scr, l_scr, acc_scr, *, nkv, **kw):
-    del bt_ref
-    _paged_prefill_body(start_ref[pl.program_id(0) // nkv], q_ref,
-                        pool_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
+def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
+                           sm_scale=None, tile_q=None, tile_kv=None):
+    """THE kernel: one launch scores a mixed prefill+decode+verify
+    batch. q: [R, nh, hd] — every sequence's query rows packed
+    back-to-back (R == sum(q_lens)). q_lens: STATIC per-sequence query
+    counts (python ints; the packed shape depends on them, so they are
+    compile-time like every other shape). kv_lens: int32 [n_seq] valid
+    lengths INCLUDING each sequence's q_lens new rows (whose K/V must
+    already sit in the pool). block_tables: int32 [n_seq, MB] — entry
+    j is the pool row holding positions [j*bs, (j+1)*bs); entries past
+    a sequence's allocation must point at a valid (e.g. reserved)
+    block. Query i of sequence s sits at position
+    kv_lens[s] - q_lens[s] + i and attends causally (so q_lens[s] == 1
+    is a decode row, == K+1 a speculative verify, == C a prefill
+    chunk). Zero-length sequences contribute no rows and are skipped.
+    Returns [R, nh, hd] in packed order."""
+    q_lens = tuple(int(x) for x in q_lens)
+    R, nh, hd = q.shape
+    if R != sum(q_lens):
+        raise ValueError(f"packed q has {R} rows, q_lens sum to "
+                         f"{sum(q_lens)}")
+    if R == 0:
+        return q         # nothing to score — no launch, not counted
+    _DISPATCH["count"] += 1
+    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
+    MB = block_tables.shape[1]
+    g = nh // nkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    if tile_q is None:
+        tile_q = min(DEFAULT_TILE_Q_CAP, max(q_lens))
+    tile_q = max(1, int(tile_q))
+    tile_seq, tile_off, tile_n, pad_idx, out_idx = \
+        _tile_layout(q_lens, tile_q)
+    T = tile_seq.shape[0]
+    rows = tile_q * g
+
+    lens = jnp.asarray(kv_lens, jnp.int32)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    qlen_arr = jnp.asarray(q_lens, jnp.int32)
+    tseq = jnp.asarray(tile_seq)
+    # per-tile (first, LAST REAL) query positions (kv_lens may be
+    # traced): the last-real column is the causal frontier — a decode
+    # row padded into a wide mixed-batch tile keeps its single
+    # position, so the page sweep never runs past it
+    pos0 = (lens[tseq] - qlen_arr[tseq]
+            + jnp.asarray(tile_off)).astype(jnp.int32)
+    pos = jnp.stack([pos0, pos0 + jnp.asarray(tile_n) - 1], axis=1)
+
+    # pad + fold: [R, nh, hd] -> [T, nkv, tile_q*g, hd]
+    qp = jnp.take(q.reshape(R, nkv, g, hd), jnp.asarray(pad_idx),
+                  axis=0)
+    qp = jnp.transpose(qp.reshape(T, tile_q, nkv, g, hd),
+                       (0, 2, 1, 3, 4)).reshape(T, nkv, rows, hd)
+
+    _require_pltpu()
+    scratch = [pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, 1), jnp.float32),
+               pltpu.VMEM((rows, hd), jnp.float32)]
+    out_shape = jax.ShapeDtypeStruct((T, nkv, rows, hd), q.dtype)
+
+    if _interpret():
+        # no scalar prefetch in interpret mode: pre-gather each tile's
+        # pages (test/CPU path only; the kernel body is identical).
+        # The gather is per TILE, so a sequence tiled into k query
+        # tiles duplicates its pages k-fold here — acceptable because
+        # tests run small shapes and the default tile_q covers whole
+        # chunks (k == 1); the scalar-prefetch path never gathers at
+        # all (one DMA per page straight off the pool row).
+        # tile_kv is honored here — the gathered layout is contiguous,
+        # so a kv grid step can cover several pages at once.
+        tkv = max(1, int(tile_kv)) if tile_kv is not None else 1
+        MBp = -(-MB // tkv) * tkv
+        if MBp != MB:
+            # pad with the reserved trash block: positions >= MB*bs
+            # are past every causal frontier, masked by construction
+            bt_p = jnp.concatenate(
+                [bt, jnp.zeros((bt.shape[0], MBp - MB), jnp.int32)], 1)
+        else:
+            bt_p = bt
+        n_kv_steps = MBp // tkv
+        pages = kv_pool[bt_p]           # [n_seq, MBp, 2, nkv, bs, hd]
+        pg = jnp.transpose(pages[tseq], (0, 3, 1, 2, 4, 5)).reshape(
+            T * nkv, MBp, 2, block_s, hd)
+        pos_r = jnp.repeat(pos, nkv, axis=0)        # [T * nkv, 2]
+        kw = dict(block_s=block_s * tkv, n_blocks=n_kv_steps,
+                  sm_scale=scale, tile_q=tile_q, g=g)
+        out = pl.pallas_call(
+            functools.partial(_kernel_ragged_interpret, tile_kv=tkv,
+                              **kw),
+            grid=(T * nkv, n_kv_steps),
+            in_specs=[
+                pl.BlockSpec((T * nkv, 2), lambda i, j: (0, 0)),
+                pl.BlockSpec((1, 1, rows, hd),
+                             lambda i, j: (i // nkv, i % nkv, 0, 0)),
+                pl.BlockSpec((1, tkv, 2, block_s, hd),
+                             lambda i, j: (i, j, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda i, j: (i // nkv, i % nkv,
+                                                 0, 0)),
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=True,
+        )(pos_r, qp, pg)
+    else:
+        # scalar-prefetch path: tile_kv stays 1 — pool pages are
+        # non-contiguous, so each kv step DMAs exactly the page the
+        # block table names (tile over q to amortize the DMA instead)
+        kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
+                  tile_q=tile_q, g=g)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,   # bt + tile->seq map + pos (SMEM)
+            grid=(T * nkv, MB),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, hd),
+                             lambda i, j, bt_, ts_, p_:
+                             (i // nkv, i % nkv, 0, 0)),
+                # one page per step, straight out of the pool row named
+                # by the block table — the whole paged-attention trick
+                pl.BlockSpec((1, 2, 1, block_s, hd),
+                             lambda i, j, bt_, ts_, p_:
+                             (bt_[ts_[i // nkv], j], 0, i % nkv,
+                              0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda i, j, bt_, ts_, p_:
+                                   (i // nkv, i % nkv, 0, 0)),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            functools.partial(_kernel_ragged_prefetch, nkv=nkv, **kw),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+        )(bt, tseq, pos, qp, kv_pool)
+
+    # unfold + unpad back to the packed row order
+    out = jnp.transpose(out.reshape(T, nkv, tile_q, g, hd),
+                        (0, 2, 1, 3, 4)).reshape(T * tile_q, nh, hd)
+    return jnp.take(out, jnp.asarray(out_idx), axis=0)
 
 
-def _kernel_prefill_interpret(start_ref, q_ref, pg_ref, o_ref, m_scr,
-                              l_scr, acc_scr, **kw):
-    _paged_prefill_body(start_ref[pl.program_id(0), 0], q_ref, pg_ref,
-                        o_ref, m_scr, l_scr, acc_scr, **kw)
+# --- the three phase entry points: thin wrappers over the ragged path -
 
+def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None):
+    """Decode: q [B, nh, hd] (one query per sequence), seq_lens int32
+    [B] valid lengths. A ragged launch with q_lens = (1,)*B and
+    tile_q = 1 (no padding rows). Returns [B, nh, hd]."""
+    return paged_attention_ragged(
+        q, kv_pool, block_tables, (1,) * q.shape[0], seq_lens,
+        sm_scale=sm_scale, tile_q=1)
+
+
+def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
+                          sm_scale=None):
+    """Multi-query verify (speculative decode): q [B, n_q, nh, hd],
+    query i of row b at position seq_lens[b] - n_q + i, masked
+    causally. seq_lens INCLUDE the n_q new tokens. A ragged launch
+    with q_lens = (n_q,)*B and tile_q = n_q (each sequence is one
+    tile, so every page is DMA'd once per sequence*kv-head). Returns
+    [B, n_q, nh, hd]."""
+    B, n_q, nh, hd = q.shape
+    out = paged_attention_ragged(
+        q.reshape(B * n_q, nh, hd), kv_pool, block_tables,
+        (n_q,) * B, seq_lens, sm_scale=sm_scale, tile_q=n_q)
+    return out.reshape(B, n_q, nh, hd)
+
+
+def paged_attention_prefill(q, kv_pool, block_tables, start_pos,
+                            sm_scale=None, tile_q=None):
+    """Chunked prefill: q [B, C, nh, hd] holds one prompt chunk per
+    sequence, query i of row b at absolute position start_pos[b] + i.
+    A ragged launch with q_lens = (C,)*B, kv_lens = start_pos + C and
+    a query-tile grid (default tile_q = min(C, 64)) whose pages past
+    each tile's causal frontier are skipped — prefill work is
+    O(tokens written), not O(page capacity). Returns [B, C, nh, hd]."""
+    B, C, nh, hd = q.shape
+    if tile_q is None:
+        tile_q = min(C, DEFAULT_TILE_Q_CAP)
+    lens = jnp.asarray(start_pos, jnp.int32) + C
+    out = paged_attention_ragged(
+        q.reshape(B * C, nh, hd), kv_pool, block_tables, (C,) * B,
+        lens, sm_scale=sm_scale, tile_q=tile_q)
+    return out.reshape(B, C, nh, hd)
+
+
+# --- references: ONE ragged reference, per-phase ones delegate --------
 
 def gather_pages(kv_pool, block_tables):
     """Pure-jnp page gather: materialize the block-table indirection as
@@ -273,293 +426,67 @@ def gather_pages(kv_pool, block_tables):
             v.reshape(B, MB * bs, nkv, hd))
 
 
-def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None):
-    """q: [B, nh, hd] (one decode step per sequence). kv_pool:
-    [num_blocks, 2, nkv, block_size, hd]. block_tables: int32 [B, MB] —
-    entry j is the pool row holding positions [j*bs, (j+1)*bs); entries
-    past a sequence's allocation must point at a valid (e.g. reserved)
-    block. seq_lens: int32 [B] valid lengths. Returns [B, nh, hd]."""
-    B, nh, hd = q.shape
-    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
-    MB = block_tables.shape[1]
+def paged_attention_ragged_reference(q, kv_pool, block_tables, q_lens,
+                                     kv_lens, sm_scale=None):
+    """jnp reference for the ragged kernel — and the ONE place the
+    reference semantics live: the per-phase ``*_reference`` functions
+    below are thin delegations, so kernel and reference can no longer
+    drift apart per phase. Gather pages dense, then per-sequence
+    masked softmax with each query at kv_lens[s] - q_lens[s] + i."""
+    q_lens = tuple(int(x) for x in q_lens)
+    R, nh, hd = q.shape
+    if R == 0:
+        return q
+    nkv = kv_pool.shape[2]
     g = nh // nkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
-
-    qg = q.reshape(B, nkv, g, hd).reshape(B * nkv, g, hd)
-    lens = jnp.asarray(seq_lens, jnp.int32)
-    bt = jnp.asarray(block_tables, jnp.int32)
-
-    _require_pltpu()
-    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale)
-    scratch = [pltpu.VMEM((g, 1), jnp.float32),
-               pltpu.VMEM((g, 1), jnp.float32),
-               pltpu.VMEM((g, hd), jnp.float32)]
-    out_shape = jax.ShapeDtypeStruct((B * nkv, g, hd), q.dtype)
-    q_spec = pl.BlockSpec((1, g, hd), lambda i, j: (i, 0, 0))
-    o_spec = pl.BlockSpec((1, g, hd), lambda i, j: (i, 0, 0))
-
-    if _interpret():
-        # no scalar prefetch in interpret mode: pre-gather each row's
-        # pages (test path only; the kernel body is identical)
-        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
-        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
-            B * nkv, MB, 2, block_s, hd)
-        lens_r = jnp.repeat(lens, nkv).reshape(B * nkv, 1)
-        out = pl.pallas_call(
-            functools.partial(_kernel_interpret, **kw),
-            grid=(B * nkv, MB),
-            in_specs=[
-                pl.BlockSpec((B * nkv, 1), lambda i, j: (0, 0)),
-                q_spec,
-                pl.BlockSpec((1, 1, 2, block_s, hd),
-                             lambda i, j: (i, j, 0, 0, 0)),
-            ],
-            out_specs=o_spec,
-            out_shape=out_shape,
-            scratch_shapes=scratch,
-            interpret=True,
-        )(lens_r, qg, pg)
-        return out.reshape(B, nkv, g, hd).reshape(B, nh, hd)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,   # block tables + lens ride in SMEM
-        grid=(B * nkv, MB),
-        in_specs=[
-            pl.BlockSpec((1, g, hd), lambda i, j, bt_, l_: (i, 0, 0)),
-            # one page per step, straight out of the pool row named by
-            # the block table — this is the whole paged-attention trick
-            pl.BlockSpec((1, 2, 1, block_s, hd),
-                         lambda i, j, bt_, l_: (bt_[i // nkv, j], 0,
-                                                i % nkv, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, g, hd), lambda i, j, bt_, l_:
-                               (i, 0, 0)),
-        scratch_shapes=scratch,
-    )
-    out = pl.pallas_call(
-        functools.partial(_kernel_prefetch, nkv=nkv, **kw),
-        grid_spec=grid_spec,
-        out_shape=out_shape,
-    )(bt, lens, qg, kv_pool)
-    return out.reshape(B, nkv, g, hd).reshape(B, nh, hd)
-
-
-def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
-                          sm_scale=None):
-    """Multi-query paged decode (speculative-decode verification):
-    q: [B, n_q, nh, hd] — each sequence scores n_q query tokens in one
-    sweep, query i at absolute position seq_lens[b] - n_q + i, masked
-    causally per query. seq_lens: int32 [B] valid lengths INCLUDING
-    the n_q new tokens (whose K/V must already sit in the pool).
-    Same block-table contract as ``paged_attention``; rides the same
-    scalar-prefetch grid on TPU (the n_q axis folds into the q block,
-    so each page is still DMA'd once per sequence*kv-head). Returns
-    [B, n_q, nh, hd]."""
-    B, n_q, nh, hd = q.shape
-    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
-    MB = block_tables.shape[1]
-    g = nh // nkv
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
-
-    # [B, n_q, nkv, g, hd] -> [B, nkv, n_q, g, hd] -> rows (n_q, g)
-    qg = jnp.transpose(q.reshape(B, n_q, nkv, g, hd),
-                       (0, 2, 1, 3, 4)).reshape(B * nkv, n_q * g, hd)
-    lens = jnp.asarray(seq_lens, jnp.int32)
-    bt = jnp.asarray(block_tables, jnp.int32)
-
-    _require_pltpu()
-    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
-              n_q=n_q, g=g)
-    rows = n_q * g
-    scratch = [pltpu.VMEM((rows, 1), jnp.float32),
-               pltpu.VMEM((rows, 1), jnp.float32),
-               pltpu.VMEM((rows, hd), jnp.float32)]
-    out_shape = jax.ShapeDtypeStruct((B * nkv, rows, hd), q.dtype)
-    q_spec = pl.BlockSpec((1, rows, hd), lambda i, j: (i, 0, 0))
-    o_spec = pl.BlockSpec((1, rows, hd), lambda i, j: (i, 0, 0))
-
-    if _interpret():
-        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
-        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
-            B * nkv, MB, 2, block_s, hd)
-        lens_r = jnp.repeat(lens, nkv).reshape(B * nkv, 1)
-        out = pl.pallas_call(
-            functools.partial(_kernel_multi_interpret, **kw),
-            grid=(B * nkv, MB),
-            in_specs=[
-                pl.BlockSpec((B * nkv, 1), lambda i, j: (0, 0)),
-                q_spec,
-                pl.BlockSpec((1, 1, 2, block_s, hd),
-                             lambda i, j: (i, j, 0, 0, 0)),
-            ],
-            out_specs=o_spec,
-            out_shape=out_shape,
-            scratch_shapes=scratch,
-            interpret=True,
-        )(lens_r, qg, pg)
-    else:
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(B * nkv, MB),
-            in_specs=[
-                pl.BlockSpec((1, rows, hd),
-                             lambda i, j, bt_, l_: (i, 0, 0)),
-                pl.BlockSpec((1, 2, 1, block_s, hd),
-                             lambda i, j, bt_, l_: (bt_[i // nkv, j], 0,
-                                                    i % nkv, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, rows, hd), lambda i, j, bt_, l_:
-                                   (i, 0, 0)),
-            scratch_shapes=scratch,
-        )
-        out = pl.pallas_call(
-            functools.partial(_kernel_multi_prefetch, nkv=nkv, **kw),
-            grid_spec=grid_spec,
-            out_shape=out_shape,
-        )(bt, lens, qg, kv_pool)
-    out = out.reshape(B, nkv, n_q, g, hd)
-    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, n_q, nh, hd)
-
-
-def paged_attention_prefill(q, kv_pool, block_tables, start_pos,
-                            sm_scale=None, tile_q=None):
-    """Chunked paged PREFILL: q [B, C, nh, hd] holds one prompt chunk
-    per sequence — query i of row b sits at absolute position
-    start_pos[b] + i and attends causally over that row's pages
-    (positions <= its own), whose K/V — INCLUDING the chunk's own
-    rows — must already sit in the pool (the paged-cache protocol
-    appends before attending, same as decode). start_pos: int32 [B]
-    chunk start positions. Rides the same scalar-prefetch block table
-    as the decode/multi kernels, but the grid adds a query-tile axis
-    (``tile_q`` queries per step, default min(C, 64)) so a long chunk
-    never holds all its rows in VMEM at once, and pages past a tile's
-    causal frontier are skipped instead of masked — prefill work is
-    O(tokens written), not O(page capacity). Returns [B, C, nh, hd].
-
-    Interpret + pure-jnp fallbacks mirror the decode/multi kernels:
-    interpret mode pre-gathers pages (no scalar-prefetch index maps);
-    the bit-exact CPU serving path in inference/paged_cache.py uses a
-    jnp gather + the dense masked-sdpa codepath instead, which is what
-    keeps chunked prefill bit-identical to dense scratch prefill."""
-    B, C, nh, hd = q.shape
-    nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
-    MB = block_tables.shape[1]
-    g = nh // nkv
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
-    if tile_q is None:
-        tile_q = min(C, 64)
-    n_qt = -(-C // tile_q)
-    C_pad = n_qt * tile_q
-    if C_pad != C:
-        # padded tail queries attend garbage (positions past the
-        # chunk) and are sliced off below
-        q = jnp.concatenate(
-            [q, jnp.zeros((B, C_pad - C, nh, hd), q.dtype)], axis=1)
-
-    # [B, C_pad, nkv, g, hd] -> [B, nkv, C_pad, g, hd] -> folded rows
-    qg = jnp.transpose(q.reshape(B, C_pad, nkv, g, hd),
-                       (0, 2, 1, 3, 4)).reshape(B * nkv, C_pad * g, hd)
-    start = jnp.asarray(start_pos, jnp.int32)
-    bt = jnp.asarray(block_tables, jnp.int32)
-
-    _require_pltpu()
-    kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
-              tile_q=tile_q, g=g)
-    rows = tile_q * g
-    scratch = [pltpu.VMEM((rows, 1), jnp.float32),
-               pltpu.VMEM((rows, 1), jnp.float32),
-               pltpu.VMEM((rows, hd), jnp.float32)]
-    out_shape = jax.ShapeDtypeStruct((B * nkv, C_pad * g, hd), q.dtype)
-
-    if _interpret():
-        pages = kv_pool[bt]                      # [B, MB, 2, nkv, bs, hd]
-        pg = jnp.transpose(pages, (0, 3, 1, 2, 4, 5)).reshape(
-            B * nkv, MB, 2, block_s, hd)
-        start_r = jnp.repeat(start, nkv).reshape(B * nkv, 1)
-        out = pl.pallas_call(
-            functools.partial(_kernel_prefill_interpret, **kw),
-            grid=(B * nkv, n_qt, MB),
-            in_specs=[
-                pl.BlockSpec((B * nkv, 1), lambda i, qt, j: (0, 0)),
-                pl.BlockSpec((1, rows, hd),
-                             lambda i, qt, j: (i, qt, 0)),
-                pl.BlockSpec((1, 1, 2, block_s, hd),
-                             lambda i, qt, j: (i, j, 0, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, rows, hd),
-                                   lambda i, qt, j: (i, qt, 0)),
-            out_shape=out_shape,
-            scratch_shapes=scratch,
-            interpret=True,
-        )(start_r, qg, pg)
-    else:
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,   # block tables + starts in SMEM
-            grid=(B * nkv, n_qt, MB),
-            in_specs=[
-                pl.BlockSpec((1, rows, hd),
-                             lambda i, qt, j, bt_, s_: (i, qt, 0)),
-                pl.BlockSpec((1, 2, 1, block_s, hd),
-                             lambda i, qt, j, bt_, s_:
-                             (bt_[i // nkv, j], 0, i % nkv, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, rows, hd),
-                                   lambda i, qt, j, bt_, s_:
-                                   (i, qt, 0)),
-            scratch_shapes=scratch,
-        )
-        out = pl.pallas_call(
-            functools.partial(_kernel_prefill_prefetch, nkv=nkv, **kw),
-            grid_spec=grid_spec,
-            out_shape=out_shape,
-        )(bt, start, qg, kv_pool)
-    out = out.reshape(B, nkv, C_pad, g, hd)
-    out = jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, C_pad, nh, hd)
-    return out[:, :C]
-
-
-def paged_attention_prefill_reference(q, kv_pool, block_tables,
-                                      start_pos, sm_scale=None):
-    """jnp reference for the chunked-prefill path: gather pages dense,
-    per-query causal mask at absolute positions start_pos[b] + i. The
-    multi-query reference already computes exactly this shape with
-    seq_lens = start + C (its queries sit at lens - n_q + i)."""
-    C = q.shape[1]
-    lens = jnp.asarray(start_pos, jnp.int32) + C
-    return paged_attention_multi_reference(q, kv_pool, block_tables,
-                                           lens, sm_scale=sm_scale)
+    k, v = gather_pages(kv_pool, block_tables)   # [n_seq, S, nkv, hd]
+    S = k.shape[1]
+    k = jnp.repeat(k, g, axis=2)                 # GQA: broadcast kv heads
+    v = jnp.repeat(v, g, axis=2)
+    lens = jnp.asarray(kv_lens, jnp.int32)
+    outs, r0 = [], 0
+    for s, ql in enumerate(q_lens):
+        if ql == 0:
+            continue
+        qs = q[r0:r0 + ql].astype(jnp.float32)   # [ql, nh, hd]
+        scores = jnp.einsum("qhd,shd->hqs", qs,
+                            k[s].astype(jnp.float32)) * scale
+        qpos = (lens[s] - ql) + jnp.arange(ql)[None, :, None]
+        kpos = jnp.arange(S)[None, None, :]
+        valid = kpos <= qpos
+        p = jax.nn.softmax(jnp.where(valid, scores, NEG_INF), axis=-1)
+        # rows with no valid key (inactive: qpos < 0) -> zeros
+        p = jnp.where(valid & (qpos >= 0), p, 0.0)
+        outs.append(jnp.einsum("hqs,shd->qhd", p,
+                               v[s].astype(jnp.float32)).astype(q.dtype))
+        r0 += ql
+    return jnp.concatenate(outs, axis=0)
 
 
 def paged_attention_reference(q, kv_pool, block_tables, seq_lens,
                               sm_scale=None):
-    """jnp reference: gather pages dense, then the decode reference."""
-    from .decode_attention import decode_attention_reference
-    k, v = gather_pages(kv_pool, block_tables)
-    return decode_attention_reference(q, k, v, seq_lens,
-                                      sm_scale=sm_scale)
+    """Decode reference = ragged reference at q_lens all 1."""
+    return paged_attention_ragged_reference(
+        q, kv_pool, block_tables, (1,) * q.shape[0], seq_lens,
+        sm_scale=sm_scale)
 
 
 def paged_attention_multi_reference(q, kv_pool, block_tables, seq_lens,
                                     sm_scale=None):
-    """jnp reference for the multi-query path: gather pages dense,
-    per-query causal mask, plain softmax."""
+    """Multi-query reference = ragged reference at uniform q_lens."""
     B, n_q, nh, hd = q.shape
-    nkv = kv_pool.shape[2]
-    g = nh // nkv
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
-    k, v = gather_pages(kv_pool, block_tables)   # [B, S, nkv, hd]
-    S = k.shape[1]
-    k = jnp.repeat(k, g, axis=2)                 # GQA: broadcast kv heads
-    v = jnp.repeat(v, g, axis=2)
-    scores = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    lens = jnp.asarray(seq_lens, jnp.int32)
-    qpos = (lens[:, None] - n_q)[:, None, :, None] + \
-        jnp.arange(n_q)[None, None, :, None]
-    kpos = jnp.arange(S)[None, None, None, :]
-    scores = jnp.where(kpos <= qpos, scores, NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1)
-    # rows with no valid key (inactive, lens <= n_q - 1 - i) -> zeros
-    p = jnp.where((kpos <= qpos) & (qpos >= 0), p, 0.0)
-    out = jnp.einsum("bhqs,bshd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = paged_attention_ragged_reference(
+        q.reshape(B * n_q, nh, hd), kv_pool, block_tables,
+        (n_q,) * B, seq_lens, sm_scale=sm_scale)
+    return out.reshape(B, n_q, nh, hd)
+
+
+def paged_attention_prefill_reference(q, kv_pool, block_tables,
+                                      start_pos, sm_scale=None):
+    """Prefill reference: a chunk at start S IS a multi-query sweep
+    with seq_lens = S + C (its queries sit at lens - n_q + i)."""
+    C = q.shape[1]
+    lens = jnp.asarray(start_pos, jnp.int32) + C
+    return paged_attention_multi_reference(q, kv_pool, block_tables,
+                                           lens, sm_scale=sm_scale)

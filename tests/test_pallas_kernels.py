@@ -21,7 +21,12 @@ from paddle_tpu.ops.pallas.grouped_gemm import (
 from paddle_tpu.ops.pallas.paged_attention import (
     gather_pages, paged_attention, paged_attention_multi,
     paged_attention_multi_reference, paged_attention_prefill,
-    paged_attention_prefill_reference, paged_attention_reference)
+    paged_attention_prefill_reference, paged_attention_ragged,
+    paged_attention_ragged_reference, paged_attention_reference)
+
+# the kernel suite is selectable in CI like spec/faults/monitor:
+#   pytest -m kernels
+pytestmark = pytest.mark.kernels
 
 rng = np.random.default_rng(0)
 
@@ -410,6 +415,193 @@ class TestPagedAttentionPrefill:
         out3 = np.asarray(paged_attention_prefill(q, pool3, bt, start))
         np.testing.assert_array_equal(out, out3)
         assert np.isfinite(out).all()
+
+
+class TestPagedAttentionRagged:
+    """ONE ragged kernel subsumes all three phases: a packed mixed
+    batch — decode rows, speculative-verify blocks and prefill chunks
+    over the shared block table — in a single launch, with per-phase
+    wrappers as thin delegations. Parity contracts:
+
+      * segment independence (element-exact): each sequence's slice of
+        a mixed launch equals the same sequence launched alone at the
+        same tile_q — the property that makes packing a pure
+        dispatch-count optimization;
+      * reference parity (float tolerance): mixed launches match the
+        shared jnp reference, and each phase's rows match that phase's
+        reference kernel;
+      * degenerate batches: all-one-phase mixed launches are exactly
+        the per-phase wrappers; empty segments and empty batches are
+        legal no-ops.
+    """
+
+    def _mixed(self, seed=0, nh=4, nkv=4, hd=16, bs=8, MB=5, NB=14):
+        r = np.random.default_rng(seed)
+        pool = jnp.asarray(r.standard_normal((NB, 2, nkv, bs, hd)),
+                           jnp.float32)
+        # decode, verify (K+1=3), prefill chunk at a non-block-aligned
+        # start, another decode, block-aligned prefill, EMPTY segment
+        q_lens = (1, 3, 7, 1, 10, 0)
+        kv_lens = jnp.asarray([17, 9, 5 + 7, 33, 10, 0], jnp.int32)
+        bt = jnp.asarray(r.integers(1, NB, (len(q_lens), MB)),
+                         jnp.int32)
+        q = jnp.asarray(r.standard_normal((sum(q_lens), nh, hd)),
+                        jnp.float32)
+        return q, pool, bt, q_lens, kv_lens
+
+    def test_mixed_matches_shared_reference(self):
+        q, pool, bt, q_lens, kv_lens = self._mixed()
+        for tq in (None, 4):
+            np.testing.assert_allclose(
+                np.asarray(paged_attention_ragged(
+                    q, pool, bt, q_lens, kv_lens, tile_q=tq)),
+                np.asarray(paged_attention_ragged_reference(
+                    q, pool, bt, q_lens, kv_lens)),
+                atol=1e-5, rtol=1e-5)
+
+    def test_mixed_rows_match_per_phase_references(self):
+        """Each phase's rows of one mixed launch agree with that
+        phase's reference kernel — the three delegating references
+        cannot drift from what the mixed launch computes."""
+        q, pool, bt, q_lens, kv_lens = self._mixed()
+        out = np.asarray(paged_attention_ragged(q, pool, bt, q_lens,
+                                                kv_lens))
+        r0 = 0
+        for s, ql in enumerate(q_lens):
+            if ql == 0:
+                continue
+            rows = out[r0:r0 + ql]
+            if ql == 1:
+                ref = paged_attention_reference(
+                    q[r0:r0 + 1], pool, bt[s:s + 1], kv_lens[s:s + 1])
+            else:
+                ref = paged_attention_multi_reference(
+                    q[r0:r0 + ql][None], pool, bt[s:s + 1],
+                    kv_lens[s:s + 1])[0]
+            np.testing.assert_allclose(rows, np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+            r0 += ql
+
+    @pytest.mark.parametrize("seed", [1, 2])
+    def test_randomized_mixed_property(self, seed):
+        """Property sweep: random mixed compositions (random segment
+        counts/lengths/starts, non-aligned everywhere) hold both
+        contracts — reference parity, and SEGMENT INDEPENDENCE
+        (element-exact: packing sequences into one launch must not
+        move any sequence's output by a single bit vs launching it
+        alone at the same tile_q — what makes packing a pure
+        dispatch-count optimization)."""
+        r = np.random.default_rng(100 + seed)
+        nh, hd, bs, MB, NB = 4, 16, 8, 6, 16
+        pool = jnp.asarray(r.standard_normal((NB, 2, nh, bs, hd)),
+                           jnp.float32)
+        n_seq = int(r.integers(2, 6))
+        q_lens, kv_lens = [], []
+        for _ in range(n_seq):
+            kind = r.integers(0, 3)
+            if kind == 0:          # decode
+                ql = 1
+                kv = int(r.integers(1, MB * bs))
+            elif kind == 1:        # verify
+                ql = int(r.integers(2, 5))
+                kv = int(r.integers(ql, MB * bs))
+            else:                  # prefill chunk
+                ql = int(r.integers(2, 14))
+                kv = int(r.integers(ql, MB * bs))
+            q_lens.append(ql)
+            kv_lens.append(kv)
+        q_lens = tuple(q_lens)
+        kv_arr = jnp.asarray(kv_lens, jnp.int32)
+        bt = jnp.asarray(r.integers(1, NB, (n_seq, MB)), jnp.int32)
+        q = jnp.asarray(r.standard_normal((sum(q_lens), nh, hd)),
+                        jnp.float32)
+        out = np.asarray(paged_attention_ragged(q, pool, bt, q_lens,
+                                                kv_arr, tile_q=4))
+        np.testing.assert_allclose(
+            out,
+            np.asarray(paged_attention_ragged_reference(
+                q, pool, bt, q_lens, kv_arr)),
+            atol=1e-5, rtol=1e-5)
+        r0 = 0
+        for s, ql in enumerate(q_lens):
+            solo = np.asarray(paged_attention_ragged(
+                q[r0:r0 + ql], pool, bt[s:s + 1], (ql,),
+                kv_arr[s:s + 1], tile_q=4))
+            np.testing.assert_array_equal(out[r0:r0 + ql], solo)
+            r0 += ql
+
+    def test_all_one_phase_degenerate_batches(self):
+        """All-decode == the decode wrapper, all-verify == the multi
+        wrapper, all-prefill == the prefill wrapper — element-exact
+        (the wrappers ARE ragged launches at those tilings)."""
+        r = np.random.default_rng(7)
+        nh, hd, bs, MB, NB = 4, 16, 8, 4, 10
+        pool = jnp.asarray(r.standard_normal((NB, 2, nh, bs, hd)),
+                           jnp.float32)
+        bt = jnp.asarray(r.integers(1, NB, (3, MB)), jnp.int32)
+        lens = jnp.asarray([5, 17, 32], jnp.int32)
+        qd = jnp.asarray(r.standard_normal((3, nh, hd)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(paged_attention_ragged(qd, pool, bt, (1, 1, 1),
+                                              lens, tile_q=1)),
+            np.asarray(paged_attention(qd, pool, bt, lens)))
+        qm = jnp.asarray(r.standard_normal((3, 4, nh, hd)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(paged_attention_ragged(
+                qm.reshape(12, nh, hd), pool, bt, (4, 4, 4), lens,
+                tile_q=4)).reshape(3, 4, nh, hd),
+            np.asarray(paged_attention_multi(qm, pool, bt, lens)))
+        qp = jnp.asarray(r.standard_normal((3, 6, nh, hd)), jnp.float32)
+        start = jnp.asarray([0, 9, 20], jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(paged_attention_ragged(
+                qp.reshape(18, nh, hd), pool, bt, (6, 6, 6), start + 6,
+                tile_q=6)).reshape(3, 6, nh, hd),
+            np.asarray(paged_attention_prefill(qp, pool, bt, start)))
+
+    def test_empty_segments_and_empty_batch(self):
+        q, pool, bt, q_lens, kv_lens = self._mixed()
+        # all-empty batch: legal no-op, shape-preserving
+        out = paged_attention_ragged(q[:0], pool, bt[:2], (0, 0),
+                                     kv_lens[:2])
+        assert out.shape == (0,) + q.shape[1:]
+        # a zero-length segment in the middle changes nothing
+        ref = np.asarray(paged_attention_ragged(
+            q, pool, bt, q_lens, kv_lens, tile_q=2))
+        keep = [s for s, ql in enumerate(q_lens) if ql > 0]
+        out2 = np.asarray(paged_attention_ragged(
+            q, pool, bt[jnp.asarray(keep)],
+            tuple(q_lens[s] for s in keep),
+            kv_lens[jnp.asarray(keep)], tile_q=2))
+        np.testing.assert_array_equal(ref, out2)
+
+    def test_tile_kv_is_pure_scheduling(self):
+        """tile_kv groups pages per kv grid step on the pre-gathered
+        layout; any grouping (dividing MB or not) gives the same
+        attention to float tolerance (the online-softmax update order
+        changes, values do not)."""
+        q, pool, bt, q_lens, kv_lens = self._mixed()
+        ref = np.asarray(paged_attention_ragged(
+            q, pool, bt, q_lens, kv_lens, tile_q=4, tile_kv=1))
+        for tkv in (2,):      # non-dividing: pads MB 5 -> 6 with trash
+            np.testing.assert_allclose(
+                np.asarray(paged_attention_ragged(
+                    q, pool, bt, q_lens, kv_lens, tile_q=4,
+                    tile_kv=tkv)),
+                ref, atol=1e-5, rtol=1e-5)
+
+    def test_zero_length_sequence_rows_are_zero(self):
+        """kv_len 0 with a live query row (an inactive slot's masked
+        decode row): zeros out, never NaN."""
+        r = np.random.default_rng(9)
+        nh, hd, bs, MB, NB = 4, 16, 8, 3, 6
+        pool = jnp.asarray(r.standard_normal((NB, 2, nh, bs, hd)),
+                           jnp.float32)
+        bt = jnp.asarray([[0, 0, 0], [3, 0, 0]], jnp.int32)
+        q = jnp.asarray(r.standard_normal((2, nh, hd)), jnp.float32)
+        out = np.asarray(paged_attention_ragged(
+            q, pool, bt, (1, 1), jnp.asarray([0, 7], jnp.int32)))
+        assert np.all(out[0] == 0.0) and np.isfinite(out).all()
 
 
 class TestDecodeAttention:

@@ -417,6 +417,123 @@ def _server(tsm, draft, jp, sp, *, injector=None, snapshot_every=2,
                              snapshot_every=snapshot_every)
 
 
+class TestJournalCompaction:
+    """Satellite: journal compaction at snapshot boundaries — records
+    a durable snapshot covers are dropped (they can never replay:
+    recovery skips seq <= the snapshot's journal_seq), bounding the
+    journal on a long-running server. The compact marker reuses the
+    covered seq so the lineage check, seq numbering and the
+    lag/bytes gauges all stay correct."""
+
+    def test_compact_drops_covered_records_atomically(self, tmp_path):
+        path = str(tmp_path / "req.wal")
+        j = RequestJournal(path, fresh=True)
+        for i in range(6):
+            j.append("submit", {"i": i})
+        before = j.bytes_written
+        assert before == os.path.getsize(path)
+        reclaimed = j.compact(4)
+        assert reclaimed > 0
+        assert j.bytes_written == os.path.getsize(path) < before
+        # marker (seq 4) + survivors 5, 6; seq numbering continues
+        recs = read_journal(path)
+        assert [(s, k) for s, k, _ in recs] == \
+            [(4, "compact"), (5, "submit"), (6, "submit")]
+        assert j.append("release", {"rid": 0}) == 7
+        # idempotent: nothing left at/below 4 but the marker
+        assert j.compact(4) == 0
+        j.close()
+        assert [s for s, _, _ in read_journal(path)] == [4, 5, 6, 7]
+        assert [f for f in os.listdir(tmp_path)
+                if ".compact." in f] == []
+
+    def test_snapshot_compacts_and_gauges_stay_correct(self, tmp_path):
+        tsm = _tsm()
+        jp, sp = _paths(tmp_path)
+        rng = np.random.default_rng(21)
+        srv = _server(tsm, None, jp, sp, snapshot_every=2)
+        reg = srv.engine.registry
+        # fresh server: snapshot 0's compaction is a no-op, the bytes
+        # gauge starts at zero
+        assert reg.as_dict()["journal.bytes"] == 0
+        for p in [list(rng.integers(0, VOCAB, 6)) for _ in range(2)]:
+            srv.submit(p)
+        grown = reg.as_dict()["journal.bytes"]
+        assert grown > 0
+        sizes = []
+        for _ in range(4):
+            srv.step()
+            d = reg.as_dict()
+            assert d["journal.bytes"] == srv.journal.bytes_written \
+                == os.path.getsize(jp)
+            sizes.append(d["journal.bytes"])
+        # the periodic snapshots really compacted: the file shrank at
+        # a snapshot boundary instead of growing monotonically
+        assert any(b < a for a, b in zip(sizes, sizes[1:])), \
+            f"journal never shrank: {sizes}"
+        assert reg.as_dict()["journal.lag_records"] == \
+            srv.journal.seq - srv._snap_seq
+        srv.close()
+
+    def test_recovery_from_compacted_journal(self, tmp_path):
+        """Crash AFTER a compacting snapshot plus a few more rounds:
+        the lineage check accepts the compacted journal (marker seq ==
+        snapshot seq), replay runs only the surviving suffix, and the
+        recovered stream is bit-identical to an uninterrupted run."""
+        tsm = _tsm()
+        jp, sp = _paths(tmp_path)
+        rng = np.random.default_rng(22)
+        prompts = [list(rng.integers(0, VOCAB, 7)) for _ in range(2)]
+
+        def run(inj):
+            srv = _server(_tsm(), None, jp, sp, injector=inj,
+                          snapshot_every=2)
+            rids = [srv.submit(p) for p in prompts]
+            crashes = 0
+            for _ in range(20):
+                if all(len(srv.generated(r)) >= 6 for r in rids):
+                    break
+                try:
+                    srv.step()
+                except EngineCrash:
+                    crashes += 1
+                    srv = RecoverableServer.recover(
+                        tsm, None, journal_path=jp, snapshot_path=sp,
+                        injector=inj)
+                    srv.check_invariants()
+            out = {r: srv.generated(r)[:6] for r in rids}
+            srv.close()
+            return out, crashes
+
+        clean, _ = run(None)
+        # crash at round 5: snapshots (and compactions) fired at
+        # rounds 2 and 4, so the journal at crash time is compacted
+        stormy, crashes = run(CrashInjector(crash_at={5: "begin"}))
+        assert crashes == 1
+        assert stormy == clean
+        # the compaction really happened before the crash: the
+        # journal's first record is a compact marker
+        recs = read_journal(jp)
+        assert recs[0][1] == "compact"
+
+    def test_compact_journal_false_keeps_history(self, tmp_path):
+        tsm = _tsm()
+        jp, sp = _paths(tmp_path)
+        rng = np.random.default_rng(23)
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                block_size=4, num_blocks=60,
+                                max_blocks_per_seq=10)
+        srv = RecoverableServer(eng, journal_path=jp,
+                                snapshot_path=sp, snapshot_every=2,
+                                compact_journal=False)
+        srv.submit(list(rng.integers(0, VOCAB, 6)))
+        for _ in range(5):
+            srv.step()
+        kinds = [k for _, k, _ in read_journal(jp)]
+        assert "compact" not in kinds and kinds.count("round") == 5
+        srv.close()
+
+
 class TestExactlyOnceOutcomes:
     def test_drained_outcome_not_redelivered_after_crash(self, tmp_path):
         """The outcome is drained (journaled) BEFORE the crash: replay

@@ -1,0 +1,189 @@
+"""Offline tile-sizing aid for the ragged paged-attention kernel:
+read the ``span.model`` step-phase timings out of a saved Chrome
+trace (``TraceCollector.save_chrome_trace`` — PR 8/9's step-phase
+timeline) and turn them into the numbers a ``tile_q``/``tile_kv``
+sweep on real hardware starts from — so TPU tile tuning is
+data-driven, not a guess. Sibling of tools/trace_report.py (the
+timeline doctor) and tools/recovery_check.py (the snapshot doctor);
+this is the kernel-tuning doctor.
+
+What it does with the trace:
+
+  * splits completed engine steps into DECODE-ONLY / MIXED (a prefill
+    phase ran — the ragged one-launch steps) / VERIFY (speculative
+    rounds) using the per-step phase spans and the ``queue`` counter
+    track (``prefilling`` > 0 marks a step with chunks in flight);
+  * reports model-phase duration percentiles per class — the cost the
+    tile knobs move — plus the prefill-phase share;
+  * estimates the marginal model cost per prefill token (mixed p50
+    minus decode-only p50, over ``--budget`` tokens) and prints the
+    tile_q sweep candidates bracketing the observed chunk sizes,
+    next to the kernel's default table.
+
+Usage:
+  python tools/tile_report.py TRACE.json [--budget N] [--json]
+
+Exit status: 0 report printed, 1 structurally invalid trace or no
+usable model spans, 2 unreadable file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _pcts(vals):
+    if not vals:
+        return {}
+    v = sorted(vals)
+
+    def p(q):
+        return v[min(len(v) - 1, int(q * len(v)))]
+    return {"count": len(v), "p50_ms": round(p(0.50) / 1e3, 3),
+            "p90_ms": round(p(0.90) / 1e3, 3),
+            "max_ms": round(v[-1] / 1e3, 3)}
+
+
+def analyze(trace: dict, budget=None) -> dict:
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError("top-level 'traceEvents' missing or not a "
+                         "list — not a Chrome trace")
+    # per-step phase spans (args.step keys them) + step-kind spans
+    phases: dict = {}          # step -> {phase: dur_us}
+    kinds: dict = {}           # step -> "step" | "verify" | ...
+    queue_counters = []        # (ts, prefilling, active) in emit order
+    for ev in evs:
+        name, ph = ev.get("name"), ev.get("ph")
+        args = ev.get("args") or {}
+        if ph == "X" and "step" in args:
+            s = int(args["step"])
+            if name in ("admission", "prefill", "model",
+                        "bookkeeping"):
+                phases.setdefault(s, {})
+                phases[s][name] = phases[s].get(name, 0.0) \
+                    + float(ev.get("dur", 0.0))
+            elif not args.get("aborted"):
+                kinds[s] = name
+        elif ph == "C" and name == "queue":
+            queue_counters.append((float(ev.get("ts", 0.0)),
+                                   int(args.get("prefilling", 0)),
+                                   int(args.get("active", 0))))
+    # the k-th queue counter closes the k-th completed step — pair
+    # them over ALL completed steps (admission/prefill-only steps
+    # emit a counter but no model phase; skipping them here would
+    # shift every later step onto its predecessor's gauges)
+    all_steps = sorted(kinds)
+    step_pos = {s: i for i, s in enumerate(all_steps)}
+    counter_of = {s: queue_counters[i]
+                  for i, s in enumerate(all_steps)
+                  if i < len(queue_counters)}
+    steps = [s for s in all_steps if "model" in phases.get(s, {})]
+    if not steps:
+        raise ValueError("no completed steps with a model phase in "
+                         "this trace (was a collector attached?)")
+    by_class = {"decode_only": [], "mixed": [], "verify": []}
+    active_rows = {"decode_only": [], "mixed": [], "verify": []}
+    prefill_share = []
+    for s in steps:
+        dur = phases[s]["model"]
+        pre = phases[s].get("prefill", 0.0)
+        _, prefilling, act = counter_of.get(s, (0.0, 0, 0))
+        # prefill work shows either as a prefill-phase span (per-chunk
+        # launches) or inside the model span (the ragged packed
+        # launch, where the prefill phase is host-side planning only)
+        # — a step that STARTED with prefilling slots did prefill work
+        # even when it finished them, so look at the previous step's
+        # end-of-step gauge too
+        idx = step_pos[s]
+        prev_prefilling = (counter_of.get(all_steps[idx - 1],
+                                          (0.0, 0, 0))[1]
+                           if idx > 0 else prefilling)
+        if kinds[s] == "verify":
+            cls = "verify"
+        elif prefilling > 0 or prev_prefilling > 0 \
+                or pre > 0.05 * max(dur, 1e-9):
+            cls = "mixed"
+            prefill_share.append(pre / max(pre + dur, 1e-9))
+        else:
+            cls = "decode_only"
+        by_class[cls].append(dur)
+        active_rows[cls].append(act)
+    out = {"steps": len(steps)}
+    for cls, vals in by_class.items():
+        if vals:
+            rec = _pcts(vals)
+            rows = active_rows[cls]
+            rec["mean_active_rows"] = round(sum(rows) / len(rows), 2)
+            out[cls] = rec
+    if prefill_share:
+        out["mixed_prefill_phase_share"] = round(
+            sum(prefill_share) / len(prefill_share), 3)
+    # marginal prefill-token cost -> the number a tile_q sweep moves
+    if by_class["mixed"] and by_class["decode_only"] and budget:
+        d = (out["mixed"]["p50_ms"] - out["decode_only"]["p50_ms"])
+        out["est_model_ms_per_prefill_token"] = round(
+            max(d, 0.0) / budget, 5)
+    cands = sorted({8, 16, 32, 64}
+                   | ({min(128, int(budget))} if budget else set()))
+    out["tile_q_sweep_candidates"] = cands
+    out["default_tile_table"] = {
+        "decode": "tile_q=1 (no padding rows)",
+        "verify": "tile_q=K+1 (one tile per sequence)",
+        "prefill/mixed": "tile_q=min(64, max q_len)",
+        "tile_kv": "1 on the scalar-prefetch path (non-contiguous "
+                   "pages: one DMA per page); sweep on the gathered "
+                   "layout only",
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="the run's prefill_token_budget (enables the "
+                         "per-prefill-token cost estimate)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"unreadable trace {args.trace!r}: {e}", file=sys.stderr)
+        return 2
+    try:
+        rep = analyze(trace, budget=args.budget)
+    except ValueError as e:
+        print(f"invalid trace: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rep, indent=1))
+        return 0
+    print(f"tile report over {rep['steps']} completed step(s)")
+    for cls in ("decode_only", "mixed", "verify"):
+        if cls in rep:
+            r = rep[cls]
+            print(f"  {cls:12s} n={r['count']:4d}  "
+                  f"model p50={r['p50_ms']}ms p90={r['p90_ms']}ms "
+                  f"max={r['max_ms']}ms  "
+                  f"active~{r['mean_active_rows']}")
+    if "mixed_prefill_phase_share" in rep:
+        print(f"  mixed steps spend "
+              f"{rep['mixed_prefill_phase_share'] * 100:.1f}% of "
+              f"prefill+model time in the prefill phase")
+    if "est_model_ms_per_prefill_token" in rep:
+        print(f"  est. marginal model cost per prefill token: "
+              f"{rep['est_model_ms_per_prefill_token']}ms")
+    print(f"  tile_q sweep candidates: "
+          f"{rep['tile_q_sweep_candidates']}")
+    print("  default tile table:")
+    for k, v in rep["default_tile_table"].items():
+        print(f"    {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
