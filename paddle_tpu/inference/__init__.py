@@ -36,6 +36,8 @@ from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       SpecDecodeStats, TenantStats)
 from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
                         TraceCollector)
+from .accounting import (CostLedger, WorkModel,  # noqa: F401
+                         WASTE_CAUSES)
 from .monitor import (Alert, HealthMonitor,  # noqa: F401
                       HealthReport, SeriesBuffer, SloPolicy,
                       SloTracker)
@@ -58,7 +60,7 @@ from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "Alert", "ContinuousBatchingEngine",
-           "BlockAllocator",
+           "BlockAllocator", "CostLedger", "WorkModel", "WASTE_CAUSES",
            "BlockOOM", "CrashInjector", "EngineCrash", "FaultInjector",
            "HealthMonitor", "HealthReport", "SeriesBuffer",
            "SloPolicy", "SloTracker",

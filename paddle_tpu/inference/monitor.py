@@ -412,6 +412,14 @@ class HealthMonitor:
         "queue_growth_min": 3,
         # journal records appended since the last snapshot
         "journal_lag_high": 256,
+        # goodput-collapse: the share of the window's TOTAL ledger
+        # work not known-wasted ((work - waste) / work) fell through
+        # the floor (CostLedger-fed; dark without a ledger)
+        "goodput_floor": 0.5,
+        # waste-spike: windowed waste rate > factor x its EWMA
+        # baseline (the shed-spike pattern on wasted token-rows)
+        "waste_spike_factor": 4.0,
+        "waste_ewma_alpha": 0.2,
         # SLO error-budget burn rate that fires, and the minimum
         # window occupancy before burn is judged at all
         "slo_burn_high": 2.0,
@@ -563,6 +571,27 @@ class HealthMonitor:
                 acc = num(cur, "spec.accepted") \
                     - num(prev, "spec.accepted")
                 self._push("spec.acceptance", step, acc / prop)
+            # cost-ledger work signals (present only with a ledger
+            # wired — inference/accounting.py): work and goodput vs
+            # waste per step, and the share of the interval's work
+            # NOT known-wasted. Goodput itself resolves only at
+            # request FINISH (lumpy at request granularity), so the
+            # collapse fraction is judged against TOTAL work done —
+            # a long generation with no completions in the window
+            # must not read as a collapse
+            if "work.total_tokens" in cur:
+                tot = num(cur, "work.total_tokens") \
+                    - num(prev, "work.total_tokens")
+                good = num(cur, "work.goodput_tokens") \
+                    - num(prev, "work.goodput_tokens")
+                waste = num(cur, "work.waste_tokens") \
+                    - num(prev, "work.waste_tokens")
+                self._push("work_per_step", step, tot / dstep)
+                self._push("goodput_per_step", step, good / dstep)
+                self._push("waste_rate", step, waste / dstep)
+                if tot > 0:
+                    self._push("goodput_fraction", step,
+                               max(0.0, (tot - waste) / tot))
 
         # per-phase step-span durations (collector-side wall clock —
         # observational, feeds kernel tile sizing, never a detector)
@@ -648,6 +677,45 @@ class HealthMonitor:
                 and v[-1] - v[0] >= th["queue_growth_min"]
             self._fire("queue-growth", firing, step, "queue.depth",
                        sb.last(), th["queue_growth_min"])
+        # 4b. goodput-collapse (CostLedger-fed: the share of the
+        #     window's TOTAL work not known-wasted fell through the
+        #     floor — judged against work done, not work resolved,
+        #     because goodput lands in one lump when a request
+        #     finishes: a long generation mid-flight has zero
+        #     resolved goodput and must not read as a collapse)
+        sbt = self._series.get("work_per_step")
+        sbw = self._series.get("waste_rate")
+        if sbt is not None and sbw is not None:
+            t_sum = sbt.sum(self.window)
+            w = sbw.sum(self.window)
+            frac = max(0.0, (t_sum - w) / t_sum) if t_sum > 0 else None
+            self._fire("goodput-collapse",
+                       frac is not None and frac < th["goodput_floor"],
+                       step, "goodput_fraction",
+                       frac if frac is not None else 1.0,
+                       th["goodput_floor"])
+            # 4c. waste-spike (EWMA baseline, the shed-spike pattern —
+            #     except the FIRST NONZERO waste sample only SEEDS the
+            #     baseline: speculative rejection makes routine waste,
+            #     so "any waste at all" must not read as a spike the
+            #     way a first shed legitimately does. Zero-waste
+            #     intervals before that leave the baseline UNSEEDED —
+            #     a 0.0-seeded EWMA would turn the first routine
+            #     rejection into a division-free infinite spike.)
+            v = sbw.last()
+            base = self._ewma.get("waste_rate")
+            b = 0.0 if base is None else base
+            if ("waste-spike", None) in self._active:
+                firing = v > b
+            else:
+                firing = base is not None and v > 0 and \
+                    v > th["waste_spike_factor"] * b
+            self._fire("waste-spike", firing, step, "waste_rate", v,
+                       th["waste_spike_factor"] * b)
+            if v > 0 or base is not None:
+                a = th["waste_ewma_alpha"]
+                self._ewma["waste_rate"] = v if base is None \
+                    else a * v + (1 - a) * base
         # 5. journal-lag (clears below half the bound)
         sb = self._series.get("journal.lag")
         if sb is not None:
@@ -698,6 +766,14 @@ class HealthMonitor:
         elif name == "queue.depth":
             if ("queue-growth", None) in self._active:
                 return "warn"
+        elif name == "goodput_fraction":
+            if ("goodput-collapse", None) in self._active:
+                return "critical"
+        elif name == "waste_rate":
+            # routine speculative rejection IS waste — only a spike
+            # over the run's own baseline degrades the verdict
+            if ("waste-spike", None) in self._active:
+                return "critical"
         elif name == "journal.lag":
             if ("journal-lag", None) in self._active:
                 return "critical"

@@ -825,6 +825,14 @@ class PagedKVCache:
         return sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
                    for p in self.pools)
 
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one token's K/V occupies across every layer
+        (2 x heads x head_dim x itemsize x layers) — the KV-traffic
+        unit of the analytic work model (inference/accounting.py)."""
+        return int(2 * self.num_heads * self.head_dim
+                   * self.pools[0].data.dtype.itemsize
+                   * self.num_layers)
+
     # -- tenant accounting --------------------------------------------
     def _charge(self, slot: int, delta: int) -> None:
         """Move ``slot``'s tenant's block charge by ``delta`` table

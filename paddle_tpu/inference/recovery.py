@@ -547,7 +547,7 @@ class RecoverableServer:
     @classmethod
     def recover(cls, target, draft=None, *, journal_path: str,
                 snapshot_path: str, injector=None, collector=None,
-                monitor=None, sync: bool = False,
+                monitor=None, ledger=None, sync: bool = False,
                 compact_journal: bool = True,
                 num_blocks: Optional[int] = None) -> "RecoverableServer":
         """Rebuild a server after a crash: restore the last snapshot,
@@ -589,12 +589,13 @@ class RecoverableServer:
                 target, draft, _resize_engine_snap(eng_snap,
                                                    num_blocks),
                 injector=injector, collector=collector,
-                monitor=monitor)
+                monitor=monitor, ledger=ledger)
         else:
             eng = SpeculativeEngine.restore(target, draft, eng_snap,
                                             injector=injector,
                                             collector=collector,
-                                            monitor=monitor)
+                                            monitor=monitor,
+                                            ledger=ledger)
         srv = cls(eng, journal_path=journal_path,
                   snapshot_path=snapshot_path, sync=sync,
                   compact_journal=compact_journal,
@@ -633,6 +634,11 @@ class RecoverableServer:
             collector.set_replay(True)
         if monitor is not None:
             monitor.set_replay(True)
+        if ledger is not None:
+            # same bracket as the collector/monitor: records the dead
+            # incarnation observed live freeze; replay-born records
+            # (and replayed steps a fresh ledger never saw) accumulate
+            ledger.set_replay(True)
         try:
             for seq, kind, payload in records:
                 if kind == "outcomes":
@@ -698,6 +704,8 @@ class RecoverableServer:
                 collector.set_replay(False)
             if monitor is not None:
                 monitor.set_replay(False)
+            if ledger is not None:
+                ledger.set_replay(False)
         # outcomes regenerated by the replay that were already drained
         # pre-crash: drop them here, exactly-once stands
         eng.outcomes[:] = [oc for oc in eng.outcomes

@@ -358,7 +358,7 @@ class PagedServingEngine:
                  injector=None, max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
                  tenants: Optional[Dict[str, dict]] = None,
-                 collector=None, monitor=None,
+                 collector=None, monitor=None, ledger=None,
                  ragged_step: bool = True,
                  tile_q: Optional[int] = None,
                  tile_kv: Optional[int] = None):
@@ -423,6 +423,16 @@ class PagedServingEngine:
         # wall-clock timestamps stay out of engine-behavioral state;
         # a restored engine gets the caller's collector wired fresh.
         self.collector = collector
+        # ledger (inference/accounting.py): the opt-in CostLedger —
+        # classifies every token-row of model work as goodput, waste
+        # (per-cause: speculative rejection, re-prefill replay, failed
+        # requests) or pending, prices it through the analytic
+        # WorkModel, and integrates per-tenant block-step billing.
+        # Same contracts as the collector: None (default) keeps every
+        # hook site dark, the ledger is PASSIVE (counters only, never
+        # consulted for control flow, never reads a clock) and never
+        # part of snapshot() — ledger state is derived.
+        self.ledger = ledger
         # monitor (inference/monitor.py): the opt-in HealthMonitor —
         # windowed time-series over the registry, per-tenant SLO
         # tracking, deterministic threshold alerting. Sampled at the
@@ -502,6 +512,11 @@ class PagedServingEngine:
         self.admitted: List[Tuple[int, int, Tensor]] = []
         self.finished: List[Tuple[int, int, int]] = []
         self.preempted: List[int] = []
+        # the ledger binds before the monitor so the monitor's
+        # baseline registry snapshot already carries the work.* keys
+        if ledger is not None:
+            ledger.bind(self.registry, model=model,
+                        kv_token_bytes=self.cache.kv_bytes_per_token())
         # wire the monitor LAST (its baseline snapshot reads the live
         # registry sources, which need the engine fully built); the
         # rebase pins the interval-delta baseline at the current step
@@ -618,7 +633,9 @@ class PagedServingEngine:
             req = self._requests[int(s)]
             if req is not None:
                 active[req.tenant] = active.get(req.tenant, 0) + 1
-        return {tid: {
+        cost = (self.ledger.tenant_cost()
+                if self.ledger is not None else None)
+        return {tid: dict({
             "quota_blocks": t.quota_blocks,
             "reserved_blocks": t.reserved_blocks,
             "weight": t.weight,
@@ -627,7 +644,8 @@ class PagedServingEngine:
             "active": active.get(tid, 0),
             "queued": t.queued,
             "stats": t.stats.as_dict(),
-        } for tid, t in self.tenants.items()}
+        }, **({"cost": cost[tid]} if cost and tid in cost else {}))
+            for tid, t in self.tenants.items()}
 
     # -- admission ----------------------------------------------------
     def submit(self, prompt, *, max_preemptions: Optional[int] = None,
@@ -690,6 +708,8 @@ class PagedServingEngine:
             req.deadline_time = time.monotonic() + float(deadline_s)
         if self.collector is not None:
             self.collector.on_submit(req.rid, ten.tid, arr.shape[0])
+        if self.ledger is not None:
+            self.ledger.on_submit(req.rid, ten.tid, arr.shape[0])
         reject = self._admission_health(req, ten)
         if reject:
             self._record(req, RequestOutcome.REJECTED_ADMISSION,
@@ -883,6 +903,10 @@ class PagedServingEngine:
             self.prefix_stats.hit_blocks += n_cached
         P = max(0, min(n_cached * bs, T - MIN_PREFILL_SUFFIX_ROWS)) \
             if n_cached else 0
+        if self.ledger is not None and P:
+            # rows [0, P) adopted, never computed: prefix-cache
+            # savings (warm-resume savings on a re-prefill)
+            self.ledger.on_prefill_skip(req.rid, P)
         self._prefills[slot] = {"pos": P, "start": P,
                                 "n_cached": n_cached, "hashes": hashes}
         self.prefilling[slot] = True
@@ -944,18 +968,27 @@ class PagedServingEngine:
 
     def _chunk_hook(self, slot: int, st: dict, req: PagedRequest):
         """``on_chunk`` for engine prefills: the prefix registrar
-        (above) composed with the telemetry chunk event — one
-        callback, built only when either consumer exists."""
+        (above) composed with the telemetry chunk event and the cost
+        ledger's chunk accounting — one callback, built only when a
+        consumer exists. The ledger sees every computed chunk as a
+        [prev, pos) row span (the replay-vs-fresh split happens
+        inside the ledger off its per-request high-water mark)."""
         reg = self._chunk_registrar(slot, st)
         col = self.collector
-        if col is None:
+        led = self.ledger
+        if col is None and led is None:
             return reg
         rid = req.rid
+        prev = [st["pos"]]
 
         def hook(pos: int) -> None:
             if reg is not None:
                 reg(pos)
-            col.on_prefill_chunk(rid, pos)
+            if led is not None:
+                led.on_prefill(rid, prev[0], pos)
+                prev[0] = pos
+            if col is not None:
+                col.on_prefill_chunk(rid, pos)
         return hook
 
     def _prefill(self, req: PagedRequest) -> None:
@@ -1208,6 +1241,10 @@ class PagedServingEngine:
             st.rejected += 1
             ts.rejections += 1
         col = self.collector
+        if self.ledger is not None:
+            # the terminal verdict resolves the request's pending work
+            # (goodput on FINISHED, retroactive waste on failure)
+            self.ledger.on_outcome(req.rid, status)
         if col is not None:
             col.on_outcome(req.rid, status, self._step_count,
                            reason=reason)
@@ -1536,6 +1573,12 @@ class PagedServingEngine:
             col.on_decode([self._requests[int(s)].rid
                            for s in np.flatnonzero(stepping)
                            if self._requests[int(s)] is not None], 1)
+        if self.ledger is not None:
+            # the consumed row's absolute position (pre-increment len)
+            self.ledger.on_decode(
+                [(self._requests[int(s)].rid, int(self.lens[s]) - 1)
+                 for s in np.flatnonzero(stepping)
+                 if self._requests[int(s)] is not None], 1)
         self.prefill_stats.decode_steps += 1
         if ran_prefill:
             self.prefill_stats.mixed_steps += 1
@@ -1636,6 +1679,12 @@ class PagedServingEngine:
             col.on_decode([self._requests[int(s)].rid
                            for s in np.flatnonzero(self.active)
                            if self._requests[int(s)] is not None], L)
+        if self.ledger is not None:
+            # L verified rows per slot at positions [len-L, len)
+            self.ledger.on_decode(
+                [(self._requests[int(s)].rid, int(self.lens[s]) - L)
+                 for s in np.flatnonzero(self.active)
+                 if self._requests[int(s)] is not None], L)
         self.prefill_stats.decode_steps += 1
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
@@ -1666,10 +1715,14 @@ class PagedServingEngine:
         self._requests[slot].truncate_history(new_len,
                                               self.cache.block_size)
         self.cache.truncate(slot, new_len)
+        old_len = new_len + rejected
         self.lens[slot] = new_len
         if self.collector is not None and rejected > 0:
             self.collector.on_rollback(self._requests[slot].rid,
                                        rejected)
+        if self.ledger is not None and rejected > 0:
+            self.ledger.on_rollback(self._requests[slot].rid,
+                                    new_len, old_len)
 
     # -- resilience ---------------------------------------------------
     def _crash(self, phase: str) -> None:
@@ -1721,6 +1774,16 @@ class PagedServingEngine:
         the engine is abandoned, so sampling it would diverge the
         series from an uninterrupted run's)."""
         col = self.collector
+        charges = None
+        if not aborted and (col is not None or
+                            self.ledger is not None):
+            # ONE per-tenant charge walk shared by the collector's
+            # gauge track and the ledger's block-step bill. Unlike
+            # the occupancy blocks-per-tenant histogram (which drops
+            # zeros), this reports every REGISTERED tenant — a
+            # charge falling to 0 must emit a 0, not vanish
+            charges = {tid: self.cache.tenant_charge(tid)
+                       for tid in self.tenants}
         if col is not None:
             if aborted:
                 # close the torn step's span flagged; no gauges — the
@@ -1736,14 +1799,23 @@ class PagedServingEngine:
                              "cached_free": occ["cached_free"],
                              "free": occ["free"]},
                     "queue": self._queue_gauges(),
-                    # unlike the occupancy blocks-per-tenant histogram
-                    # (which drops zeros), the gauge reports every
-                    # REGISTERED tenant — a charge falling to 0 must
-                    # emit a 0, not vanish
-                    "tenant_blocks": {
-                        tid: self.cache.tenant_charge(tid)
-                        for tid in self.tenants},
+                    "tenant_blocks": charges,
                 })
+        if self.ledger is not None:
+            if aborted:
+                # a torn step is not a billing boundary: drop its
+                # partial work-log sample (event tallies stand)
+                self.ledger.on_step_abort()
+            else:
+                # block-step billing integrates the per-tenant charge
+                # at every completed step boundary; the collector's
+                # registry rides along so the ledger can pair the
+                # step's analytic work with its measured model-span
+                # duration (MFU/MBU)
+                self.ledger.on_step(
+                    self._step_count, charges,
+                    span_src=(col.registry if col is not None
+                              else None))
         if self.monitor is not None and not aborted:
             self.monitor.on_step(self._step_count)
 
@@ -2041,7 +2113,7 @@ class PagedServingEngine:
 
     @classmethod
     def restore(cls, model, snap: dict, *, injector=None,
-                collector=None, monitor=None,
+                collector=None, monitor=None, ledger=None,
                 num_blocks: Optional[int] = None) -> "PagedServingEngine":
         """Rebuild an engine from a ``snapshot`` around the caller's
         model (weights are the caller's problem — a snapshot holds
@@ -2071,7 +2143,7 @@ class PagedServingEngine:
                   chunk_tokens=cfg["chunk_tokens"],
                   prefill_token_budget=cfg["prefill_token_budget"],
                   injector=injector, collector=collector,
-                  monitor=monitor,
+                  monitor=monitor, ledger=ledger,
                   max_preemptions=cfg["max_preemptions"],
                   numeric_guard=cfg["numeric_guard"],
                   # pre-ragged snapshots restore onto the (equivalent)

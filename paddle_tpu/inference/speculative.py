@@ -251,7 +251,7 @@ class SpeculativeEngine:
                  max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
                  tenants: Optional[Dict[str, dict]] = None,
-                 collector=None, monitor=None):
+                 collector=None, monitor=None, ledger=None):
         if k < 0:
             raise ValueError("k must be >= 0")
         self.target = target
@@ -270,7 +270,7 @@ class SpeculativeEngine:
             prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
             injector=injector, max_preemptions=max_preemptions,
             numeric_guard=numeric_guard, tenants=tenants,
-            collector=collector, monitor=monitor)
+            collector=collector, monitor=monitor, ledger=ledger)
         self.max_batch = self.engine.max_batch
         self.stats = SpecDecodeStats()
         # the speculative layer's stats export through the SAME
@@ -302,6 +302,10 @@ class SpeculativeEngine:
             if injector is not None:
                 self.draft_cache.allocator.fault_hook = \
                     lambda n: injector.on_alloc("draft", n)
+            if ledger is not None:
+                # the draft pool's rows are priced by the DRAFT
+                # model's own (smaller) work model
+                ledger.bind_draft(self.draft.core)
         else:
             self.draft_cache = None
 
@@ -459,6 +463,13 @@ class SpeculativeEngine:
         return self.engine.collector
 
     @property
+    def ledger(self):
+        """The wrapped engine's CostLedger (None when accounting is
+        off) — the speculative layer reports its draft-pool work
+        there."""
+        return self.engine.ledger
+
+    @property
     def registry(self):
         """The unified MetricsRegistry (wrapped engine's, with this
         layer's SpecDecodeStats attached under ``spec``)."""
@@ -515,6 +526,12 @@ class SpeculativeEngine:
                         self.draft.embed(consumed),
                         chunk_tokens=self.engine.chunk_tokens)
         self._draft_lens[slot] = len(consumed)
+        led = self.engine.ledger
+        if led is not None:
+            # a first build is fresh draft work; a rebuild (preempt /
+            # dirty-slot recovery) recomputes rows below the draft
+            # high-water mark — the ledger splits replay vs fresh
+            led.on_draft_prefill(seq.rid, 0, len(consumed))
 
     # -- the speculative round ----------------------------------------
     def step(self) -> Dict[int, List[int]]:
@@ -548,6 +565,7 @@ class SpeculativeEngine:
     def _step_impl(self, col) -> Dict[int, List[int]]:
         import paddle_tpu as paddle
         eng = self.engine
+        led = eng.ledger
         if self.injector is not None:
             # draft-phase faults share the verify step's clock: label
             # the round with the upcoming step_multi index
@@ -639,6 +657,11 @@ class SpeculativeEngine:
                     for s in slots:
                         self._draft_lens[s] += 1
                     self.stats.draft_steps += len(slots)
+                    if led is not None:
+                        led.on_draft_rows(
+                            [(self._seqs[s].rid,
+                              int(self._draft_lens[s]) - 1)
+                             for s in slots])
                     if j < k_eff:
                         lg = self.draft.logits(out[:, -1])
                         if self.injector is not None:
@@ -654,6 +677,12 @@ class SpeculativeEngine:
                 # draft pages fall off the table tails, target state
                 # untouched; this round verifies the pending token only
                 for s in slots:
+                    if led is not None and \
+                            int(self._draft_lens[s]) > pre_draft[s]:
+                        led.on_draft_truncate(
+                            self._seqs[s].rid, pre_draft[s],
+                            int(self._draft_lens[s]),
+                            cause="draft_oom")
                     self.draft_cache.truncate(s, pre_draft[s])
                     self._draft_lens[s] = pre_draft[s]
                 drafts = {s: [] for s in slots}
@@ -683,8 +712,19 @@ class SpeculativeEngine:
                     for s in live:
                         self._draft_lens[s] += 1
                     self.stats.draft_steps += len(live)
+                    if led is not None:
+                        led.on_draft_rows(
+                            [(self._seqs[s].rid,
+                              int(self._draft_lens[s]) - 1)
+                             for s in live])
                 except BlockOOM:
                     for s in live:
+                        if led is not None and \
+                                int(self._draft_lens[s]) > pre_draft[s]:
+                            led.on_draft_truncate(
+                                self._seqs[s].rid, pre_draft[s],
+                                int(self._draft_lens[s]),
+                                cause="draft_oom")
                         self.draft_cache.truncate(s, pre_draft[s])
                         self._draft_lens[s] = pre_draft[s]
                     roll_oom = True
@@ -747,6 +787,11 @@ class SpeculativeEngine:
                 # this slot's draft advanced in lockstep: align it to
                 # the accepted length (dirty / OOM-rolled-back slots
                 # are behind and rebuild below instead)
+                if led is not None and \
+                        int(self._draft_lens[s]) > new_len:
+                    led.on_draft_truncate(
+                        seq.rid, new_len, int(self._draft_lens[s]),
+                        cause="spec_rejected")
                 self.draft_cache.truncate(s, new_len)
                 self._draft_lens[s] = new_len
             seq.toks.extend(emitted)
@@ -841,7 +886,7 @@ class SpeculativeEngine:
     def restore(cls, target: TokenServingModel,
                 draft: Optional[TokenServingModel], snap: dict, *,
                 injector=None, collector=None,
-                monitor=None) -> "SpeculativeEngine":
+                monitor=None, ledger=None) -> "SpeculativeEngine":
         """Rebuild a speculative engine from ``snapshot`` around the
         caller's models. The target engine restores exactly
         (PagedServingEngine.restore); the draft pool is REBUILT from
@@ -885,10 +930,11 @@ class SpeculativeEngine:
                    chunk_tokens=ecfg["chunk_tokens"],
                    injector=injector, collector=collector,
                    max_preemptions=ecfg["max_preemptions"],
-                   numeric_guard=ecfg["numeric_guard"])
+                   numeric_guard=ecfg["numeric_guard"],
+                   ledger=ledger)
         spec.engine = PagedServingEngine.restore(
             target.core, snap["engine"], injector=injector,
-            collector=collector, monitor=monitor)
+            collector=collector, monitor=monitor, ledger=ledger)
         spec.engine.registry.attach("spec", spec.stats)
         for rec in snap["seqs"]:
             seq = _SpecSeq(rec["rid"], rec["toks"])

@@ -215,6 +215,13 @@ class MetricsRegistry:
         interval, pass to ``percentiles_since`` after it."""
         return {name: self.hist_total(name) for name in self._hists}
 
+    def last_value(self, name: str) -> Optional[float]:
+        """Most recent observation on ``name`` (None when empty) —
+        how the cost ledger pairs a step's analytic work with the
+        step's just-closed ``span.model`` duration."""
+        lst = self._hists.get(name)
+        return lst[-1] if lst else None
+
     def values_since(self, name: str, start: int) -> List[float]:
         """Observations on ``name`` from absolute index ``start``
         (a previous ``hist_total``). Observations already trimmed by

@@ -28,6 +28,42 @@ def _seed_everything():
     yield
 
 
+# --- counting clock: the zero-overhead-when-off test pattern ---------
+# One time-module stand-in shared by the telemetry / monitor / cost
+# suites (it used to be copy-pasted per file): patch it over the
+# modules whose hot paths must not read a clock, serve, assert
+# ``fake.calls == 0``.
+
+class CountingTime:
+    """time-module stand-in that counts every clock read."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def perf_counter(self):
+        self.calls += 1
+        import time
+        return time.perf_counter()
+
+    def monotonic(self):
+        self.calls += 1
+        import time
+        return time.monotonic()
+
+
+@pytest.fixture
+def counting_clock(monkeypatch):
+    """CountingTime patched over the serving modules that own hot-path
+    clock reads (scheduler + telemetry — monitor/accounting never
+    import ``time`` at all, which their tests assert separately)."""
+    from paddle_tpu.inference import scheduler as sched_mod
+    from paddle_tpu.inference import telemetry as tele_mod
+    fake = CountingTime()
+    monkeypatch.setattr(sched_mod, "time", fake)
+    monkeypatch.setattr(tele_mod, "time", fake)
+    return fake
+
+
 # --- pool invariant auditing (inference/resilience.py) ---------------
 # `pytest --audit-invariants` wraps every paged-engine step so
 # PagedKVCache/engine bookkeeping is audited after EACH step across

@@ -1,0 +1,1053 @@
+"""Serving cost accounting (inference/accounting.py + the ledger
+wiring in scheduler.py / speculative.py / recovery.py and the
+goodput-collapse / waste-spike detectors in monitor.py).
+
+The acceptance bars:
+
+* CONSERVATION — goodput + per-cause waste + pending sums EXACTLY to
+  total accounted work (rows AND FLOPs) on seeded workloads mixing
+  speculation, preemption, prefix hits, sheds and crash-recovery.
+* ZERO OVERHEAD OFF — with ``ledger=None`` the engines perform zero
+  clock reads (counting-clock); the ledger itself never reads a clock
+  even when on (the module does not import ``time``).
+* PASSIVE — token streams and terminal outcomes are BIT-IDENTICAL
+  with the ledger on vs off across plain / prefix-cached /
+  speculative / recoverable serving, including the PR 5 fault storm;
+  engine snapshots carry no ledger state.
+* DETERMINISTIC — two runs of the seeded overload produce the
+  IDENTICAL waste breakdown and the identical ordered alert sequence
+  (goodput-collapse / waste-spike included).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (CostLedger, CrashInjector,
+                                  EngineCrash, FaultInjector,
+                                  HealthMonitor, MetricsRegistry,
+                                  PagedServingEngine,
+                                  RecoverableServer, SpeculativeEngine,
+                                  TokenServingModel, TraceCollector,
+                                  WorkModel, WASTE_CAUSES)
+from paddle_tpu.inference import accounting as acc_mod
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.cost
+
+D, HEADS, FFN, LAYERS = 32, 4, 64, 2
+VOCAB = 50
+
+_RNG = np.random.RandomState(4321)
+_EMBED = _RNG.randn(VOCAB, D).astype(np.float32)
+
+
+def _model(layers=LAYERS):
+    paddle.seed(0)
+    return FusedMultiTransformer(D, HEADS, FFN, num_layers=layers)
+
+
+_TSM = None
+_DRAFT1 = None
+
+
+def _tsm():
+    """One shared TokenServingModel for the whole suite: it is
+    stateless (engines own all serving state; paddle.seed(0) makes
+    every rebuild identical anyway), and model construction is the
+    dominant per-test fixed cost at these dims."""
+    global _TSM
+    if _TSM is None:
+        _TSM = TokenServingModel(_model(), _EMBED)
+    return _TSM
+
+
+def _draft1(tsm):
+    """The shared 1-layer truncated draft of the shared target."""
+    global _DRAFT1
+    if _DRAFT1 is None:
+        assert tsm is _tsm()
+        _DRAFT1 = tsm.truncated_draft(1)
+    return _DRAFT1
+
+
+def _reject_injector(steps=(3, 5, 7, 9)):
+    """Corrupt the draft logits at the given verify steps (the PR 5
+    rollback-storm path): proposals turn to noise, the target rejects
+    them, and the spec_rejected machinery gets real traffic. At these
+    toy dims the residual stream dominates the argmax, so an honest
+    truncated draft agrees ~always — corruption is the deterministic
+    way to force disagreement."""
+    return FaultInjector(draft_nan_at={s: [0, 1] for s in steps})
+
+
+def _prompts(seed, n=4, lo=6, hi=10):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, VOCAB, int(L)))
+            for L in rng.integers(lo, hi, n)]
+
+
+def _drive(tsm, prompts, n_gen, *, ledger=None, monitor=None,
+           collector=None, injector=None, draft=None, max_iters=400,
+           submit_kw=None, **eng_kw):
+    """Token-ID serving loop over SpeculativeEngine (k=0 == plain
+    paged decode). Returns (streams, (rid, status) outcomes, eng)."""
+    kw = dict(k=0, max_batch=2, block_size=4, num_blocks=60,
+              max_blocks_per_seq=10)
+    kw.update(eng_kw)
+    eng = SpeculativeEngine(tsm, draft, ledger=ledger, monitor=monitor,
+                            collector=collector, injector=injector,
+                            **kw)
+    rids = [eng.submit(p, **(submit_kw or {})) for p in prompts]
+    done, failed, outcomes = {}, set(), []
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        eng.step()
+        for oc in eng.outcomes:
+            outcomes.append((oc.rid, oc.status))
+            if oc.failed:
+                failed.add(oc.rid)
+        eng.outcomes.clear()
+        for r in live:
+            if r in failed:
+                continue
+            if len(eng.generated(r)) >= n_gen:
+                done[r] = tuple(eng.generated(r)[:n_gen])
+                eng.release(r)
+    else:
+        raise AssertionError("accounting driver did not converge")
+    for oc in eng.outcomes:
+        outcomes.append((oc.rid, oc.status))
+    eng.outcomes.clear()
+    return done, outcomes, eng
+
+
+def _assert_conserved(led, pending=None):
+    cons = led.conservation()
+    assert cons["ok"], cons
+    if pending is not None:
+        assert cons["rows"]["pending"] == pending, cons
+
+
+# ---------------------------------------------------------------------
+# the analytic work model
+# ---------------------------------------------------------------------
+
+class TestWorkModel:
+    def test_span_flops_matches_row_sum(self):
+        wm = WorkModel(3, 64, 256)
+        for a, b in ((0, 1), (0, 7), (5, 12), (3, 3), (9, 8)):
+            assert wm.span_flops(a, b) == \
+                sum(wm.row_flops(p) for p in range(a, b))
+
+    def test_row_flops_formula(self):
+        wm = WorkModel(2, 32, 64)
+        # L*(8d^2 + 4df) linear + L*4d*(p+1) attention
+        lin = 2 * (8 * 32 * 32 + 4 * 32 * 64)
+        assert wm.row_flops(0) == lin + 2 * 4 * 32 * 1
+        assert wm.row_flops(9) == lin + 2 * 4 * 32 * 10
+
+    def test_kv_bytes_and_weights(self):
+        wm = WorkModel(2, 32, 64)
+        # kv: 2 * d * itemsize * L per token
+        assert wm.kv_token_bytes == 2 * 32 * 4 * 2
+        # span [0, 2): reads 1 + 2 keys, writes 2 tokens
+        assert wm.span_kv_bytes(0, 2) == wm.kv_token_bytes * (3 + 2)
+        assert wm.span_kv_bytes(4, 4) == 0
+        assert wm.weight_bytes > 0
+
+    def test_for_model_reads_the_dims(self):
+        wm = WorkModel.for_model(_tsm())   # unwraps .core
+        assert (wm.num_layers, wm.d_model, wm.ffn_dim) == \
+            (LAYERS, D, FFN)
+
+    def test_cache_kv_bytes_helper_agrees(self):
+        from paddle_tpu.inference import PagedKVCache
+        cache = PagedKVCache.for_model(_model(), 4, 10, max_seqs=2)
+        assert cache.kv_bytes_per_token() == \
+            WorkModel.for_model(_tsm()).kv_token_bytes
+
+    def test_module_never_imports_time(self):
+        """The ledger is clockless by construction — durations only
+        ever arrive as collector-measured spans."""
+        assert not hasattr(acc_mod, "time")
+        assert "import time" not in open(acc_mod.__file__).read()
+
+
+# ---------------------------------------------------------------------
+# conservation: the load-bearing identity, across every serving mode
+# ---------------------------------------------------------------------
+
+class TestConservation:
+    N_GEN = 6
+
+    def test_plain_all_goodput(self):
+        led = CostLedger()
+        done, _, eng = _drive(_tsm(), _prompts(11, n=3), self.N_GEN,
+                              ledger=led)
+        _assert_conserved(led, pending=0)
+        bd = led.waste_breakdown()
+        assert bd["goodput"] == bd["total"] > 0
+        assert all(v == 0 for v in bd["waste"].values())
+        # every prompt row + every decode row is accounted (token 1
+        # samples off the prefill hidden, so N_GEN tokens consume
+        # exactly N_GEN - 1 decode rows per request)
+        prompt_rows = sum(len(p) for p in _prompts(11, n=3))
+        assert bd["total"] == prompt_rows + 3 * (self.N_GEN - 1)
+
+    def test_speculative_rejection_is_spec_waste(self):
+        """A truncated 1-layer draft disagrees with the 2-layer
+        target: rejected rows (target verify tail + draft tail) land
+        in spec_rejected, exactly."""
+        tsm = _tsm()
+        led = CostLedger()
+        done, _, eng = _drive(tsm, _prompts(12, n=3), self.N_GEN,
+                              ledger=led, draft=_draft1(tsm),
+                              k=3, injector=_reject_injector())
+        _assert_conserved(led, pending=0)
+        bd = led.waste_breakdown()
+        st = eng.stats
+        assert st.rolled_back > 0, "draft never disagreed — bad test"
+        # target rolled-back rows + draft rejected rows, nothing else
+        assert bd["waste"]["spec_rejected"] > 0
+        assert bd["waste"]["replay"] == 0
+        assert led.draft_rows > 0 and led.target_rows > 0
+
+    def test_preemption_replay_is_replay_waste(self):
+        """A pool sized below two full sequences forces preempt ->
+        re-prefill: the recomputed rows are replay waste."""
+        led = CostLedger()
+        done, _, eng = _drive(_tsm(), _prompts(13, n=3, lo=8, hi=9),
+                              self.N_GEN, ledger=led,
+                              num_blocks=8, max_blocks_per_seq=5)
+        _assert_conserved(led, pending=0)
+        bd = led.waste_breakdown()
+        assert eng.engine.resilience_stats.retried > 0, \
+            "no preemption happened — bad pool sizing"
+        assert bd["waste"]["replay"] > 0
+        assert bd["goodput"] > 0
+
+    def test_warm_resume_reduces_replay_waste(self):
+        """prefix_cache=True: a preempted request re-adopts its own
+        registered prompt pages — the skipped rows are reported as
+        replay savings and never re-enter the ledger."""
+        runs = {}
+        for tag, prefix in (("cold", False), ("warm", True)):
+            led = CostLedger()
+            done, _, eng = _drive(_tsm(), _prompts(13, n=3, lo=8,
+                                                   hi=9),
+                                  self.N_GEN, ledger=led,
+                                  num_blocks=8, max_blocks_per_seq=5,
+                                  prefix_cache=prefix)
+            _assert_conserved(led, pending=0)
+            assert eng.engine.resilience_stats.retried > 0
+            runs[tag] = led
+        assert runs["warm"].replay_saved_tokens > 0
+        assert runs["cold"].replay_saved_tokens == 0
+        # the saved rows are exactly the replay waste the warm run
+        # does not pay (both runs preempt identically: the schedule
+        # does not depend on the prefix cache)
+        assert runs["warm"].totals.waste_rows["replay"] \
+            < runs["cold"].totals.waste_rows["replay"]
+
+    def test_shed_and_deadline_are_retroactive_waste(self):
+        """A shed (FAILED_OOM with zero retry budget) and a blown
+        deadline move the ENTIRE pending work of the victim into
+        their causes."""
+        led = CostLedger()
+        prompts = _prompts(14, n=4, lo=8, hi=9)
+        done, outcomes, eng = _drive(
+            _tsm(), prompts, self.N_GEN, ledger=led,
+            num_blocks=11, max_blocks_per_seq=5, max_batch=3,
+            max_preemptions=0)
+        _assert_conserved(led, pending=0)
+        statuses = {s for _, s in outcomes}
+        assert "failed_oom" in statuses
+        assert led.totals.waste_rows["shed"] > 0
+
+        led2 = CostLedger()
+        done2, outcomes2, _ = _drive(
+            _tsm(), _prompts(15, n=2), self.N_GEN, ledger=led2,
+            submit_kw={"deadline_steps": 3})
+        _assert_conserved(led2, pending=0)
+        if any(s == "failed_deadline" for _, s in outcomes2):
+            assert led2.totals.waste_rows["deadline"] > 0
+
+    def test_fault_storm_numeric_waste(self):
+        """The PR 5 pattern: injected NaN fails a request — its whole
+        accounted work lands in the numeric cause."""
+        led = CostLedger()
+        inj = FaultInjector(nan_at={4: [0]})
+        done, outcomes, _ = _drive(_tsm(), _prompts(16, n=3), self.N_GEN,
+                                   ledger=led, injector=inj)
+        _assert_conserved(led, pending=0)
+        assert any(s == "failed_numeric" for _, s in outcomes)
+        assert led.totals.waste_rows["numeric"] > 0
+
+    def test_draft_oom_rollback_is_draft_oom_waste(self):
+        tsm = _tsm()
+        led = CostLedger()
+        inj = FaultInjector(draft_oom_at=[3])
+        done, _, eng = _drive(tsm, _prompts(17, n=3), self.N_GEN,
+                              ledger=led, injector=inj,
+                              draft=_draft1(tsm), k=3)
+        _assert_conserved(led, pending=0)
+        assert eng.stats.draft_oom_rolls > 0
+        assert led.totals.waste_rows["draft_oom"] > 0
+
+    def test_conservation_holds_after_every_step(self):
+        """Not just at quiescence: the identity holds at every step
+        boundary of a mixed spec + preemption run (pending > 0 while
+        requests are live)."""
+        tsm = _tsm()
+        led = CostLedger()
+        eng = SpeculativeEngine(tsm, _draft1(tsm), k=2,
+                                max_batch=2, block_size=4,
+                                num_blocks=12, max_blocks_per_seq=5,
+                                ledger=led)
+        rids = [eng.submit(p) for p in _prompts(18, n=3, lo=8, hi=9)]
+        done = set()
+        for _ in range(60):
+            eng.step()
+            assert led.conservation()["ok"]
+            eng.outcomes.clear()
+            for r in rids:
+                if r not in done and len(eng.generated(r)) >= 4:
+                    done.add(r)
+                    eng.release(r)
+                    assert led.conservation()["ok"]
+            if len(done) == len(rids):
+                break
+
+    @pytest.mark.parametrize("ragged", [True, "force", False])
+    def test_token_budget_mixed_steps_account_prefill(self, ragged):
+        """The Sarathi-style mixed step (prefill_token_budget) routes
+        chunk accounting through the SAME hook on all three prefill
+        paths — eager, planned-ragged (CPU fallback) and forced-packed
+        — and the prompt rows land exactly once."""
+        model = _model()
+        rng = np.random.RandomState(5)
+        led = CostLedger()
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=30, max_blocks_per_seq=8,
+                                 prefill_token_budget=6,
+                                 ragged_step=ragged, ledger=led)
+        T = 14
+        eng.submit(paddle.to_tensor(rng.randn(T, D).astype(np.float32)))
+        x = paddle.to_tensor(np.zeros((2, 1, D), np.float32))
+        admitted = None
+        for _ in range(10):
+            out = eng.step(x)
+            if eng.admitted:
+                admitted = eng.admitted.pop()
+                break
+        assert admitted is not None
+        assert led.conservation()["ok"]
+        # every prompt row accounted exactly once, as prefill work
+        assert led.totals.rows == T
+        assert led.pending_rows == T
+        eng.release(admitted[1])
+        assert led.totals.goodput_rows == T
+
+    def test_per_tenant_buckets_sum_to_totals(self):
+        led = CostLedger()
+        tsm = _tsm()
+        eng = SpeculativeEngine(
+            tsm, None, k=0, max_batch=2, block_size=4, num_blocks=60,
+            max_blocks_per_seq=10, ledger=led,
+            tenants={"a": {"weight": 2.0}, "b": {}})
+        prompts = _prompts(19)
+        rids = [eng.submit(p, tenant_id="a" if i % 2 else "b")
+                for i, p in enumerate(prompts)]
+        for _ in range(200):
+            live = [r for r in rids if len(eng.generated(r)) < 6]
+            if not live:
+                break
+            eng.step()
+            eng.outcomes.clear()
+        for r in rids:
+            eng.release(r)
+        _assert_conserved(led)
+        cost = led.tenant_cost()
+        assert set(cost) >= {"a", "b"}
+        assert sum(b["rows"] for b in cost.values()) \
+            == led.totals.rows
+        assert sum(b["block_steps"] for b in cost.values()) \
+            == led.totals.block_steps > 0
+        # the bill is surfaced through tenant_report too
+        rep = eng.tenant_report()
+        assert rep["a"]["cost"]["block_steps"] \
+            == cost["a"]["block_steps"]
+
+
+# ---------------------------------------------------------------------
+# zero overhead off / clockless on
+# ---------------------------------------------------------------------
+
+class TestZeroOverheadWhenOff:
+    def _serve(self, ledger):
+        model = _model()
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=20, max_blocks_per_seq=5,
+                                 ledger=ledger)
+        rng = np.random.RandomState(3)
+        for _ in range(2):
+            eng.submit(paddle.to_tensor(
+                rng.randn(6, D).astype(np.float32)))
+        x = np.zeros((2, 1, D), np.float32)
+        for _, slot, h in eng.admitted:
+            x[slot, 0] = np.asarray(h.numpy())[0]
+        eng.admitted.clear()
+        for _ in range(4):
+            out = eng.step(paddle.to_tensor(x))
+            x = np.asarray(out.numpy())[:, :1].copy()
+        eng.release(0)
+        return eng
+
+    def test_ledger_none_means_zero_clock_reads(self, counting_clock):
+        self._serve(ledger=None)
+        assert counting_clock.calls == 0
+
+    def test_ledger_on_is_still_clockless(self, counting_clock):
+        """The stronger clause: FULL accounting (no collector) never
+        reads a wall clock — work is step- and event-keyed."""
+        led = CostLedger()
+        eng = self._serve(ledger=led)
+        assert counting_clock.calls == 0
+        assert led.totals.rows > 0
+        assert eng.ledger is led
+
+
+# ---------------------------------------------------------------------
+# passivity: bit-identity with the ledger on vs off
+# ---------------------------------------------------------------------
+
+class TestPassiveBitIdentity:
+    N_GEN = 6
+
+    def _both(self, seed, **kw):
+        tsm = _tsm()
+        prompts = _prompts(seed, n=3)
+        base, base_oc, _ = _drive(tsm, prompts, self.N_GEN, **kw)
+        led = CostLedger()
+        mine, mine_oc, eng = _drive(tsm, prompts, self.N_GEN,
+                                    ledger=led, **kw)
+        assert mine == base, "the ledger changed a token stream"
+        assert mine_oc == base_oc, "the ledger changed an outcome"
+        _assert_conserved(led)
+        return led, eng
+
+    def test_plain(self):
+        led, _ = self._both(41)
+        assert led.totals.goodput_rows > 0
+
+    def test_prefix_cached(self):
+        self._both(42, prefix_cache=True)
+
+    def test_speculative(self):
+        tsm = _tsm()
+        prompts = _prompts(43, n=3)
+        base, base_oc, _ = _drive(tsm, prompts, self.N_GEN,
+                                  draft=_draft1(tsm), k=3)
+        led = CostLedger()
+        mine, mine_oc, _ = _drive(tsm, prompts, self.N_GEN,
+                                  ledger=led,
+                                  draft=_draft1(tsm), k=3)
+        assert mine == base and mine_oc == base_oc
+        _assert_conserved(led, pending=0)
+
+    def test_fault_storm(self):
+        """The PR 5 seeded storm: whole-step OOM sheds + a NaN slot,
+        ledger on vs off — streams and outcomes identical."""
+        for led in (None, CostLedger()):
+            inj = FaultInjector(oom_at=[3, 4, 5, 6], nan_at={8: [1]})
+            out = _drive(_tsm(), _prompts(44, n=3), self.N_GEN,
+                         ledger=led, injector=inj, max_batch=2,
+                         num_blocks=14, max_blocks_per_seq=6,
+                         max_preemptions=1)
+            if led is None:
+                base = out[:2]
+            else:
+                assert out[:2] == base
+                _assert_conserved(led, pending=0)
+                assert led.totals.wasted_rows > 0
+
+    def test_snapshot_carries_no_ledger_state(self):
+        """Ledger state is derived, never snapshotted: an accounted
+        engine's snapshot equals the bare engine's, bit for bit."""
+        import pickle
+        tsm = _tsm()
+        prompts = _prompts(45, n=2)
+        snaps = {}
+        for tag, led in (("off", None), ("on", CostLedger())):
+            eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                    block_size=4, num_blocks=30,
+                                    max_blocks_per_seq=8, ledger=led)
+            for p in prompts:
+                eng.submit(p)
+            for _ in range(3):
+                eng.step()
+            snaps[tag] = pickle.dumps(eng.snapshot())
+        assert snaps["on"] == snaps["off"]
+
+
+# ---------------------------------------------------------------------
+# determinism: identical waste breakdown + alert sequence, every run
+# ---------------------------------------------------------------------
+
+class TestDeterminism:
+    def _overload(self):
+        """Seeded overload: spec + tight pool + zero retry budget —
+        preemptions, sheds and rejections all fire."""
+        tsm = _tsm()
+        led = CostLedger()
+        mon = HealthMonitor(thresholds={"goodput_floor": 0.9,
+                                        "waste_spike_factor": 1.5})
+        done, outcomes, eng = _drive(
+            tsm, _prompts(55, n=5, lo=8, hi=9), 6, ledger=led,
+            monitor=mon, draft=_draft1(tsm), k=2,
+            injector=_reject_injector((4, 6, 8, 10, 12)),
+            max_batch=3, num_blocks=12, max_blocks_per_seq=5,
+            max_preemptions=0, max_iters=600)
+        return led, mon, done, outcomes
+
+    def test_two_runs_identical_breakdown_and_alerts(self):
+        a = self._overload()
+        b = self._overload()
+        assert a[0].waste_breakdown() == b[0].waste_breakdown()
+        assert a[0].tenant_cost() == b[0].tenant_cost()
+        assert [x.sig() for x in a[1].alerts] == \
+            [x.sig() for x in b[1].alerts]
+        assert a[2] == b[2] and a[3] == b[3]
+        _assert_conserved(a[0], pending=0)
+        # the storm actually wasted work
+        assert a[0].totals.wasted_rows > 0
+
+
+# ---------------------------------------------------------------------
+# monitor detectors: goodput-collapse / waste-spike
+# ---------------------------------------------------------------------
+
+def _work_registry():
+    reg = MetricsRegistry()
+    state = {"total": 0, "good": 0, "waste": 0}
+
+    def src():
+        return {"total_tokens": state["total"],
+                "goodput_tokens": state["good"],
+                "waste_tokens": state["waste"]}
+    reg.attach("work", src)
+    return reg, state
+
+
+class TestDetectors:
+    def test_goodput_collapse_fires_and_rearms(self):
+        reg, st = _work_registry()
+        mon = HealthMonitor(window=4)
+        mon.bind(reg)
+        step = 0
+        for _ in range(6):      # healthy: all resolved work is good
+            step += 1
+            st["total"] += 10
+            st["good"] += 10
+            mon.on_step(step)
+        assert "goodput-collapse" not in mon.alert_counts
+        for _ in range(6):      # collapse: everything wastes
+            step += 1
+            st["total"] += 10
+            st["waste"] += 10
+            mon.on_step(step)
+        assert mon.alert_counts.get("goodput-collapse") == 1
+        kinds = [a.kind for a in mon.alerts]
+        assert "goodput-collapse" in kinds
+        for _ in range(8):      # recovery: goodput flows again
+            step += 1
+            st["total"] += 10
+            st["good"] += 10
+            mon.on_step(step)
+        for _ in range(6):      # second collapse = second alert
+            step += 1
+            st["total"] += 10
+            st["waste"] += 10
+            mon.on_step(step)
+        assert mon.alert_counts.get("goodput-collapse") == 2
+
+    def test_waste_spike_needs_a_spike_not_a_level(self):
+        reg, st = _work_registry()
+        mon = HealthMonitor()
+        mon.bind(reg)
+        step = 0
+        for _ in range(10):     # steady 2-rows-per-step waste: the
+            step += 1           # EWMA baseline absorbs it
+            st["total"] += 10
+            st["good"] += 8
+            st["waste"] += 2
+            mon.on_step(step)
+        assert "waste-spike" not in mon.alert_counts
+        step += 1               # 20x the baseline: spike
+        st["total"] += 50
+        st["waste"] += 40
+        mon.on_step(step)
+        assert mon.alert_counts.get("waste-spike") == 1
+
+    def test_goodput_collapse_ignores_completion_lumpiness(self):
+        """Review regression: goodput lands in ONE lump when a
+        request finishes, so a long generation mid-flight (windows
+        full of work + routine waste but zero completions) must not
+        read as a collapse — the fraction is judged against total
+        work done, not work resolved."""
+        reg, st = _work_registry()
+        mon = HealthMonitor(window=4)
+        mon.bind(reg)
+        step = 0
+        for i in range(30):     # work flows, waste trickles (10%),
+            step += 1           # goodput only every 15th step
+            st["total"] += 10
+            st["waste"] += 1
+            if i % 15 == 14:
+                st["good"] += 135
+            mon.on_step(step)
+        assert "goodput-collapse" not in mon.alert_counts
+
+    def test_ledger_records_bounded_with_eviction(self):
+        """Review regression: the per-request record map is bounded
+        (the collector's max_requests pattern) — terminal records
+        evict oldest-first past the cap, and eviction never touches
+        the conservation identity."""
+        led = CostLedger(work_model=WorkModel(1, 8, 16),
+                         max_requests=4)
+        for rid in range(10):
+            led.on_submit(rid, "t", 2)
+            led.on_prefill(rid, 0, 2)
+            led.on_outcome(rid, "finished")
+        assert len(led._recs) == 4
+        assert led.evicted_records == 6
+        assert led.conservation()["ok"]
+        assert led.totals.goodput_rows == 20
+        assert led.as_dict()["evicted_records"] == 6
+
+    def test_waste_spike_not_seeded_by_zero_waste_warmup(self):
+        """Review regression: pure-goodput warmup intervals must
+        leave the EWMA baseline UNSEEDED — a 0.0-seeded baseline
+        would turn the first routine rejection into an infinite
+        spike."""
+        reg, st = _work_registry()
+        mon = HealthMonitor()
+        mon.bind(reg)
+        step = 0
+        for _ in range(5):      # zero-waste warmup
+            step += 1
+            st["total"] += 10
+            st["good"] += 10
+            mon.on_step(step)
+        for _ in range(5):      # routine waste begins: seeds, no fire
+            step += 1
+            st["total"] += 10
+            st["good"] += 8
+            st["waste"] += 2
+            mon.on_step(step)
+        assert "waste-spike" not in mon.alert_counts
+        step += 1               # a real spike still fires
+        st["total"] += 50
+        st["waste"] += 40
+        mon.on_step(step)
+        assert mon.alert_counts.get("waste-spike") == 1
+
+    def test_detectors_dark_without_a_ledger(self):
+        """No work.* keys -> no series -> no new detectors: existing
+        monitor behavior (and its alert sequences) are untouched."""
+        reg = MetricsRegistry()
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 1)
+        mon = HealthMonitor()
+        mon.bind(reg)
+        for s in range(1, 8):
+            mon.on_step(s)
+        assert mon.series("waste_rate") is None
+        assert mon.series("goodput_per_step") is None
+        assert not mon.alert_counts
+
+
+# ---------------------------------------------------------------------
+# recovery: derived, replay-frozen, deterministic
+# ---------------------------------------------------------------------
+
+def _drive_recoverable(tsm, prompts, n_gen, jp, sp, injector, ledger,
+                       fresh_ledgers=False, snapshot_every=4,
+                       max_iters=400):
+    eng = SpeculativeEngine(tsm, None, k=0, max_batch=2, block_size=4,
+                            num_blocks=60, max_blocks_per_seq=10,
+                            injector=injector, ledger=ledger)
+    srv = RecoverableServer(eng, journal_path=jp, snapshot_path=sp,
+                            snapshot_every=snapshot_every)
+    ledgers = [ledger]
+    rids = [srv.submit(p) for p in prompts]
+    done, failed = {}, set()
+    for _ in range(max_iters):
+        live = [r for r in rids if r not in done and r not in failed]
+        if not live:
+            break
+        try:
+            srv.step()
+            for oc in srv.drain_outcomes():
+                if oc.failed:
+                    failed.add(oc.rid)
+            for r in live:
+                if r in failed:
+                    continue
+                if len(srv.generated(r)) >= n_gen:
+                    done[r] = tuple(srv.generated(r)[:n_gen])
+                    srv.release(r)
+        except EngineCrash:
+            led = CostLedger() if fresh_ledgers else ledgers[-1]
+            if led is not ledgers[-1]:
+                ledgers.append(led)
+            srv = RecoverableServer.recover(
+                tsm, None, journal_path=jp, snapshot_path=sp,
+                injector=injector, ledger=led)
+            srv.check_invariants()
+    else:
+        raise AssertionError("recoverable driver did not converge")
+    srv.close()
+    return done, ledgers
+
+
+@pytest.mark.recovery
+class TestRecoveryDerived:
+    N_GEN = 6
+
+    def test_ledger_rides_through_crashes_frozen(self, tmp_path):
+        """Crashes at journaled round boundaries: the riding ledger's
+        replay is frozen, so the final breakdown equals the
+        uninterrupted run's exactly."""
+        tsm = _tsm()
+        prompts = _prompts(71, n=3)
+        runs = {}
+        for tag, inj in (
+                ("clean", None),
+                ("storm", CrashInjector(crash_at={3: "post_journal",
+                                                  6: "post_journal"}))):
+            jp, sp = str(tmp_path / f"{tag}.wal"), \
+                str(tmp_path / f"{tag}.ckpt")
+            runs[tag] = _drive_recoverable(
+                tsm, prompts, self.N_GEN, jp, sp, inj, CostLedger())
+        clean_done, (clean_led,) = runs["clean"]
+        storm_done, (storm_led,) = runs["storm"]
+        assert storm_done == clean_done
+        assert storm_led.waste_breakdown() == \
+            clean_led.waste_breakdown()
+        assert storm_led.tenant_cost() == clean_led.tenant_cost()
+        _assert_conserved(storm_led, pending=0)
+
+    def test_fresh_ledger_rebuilds_and_conserves(self, tmp_path):
+        """A FRESH ledger per crash reconstructs the post-snapshot
+        suffix from the replay: conservation holds and two identical
+        crashy runs agree exactly."""
+        tsm = _tsm()
+        prompts = _prompts(72, n=3)
+        outs = []
+        for i in range(2):
+            jp, sp = str(tmp_path / f"f{i}.wal"), \
+                str(tmp_path / f"f{i}.ckpt")
+            inj = CrashInjector(crash_at={4: "post_journal"})
+            outs.append(_drive_recoverable(
+                tsm, prompts, self.N_GEN, jp, sp, inj, CostLedger(),
+                fresh_ledgers=True))
+        (done_a, ledgers_a), (done_b, ledgers_b) = outs
+        assert done_a == done_b
+        assert len(ledgers_a) == 2      # original + one fresh
+        for led in ledgers_a + ledgers_b:
+            assert led.conservation()["ok"]
+        assert ledgers_a[-1].waste_breakdown() == \
+            ledgers_b[-1].waste_breakdown()
+
+    def test_unjournaled_crash_work_counts_twice_but_conserves(
+            self, tmp_path):
+        """A pre_journal crash loses a round the ledger already
+        counted: the re-served round is genuinely computed again, so
+        the riding ledger reports MORE total work than the clean run
+        — and still balances its books."""
+        tsm = _tsm()
+        prompts = _prompts(73, n=3)
+        jp, sp = str(tmp_path / "p.wal"), str(tmp_path / "p.ckpt")
+        inj = CrashInjector(crash_at={3: "pre_journal"})
+        done, (led,) = _drive_recoverable(
+            tsm, prompts, self.N_GEN, jp, sp, inj, CostLedger())
+        _assert_conserved(led, pending=0)
+        jp2, sp2 = str(tmp_path / "c.wal"), str(tmp_path / "c.ckpt")
+        done2, (led2,) = _drive_recoverable(
+            tsm, prompts, self.N_GEN, jp2, sp2, None, CostLedger())
+        assert done == done2
+        assert led.totals.rows >= led2.totals.rows
+
+    def test_restore_wires_the_ledger(self):
+        tsm = _tsm()
+        eng = SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                                block_size=4, num_blocks=30,
+                                max_blocks_per_seq=8)
+        eng.submit(_prompts(74, n=1)[0])
+        for _ in range(3):
+            eng.step()
+        led = CostLedger()
+        restored = SpeculativeEngine.restore(tsm, None, eng.snapshot(),
+                                             ledger=led)
+        assert restored.ledger is led
+        assert restored.engine.ledger is led
+        # the restored registry exports the work source
+        assert "work.total_tokens" in restored.registry.as_dict()
+
+
+# ---------------------------------------------------------------------
+# MFU/MBU: analytic work paired with measured span durations
+# ---------------------------------------------------------------------
+
+class TestWorkGauges:
+    def test_collector_pairs_work_with_model_spans(self):
+        led = CostLedger()
+        col = TraceCollector()
+        done, _, eng = _drive(_tsm(), _prompts(81, n=1), 4,
+                              ledger=led, collector=col)
+        reg = eng.registry.as_dict()
+        assert reg["work.model_flops_per_s.count"] > 0
+        # the step log carries measured model seconds for those steps
+        timed = [rec for rec in led.step_log if rec[5]]
+        assert timed, "no step carried a model duration"
+        step, kind, rows, flops, byts, model_s = timed[0]
+        assert rows > 0 and flops > 0 and byts > 0 and model_s > 0
+        assert kind in ("decode", "mixed", "prefill", "verify")
+
+    def test_step_log_is_target_scoped(self):
+        """Review regression: span.model times the TARGET call only,
+        so draft-pool FLOPs must stay out of the paired step-log
+        numerator (pairing them would overstate MFU) while still
+        landing in the conservation totals."""
+        wm = WorkModel(2, 32, 64)
+        dwm = WorkModel(1, 32, 64)
+        led = CostLedger(work_model=wm, draft_work_model=dwm)
+        led.bind(MetricsRegistry())
+        led.on_submit(0, "t", 3)
+        led.on_prefill(0, 0, 3)
+        led.on_draft_prefill(0, 0, 3)
+        led.on_decode([(0, 3)], 1)
+        led.on_draft_rows([(0, 3)])
+        src = MetricsRegistry()
+        src.observe("span.model", 0.5)
+        led.on_step(1, {}, span_src=src)
+        step, kind, rows, flops, byts, model_s = led.step_log[0]
+        assert rows == 4                      # target rows only
+        assert flops == wm.span_flops(0, 4)   # no draft flops
+        assert model_s == 0.5
+        assert led.totals.rows == 8           # conservation keeps all
+        assert led.draft_rows == 4
+        assert led.conservation()["ok"]
+
+    def test_fresh_collector_rebases_the_span_mark(self):
+        """Review regression: recovery wires collectors FRESH — a
+        restarted span.model series must re-enable MFU pairing
+        immediately, not after a pre-crash run's worth of steps."""
+        led = CostLedger(work_model=WorkModel(2, 32, 64))
+        led.bind(MetricsRegistry())
+        led.on_submit(0, "t", 2)
+        src = MetricsRegistry()
+        for i in range(3):
+            led.on_decode([(0, i)], 1)
+            src.observe("span.model", 0.1)
+            led.on_step(i + 1, {}, span_src=src)
+        assert led.step_log[-1][5] == 0.1
+        fresh = MetricsRegistry()     # the recovered engine's
+        fresh.observe("span.model", 0.2)
+        led.on_decode([(0, 3)], 1)
+        led.on_step(4, {}, span_src=fresh)
+        assert led.step_log[-1][5] == 0.2
+
+    def test_mfu_needs_a_peak(self):
+        led = CostLedger(peak_flops_per_s=1e12,
+                         peak_bytes_per_s=1e11)
+        col = TraceCollector()
+        done, _, eng = _drive(_tsm(), _prompts(82, n=1), 4,
+                              ledger=led, collector=col)
+        reg = eng.registry.as_dict()
+        assert reg["work.mfu.count"] > 0
+        assert reg["work.mbu.count"] > 0
+        # no collector -> no durations -> no MFU observations
+        led2 = CostLedger(peak_flops_per_s=1e12)
+        _, _, eng2 = _drive(_tsm(), _prompts(82, n=1), 4, ledger=led2)
+        assert "work.mfu.count" not in eng2.registry.as_dict()
+        assert not [r for r in led2.step_log if r[5]]
+
+
+# ---------------------------------------------------------------------
+# satellite: divide-by-zero edges of the derived stats fields
+# ---------------------------------------------------------------------
+
+class TestDerivedStatsEdges:
+    def test_spec_stats_zero_denominators(self):
+        from paddle_tpu.inference import SpecDecodeStats
+        st = SpecDecodeStats()
+        # k=0 / nothing proposed / no target steps: all defined
+        assert st.acceptance_rate == 0.0
+        assert st.tokens_per_target_step == 0.0
+        d = st.as_dict()
+        assert d["acceptance_rate"] == 0.0
+        assert d["tokens_per_target_step"] == 0.0
+
+    def test_spec_engine_k0_exports_finite_rates(self):
+        """A k=0 engine proposes nothing ever — the derived fields
+        stay finite through a real serving run."""
+        done, _, eng = _drive(_tsm(), _prompts(91, n=2), 4)
+        st = eng.stats
+        assert st.proposed == 0
+        assert st.acceptance_rate == 0.0
+        assert np.isfinite(st.tokens_per_target_step)
+
+    def test_prefill_stats_prefill_free_run(self):
+        from paddle_tpu.inference import PrefillStats
+        st = PrefillStats()
+        assert st.mixed_step_rate == 0.0
+        assert st.tokens_per_chunk == 0.0
+        assert st.prefill_tokens_per_step == 0.0
+        st.decode_steps = 7          # decode-only serving
+        assert st.mixed_step_rate == 0.0
+        assert np.isfinite(st.as_dict()["mixed_step_rate"])
+
+    def test_prefix_stats_no_lookups(self):
+        from paddle_tpu.inference import PrefixCacheStats
+        st = PrefixCacheStats()
+        assert st.hit_rate == 0.0
+
+    def test_collector_tpot_single_token(self):
+        from paddle_tpu.inference.telemetry import percentiles
+        col = TraceCollector(clock=lambda: 0.0)
+        col.on_submit(0, "t", 3)
+        col.on_admitted(0, 0, retry=False)
+        col.on_first_token(0)
+        col.on_decode([0], 1)        # one token: TPOT undefined
+        assert col.requests[0].tpot_s is None
+        assert percentiles([]) == {"count": 0}
+
+    def test_goodput_fraction_unresolved(self):
+        led = CostLedger()
+        assert led.goodput_fraction() is None
+        assert led.conservation()["ok"]
+
+
+# ---------------------------------------------------------------------
+# the offline doctor + the shared --json schema
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def _ledger_dump(request, tmp_path_factory):
+    """ONE accounted spec serving run shared by every doctor test in
+    the class (each re-driving the engine would triple the suite's
+    wall time for no extra coverage)."""
+    led = CostLedger()
+    col = TraceCollector()
+    tsm = _tsm()
+    _drive(tsm, _prompts(95, n=2), 6, ledger=led, collector=col,
+           draft=_draft1(tsm), k=2,
+           injector=_reject_injector())
+    path = str(tmp_path_factory.mktemp("cost") / "ledger.json")
+    led.save(path)
+    request.cls.dump_path = path
+    request.cls.dump_ledger = led
+    request.cls.dump_collector = col
+
+
+@pytest.mark.usefixtures("_ledger_dump")
+class TestCostReportTool:
+    def test_exit_codes(self, tmp_path, capsys):
+        from tools import cost_report
+        path, led = self.dump_path, self.dump_ledger
+        assert cost_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "BALANCED" in out and "token-row(s)" in out
+        # the waste gate trips
+        assert cost_report.main([path, "--max-waste-frac", "0.0"]) \
+            in (0, 1)   # 1 iff the seeded run wasted anything
+        if led.totals.wasted_rows:
+            assert cost_report.main(
+                [path, "--max-waste-frac", "0.0"]) == 1
+        # unreadable inputs
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write("{]")
+        assert cost_report.main([bad]) == 2
+        other = str(tmp_path / "other.json")
+        with open(other, "w") as f:
+            json.dump({"kind": "health_monitor"}, f)
+        assert cost_report.main([other]) == 2
+
+    def test_broken_conservation_exits_one(self, tmp_path, capsys):
+        with open(self.dump_path) as f:
+            dump = json.load(f)
+        dump["conservation"]["ok"] = False
+        path = str(tmp_path / "broken.json")
+        with open(path, "w") as f:
+            json.dump(dump, f)
+        from tools import cost_report
+        assert cost_report.main([path]) == 1
+
+    def test_json_envelope_schema(self, tmp_path, capsys):
+        """Satellite: all three doctors share ONE machine-readable
+        schema (paddle_tpu.report.v1), so CI can gate on any artifact
+        without parsing tables."""
+        from tools import cost_report, health_report, trace_report
+        from tools._report import SCHEMA
+
+        assert cost_report.main([self.dump_path, "--json"]) == 0
+        env = json.loads(capsys.readouterr().out)
+
+        # a health dump off a synthetic registry + the shared run's
+        # trace (no extra serving runs needed for schema coverage)
+        mon = HealthMonitor()
+        reg = MetricsRegistry()
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 2)
+        mon.bind(reg)
+        for s in range(1, 4):
+            mon.on_step(s)
+        hp = str(tmp_path / "health.json")
+        mon.save(hp)
+        assert health_report.main([hp, "--json"]) == 0
+        henv = json.loads(capsys.readouterr().out)
+        tp = str(tmp_path / "trace.json")
+        self.dump_collector.save_chrome_trace(tp)
+        assert trace_report.main([tp, "--json"]) == 0
+        tenv = json.loads(capsys.readouterr().out)
+
+        for env_i, tool in ((env, "cost_report"),
+                            (henv, "health_report"),
+                            (tenv, "trace_report")):
+            assert env_i["schema"] == SCHEMA
+            assert env_i["tool"] == tool
+            assert env_i["ok"] is True and env_i["exit"] == 0
+            assert env_i["problems"] == []
+            assert isinstance(env_i["data"], dict)
+        # tool-specific payloads carry their headline facts
+        assert env["data"]["conservation"]["ok"] is True
+        assert "breakdown" in env["data"]
+        assert "report" in henv["data"]
+        assert tenv["data"]["spans"]
+
+    def test_trace_report_json_slo_violation_exits_one(
+            self, tmp_path, capsys):
+        from tools import trace_report
+        tp = str(tmp_path / "trace.json")
+        self.dump_collector.save_chrome_trace(tp)
+        tgt = str(tmp_path / "targets.json")
+        with open(tgt, "w") as f:
+            json.dump({"objective": 0.99,
+                       "targets": {"ttft_s": 1e-9}}, f)
+        assert trace_report.main([tp, "--json", "--slo", tgt]) == 1
+        env = json.loads(capsys.readouterr().out)
+        assert env["ok"] is False and env["exit"] == 1
+        assert env["data"]["slo"]["ok"] is False
+        assert env["problems"]

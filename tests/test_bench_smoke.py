@@ -187,6 +187,40 @@ def test_serving_obs_smoke_leg():
     assert res["traced"]["tokens_per_sec"] > 0
 
 
+def test_serving_cost_smoke_leg():
+    res = bench_extra.bench_serving_cost(smoke=True)
+    assert res["metric"] == "serving_cost_accounting"
+    # the headline guarantees rode the bench: accounting is PASSIVE
+    # (streams bit-identical) and DETERMINISTIC (two accounted runs
+    # produced the identical waste breakdown + tenant bill)
+    assert res["streams_bit_identical"] is True
+    assert res["breakdown_deterministic"] is True
+    storm = res["waste_storm"]
+    # the conservation identity held exactly at quiescence
+    assert storm["conservation_ok"] is True
+    bd = storm["breakdown"]
+    assert bd["pending"] == 0
+    assert bd["goodput"] + sum(bd["waste"].values()) == bd["total"]
+    # the seeded storm really wasted work in the headline causes
+    assert bd["waste"]["spec_rejected"] > 0
+    assert bd["waste"]["shed"] > 0
+    assert storm["failed"] > 0
+    assert 0 < storm["goodput_fraction"] < 1
+    # both tenants got billed block-steps and attributed rows
+    bill = storm["tenant_bill"]
+    assert set(bill) >= {"alice", "bob"}
+    for b in bill.values():
+        assert b["block_steps"] > 0 and b["rows"] > 0
+    # the MFU pairing ran on the collector-timed steady phase
+    assert res["accounted"]["mfu_paired_steps"] > 0
+    assert res["accounted"]["goodput_tokens"] > 0
+    # both runs actually served tokens; the <= 3% overhead bound is
+    # ENFORCED inside the leg at bench scale only (smoke shapes are
+    # jit/jitter-dominated, so no timing assert rides tier-1)
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["accounted"]["tokens_per_sec"] > 0
+
+
 def test_serving_monitor_smoke_leg():
     res = bench_extra.bench_serving_monitor(smoke=True)
     assert res["metric"] == "serving_health_monitoring"

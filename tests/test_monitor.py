@@ -25,7 +25,6 @@ The acceptance bars:
 import json
 import os
 import sys
-import time
 
 import numpy as np
 import pytest
@@ -40,8 +39,6 @@ from paddle_tpu.inference import (CrashInjector, EngineCrash,
                                   SpeculativeEngine, TokenServingModel,
                                   TraceCollector)
 from paddle_tpu.inference import monitor as mon_mod
-from paddle_tpu.inference import scheduler as sched_mod
-from paddle_tpu.inference import telemetry as tele_mod
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -287,21 +284,10 @@ class TestSlo:
 
 
 # ---------------------------------------------------------------------
-# zero overhead when off; the monitor never reads a clock
+# zero overhead when off; the monitor never reads a clock (the
+# CountingTime stand-in lives in conftest.py — the shared
+# ``counting_clock`` fixture)
 # ---------------------------------------------------------------------
-
-class _CountingTime:
-    def __init__(self):
-        self.calls = 0
-
-    def perf_counter(self):
-        self.calls += 1
-        return time.perf_counter()
-
-    def monotonic(self):
-        self.calls += 1
-        return time.monotonic()
-
 
 class TestZeroOverheadWhenOff:
     def _serve(self, monitor, collector=None):
@@ -323,22 +309,16 @@ class TestZeroOverheadWhenOff:
         eng.release(0)
         return eng
 
-    def test_monitor_none_means_zero_clock_reads(self, monkeypatch):
-        fake = _CountingTime()
-        monkeypatch.setattr(sched_mod, "time", fake)
-        monkeypatch.setattr(tele_mod, "time", fake)
+    def test_monitor_none_means_zero_clock_reads(self, counting_clock):
         self._serve(monitor=None)
-        assert fake.calls == 0
+        assert counting_clock.calls == 0
 
-    def test_monitor_on_is_still_clockless(self, monkeypatch):
+    def test_monitor_on_is_still_clockless(self, counting_clock):
         """The stronger clause: FULL monitoring (no collector) is
         step-clock driven — zero wall-clock reads even when ON."""
-        fake = _CountingTime()
-        monkeypatch.setattr(sched_mod, "time", fake)
-        monkeypatch.setattr(tele_mod, "time", fake)
         mon = HealthMonitor()
         eng = self._serve(monitor=mon)
-        assert fake.calls == 0
+        assert counting_clock.calls == 0
         assert mon.samples > 0
         assert eng.monitor is mon
 

@@ -17,7 +17,6 @@ The acceptance bars:
 import json
 import os
 import sys
-import time
 
 import numpy as np
 import pytest
@@ -30,8 +29,6 @@ from paddle_tpu.inference import (BlockOOM, CrashInjector, EngineCrash,
                                   RecoverableServer, SpeculativeEngine,
                                   StatsBase, TokenServingModel,
                                   TraceCollector)
-from paddle_tpu.inference import scheduler as sched_mod
-from paddle_tpu.inference import telemetry as tele_mod
 from paddle_tpu.inference.telemetry import percentiles
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -237,6 +234,64 @@ class TestMetricsRegistry:
 
 
 # ---------------------------------------------------------------------
+# satellite (PR 11): windowed-view edges — empty window, single mark,
+# a window spanning the retention eviction
+# ---------------------------------------------------------------------
+
+class TestWindowedViewEdges:
+    def test_empty_window(self):
+        """Marks taken, nothing observed since: the interval view is
+        an empty percentile dict, never a crash."""
+        reg = MetricsRegistry()
+        reg.observe("lat", 1.0)
+        marks = reg.hist_marks()
+        assert reg.values_since("lat", marks["lat"]) == []
+        since = reg.percentiles_since(marks)
+        assert since["lat"] == {"count": 0}
+        # a registry with no histograms at all
+        empty = MetricsRegistry()
+        assert empty.hist_marks() == {}
+        assert empty.percentiles_since() == {}
+        assert empty.values_since("lat", 0) == []
+        assert empty.last_value("lat") is None
+
+    def test_single_mark_single_observation(self):
+        reg = MetricsRegistry()
+        marks = reg.hist_marks()            # before the series exists
+        reg.observe("lat", 7.0)
+        assert reg.hist_total("lat") == 1
+        assert reg.last_value("lat") == 7.0
+        vals = reg.values_since("lat", marks.get("lat", 0))
+        assert vals == [7.0]
+        since = reg.percentiles_since(marks)
+        assert since["lat"]["count"] == 1
+        assert since["lat"]["p50"] == 7.0 == since["lat"]["max"]
+
+    def test_window_spanning_eviction(self):
+        """A mark taken BEFORE the retention trim: the view clamps to
+        what is retained (count < requested span), monotonic totals
+        keep later marks exact."""
+        reg = MetricsRegistry()
+        reg.observe("lat", -1.0)
+        marks = reg.hist_marks()            # mark at total=1
+        n = 2 * reg.HIST_WINDOW             # fill to the trim edge...
+        for i in range(n):
+            reg.observe("lat", float(i))    # ...and over it
+        assert reg.hist_total("lat") == n + 1
+        vals = reg.values_since("lat", marks["lat"])
+        # the trim dropped HIST_WINDOW observations, the window
+        # clamps: retained = n + 1 - HIST_WINDOW
+        assert len(vals) == n + 1 - reg.HIST_WINDOW
+        assert vals[-1] == float(n - 1)
+        since = reg.percentiles_since(marks)
+        assert since["lat"]["count"] == len(vals)
+        # a mark taken AFTER the trim stays exact
+        m2 = reg.hist_marks()
+        reg.observe("lat", 123.0)
+        assert reg.values_since("lat", m2["lat"]) == [123.0]
+
+
+# ---------------------------------------------------------------------
 # satellite: structured BlockOOM
 # ---------------------------------------------------------------------
 
@@ -297,23 +352,10 @@ class TestBlockOOMDetails:
 
 
 # ---------------------------------------------------------------------
-# zero overhead when off: the counting-clock test
+# zero overhead when off: the counting-clock test (the CountingTime
+# stand-in lives in conftest.py — shared with the monitor and cost
+# suites via the ``counting_clock`` fixture)
 # ---------------------------------------------------------------------
-
-class _CountingTime:
-    """time-module stand-in that counts every clock read."""
-
-    def __init__(self):
-        self.calls = 0
-
-    def perf_counter(self):
-        self.calls += 1
-        return time.perf_counter()
-
-    def monotonic(self):
-        self.calls += 1
-        return time.monotonic()
-
 
 class TestZeroOverheadWhenOff:
     def _serve(self, collector):
@@ -335,27 +377,22 @@ class TestZeroOverheadWhenOff:
         eng.release(0)
         return eng
 
-    def test_no_collector_means_zero_clock_reads(self, monkeypatch):
+    def test_no_collector_means_zero_clock_reads(self, counting_clock):
         """The acceptance clause: with no collector installed the
         serving hot path performs NO clock reads — submit, prefill,
         steps, release. (Deadline-carrying submits still read the
         monotonic clock, as before this PR — that is behavioral
         state, not telemetry.)"""
-        fake = _CountingTime()
-        monkeypatch.setattr(sched_mod, "time", fake)
-        monkeypatch.setattr(tele_mod, "time", fake)
         self._serve(collector=None)
-        assert fake.calls == 0
+        assert counting_clock.calls == 0
 
-    def test_collector_reads_the_injected_clock_only(self, monkeypatch):
+    def test_collector_reads_the_injected_clock_only(self,
+                                                     counting_clock):
         """Sanity for the counter itself, and for clock injection: a
         collector built AFTER the patch reads only through the
         patched module / its injected clock."""
-        fake = _CountingTime()
-        monkeypatch.setattr(sched_mod, "time", fake)
-        monkeypatch.setattr(tele_mod, "time", fake)
         self._serve(collector=TraceCollector())
-        assert fake.calls > 0
+        assert counting_clock.calls > 0
 
     def test_deterministic_injected_clock(self):
         """A fake clock makes every latency exact: TTFT/TPOT/queue

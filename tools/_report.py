@@ -1,0 +1,48 @@
+"""Shared machine-readable report envelope for the offline doctors
+(tools/trace_report.py, tools/health_report.py, tools/cost_report.py).
+
+All three emit, under ``--json``, ONE schema so CI can gate on any of
+their artifacts without parsing human tables:
+
+  {"schema": "paddle_tpu.report.v1",
+   "tool":   "<trace_report|health_report|cost_report>",
+   "ok":     <bool>,        # exit 0 <=> ok (exit 2 = unreadable input
+   "exit":   <0|1|2>,       #            and no envelope is emitted)
+   "problems": [<str>...],  # why ok is false, human-readable
+   "data":   {...}}         # tool-specific payload
+
+``problems`` is always a list (empty when ok); ``data`` is always an
+object. Emit through ``emit_json`` so every tool serializes numpy
+scalars the same way.
+"""
+from __future__ import annotations
+
+import json
+
+SCHEMA = "paddle_tpu.report.v1"
+
+
+def envelope(tool: str, ok: bool, exit_code: int, data: dict,
+             problems=None) -> dict:
+    return {"schema": SCHEMA, "tool": str(tool), "ok": bool(ok),
+            "exit": int(exit_code),
+            "problems": [str(p) for p in (problems or [])],
+            "data": data}
+
+
+def _default(o):
+    try:
+        import numpy as np
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+def emit_json(env: dict) -> None:
+    print(json.dumps(env, indent=1, default=_default))

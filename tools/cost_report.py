@@ -1,0 +1,254 @@
+"""Offline cost doctor: load a ``CostLedger.save`` JSON dump
+(inference/accounting.py) and print the serving cost story — the
+goodput-vs-waste breakdown by cause, the conservation audit, the
+per-tenant bill (block-steps, attributed FLOPs, waste), per-phase
+achieved-FLOP/s / MFU / MBU percentiles from the per-step work log —
+without the engine, the model, or a live process. Sibling of
+tools/recovery_check.py (snapshot), tools/trace_report.py (timeline)
+and tools/health_report.py (control plane); this is the BILLING
+doctor, and its exit code is CI-gateable.
+
+Usage:
+  python tools/cost_report.py LEDGER.json [--json] [--tenant TID]
+         [--max-waste-frac F] [--peak-tflops T] [--peak-gbps G]
+         [--step-seconds S]
+
+``--max-waste-frac F`` gates on the wasted share of RESOLVED work
+(exit 1 when waste/(goodput+waste) > F). A violated conservation
+identity always exits 1 — a ledger that cannot balance its own books
+is a bug, not a report. ``--peak-tflops`` / ``--peak-gbps`` express
+the achieved-throughput percentiles as MFU / MBU (overriding peaks
+recorded in the dump); ``--step-seconds`` converts block-steps to
+block-seconds for the bill (use the measured mean step wall time from
+tools/trace_report.py on the same run).
+
+``--json`` emits the machine-readable envelope every doctor shares
+(tools/_report.py, schema ``paddle_tpu.report.v1``).
+
+Exit status: 0 ok, 1 conservation violated or the waste gate tripped,
+2 unreadable / not a cost-ledger dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    from tools._report import envelope, emit_json
+except ImportError:      # run as a script: tools/ is sys.path[0]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools._report import envelope, emit_json
+
+
+def _pcts(vals):
+    if not vals:
+        return {}
+    v = sorted(vals)
+
+    def p(q):
+        return v[min(len(v) - 1, int(q * len(v)))]
+    return {"count": len(v), "p50": p(0.50), "p90": p(0.90),
+            "max": v[-1]}
+
+
+def analyze(dump: dict, peak_flops=None, peak_bytes=None,
+            max_waste_frac=None, step_seconds=None) -> dict:
+    """The machine-readable report body + problems list."""
+    problems = []
+    cons = dump.get("conservation", {})
+    if not cons.get("ok", False):
+        problems.append(
+            f"conservation violated: rows {cons.get('rows')}, "
+            f"flops {cons.get('flops')}")
+    bd = dump.get("breakdown", {})
+    waste = bd.get("waste", {})
+    wasted = sum(waste.values())
+    resolved = bd.get("goodput", 0) + wasted
+    waste_frac = wasted / resolved if resolved else 0.0
+    if max_waste_frac is not None and waste_frac > max_waste_frac:
+        problems.append(
+            f"waste fraction {waste_frac:.4f} over the "
+            f"--max-waste-frac gate {max_waste_frac}")
+
+    peak_flops = peak_flops or dump.get("peak_flops_per_s")
+    peak_bytes = peak_bytes or dump.get("peak_bytes_per_s")
+    # per-phase percentiles over the step log: achieved FLOP/s and
+    # bytes/s for steps a collector timed (model_s present), MFU/MBU
+    # when a peak is known
+    phases: dict = {}
+    for rec in dump.get("step_log", []):
+        _, kind, rows, flops, byts, model_s = rec
+        ph = phases.setdefault(kind, {"steps": 0, "rows": 0,
+                                      "flops": 0, "bytes": 0,
+                                      "fps": [], "bps": []})
+        ph["steps"] += 1
+        ph["rows"] += rows
+        ph["flops"] += flops
+        ph["bytes"] += byts
+        if model_s:
+            ph["fps"].append(flops / model_s)
+            ph["bps"].append(byts / model_s)
+    phase_out = {}
+    for kind, ph in sorted(phases.items()):
+        fps_p = _pcts(ph["fps"])
+        bps_p = _pcts(ph["bps"])
+        rec = {"steps": ph["steps"], "rows": ph["rows"],
+               "flops": ph["flops"], "hbm_bytes": ph["bytes"],
+               "flops_per_s": fps_p, "bytes_per_s": bps_p}
+        if peak_flops and ph["fps"]:
+            rec["mfu"] = {k: (v / peak_flops if k != "count" else v)
+                          for k, v in fps_p.items()}
+        if peak_bytes and ph["bps"]:
+            rec["mbu"] = {k: (v / peak_bytes if k != "count" else v)
+                          for k, v in bps_p.items()}
+        phase_out[kind] = rec
+
+    bill = {}
+    for tid, b in dump.get("tenants", {}).items():
+        ent = {"block_steps": b.get("block_steps", 0),
+               "rows": b.get("rows", 0),
+               "flops": b.get("flops", 0),
+               "goodput_rows": b.get("goodput_rows", 0),
+               "wasted_rows": b.get("wasted_rows",
+                                    sum(b.get("waste_rows",
+                                              {}).values())),
+               "waste_rows": dict(b.get("waste_rows", {}))}
+        if step_seconds:
+            ent["block_seconds"] = round(
+                ent["block_steps"] * step_seconds, 6)
+        bill[tid] = ent
+
+    return {"steps": dump.get("steps", 0),
+            "conservation": cons,
+            "breakdown": bd,
+            "waste_fraction": round(waste_frac, 6),
+            "goodput_fraction": dump.get("goodput_fraction"),
+            "savings": dump.get("savings", {}),
+            "phases": phase_out,
+            "tenants": bill,
+            "step_log_dropped": dump.get("step_log_dropped", 0),
+            "work_model": dump.get("work_model"),
+            "draft_work_model": dump.get("draft_work_model"),
+            "problems": problems}
+
+
+def _fmt_flops(f):
+    for unit, div in (("TF", 1e12), ("GF", 1e9), ("MF", 1e6)):
+        if f >= div:
+            return f"{f / div:.2f}{unit}"
+    return f"{f:.0f}F"
+
+
+def render(rep: dict, tenant=None) -> str:
+    bd = rep["breakdown"]
+    cons = rep["conservation"]
+    verdict = "BALANCED" if cons.get("ok") else "CONSERVATION VIOLATED"
+    lines = [f"cost report over {rep['steps']} step(s): {verdict}"]
+    rows = cons.get("rows", {})
+    lines.append(
+        f"  accounted work: {rows.get('total', 0)} token-row(s) = "
+        f"{rows.get('goodput', 0)} goodput + {rows.get('waste', 0)} "
+        f"waste + {rows.get('pending', 0)} pending")
+    gf = rep.get("goodput_fraction")
+    lines.append(f"  goodput fraction (resolved): "
+                 f"{'-' if gf is None else f'{gf:.1%}'}   "
+                 f"waste fraction: {rep['waste_fraction']:.1%}")
+    waste = bd.get("waste", {})
+    if any(waste.values()):
+        lines.append("  waste by cause:")
+        for cause, n in sorted(waste.items(), key=lambda kv: -kv[1]):
+            if n:
+                lines.append(f"    {cause:<14} {n}")
+    sav = rep.get("savings", {})
+    if any(sav.values()):
+        lines.append(f"  prefill avoided: "
+                     f"{sav.get('prefix_saved_tokens', 0)} prefix-hit "
+                     f"+ {sav.get('replay_saved_tokens', 0)} "
+                     f"warm-resume token(s)")
+    if rep["phases"]:
+        lines.append("  per-phase model work:")
+        for kind, ph in rep["phases"].items():
+            ln = (f"    {kind:<8} {ph['steps']} step(s), "
+                  f"{ph['rows']} row(s), "
+                  f"{_fmt_flops(ph['flops'])}")
+            fps = ph.get("flops_per_s", {})
+            if fps.get("count"):
+                ln += (f", p50 {_fmt_flops(fps['p50'])}/s "
+                       f"p90 {_fmt_flops(fps['p90'])}/s")
+            if "mfu" in ph:
+                ln += f", MFU p50 {ph['mfu']['p50']:.1%}"
+            if "mbu" in ph:
+                ln += f", MBU p50 {ph['mbu']['p50']:.1%}"
+            lines.append(ln)
+    items = sorted(rep["tenants"].items())
+    if tenant is not None:
+        items = [(t, b) for t, b in items if t == tenant]
+        if not items:
+            lines.append(f"tenant {tenant!r}: no accounted work")
+    for tid, b in items:
+        ln = (f"  tenant {tid!r}: {b['block_steps']} block-step(s)")
+        if "block_seconds" in b:
+            ln += f" (~{b['block_seconds']}s)"
+        ln += (f", {b['rows']} row(s) "
+               f"({_fmt_flops(b['flops'])}), "
+               f"{b['goodput_rows']} goodput / "
+               f"{b['wasted_rows']} wasted")
+        lines.append(ln)
+    for p in rep["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a CostLedger JSON dump offline")
+    ap.add_argument("ledger")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable envelope "
+                         "(paddle_tpu.report.v1)")
+    ap.add_argument("--tenant", default=None,
+                    help="show only this tenant's bill")
+    ap.add_argument("--max-waste-frac", type=float, default=None,
+                    help="exit 1 when waste/(goodput+waste) exceeds "
+                         "this fraction")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="hardware peak TFLOP/s (enables MFU)")
+    ap.add_argument("--peak-gbps", type=float, default=None,
+                    help="hardware peak HBM GB/s (enables MBU)")
+    ap.add_argument("--step-seconds", type=float, default=None,
+                    help="mean step wall time: converts block-steps "
+                         "to block-seconds in the bill")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.ledger) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"UNREADABLE: {e}")
+        return 2
+    if not isinstance(dump, dict) or dump.get("kind") != "cost_ledger":
+        print("UNREADABLE: not a CostLedger dump "
+              "(expected kind='cost_ledger')")
+        return 2
+
+    rep = analyze(
+        dump,
+        peak_flops=(args.peak_tflops * 1e12
+                    if args.peak_tflops else None),
+        peak_bytes=(args.peak_gbps * 1e9 if args.peak_gbps else None),
+        max_waste_frac=args.max_waste_frac,
+        step_seconds=args.step_seconds)
+    code = 1 if rep["problems"] else 0
+    if args.json:
+        emit_json(envelope("cost_report", code == 0, code, rep,
+                           rep["problems"]))
+    else:
+        print(render(rep, tenant=args.tenant))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

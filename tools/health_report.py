@@ -18,7 +18,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+try:
+    from tools._report import envelope, emit_json
+except ImportError:      # run as a script: tools/ is sys.path[0]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools._report import envelope, emit_json
 
 _MARK = {"ok": " ", "warn": "!", "critical": "X"}
 
@@ -102,6 +110,10 @@ def main(argv=None) -> int:
                     help="show only this tenant's section")
     ap.add_argument("--alerts", action="store_true",
                     help="print every alert in the stream")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable envelope "
+                         "(paddle_tpu.report.v1, shared with "
+                         "trace_report/cost_report)")
     args = ap.parse_args(argv)
 
     try:
@@ -117,9 +129,21 @@ def main(argv=None) -> int:
               "(expected kind='health_monitor' with a 'report')")
         return 2
 
+    critical = dump["report"].get("verdict") == "critical"
+    if args.json:
+        problems = (["overall verdict is critical"] if critical
+                    else [])
+        emit_json(envelope("health_report", not critical,
+                           1 if critical else 0,
+                           {"report": dump["report"],
+                            "alerts": dump.get("alerts", []),
+                            "slo": dump.get("slo", {})},
+                           problems))
+        return 1 if critical else 0
+
     print(render(dump, tenant=args.tenant,
                  show_alerts=args.alerts))
-    return 1 if dump["report"].get("verdict") == "critical" else 0
+    return 1 if critical else 0
 
 
 if __name__ == "__main__":

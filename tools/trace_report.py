@@ -35,7 +35,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+try:
+    from tools._report import envelope, emit_json
+except ImportError:      # run as a script: tools/ is sys.path[0]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools._report import envelope, emit_json
 
 # span names that belong to one engine step (phases) vs wrappers
 _PHASES = ("admission", "prefill", "model", "bookkeeping")
@@ -92,11 +100,12 @@ def validate(trace: dict) -> list:
     return bad
 
 
-def summarize(trace: dict, tenant: str = None,
-              show_requests: bool = False) -> str:
-    evs = trace["traceEvents"]
-    lines = []
-    # -- span rollup --------------------------------------------------
+def _rollup(evs):
+    """ONE aggregation pass over the timeline events, shared by the
+    human renderer (``summarize``) and the machine one
+    (``machine_report``) so the two can never drift: returns
+    (spans {name: (total, count, max)}, counter-track names,
+    instant tallies, replay-flagged span count)."""
     spans = {}
     counters = set()
     insts = {}
@@ -114,6 +123,14 @@ def summarize(trace: dict, tenant: str = None,
             counters.add(ev["name"])
         elif ph == "i":
             insts[ev["name"]] = insts.get(ev["name"], 0) + 1
+    return spans, counters, insts, replayed
+
+
+def summarize(trace: dict, tenant: str = None,
+              show_requests: bool = False) -> str:
+    evs = trace["traceEvents"]
+    lines = []
+    spans, counters, insts, replayed = _rollup(evs)
     lines.append(f"timeline: {len(evs)} event(s), "
                  f"{sum(n for _, n, _ in spans.values())} span(s)"
                  + (f" ({replayed} replay-flagged)" if replayed
@@ -183,6 +200,31 @@ def summarize(trace: dict, tenant: str = None,
     return "\n".join(lines)
 
 
+def machine_report(trace: dict) -> dict:
+    """The ``--json`` payload: span rollups (totals in seconds),
+    instant/counter tallies and the collector metadata summary — the
+    same facts ``summarize`` renders (same ``_rollup`` pass), as
+    data."""
+    spans, counters, insts, replayed = _rollup(trace["traceEvents"])
+    meta = trace.get("metadata")
+    out = {
+        "events": len(trace["traceEvents"]),
+        "spans": {name: {"count": n,
+                         "total_s": round(tot / 1e6, 6),
+                         "max_s": round(mx / 1e6, 6)}
+                  for name, (tot, n, mx) in sorted(spans.items())},
+        "replayed_spans": replayed,
+        "instants": dict(sorted(insts.items())),
+        "gauge_tracks": sorted(counters),
+    }
+    if isinstance(meta, dict) and "summary" in meta:
+        out["steps"] = meta.get("steps")
+        out["replayed_steps"] = meta.get("replayed_steps")
+        out["dropped_events"] = meta.get("dropped_events")
+        out["summary"] = meta["summary"]
+    return out
+
+
 _SLO_METRICS = ("ttft_s", "tpot_s", "queue_wait_s")
 
 
@@ -247,6 +289,10 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", default=None, metavar="TARGETS.json",
                     help="evaluate per-tenant SLO compliance against "
                          "the trace (exit 1 on violation)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable envelope "
+                         "(paddle_tpu.report.v1, shared with "
+                         "health_report/cost_report)")
     args = ap.parse_args(argv)
 
     try:
@@ -261,14 +307,17 @@ def main(argv=None) -> int:
 
     problems = validate(trace)
     if problems:
-        print(f"INVALID trace ({len(problems)} problem(s)):")
-        for p in problems:
-            print(f"  - {p}")
+        if args.json:
+            emit_json(envelope("trace_report", False, 1,
+                               {"events": 0}, problems))
+        else:
+            print(f"INVALID trace ({len(problems)} problem(s)):")
+            for p in problems:
+                print(f"  - {p}")
         return 1
 
-    print(f"trace {args.trace}: valid trace_events JSON")
-    print(summarize(trace, tenant=args.tenant,
-                    show_requests=args.requests))
+    slo_result = None
+    slo_problems: list = []
     if args.slo is not None:
         try:
             with open(args.slo) as f:
@@ -279,11 +328,28 @@ def main(argv=None) -> int:
         if not isinstance(targets, dict):
             print("UNREADABLE targets: top level is not a JSON object")
             return 2
-        lines, ok = slo_check(trace, targets)
+        slo_lines, slo_ok = slo_check(trace, targets)
+        slo_result = {"ok": slo_ok, "lines": slo_lines}
+        if not slo_ok:
+            slo_problems.append("SLO violation (see data.slo.lines)")
+
+    if args.json:
+        data = machine_report(trace)
+        if slo_result is not None:
+            data["slo"] = slo_result
+        code = 1 if slo_problems else 0
+        emit_json(envelope("trace_report", code == 0, code, data,
+                           slo_problems))
+        return code
+
+    print(f"trace {args.trace}: valid trace_events JSON")
+    print(summarize(trace, tenant=args.tenant,
+                    show_requests=args.requests))
+    if slo_result is not None:
         print("SLO evaluation:")
-        for ln in lines:
+        for ln in slo_result["lines"]:
             print(f"  {ln}")
-        if not ok:
+        if not slo_result["ok"]:
             return 1
     return 0
 
