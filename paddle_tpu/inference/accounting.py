@@ -124,24 +124,36 @@ class WorkModel:
     subtractable on rollback."""
 
     __slots__ = ("num_layers", "d_model", "ffn_dim", "itemsize",
-                 "kv_token_bytes", "weight_bytes", "_row_linear")
+                 "weight_itemsize", "kv_token_bytes", "weight_bytes",
+                 "_row_linear")
 
     def __init__(self, num_layers: int, d_model: int, ffn_dim: int,
                  kv_token_bytes: Optional[int] = None,
-                 itemsize: int = 4):
+                 itemsize: int = 4,
+                 weight_itemsize: Optional[int] = None):
         self.num_layers = int(num_layers)
         self.d_model = int(d_model)
         self.ffn_dim = int(ffn_dim)
         self.itemsize = int(itemsize)
+        # int8-weight serving streams 1-byte weights (w8a16): a
+        # distinct weight itemsize keeps MBU honest there — pricing an
+        # int8 pass at 4-byte traffic would overstate MBU ~4x, the
+        # same lie a stale bf16 KV byte model tells on int8 pools
+        self.weight_itemsize = (self.itemsize if weight_itemsize is None
+                                else int(weight_itemsize))
         L, d, f = self.num_layers, self.d_model, self.ffn_dim
-        # K + V, all heads (num_heads * head_dim == d), every layer
+        # K + V, all heads (num_heads * head_dim == d), every layer.
+        # Callers with a real pool pass kv_token_bytes from
+        # PagedKVCache.kv_bytes_per_token() — which on int8 pools
+        # counts 1-byte payload + per-row scale bytes, so the analytic
+        # KV traffic follows the pool's actual density
         self.kv_token_bytes = (int(kv_token_bytes)
                                if kv_token_bytes is not None
                                else 2 * d * self.itemsize * L)
         # qkv [d,3d]+[3d], out [d,d]+[d], ffn1 [d,f]+[f], ffn2 [f,d]+
         # [d], two LayerNorms [2d] each — the bytes one model call
         # streams through the weights once
-        self.weight_bytes = L * self.itemsize * (
+        self.weight_bytes = L * self.weight_itemsize * (
             4 * d * d + 2 * d * f + 9 * d + f)
         # position-independent FLOPs of one row: the four projections
         # (2*m*n per matmul row)
@@ -149,13 +161,15 @@ class WorkModel:
 
     @classmethod
     def for_model(cls, model, itemsize: int = 4,
-                  kv_token_bytes: Optional[int] = None) -> "WorkModel":
+                  kv_token_bytes: Optional[int] = None,
+                  weight_itemsize: Optional[int] = None) -> "WorkModel":
         """Build from a FusedMultiTransformer-protocol core (or a
         TokenServingModel wrapping one)."""
         core = getattr(model, "core", model)
         return cls(core.num_layers, core.embed_dim,
                    int(core.layers[0].ffn1.weight.shape[1]),
-                   kv_token_bytes=kv_token_bytes, itemsize=itemsize)
+                   kv_token_bytes=kv_token_bytes, itemsize=itemsize,
+                   weight_itemsize=weight_itemsize)
 
     # -- FLOPs --------------------------------------------------------
     def row_flops(self, pos: int) -> int:
@@ -190,6 +204,7 @@ class WorkModel:
                 "ffn_dim": self.ffn_dim,
                 "kv_token_bytes": self.kv_token_bytes,
                 "weight_bytes": self.weight_bytes,
+                "weight_itemsize": self.weight_itemsize,
                 "row_linear_flops": self._row_linear}
 
 

@@ -36,6 +36,22 @@ reclaimed least-recently-used only when the free list runs dry. Block
 lifecycle: free -> active -> cached-free -> (resurrect -> active |
 reclaim -> free).
 
+QUANTIZED SERVING (``dtype="int8"``): K/V pages store int8 payload
+with per-(position, head) float32 scales in per-block metadata
+arrays (``scales``) that ride next to the pools — quantized at
+page-write time inside every append op, dequantized on every read
+(in-register on the ragged kernel's scalar-prefetch path, inside
+``gather_pages`` on the jnp fallbacks). ~1.88x KV density vs bf16 at
+head_dim 64 (4/head_dim scale overhead), which at a fixed HBM budget
+~1.88x's the block pool and therefore admission concurrency. The
+whole page lifecycle below — COW fork, prefix-hash sharing,
+cached-free resurrection, quarantine, tenant charge,
+snapshot/restore — operates on quantized payloads unchanged: scales
+move with their page through COW copies and snapshots, the deep
+audit fingerprints payload + scales, and because each position's
+quantized bytes are a pure function of that token's K/V (see
+``_quant_rows``), prefix adoption of a quantized page is EXACT.
+
 CRASH RECOVERY (``snapshot``/``restore``): because every block is
 content-addressed by its chain hash, a pool checkpoint is "serialize
 the live + cached-free pages plus the allocator's exact state"
@@ -224,6 +240,38 @@ class BlockAllocator:
         self.refcount[block] = 1
 
 
+# --- int8 KV quantization (``dtype="int8"`` pools) --------------------
+# Symmetric per-position-per-head scales: each written K/V row
+# quantizes over its head_dim with its own scale, stored in the pool's
+# per-block scale metadata [num_blocks, 2, heads, block_size]. Row
+# granularity (not one scalar per block) is load-bearing twice over:
+# (1) appends into a partially-filled block never re-quantize earlier
+# positions — no read-modify-write on the hot append path, and shared
+# / hash-indexed pages stay immutable (the deep audit's contract);
+# (2) a position's quantized bytes are a pure function of that
+# token's K/V — which chunking cannot change (per-row invariance of
+# multi-row calls, the established chunked-prefill contract) — so the
+# int8 payload + scales of a full block are a deterministic function
+# of the prefix token stream, and prefix-hash adoption of a quantized
+# page is EXACT (the adopter shares the very bytes it would have
+# written). Scale overhead: 4 bytes per (position, head, K|V) next to
+# head_dim int8 payload bytes — 4/head_dim relative (6.25% at
+# head_dim 64), leaving ~1.88x density vs bf16 pools.
+
+KV_QMAX = 127.0
+
+
+def _quant_rows(x):
+    """x [..., D] float -> (int8 payload [..., D], float32 scale
+    [...]): symmetric round-to-nearest at amax/127 per row. All-zero
+    rows quantize to zeros with scale 0 (dequantizes to exact 0)."""
+    x = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x), axis=-1) / KV_QMAX
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-30)[..., None]),
+                 -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return q, scale
+
+
 # --- per-op impls at module scope: the factory closures carry only ----
 # --- hashable ints, so framework/op.py's executable cache hits --------
 
@@ -258,8 +306,83 @@ def _make_append_multi(block_size, n_tokens):
     return paged_cache_kv_multi
 
 
+def _make_append_q(block_size):
+    def paged_cache_kv_q(pool, scales, k, v, t, bt):
+        # quantized twin of paged_cache_kv: the int8 payload and the
+        # per-(position, head) scale scatter through the same routing
+        blk = jnp.take_along_axis(bt, (t // block_size)[:, None],
+                                  axis=1)[:, 0]
+        off = t % block_size
+        kq, ks = _quant_rows(k[:, 0])
+        vq, vs = _quant_rows(v[:, 0])
+        pool = pool.at[blk, 0, :, off, :].set(kq)
+        pool = pool.at[blk, 1, :, off, :].set(vq)
+        scales = scales.at[blk, 0, :, off].set(ks)
+        scales = scales.at[blk, 1, :, off].set(vs)
+        return pool, scales
+    return paged_cache_kv_q
+
+
+def _make_append_multi_q(block_size, n_tokens):
+    def paged_cache_kv_multi_q(pool, scales, k, v, t, bt):
+        pos = t[:, None] + jnp.arange(n_tokens, dtype=t.dtype)[None, :]
+        blk = jnp.take_along_axis(bt, pos // block_size, axis=1)
+        off = pos % block_size                        # [B, L]
+        kq, ks = _quant_rows(k)                 # [B, L, H, D], [B, L, H]
+        vq, vs = _quant_rows(v)
+        pool = pool.at[blk, 0, :, off, :].set(kq)
+        pool = pool.at[blk, 1, :, off, :].set(vq)
+        scales = scales.at[blk, 0, :, off].set(ks)
+        scales = scales.at[blk, 1, :, off].set(vs)
+        return pool, scales
+    return paged_cache_kv_multi_q
+
+
+def _make_append_chunk_q(block_size, n_tokens):
+    def paged_prefill_chunk_kv_q(pool, scales, k, v, t, bt, ws):
+        # quantized twin of paged_prefill_chunk_kv: adopted-prefix
+        # positions (< ws) route payload AND scale to the trash block
+        pos = t[:, None] + jnp.arange(n_tokens, dtype=t.dtype)[None, :]
+        blk = jnp.take_along_axis(bt, pos // block_size, axis=1)
+        blk = jnp.where(pos >= ws, blk, 0)
+        off = pos % block_size                        # [1, C]
+        kq, ks = _quant_rows(k)
+        vq, vs = _quant_rows(v)
+        pool = pool.at[blk, 0, :, off, :].set(kq)
+        pool = pool.at[blk, 1, :, off, :].set(vq)
+        scales = scales.at[blk, 0, :, off].set(ks)
+        scales = scales.at[blk, 1, :, off].set(vs)
+        return pool, scales
+    return paged_prefill_chunk_kv_q
+
+
+def _make_prefill_scatter_q(start_block, n_blocks, block_size):
+    def paged_prefill_scatter_q(pool, scales, row_cache, blks):
+        lo = start_block * block_size
+        seg = row_cache[:, 0, :, lo:lo + n_blocks * block_size, :]
+        two, H, _, D = seg.shape
+        seg = seg.reshape(two, H, n_blocks, block_size, D)
+        seg = jnp.transpose(seg, (2, 0, 1, 3, 4))  # [n, 2, H, bs, D]
+        q, s = _quant_rows(seg)
+        return pool.at[blks].set(q), scales.at[blks].set(s)
+    return paged_prefill_scatter_q
+
+
+def _ragged_append_q(pool, scales, k, v, blk, off):
+    # quantized twin of _ragged_append (packed mixed-batch scatter)
+    kq, ks = _quant_rows(k[0])
+    vq, vs = _quant_rows(v[0])
+    pool = pool.at[blk, 0, :, off, :].set(kq)
+    pool = pool.at[blk, 1, :, off, :].set(vq)
+    scales = scales.at[blk, 0, :, off].set(ks)
+    scales = scales.at[blk, 1, :, off].set(vs)
+    return pool, scales
+
+
 def _block_copy(pool, src, dst):
-    # copy-on-write split: pool[dst[i]] = pool[src[i]]
+    # copy-on-write split: pool[dst[i]] = pool[src[i]] (shared by the
+    # payload pools AND, on quantized pools, the scale arrays — a COW
+    # split must move the page's scales with its bytes)
     return pool.at[dst].set(pool[src])
 
 
@@ -312,6 +435,12 @@ class PagedLayerCache:
         return self._cache.pools[self._layer]
 
     @property
+    def kv_scales(self) -> Optional[Tensor]:
+        """Per-page dequantization scales (int8 pools), else None."""
+        c = self._cache
+        return c.scales[self._layer] if c.quantized else None
+
+    @property
     def shape(self):
         return self.pool.shape
 
@@ -360,7 +489,16 @@ class PagedLayerCache:
                         f"ensure(row, position+{L}) first")
         bt = c.bt_tensor()
         tt = Tensor(t)
-        if L == 1:
+        new_sc = None
+        if c.quantized:
+            impl = (_make_append_q(c.block_size) if L == 1
+                    else _make_append_multi_q(c.block_size, L))
+            new_pool, new_sc = apply(
+                impl, (self.pool, self.kv_scales, k, v, tt, bt),
+                op_name="paged_cache_kv_q" if L == 1
+                else "paged_cache_kv_multi_q")
+            c.scales[self._layer] = new_sc
+        elif L == 1:
             new_pool = apply(_make_append(c.block_size),
                              (self.pool, k, v, tt, bt),
                              op_name="paged_cache_kv")
@@ -371,6 +509,25 @@ class PagedLayerCache:
         c.pools[self._layer] = new_pool
 
         if use_kernel:
+            if c.quantized:
+                if L == 1:
+                    def dec_q(p, sc, q_, tv, bta):
+                        from ..ops.pallas.paged_attention import \
+                            paged_attention
+                        return paged_attention(q_[:, 0], p, bta,
+                                               tv + 1,
+                                               kv_scales=sc)[:, None]
+                    return apply(dec_q, (new_pool, new_sc, q, tt, bt),
+                                 op_name="paged_attention_q")
+
+                def dec_multi_q(p, sc, q_, tv, bta):
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention_multi
+                    return paged_attention_multi(q_, p, bta, tv + L,
+                                                 kv_scales=sc)
+                return apply(dec_multi_q,
+                             (new_pool, new_sc, q, tt, bt),
+                             op_name="paged_attention_multi_q")
             if L == 1:
                 def dec(p, q_, tv, bta):
                     from ..ops.pallas.paged_attention import \
@@ -388,7 +545,8 @@ class PagedLayerCache:
                          op_name="paged_attention_multi")
 
         # CPU / fallback: gather pages dense (the kernel module's
-        # gather, so both paths share one layout definition), then
+        # gather, so both paths share one layout definition —
+        # quantized pools dequantize inside the gather), then
         # mirror the dense ragged decode branch (same mask, same sdpa
         # op executable). For L > 1 the L axis FOLDS INTO THE BATCH
         # axis (virtual rows [b*L+i] share slot b's pages, query i at
@@ -400,7 +558,9 @@ class PagedLayerCache:
         # lowering trap as scheduler.MIN_PREFILL_SUFFIX_ROWS.
         from ..nn import functional as F
         from ..ops.pallas.paged_attention import gather_pages
-        k_full, v_full = apply(gather_pages, (new_pool, bt),
+        gargs = (new_pool, bt) if new_sc is None \
+            else (new_pool, bt, new_sc)
+        k_full, v_full = apply(gather_pages, gargs,
                                op_name="paged_gather")
         S = k_full.shape[1]
         if L == 1:
@@ -467,6 +627,11 @@ class PagedPrefillView:
         return self._cache.pools[self._layer]
 
     @property
+    def kv_scales(self) -> Optional[Tensor]:
+        c = self._cache
+        return c.scales[self._layer] if c.quantized else None
+
+    @property
     def shape(self):
         return self.pool.shape
 
@@ -495,12 +660,29 @@ class PagedPrefillView:
         bt = c.bt_row_tensor(self._slot)
         tt = Tensor(t)
         ws = Tensor(jnp.asarray([self._write_start], jnp.int32))
-        new_pool = apply(_make_append_chunk(c.block_size, C),
-                         (self.pool, k, v, tt, bt, ws),
-                         op_name="paged_prefill_chunk_kv")
+        new_sc = None
+        if c.quantized:
+            new_pool, new_sc = apply(
+                _make_append_chunk_q(c.block_size, C),
+                (self.pool, self.kv_scales, k, v, tt, bt, ws),
+                op_name="paged_prefill_chunk_kv_q")
+            c.scales[self._layer] = new_sc
+        else:
+            new_pool = apply(_make_append_chunk(c.block_size, C),
+                             (self.pool, k, v, tt, bt, ws),
+                             op_name="paged_prefill_chunk_kv")
         c.pools[self._layer] = new_pool
 
         if use_kernel:
+            if c.quantized:
+                def att_q(p, sc, q_, tv, bta):
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention_prefill
+                    return paged_attention_prefill(q_, p, bta, tv,
+                                                   kv_scales=sc)
+                return apply(att_q, (new_pool, new_sc, q, tt, bt),
+                             op_name="paged_attention_prefill_q")
+
             def att(p, q_, tv, bta):
                 from ..ops.pallas.paged_attention import \
                     paged_attention_prefill
@@ -513,7 +695,9 @@ class PagedPrefillView:
         # mask mirrors the dense prefill branch's construction)
         from ..nn import functional as F
         from ..ops.pallas.paged_attention import gather_pages
-        k_full, v_full = apply(gather_pages, (new_pool, bt),
+        gargs = (new_pool, bt) if new_sc is None \
+            else (new_pool, bt, new_sc)
+        k_full, v_full = apply(gather_pages, gargs,
                                op_name="paged_gather")
         S = k_full.shape[1]
         qpos = t[0] + jnp.arange(C)[:, None]
@@ -582,22 +766,38 @@ class _RaggedLayout:
                 lo += length
             elif kind == "decode":
                 _, lens, L = seg
-                if L != 1:
-                    raise ValueError(
-                        "ragged decode segments carry one query row "
-                        "per slot (the multi-query verify path rides "
-                        "paged_attention_multi)")
+                if L < 1:
+                    raise ValueError("decode segments carry >= 1 "
+                                     "query row per slot")
                 lens = np.asarray(lens, np.int64)
                 B = lens.shape[0]
-                b = masked_tbl[np.arange(B), lens // bs]
-                blk.append(b)
-                off.append(lens % bs)
-                q_lens.extend([1] * B)
-                kv_lens.extend((lens + 1).tolist())
+                # masked rows (mid-prefill / fresh slots riding along
+                # at their real lens) may sit at page capacity: clamp
+                # their table column — they present all-trash rows, so
+                # any in-range column lands the write in block 0, and
+                # covered (unmasked) rows are never clamped
+                cols = masked_tbl.shape[1]
+                if L == 1:
+                    b = masked_tbl[np.arange(B),
+                                   np.minimum(lens // bs, cols - 1)]
+                    blk.append(b)
+                    off.append(lens % bs)
+                else:
+                    # multi-query verify rows: slot b's L tokens land
+                    # at positions lens[b] .. lens[b]+L-1 through the
+                    # DECODE-MASKED table (masked rows write trash),
+                    # packed row-major as [b*L + i]
+                    pos = lens[:, None] + np.arange(L)[None, :]
+                    b = masked_tbl[np.arange(B)[:, None],
+                                   np.minimum(pos // bs, cols - 1)]
+                    blk.append(b.reshape(-1))
+                    off.append((pos % bs).reshape(-1))
+                q_lens.extend([L] * B)
+                kv_lens.extend((lens + L).tolist())
                 bt_rows.extend(masked_tbl)
-                self.segs.append(("decode", lo, lo + B,
-                                  lens.astype(np.int32)))
-                lo += B
+                self.segs.append(("decode", lo, lo + B * L,
+                                  lens.astype(np.int32), L))
+                lo += B * L
             else:
                 raise ValueError(f"unknown ragged segment kind {kind!r}")
         self.total_rows = lo
@@ -647,6 +847,11 @@ class PagedRaggedView:
         return self._cache.pools[self._layer]
 
     @property
+    def kv_scales(self) -> Optional[Tensor]:
+        c = self._cache
+        return c.scales[self._layer] if c.quantized else None
+
+    @property
     def shape(self):
         return self.pool.shape
 
@@ -662,14 +867,33 @@ class PagedRaggedView:
             raise ValueError(
                 f"ragged call expects [1, {lay.total_rows}, H, D], "
                 f"got {tuple(q.shape)}")
-        new_pool = apply(_ragged_append,
-                         (self.pool, k, v, lay.blk, lay.off),
-                         op_name="paged_ragged_append")
+        new_sc = None
+        if c.quantized:
+            new_pool, new_sc = apply(
+                _ragged_append_q,
+                (self.pool, self.kv_scales, k, v, lay.blk, lay.off),
+                op_name="paged_ragged_append_q")
+            c.scales[self._layer] = new_sc
+        else:
+            new_pool = apply(_ragged_append,
+                             (self.pool, k, v, lay.blk, lay.off),
+                             op_name="paged_ragged_append")
         c.pools[self._layer] = new_pool
 
         if use_kernel:
             q_lens, tile_q, tile_kv = (lay.q_lens, lay.tile_q,
                                        lay.tile_kv)
+
+            if c.quantized:
+                def att_q(p, sc, q_, kvl, bts):
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention_ragged
+                    return paged_attention_ragged(
+                        q_[0], p, bts, q_lens, kvl, tile_q=tile_q,
+                        tile_kv=tile_kv, kv_scales=sc)[None]
+                return apply(att_q, (new_pool, new_sc, q, lay.kv_lens,
+                                     lay.bt_all),
+                             op_name="paged_attention_ragged_q")
 
             def att(p, q_, kvl, bts):
                 from ..ops.pallas.paged_attention import \
@@ -692,7 +916,9 @@ class PagedRaggedView:
                 C = hi - lo
                 qs = Tensor(q.data[:, lo:hi])
                 bt = c.bt_row_tensor(slot)
-                k_full, v_full = apply(gather_pages, (new_pool, bt),
+                gargs = (new_pool, bt) if new_sc is None \
+                    else (new_pool, bt, new_sc)
+                k_full, v_full = apply(gather_pages, gargs,
                                        op_name="paged_gather")
                 S = k_full.shape[1]
                 qpos = start + jnp.arange(C)[:, None]
@@ -703,22 +929,46 @@ class PagedRaggedView:
                     qs, k_full, v_full, attn_mask=mask)
                 outs.append(out.data[0])
             else:
-                lens = seg[3]
-                B = hi - lo
-                qd = Tensor(q.data[0, lo:hi][:, None])   # [B, 1, H, D]
+                lens, L = seg[3], seg[4]
                 bt = c.bt_tensor()
-                k_full, v_full = apply(gather_pages, (new_pool, bt),
+                gargs = (new_pool, bt) if new_sc is None \
+                    else (new_pool, bt, new_sc)
+                k_full, v_full = apply(gather_pages, gargs,
                                        op_name="paged_gather")
                 S = k_full.shape[1]
-                tj = jnp.asarray(lens, jnp.int32)
-                qpos = (tj[:, None, None, None]
-                        + jnp.arange(1)[None, None, :, None])
-                kpos = jnp.arange(S)[None, None, None, :]
-                mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
-                              .astype(jnp.float32))
-                out = F.scaled_dot_product_attention(
-                    qd, k_full, v_full, attn_mask=mask)
-                outs.append(out.data[:, 0])
+                if L == 1:
+                    B = hi - lo
+                    qd = Tensor(q.data[0, lo:hi][:, None])  # [B,1,H,D]
+                    tj = jnp.asarray(lens, jnp.int32)
+                    qpos = (tj[:, None, None, None]
+                            + jnp.arange(1)[None, None, :, None])
+                    kpos = jnp.arange(S)[None, None, None, :]
+                    mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                                  .astype(jnp.float32))
+                    out = F.scaled_dot_product_attention(
+                        qd, k_full, v_full, attn_mask=mask)
+                    outs.append(out.data[:, 0])
+                else:
+                    # multi-query verify rows: fold the L axis into
+                    # the batch axis, exactly the PagedLayerCache
+                    # L > 1 fallback — same q-length-1 sdpa
+                    # executable, so a packed verify stays
+                    # bit-identical to the per-phase step_multi call
+                    B = (hi - lo) // L
+                    qd = Tensor(q.data[0, lo:hi][:, None])  # [B*L,1,..]
+                    kf = Tensor(jnp.repeat(k_full.data, L, axis=0))
+                    vf = Tensor(jnp.repeat(v_full.data, L, axis=0))
+                    tj = jnp.asarray(lens, jnp.int32)
+                    tf = (jnp.repeat(tj, L)
+                          + jnp.tile(jnp.arange(L, dtype=jnp.int32),
+                                     B))
+                    qpos = tf[:, None, None, None]
+                    kpos = jnp.arange(S)[None, None, None, :]
+                    mask = Tensor(jnp.where(kpos <= qpos, 0.0, -1e30)
+                                  .astype(jnp.float32))
+                    out = F.scaled_dot_product_attention(
+                        qd, kf, vf, attn_mask=mask)
+                    outs.append(out.data[:, 0])
         return Tensor(jnp.concatenate(outs, axis=0)[None])
 
 
@@ -743,6 +993,19 @@ class PagedKVCache:
             max_blocks_per_seq = self.num_blocks - 1
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.dtype = dtype
+        # QUANTIZED POOLS (``dtype="int8"``): payload pages hold int8
+        # and every page carries per-(position, head) dequantization
+        # scales in ``self.scales`` — allocator metadata that moves
+        # with the page through COW copies, snapshots and restores.
+        # Quantization happens at page-write time inside the append
+        # ops (_make_append_q and friends); every read path
+        # dequantizes (the ragged kernel in-register via scalar
+        # prefetch, the jnp fallbacks inside gather_pages). See the
+        # module-level note above _quant_rows for why scales are
+        # per-row: it is what keeps the quantized payload a pure
+        # function of the token stream, so chunking cannot change the
+        # bytes and prefix-hash adoption stays exact.
+        self.quantized = (str(dtype) == "int8")
         self.prefix_cache = bool(prefix_cache)
         # chained-hash block index (prefix caching): both maps stay in
         # lockstep — a block is indexed iff hash_to_block[h] == b and
@@ -767,6 +1030,13 @@ class PagedKVCache:
             paddle.zeros([self.num_blocks, 2, self.num_heads,
                           self.block_size, self.head_dim], dtype=dtype)
             for _ in range(self.num_layers)]
+        # per-page dequantization scales (int8 pools only):
+        # [num_blocks, 2, heads, block_size] float32 per layer —
+        # zero-init dequantizes to exact zeros, matching a zeroed pool
+        self.scales: Optional[List[Tensor]] = [
+            paddle.zeros([self.num_blocks, 2, self.num_heads,
+                          self.block_size], dtype="float32")
+            for _ in range(self.num_layers)] if self.quantized else None
         # all entries at the trash block until allocated
         self.block_tables = np.zeros(
             (self.max_seqs, self.max_blocks_per_seq), np.int32)
@@ -821,17 +1091,26 @@ class PagedKVCache:
 
     def pool_bytes(self) -> int:
         # itemsize off the array's own dtype: np.dtype(str(...)) has no
-        # parse for ml_dtypes names, so a bfloat16 pool would raise
-        return sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
-                   for p in self.pools)
+        # parse for ml_dtypes names, so a bfloat16 pool would raise.
+        # Quantized pools count the scale metadata too — the honest
+        # byte model (a stale bf16 model would overstate density ~2x)
+        n = sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
+                for p in self.pools)
+        if self.quantized:
+            n += sum(int(np.prod(s.shape)) * s.data.dtype.itemsize
+                     for s in self.scales)
+        return n
 
     def kv_bytes_per_token(self) -> int:
         """HBM bytes one token's K/V occupies across every layer
-        (2 x heads x head_dim x itemsize x layers) — the KV-traffic
-        unit of the analytic work model (inference/accounting.py)."""
-        return int(2 * self.num_heads * self.head_dim
-                   * self.pools[0].data.dtype.itemsize
-                   * self.num_layers)
+        (2 x heads x (head_dim x payload itemsize + scale bytes) x
+        layers) — the KV-traffic unit of the analytic work model
+        (inference/accounting.py); int8 pools carry 4 scale bytes per
+        (position, head, K|V) next to the int8 payload."""
+        per_head = self.head_dim * self.pools[0].data.dtype.itemsize
+        if self.quantized:
+            per_head += self.scales[0].data.dtype.itemsize
+        return int(2 * self.num_heads * per_head * self.num_layers)
 
     # -- tenant accounting --------------------------------------------
     def _charge(self, slot: int, delta: int) -> None:
@@ -1058,8 +1337,13 @@ class PagedKVCache:
                     del self._audit_fp[b]
             if frozen:
                 # ONE device->host pull per pool, shared by every
-                # fingerprint (not one whole-pool copy per block)
+                # fingerprint (not one whole-pool copy per block).
+                # Quantized pools fingerprint the int8 payload AND the
+                # scale pages — an in-place scale rewrite corrupts a
+                # shared page as surely as a payload write
                 arrs = [np.asarray(p.numpy()) for p in self.pools]
+                if self.quantized:
+                    arrs += [np.asarray(s.numpy()) for s in self.scales]
                 for b in frozen:
                     fp = self._fingerprint(b, arrs)
                     old = self._audit_fp.get(b)
@@ -1095,6 +1379,21 @@ class PagedKVCache:
             payload = np.zeros((0, self.num_layers, 2, self.num_heads,
                                 self.block_size, self.head_dim),
                                arrs[0].dtype)
+        scale_payload = None
+        if self.quantized:
+            # content-addressing over QUANTIZED bytes: the snapshot
+            # carries each kept page's int8 payload plus its scales —
+            # together they ARE the page's content, so a restore (same
+            # or different geometry) reproduces dequantized values
+            # bit-exactly
+            sarrs = [np.asarray(s.numpy()) for s in self.scales]
+            if keep:
+                scale_payload = np.stack([a[keep] for a in sarrs],
+                                         axis=1)   # [n, L, 2, H, bs]
+            else:
+                scale_payload = np.zeros(
+                    (0, self.num_layers, 2, self.num_heads,
+                     self.block_size), np.float32)
         return {
             "kind": "paged_kv_cache",
             "geometry": {
@@ -1119,6 +1418,8 @@ class PagedKVCache:
             "peak_blocks_used": int(self.peak_blocks_used),
             "blocks": [int(b) for b in keep],
             "payload": payload,
+            **({"scale_payload": scale_payload}
+               if scale_payload is not None else {}),
         }
 
     @classmethod
@@ -1209,6 +1510,12 @@ class PagedKVCache:
                 cache.pools[i] = Tensor(
                     cache.pools[i].data.at[ids].set(
                         seg.astype(cache.pools[i].data.dtype)))
+            if cache.quantized:
+                spay = np.asarray(snap["scale_payload"])[rows]
+                for i in range(cache.num_layers):
+                    cache.scales[i] = Tensor(
+                        cache.scales[i].data.at[ids].set(
+                            jnp.asarray(spay[:, i], jnp.float32)))
         cache.peak_blocks_used = int(snap["peak_blocks_used"])
         cache._tables_dirty()
         cache.check_invariants(deep=True)
@@ -1377,6 +1684,14 @@ class PagedKVCache:
             for i, pool in enumerate(self.pools):
                 self.pools[i] = apply(_block_copy, (pool, src, dst),
                                       op_name="paged_block_copy")
+            if self.quantized:
+                # the page's scales are part of its content: a COW
+                # split that copied only the int8 payload would
+                # dequantize the private copy through stale scales
+                for i, sc in enumerate(self.scales):
+                    self.scales[i] = apply(
+                        _block_copy, (sc, src, dst),
+                        op_name="paged_block_copy_scales")
         self.release_to_cache([old])
         self.seq_blocks[slot][bpos] = new
         self.block_tables[slot, bpos] = new
@@ -1510,10 +1825,17 @@ class PagedKVCache:
         tt = Tensor(jnp.asarray([start], jnp.int32))
         ws = Tensor(jnp.asarray([write_start], jnp.int32))
         bt = self.bt_row_tensor(slot)
-        self.pools[layer] = apply(
-            _make_append_chunk(self.block_size, C),
-            (self.pools[layer], k, v, tt, bt, ws),
-            op_name="paged_prefill_chunk_kv")
+        if self.quantized:
+            self.pools[layer], self.scales[layer] = apply(
+                _make_append_chunk_q(self.block_size, C),
+                (self.pools[layer], self.scales[layer], k, v, tt, bt,
+                 ws),
+                op_name="paged_prefill_chunk_kv_q")
+        else:
+            self.pools[layer] = apply(
+                _make_append_chunk(self.block_size, C),
+                (self.pools[layer], k, v, tt, bt, ws),
+                op_name="paged_prefill_chunk_kv")
 
     def write_prefill(self, slot: int, row_caches, length: int,
                       start_block: int = 0) -> None:
@@ -1537,6 +1859,15 @@ class PagedKVCache:
             return  # fully cached prompt: every page already written
         blks = Tensor(jnp.asarray(self.seq_blocks[slot][start_block:n],
                                   jnp.int32))
+        if self.quantized:
+            impl_q = _make_prefill_scatter_q(start_block,
+                                             n - start_block,
+                                             self.block_size)
+            for i, rc in enumerate(row_caches):
+                self.pools[i], self.scales[i] = apply(
+                    impl_q, (self.pools[i], self.scales[i], rc, blks),
+                    op_name="paged_prefill_scatter_q")
+            return
         impl = _make_prefill_scatter(start_block, n - start_block,
                                      self.block_size)
         for i, (pool, rc) in enumerate(zip(self.pools, row_caches)):

@@ -44,6 +44,15 @@ BLOCK BUDGET, not slots*max_len. Scheduling policy (vLLM-style):
     behavior as the old scratch path, still scratchless inside.
     Chunk accounting rides in ``prefill_stats`` (PrefillStats).
 
+  * quantized serving (``dtype="int8"``): the pool stores int8 K/V
+    pages with per-row scales (paged_cache.py "QUANTIZED SERVING") —
+    ~1.88x the blocks at equal HBM, so the block-budget admission
+    above admits ~1.88x the concurrent requests. Scheduling is
+    completely dtype-blind: admission, growth, preemption, prefix
+    adoption, quotas and snapshots all operate on block counts and
+    quantized payloads unchanged. Off by default (bit-identity
+    suites run on fp pools).
+
   * failure isolation (inference/resilience.py): requests end in a
     terminal ``RequestOutcome`` — FINISHED, or FAILED_OOM /
     FAILED_NUMERIC / FAILED_DEADLINE / REJECTED_ADMISSION — surfaced
@@ -160,16 +169,26 @@ class Tenant:
                        often under contention.
       vtime            the WFQ virtual-time tag (scheduler state —
                        snapshots round-trip it).
+      fifo             this tenant's FIFO SUB-QUEUE (the physical
+                       queue is sharded per tenant, so WFQ head
+                       selection reads one deque head per tenant —
+                       O(tenants) — instead of scanning one global
+                       queue per admission, O(queue)). Within a
+                       tenant the order is the same global contract
+                       as before: preempted requests (by rid) ahead
+                       of never-admitted ones (by enqueue order).
+                       ``PagedServingEngine.queue`` materializes the
+                       merged global view for snapshots/diagnostics.
       queued           live count of this tenant's queued requests
-                       (gauge maintained at every queue mutation and
-                       audited by check_invariants; derived state, so
-                       restore recomputes it from the queue instead of
-                       round-tripping it).
+                       (== len(fifo); gauge maintained at every queue
+                       mutation and audited by check_invariants;
+                       derived state, so restore recomputes it from
+                       the queue instead of round-tripping it).
       stats            TenantStats (serving.py).
     """
 
     __slots__ = ("tid", "quota_blocks", "reserved_blocks", "weight",
-                 "vtime", "queued", "stats")
+                 "vtime", "fifo", "queued", "stats")
 
     def __init__(self, tid: str, quota_blocks: Optional[int] = None,
                  reserved_blocks: int = 0, weight: float = 1.0):
@@ -188,6 +207,7 @@ class Tenant:
         self.reserved_blocks = int(reserved_blocks)
         self.weight = float(weight)
         self.vtime = 0.0
+        self.fifo: Deque[PagedRequest] = deque()
         self.queued = 0
         self.stats = TenantStats()
 
@@ -294,6 +314,9 @@ class PagedRequest:
         self._hashes: List[bytes] = []
         self.slot: Optional[int] = None
         self.admit_seq = -1
+        # global FIFO position among never-admitted requests (the
+        # per-tenant sub-queues merge by it — see _queue_key)
+        self.enqueue_seq = -1
         self.preemptions = 0
         # multi-tenant isolation: which tenant's quota/weight/floor
         # govern this request (set by submit; DEFAULT_TENANT when the
@@ -500,7 +523,14 @@ class PagedServingEngine:
         self._prefills: Dict[int, dict] = {}
         self._requests: List[Optional[PagedRequest]] = \
             [None] * self.max_batch
-        self.queue: Deque[PagedRequest] = deque()
+        # the physical queue lives SHARDED in the tenants' FIFO
+        # sub-queues (Tenant.fifo) so the WFQ admission pass touches
+        # one deque head per tenant; ``queue`` (property below) merges
+        # them back into the legacy global order for snapshots,
+        # deadline scans and diagnostics. _queue_len is the O(1)
+        # depth gauge the hot paths read.
+        self._queue_len = 0
+        self._next_enqueue_seq = 0
         # decode inputs not yet attributed to request histories:
         # (x, active-mask) per step, materialized to host lazily so the
         # hot decode loop never pays a device->host sync for the
@@ -578,6 +608,7 @@ class PagedServingEngine:
                      reserved_blocks=reserved_blocks, weight=weight)
         if existing is not None:
             ten.vtime = existing.vtime
+            ten.fifo = existing.fifo
             ten.queued = existing.queued
             ten.stats = existing.stats
         total_reserved = ten.reserved_blocks + sum(
@@ -596,11 +627,47 @@ class PagedServingEngine:
     def _tenant_of(self, req: PagedRequest) -> Tenant:
         return self.tenants[req.tenant]
 
+    @staticmethod
+    def _queue_key(req: PagedRequest):
+        """Global queue-order key the per-tenant sub-queues merge by:
+        preempted requests (sunk compute) ride ahead of never-admitted
+        ones, ordered by original submission age among themselves —
+        exactly the order the old single physical deque maintained."""
+        if req.preemptions > 0:
+            return (0, req.rid)
+        return (1, req.enqueue_seq)
+
+    @property
+    def queue(self) -> List[PagedRequest]:
+        """The merged global queue view, in admission-contract order
+        (see _queue_key). Built on demand — snapshot, deadline scans,
+        audits and external callers read it; the admission hot path
+        never does (it reads the per-tenant sub-queue heads)."""
+        out: List[PagedRequest] = []
+        for ten in self.tenants.values():
+            out.extend(ten.fifo)
+        out.sort(key=self._queue_key)
+        return out
+
+    def _enqueue(self, req: PagedRequest) -> None:
+        """Queue a never-admitted request at its tenant's tail."""
+        req.enqueue_seq = self._next_enqueue_seq
+        self._next_enqueue_seq += 1
+        ten = self.tenants[req.tenant]
+        ten.fifo.append(req)
+        ten.queued += 1
+        self._queue_len += 1
+
     def _dequeue(self, req: PagedRequest) -> None:
         """The one way OFF the queue (the tenant's queued gauge moves
         with the request) — raises ValueError if not queued."""
-        self.queue.remove(req)
-        self.tenants[req.tenant].queued -= 1
+        ten = self.tenants[req.tenant]
+        if ten.fifo and ten.fifo[0] is req:
+            ten.fifo.popleft()      # the admission path: O(1)
+        else:
+            ten.fifo.remove(req)    # rare (failure/release paths)
+        ten.queued -= 1
+        self._queue_len -= 1
 
     def _resolve_tenant(self, tenant_id: Optional[str]) -> Tenant:
         tid = DEFAULT_TENANT if tenant_id is None else str(tenant_id)
@@ -718,8 +785,7 @@ class PagedServingEngine:
         if deadline_steps is not None or deadline_s is not None:
             self._has_deadlines = True
         self._bump_vtime(ten.tid)
-        self.queue.append(req)
-        ten.queued += 1
+        self._enqueue(req)
         self._try_admit()
         return req.rid
 
@@ -801,18 +867,17 @@ class PagedServingEngine:
         lowest vtime, so it admits first once space frees)."""
         skipped: set = set()
         order = {tid: i for i, tid in enumerate(self.tenants)}
-        while self.queue and self.free_slots > 0:
-            heads: Dict[str, PagedRequest] = {}
-            for r in self.queue:
-                if r.tenant not in heads:
-                    heads[r.tenant] = r
-            cands = [t for t in heads if t not in skipped]
+        while self._queue_len and self.free_slots > 0:
+            # head selection is O(tenants): each tenant's oldest
+            # queued request IS its sub-queue head (no global scan)
+            cands = [tid for tid, t in self.tenants.items()
+                     if t.fifo and tid not in skipped]
             if not cands:
                 return
             tid = min(cands, key=lambda t: (self.tenants[t].vtime,
                                             order.get(t, len(order))))
             ten = self.tenants[tid]
-            req = heads[tid]
+            req = ten.fifo[0]
             if self.prefill_token_budget is None:
                 # cover the prompt AND the first decode token's page —
                 # admitting with zero headroom would re-preempt a
@@ -1145,7 +1210,8 @@ class PagedServingEngine:
             self.prefill_stats.prefill_steps += 1
         return ran, fresh
 
-    def _flush_ragged_plan(self, x: Optional[Tensor] = None):
+    def _flush_ragged_plan(self, x: Optional[Tensor] = None,
+                           L: int = 1):
         """Run the pending planned prefill segments — plus, at the
         step's model point, the fused decode rows — as ONE ragged
         model call through ``PagedKVCache.ragged_views``. CPU streams
@@ -1153,9 +1219,12 @@ class PagedServingEngine:
         decomposes back into the per-phase executables; the packed
         non-attention ops are per-row invariant — the same contract
         chunked prefill rests on), and the kernel path collapses the
-        step to one paged-attention dispatch per layer. Returns the
-        decode hidden [max_batch, 1, d] when ``x`` rode along, else
-        None."""
+        step to one paged-attention dispatch per layer. ``L`` > 1
+        packs a MULTI-TOKEN verify alongside the prefill chunks
+        (step_multi in token-budget mode): x is [max_batch, L, d] and
+        each slot contributes L rows at positions lens .. lens+L-1.
+        Returns the decode hidden [max_batch, L, d] when ``x`` rode
+        along, else None."""
         plan = self._ragged_plan
         segs = [s for s in plan if s["to"] > s["from"]]
         del plan[:]
@@ -1165,7 +1234,7 @@ class PagedServingEngine:
             ("prefill", s["slot"], s["from"], s["to"] - s["from"],
              s["ws"]) for s in segs]
         if x is not None:
-            desc.append(("decode", self.lens.copy(), 1))
+            desc.append(("decode", self.lens.copy(), L))
         views = self.cache.ragged_views(desc, tile_q=self.tile_q,
                                         tile_kv=self.tile_kv)
         import jax.numpy as jnp
@@ -1173,7 +1242,8 @@ class PagedServingEngine:
             s["req"].history[s["from"]:s["to"]], np.float32))
             for s in segs]
         if x is not None:
-            parts.append(x.data.reshape(self.max_batch, x.shape[-1]))
+            parts.append(x.data.reshape(self.max_batch * L,
+                                        x.shape[-1]))
         xp = Tensor(jnp.concatenate(parts, axis=0)[None])
         with no_grad():
             out, _ = self.model(xp, caches=views,
@@ -1190,7 +1260,8 @@ class PagedServingEngine:
                     s["slot"], Tensor(hv[0, lo + n - 1:lo + n]))
             lo += n
         if x is not None:
-            return Tensor(hv[0, lo:lo + self.max_batch][:, None])
+            return Tensor(hv[0, lo:lo + self.max_batch * L].reshape(
+                (self.max_batch, L) + tuple(hv.shape[2:])))
         return None
 
     def _finish_planned_prefill(self, slot: int, last_hidden) -> None:
@@ -1283,16 +1354,21 @@ class PagedServingEngine:
         — NOT plain appendleft, which reverses the order of two
         requests preempted in different engine passes (a re-admitted
         old request holds a fresh admit_seq, so it is evicted first
-        and appendleft would then queue it BEHIND its younger peer)."""
+        and appendleft would then queue it BEHIND its younger peer).
+        The insert is into the request's TENANT sub-queue, whose
+        internal order follows the same global _queue_key contract."""
         self._bump_vtime(req.tenant)
+        ten = self.tenants[req.tenant]
+        key = self._queue_key(req)
         i = 0
-        for r in self.queue:
-            if r.preemptions > 0 and r.rid < req.rid:
+        for r in ten.fifo:
+            if self._queue_key(r) < key:
                 i += 1
             else:
                 break
-        self.queue.insert(i, req)
-        self.tenants[req.tenant].queued += 1
+        ten.fifo.insert(i, req)
+        ten.queued += 1
+        self._queue_len += 1
 
     def _check_deadlines(self) -> None:
         """Fail every request (active, mid-prefill or queued) whose
@@ -1303,7 +1379,10 @@ class PagedServingEngine:
         now = None
         held = [self._requests[int(s)] for s in
                 np.flatnonzero(self.active | self.prefilling)]
-        for req in held + list(self.queue):
+        # scan the sub-queues directly: expiry does not care about the
+        # merged order, so don't pay the queue property's sort here
+        queued = [r for t in self.tenants.values() for r in t.fifo]
+        for req in held + queued:
             if req is None:
                 continue
             expired = ""
@@ -1487,8 +1566,8 @@ class PagedServingEngine:
         if col is not None:
             col.phase("bookkeeping")
         if self.num_active == 0:
-            if ran_prefill or self.num_prefilling > 0 or self.queue \
-                    or not idle:
+            if ran_prefill or self.num_prefilling > 0 \
+                    or self._queue_len or not idle:
                 if plan:
                     self._flush_ragged_plan()
                 self._try_admit()
@@ -1606,22 +1685,31 @@ class PagedServingEngine:
         a capacity-finished slot cannot ride a multi-token call at
         all. Page growth covers all L positions (preempting youngest
         on OOM, as in ``step``); ``rollback`` drops the rejected tail.
-        Returns hidden [max_batch, L, d_model]. Not yet composed with
-        ``prefill_token_budget`` (the speculative engine runs
-        synchronous admission): a multi-token step cannot host
-        rows whose admitted hidden the caller has not seen, so the
-        combination raises instead of silently starving mid-prefill
-        slots."""
+        Returns hidden [max_batch, L, d_model].
+
+        COMPOSES with ``prefill_token_budget`` (the PR 10 residual):
+        the step first spends the budget advancing pending prefill
+        chunks — packed WITH the verify rows into one ragged launch on
+        the kernel path (the ragged kernel and ``ragged_views`` speak
+        mixed q_lens natively) — and slots mid-prefill, or whose
+        prefill completed within this very step, sit the verify out
+        exactly as they sit out ``step``'s decode: their rows of x are
+        sanitized, their tables present as trash, their lens do not
+        advance, and their admitted event fires for the NEXT round's
+        pending token. May return None while prompts are still
+        streaming with no verifiable slot."""
         L = int(x.shape[1])
-        if self.prefill_token_budget is not None:
-            raise RuntimeError(
-                "step_multi() does not support prefill_token_budget "
-                "mode; use synchronous admission (the default) for "
-                "multi-token verification")
         idle = self._begin_step(kind="verify")
         ok = False
         try:
-            out = self._step_multi_impl(idle, x, L)
+            if not self._ragged_active():
+                out = self._step_multi_impl(idle, x, L)
+            else:
+                self._ragged_plan = []
+                try:
+                    out = self._step_multi_impl(idle, x, L)
+                finally:
+                    self._ragged_plan = None
             ok = True
             return out
         finally:
@@ -1629,63 +1717,105 @@ class PagedServingEngine:
 
     def _step_multi_impl(self, idle: bool, x: Tensor, L: int):
         col = self.collector
+        plan = self._ragged_plan
+        # token-budget mode: spend the prefill budget first (eagerly,
+        # or into the ragged plan), exactly like _step_body
+        if col is not None:
+            col.phase("prefill")
+        if plan is None:
+            ran_prefill, fresh = self._advance_prefills()
+        else:
+            ran_prefill, fresh = self._plan_prefills()
+        if col is not None:
+            col.phase("bookkeeping")
         if self.num_active == 0:
-            if self.queue or self.num_prefilling > 0 or not idle:
+            if ran_prefill or self.num_prefilling > 0 \
+                    or self._queue_len or not idle:
                 # deadline failures can empty the batch mid-stream;
                 # the caller sees None + the outcome events, never an
                 # exception
+                if plan:
+                    self._flush_ragged_plan()
                 self._try_admit()
                 return None
             raise RuntimeError("step_multi() with no active slots")
-        over = self.active & (self.lens + L > self.max_len)
+        # slots whose prefill completed within THIS step sit the
+        # verify out (their admitted event is undrained — their rows
+        # of x are garbage), same contract as _step_body
+        stepping = self.active.copy()
+        for slot in fresh:
+            stepping[slot] = False
+        if not stepping.any():
+            if plan:
+                self._flush_ragged_plan()
+            self._try_admit()
+            return None
+        over = stepping & (self.lens + L > self.max_len)
         if over.any():
+            if plan:
+                # the planning pass already transitioned prefill state
+                # (positions, stats, completions): flush the recorded
+                # chunks so their pages exist before unwinding, or the
+                # caller's retry would decode against prompts the
+                # scheduler believes were written
+                self._flush_ragged_plan()
             raise ValueError(
                 f"slots {np.flatnonzero(over).tolist()} cannot take "
                 f"{L} tokens within capacity {self.max_len}; clamp L "
                 f"or release them first")
         # grow pages to cover the whole write range, oldest first
-        order = sorted(np.flatnonzero(self.active),
+        order = sorted(np.flatnonzero(stepping),
                        key=lambda s: self._requests[s].admit_seq)
         for slot in order:
             slot = int(slot)
             self._grow_or_shed(slot, self._requests[slot],
                                int(self.lens[slot]) + L,
                                write_from=int(self.lens[slot]))
-        if not self.active.any():
+        stepping &= self.active     # growth may have evicted some
+        if not stepping.any():
+            if plan:
+                self._flush_ragged_plan()
             self._try_admit()
             return None
         if len(self._pending_history) >= 32:
             self._flush_history()
-        stepping = self.active.copy()
         # see step(): a NaN fed for an inactive row must not reach the
         # shared trash block (zeroed unconditionally, bitwise no-op
         # for active rows)
         x = self._sanitize_masked_rows(x, stepping)
-        self._pending_history.append((x, stepping))
-        self.cache.set_decode_mask(
-            self.prefilling if self.prefilling.any() else None)
+        self._pending_history.append((x, stepping.copy()))
+        masked = self.prefilling | (self.active & ~stepping)
+        self.cache.set_decode_mask(masked if masked.any() else None)
         if col is not None:
             col.phase("model")
-        t = Tensor(np.asarray(self.lens, np.int32))
-        with no_grad():
-            out, _ = self.model(x, caches=self.cache.views, time_step=t)
+        if plan:
+            # the step's planned prefill chunks and the L-row verify
+            # packed into ONE ragged model call
+            out = self._flush_ragged_plan(x=x, L=L)
+        else:
+            t = Tensor(np.asarray(self.lens, np.int32))
+            with no_grad():
+                out, _ = self.model(x, caches=self.cache.views,
+                                    time_step=t)
         if self.injector is not None:
             out = self.injector.corrupt_hidden(out)
         if col is not None:
             col.phase("bookkeeping")
-        self.lens[self.active] += L
-        self._count_tokens_served(self.active, L)
+        self.lens[stepping] += L
+        self._count_tokens_served(stepping, L)
         if col is not None:
             col.on_decode([self._requests[int(s)].rid
-                           for s in np.flatnonzero(self.active)
+                           for s in np.flatnonzero(stepping)
                            if self._requests[int(s)] is not None], L)
         if self.ledger is not None:
             # L verified rows per slot at positions [len-L, len)
             self.ledger.on_decode(
                 [(self._requests[int(s)].rid, int(self.lens[s]) - L)
-                 for s in np.flatnonzero(self.active)
+                 for s in np.flatnonzero(stepping)
                  if self._requests[int(s)] is not None], L)
         self.prefill_stats.decode_steps += 1
+        if ran_prefill:
+            self.prefill_stats.mixed_steps += 1
         self.prefill_stats.peak_blocks = max(
             self.prefill_stats.peak_blocks, self.cache.peak_blocks_used)
         if self.numeric_guard:
@@ -1748,7 +1878,7 @@ class PagedServingEngine:
             self.injector.begin_step(self._step_count)
             self.injector.crash_point("begin")
         idle = self.num_active == 0 and self.num_prefilling == 0 \
-            and not self.queue
+            and not self._queue_len
         self._check_deadlines()
         for tid, ten in self.tenants.items():
             ten.stats.blocks_held = self.cache.tenant_charge(tid)
@@ -1759,7 +1889,7 @@ class PagedServingEngine:
     def _queue_gauges(self) -> dict:
         """Queue/slot depths — the ONE source feeding both the
         registry's ``queue`` namespace and the per-step gauge track."""
-        return {"depth": len(self.queue),
+        return {"depth": self._queue_len,
                 "active": self.num_active,
                 "prefilling": self.num_prefilling}
 
@@ -1988,16 +2118,30 @@ class PagedServingEngine:
                 f"queued request {r.rid} of unknown tenant {r.tenant!r}"
             queued_by_tenant[r.tenant] = \
                 queued_by_tenant.get(r.tenant, 0) + 1
+        total_q = 0
         for tid, ten in self.tenants.items():
-            assert ten.queued == queued_by_tenant.get(tid, 0), \
+            assert ten.queued == queued_by_tenant.get(tid, 0) \
+                == len(ten.fifo), \
                 (f"tenant {tid!r} queued gauge {ten.queued} != "
                  f"{queued_by_tenant.get(tid, 0)} request(s) actually "
-                 f"queued")
+                 f"queued (sub-queue holds {len(ten.fifo)})")
+            total_q += len(ten.fifo)
+            # sub-queue internal order follows the global merge key
+            # (preempted by rid, then fresh by enqueue order)
+            keys = [self._queue_key(r) for r in ten.fifo]
+            assert keys == sorted(keys), \
+                (f"tenant {tid!r} sub-queue out of admission order: "
+                 f"{[r.rid for r in ten.fifo]}")
+            assert all(r.tenant == tid for r in ten.fifo), \
+                f"foreign request in tenant {tid!r} sub-queue"
             if ten.quota_blocks is not None:
                 held = self.cache.tenant_charge(tid)
                 assert held <= ten.quota_blocks, \
                     (f"tenant {tid!r} holds {held} block(s) over its "
                      f"quota {ten.quota_blocks}")
+        assert self._queue_len == total_q, \
+            (f"queue depth gauge {self._queue_len} != {total_q} "
+             f"request(s) across the sub-queues")
         self.cache.check_invariants(lens=self.lens, active=self.active)
         self.resilience_stats.audits += 1
         return True
@@ -2195,9 +2339,15 @@ class PagedServingEngine:
         for slot, r in enumerate(eng._requests):
             if r is not None:
                 eng.cache.set_seq_tenant(slot, r.tenant)
-        eng.queue = deque(reqs[rid] for rid in snap["queue"])
-        for r in eng.queue:
-            eng._resolve_tenant(r.tenant).queued += 1
+        # re-shard the snapshot's global queue-order list into the
+        # per-tenant FIFO sub-queues: the saved order is merged-key
+        # order, so per-tenant suborder is preserved by appending in
+        # sequence (enqueue seqs are reassigned monotonically — only
+        # their relative order is behavioral)
+        for rid in snap["queue"]:
+            r = reqs[rid]
+            eng._resolve_tenant(r.tenant)   # auto-register if needed
+            eng._enqueue(r)
         eng.lens = np.array(snap["lens"], np.int32)
         eng.active = np.array(snap["active"], bool)
         eng.prefilling = np.array(snap["prefilling"], bool)

@@ -71,7 +71,8 @@ class TokenServingModel:
     (and the probability rows rejection sampling needs) come to
     host."""
 
-    def __init__(self, model, embedding, lm_head=None):
+    def __init__(self, model, embedding, lm_head=None,
+                 weight_dtype: str = "float32"):
         import jax.numpy as jnp
         self.core = model
         emb = np.asarray(embedding.numpy() if hasattr(embedding, "numpy")
@@ -95,6 +96,41 @@ class TokenServingModel:
                 raise ValueError(f"lm_head must be [d_model, vocab] = "
                                  f"{head_shape}, got {head.shape}")
             self.lm_head = Tensor(jnp.asarray(head))
+        # opt-in INT8 WEIGHT path (weight_dtype="int8"): the readout
+        # projection — the one weight this serving surface owns, and
+        # at vocab x d_model typically the largest single serving
+        # matrix — is stored int8 with per-OUTPUT-CHANNEL (per-vocab-
+        # column) symmetric scales. The matmul streams the int8 weight
+        # (ops/pallas/int8_matmul.w8a16_matmul on TPU; a dequantizing
+        # XLA contraction as the CPU/odd-shape fallback) and the scale
+        # multiply folds into the readout epilogue — ~2x weight HBM
+        # vs bf16 (4x vs f32) on the weight-bound decode readout.
+        # Off by default: float32 readout is bit-identical to before.
+        if weight_dtype not in ("float32", "int8"):
+            raise ValueError(f"unsupported weight_dtype "
+                             f"{weight_dtype!r} (float32 | int8)")
+        self.weight_dtype = weight_dtype
+        self._head_int8: Optional[Tensor] = None
+        self._head_scale: Optional[Tensor] = None
+        if weight_dtype == "int8":
+            # quantization.functional convention: scale is the
+            # per-channel amax, qmax folded inside quantized_matmul
+            w = np.asarray(self.lm_head.numpy(), np.float32)
+            amax = np.abs(w).max(axis=0)             # per out-channel
+            q = np.clip(np.round(w * (127.0 / np.maximum(amax, 1e-30))
+                                 [None]), -127, 127).astype(np.int8)
+            self._head_int8 = Tensor(jnp.asarray(q))
+            self._head_scale = Tensor(jnp.asarray(
+                amax.astype(np.float32)))
+
+    def weight_bytes(self) -> int:
+        """HBM bytes of the readout head as stored (int8 payload +
+        per-channel scales when quantized) — the honest number the
+        cost reports cite next to kv_bytes_per_token()."""
+        if self._head_int8 is not None:
+            return (int(np.prod(self._head_int8.shape))
+                    + 4 * int(self._head_scale.shape[0]))
+        return int(np.prod(self.lm_head.shape)) * 4
 
     @property
     def vocab_size(self) -> int:
@@ -115,9 +151,19 @@ class TokenServingModel:
 
     def logits(self, hidden) -> Tensor:
         """hidden [..., d_model] Tensor -> logits [..., vocab] Tensor
-        (on-device readout matmul)."""
+        (on-device readout matmul; the int8 weight path streams the
+        quantized head and folds the per-channel scale into the
+        epilogue — see __init__)."""
         import paddle_tpu as paddle
-        return paddle.matmul(hidden, self.lm_head)
+        if self._head_int8 is None:
+            return paddle.matmul(hidden, self.lm_head)
+        # weight-only int8 GEMM: the w8a16 Pallas kernel behind the
+        # FLAGS_enable_pallas_kernels gate, dequantizing XLA
+        # contraction at shapes outside the kernel tiling — the ONE
+        # implementation quantization/functional.py already owns
+        from ..quantization.functional import quantized_matmul
+        return quantized_matmul(hidden, self._head_int8,
+                                self._head_scale)
 
     # -- sampling ------------------------------------------------------
     def probs(self, logits, temperature: float = 1.0,
@@ -194,7 +240,8 @@ class TokenServingModel:
                     if par is not None and \
                             dmod._parameters.get(pname) is not None:
                         dmod._parameters[pname]._data = par.data
-        return TokenServingModel(d, self._embed_np, self.lm_head)
+        return TokenServingModel(d, self._embed_np, self.lm_head,
+                                 weight_dtype=self.weight_dtype)
 
 
 class _SpecSeq:
@@ -246,7 +293,9 @@ class SpeculativeEngine:
                  prefix_cache: bool = False, sampling: str = "greedy",
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  watermark_blocks: int = 0,
-                 chunk_tokens: Optional[int] = None, seed: int = 0,
+                 chunk_tokens: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None,
+                 kv_dtype: str = "float32", seed: int = 0,
                  injector=None,
                  max_preemptions: Optional[int] = None,
                  numeric_guard: Optional[bool] = None,
@@ -263,11 +312,20 @@ class SpeculativeEngine:
         self.top_k = top_k
         self._rng = np.random.RandomState(seed)
         self.injector = injector
+        # kv_dtype="int8" quantizes the TARGET pool (the quota/HBM
+        # domain — ~2x block density at equal bytes); the draft pool
+        # stays float (it is small by construction and its proposals
+        # are verified anyway). prefill_token_budget composes with the
+        # verify step since the step_multi refusal was lifted: each
+        # round first streams pending prompt chunks, packed with the
+        # verify rows on the kernel path.
         self.engine = PagedServingEngine(
             target.core, max_batch, block_size, num_blocks,
             max_blocks_per_seq=max_blocks_per_seq,
+            dtype=kv_dtype,
             watermark_blocks=watermark_blocks,
             prefix_cache=prefix_cache, chunk_tokens=chunk_tokens,
+            prefill_token_budget=prefill_token_budget,
             injector=injector, max_preemptions=max_preemptions,
             numeric_guard=numeric_guard, tenants=tenants,
             collector=collector, monitor=monitor, ledger=ledger)
@@ -588,6 +646,18 @@ class SpeculativeEngine:
                 self._clear_draft_slot(slot)
                 eng.release(slot)
         slots = sorted(self._seqs)
+        if not slots and eng.prefill_token_budget is not None and \
+                (eng.num_prefilling > 0 or eng._queue_len):
+            # token-budget mode with every tracked stream still
+            # mid-prefill: run an (empty-verify) engine step so the
+            # pending prompts keep streaming — admitted events land in
+            # _handle_events and next round verifies their pending
+            # token
+            eng.step_multi(paddle.to_tensor(
+                np.zeros((self.max_batch, 1, self.target.d_model),
+                         np.float32)))
+            self._handle_events()
+            return {}
         if not slots:
             # a fault storm can empty the whole batch mid-round
             # (everything preempted/shed): kick admission so queued
@@ -596,7 +666,7 @@ class SpeculativeEngine:
             # like an admission-only PagedServingEngine.step — so
             # step-keyed fault schedules expire even when admission
             # itself is the faulted path (no injection deadlock)
-            if eng.queue:
+            if eng._queue_len:
                 eng._begin_step(kind="admission_kick")
                 ok = False
                 try:

@@ -53,6 +53,19 @@ scalar-prefetch path because pool pages are non-contiguous (one DMA
 per page is the indirection's price; tile over q to amortize it).
 ``tools/tile_report.py`` sizes both from recorded ``span.model``
 step-phase timings (PR 8/9) so real-TPU tuning is data-driven.
+
+QUANTIZED PAGES (``kv_scales``): an int8 KV pool rides the SAME block
+table with a per-page scale array [num_blocks, 2, nkv, block_size]
+(symmetric per-position-per-head scales — see
+inference/paged_cache.py for why scales are per row, not one scalar
+per block: row granularity is what keeps the quantized payload a pure
+function of the token stream, so prefix adoption stays exact). On the
+scalar-prefetch path the scale page is DMA'd next to its int8 page
+through the same ``bt[tile_seq[t], j]`` index map and the kernel
+dequantizes in-register (int8 page bytes + 1/16th of them in scales
+over the wire instead of bf16 — the HBM win). In interpret / jnp-
+reference mode the pre-gathered pages are dequantized before the
+kernel body, which then runs unchanged in float32.
 """
 from __future__ import annotations
 
@@ -182,6 +195,23 @@ def _kernel_ragged_prefetch(bt_ref, tseq_ref, pos_ref, q_ref,
                  q_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
 
 
+def _kernel_ragged_prefetch_quant(bt_ref, tseq_ref, pos_ref, q_ref,
+                                  pool_ref, scale_ref, o_ref, m_scr,
+                                  l_scr, acc_scr, *, nkv, **kw):
+    # int8 pages: the scale page [1, 2, 1, block_s] rides the same
+    # block-table index map as its pool page; dequantize in-register
+    # (q * scale per row) before the shared online-softmax body
+    del bt_ref, tseq_ref
+    hd = q_ref.shape[-1]
+    t = pl.program_id(0) // nkv
+    kv = pool_ref[...].reshape(2, kw["block_s"], hd)
+    sc = scale_ref[...].reshape(2, kw["block_s"])
+    _ragged_body(pos_ref[t, 0], pos_ref[t, 1],
+                 kv[0].astype(jnp.float32) * sc[0][:, None],
+                 kv[1].astype(jnp.float32) * sc[1][:, None],
+                 q_ref, o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
 def _kernel_ragged_interpret(pos_ref, q_ref, pg_ref, o_ref, m_scr,
                              l_scr, acc_scr, *, tile_kv, **kw):
     hd = q_ref.shape[-1]
@@ -225,7 +255,8 @@ def _tile_layout(q_lens, tile_q):
 
 
 def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
-                           sm_scale=None, tile_q=None, tile_kv=None):
+                           sm_scale=None, tile_q=None, tile_kv=None,
+                           kv_scales=None):
     """THE kernel: one launch scores a mixed prefill+decode+verify
     batch. q: [R, nh, hd] — every sequence's query rows packed
     back-to-back (R == sum(q_lens)). q_lens: STATIC per-sequence query
@@ -239,6 +270,9 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
     kv_lens[s] - q_lens[s] + i and attends causally (so q_lens[s] == 1
     is a decode row, == K+1 a speculative verify, == C a prefill
     chunk). Zero-length sequences contribute no rows and are skipped.
+    ``kv_scales``: per-page dequantization scales
+    [num_blocks, 2, nkv, block_size] for an int8 ``kv_pool`` (None =
+    the pool holds real values) — see the module docstring.
     Returns [R, nh, hd] in packed order."""
     q_lens = tuple(int(x) for x in q_lens)
     R, nh, hd = q.shape
@@ -305,6 +339,13 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
             bt_p = bt
         n_kv_steps = MBp // tkv
         pages = kv_pool[bt_p]           # [n_seq, MBp, 2, nkv, bs, hd]
+        if kv_scales is not None:
+            # interpret mode has no scalar-prefetch index maps, so the
+            # pages are already materialized — dequantize them here
+            # and run the float kernel body unchanged (the prefetch
+            # path below dequantizes in-register instead)
+            sc = jnp.asarray(kv_scales)[bt_p]   # [n_seq, MBp, 2, nkv, bs]
+            pages = pages.astype(jnp.float32) * sc[..., None]
         pg = jnp.transpose(pages[tseq], (0, 3, 1, 2, 4, 5)).reshape(
             T * nkv, MBp, 2, block_s, hd)
         pos_r = jnp.repeat(pos, nkv, axis=0)        # [T * nkv, 2]
@@ -334,30 +375,44 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
         # block table names (tile over q to amortize the DMA instead)
         kw = dict(block_s=block_s, n_blocks=MB, sm_scale=scale,
                   tile_q=tile_q, g=g)
+        in_specs = [
+            pl.BlockSpec((1, 1, rows, hd),
+                         lambda i, j, bt_, ts_, p_:
+                         (i // nkv, i % nkv, 0, 0)),
+            # one page per step, straight out of the pool row named
+            # by the block table — the whole paged-attention trick
+            pl.BlockSpec((1, 2, 1, block_s, hd),
+                         lambda i, j, bt_, ts_, p_:
+                         (bt_[ts_[i // nkv], j], 0, i % nkv,
+                          0, 0)),
+        ]
+        operands = [bt, tseq, pos, qp, kv_pool]
+        if kv_scales is None:
+            kernel = functools.partial(_kernel_ragged_prefetch,
+                                       nkv=nkv, **kw)
+        else:
+            # the scale page rides the SAME index map as its int8 page
+            in_specs.append(
+                pl.BlockSpec((1, 2, 1, block_s),
+                             lambda i, j, bt_, ts_, p_:
+                             (bt_[ts_[i // nkv], j], 0, i % nkv, 0)))
+            operands.append(jnp.asarray(kv_scales))
+            kernel = functools.partial(_kernel_ragged_prefetch_quant,
+                                       nkv=nkv, **kw)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,   # bt + tile->seq map + pos (SMEM)
             grid=(T * nkv, MB),
-            in_specs=[
-                pl.BlockSpec((1, 1, rows, hd),
-                             lambda i, j, bt_, ts_, p_:
-                             (i // nkv, i % nkv, 0, 0)),
-                # one page per step, straight out of the pool row named
-                # by the block table — the whole paged-attention trick
-                pl.BlockSpec((1, 2, 1, block_s, hd),
-                             lambda i, j, bt_, ts_, p_:
-                             (bt_[ts_[i // nkv], j], 0, i % nkv,
-                              0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, rows, hd),
                                    lambda i, j, bt_, ts_, p_:
                                    (i // nkv, i % nkv, 0, 0)),
             scratch_shapes=scratch,
         )
         out = pl.pallas_call(
-            functools.partial(_kernel_ragged_prefetch, nkv=nkv, **kw),
+            kernel,
             grid_spec=grid_spec,
             out_shape=out_shape,
-        )(bt, tseq, pos, qp, kv_pool)
+        )(*operands)
 
     # unfold + unpad back to the packed row order
     out = jnp.transpose(out.reshape(T, nkv, tile_q, g, hd),
@@ -367,17 +422,18 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
 
 # --- the three phase entry points: thin wrappers over the ragged path -
 
-def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None):
+def paged_attention(q, kv_pool, block_tables, seq_lens, sm_scale=None,
+                    kv_scales=None):
     """Decode: q [B, nh, hd] (one query per sequence), seq_lens int32
     [B] valid lengths. A ragged launch with q_lens = (1,)*B and
     tile_q = 1 (no padding rows). Returns [B, nh, hd]."""
     return paged_attention_ragged(
         q, kv_pool, block_tables, (1,) * q.shape[0], seq_lens,
-        sm_scale=sm_scale, tile_q=1)
+        sm_scale=sm_scale, tile_q=1, kv_scales=kv_scales)
 
 
 def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
-                          sm_scale=None):
+                          sm_scale=None, kv_scales=None):
     """Multi-query verify (speculative decode): q [B, n_q, nh, hd],
     query i of row b at position seq_lens[b] - n_q + i, masked
     causally. seq_lens INCLUDE the n_q new tokens. A ragged launch
@@ -387,12 +443,14 @@ def paged_attention_multi(q, kv_pool, block_tables, seq_lens,
     B, n_q, nh, hd = q.shape
     out = paged_attention_ragged(
         q.reshape(B * n_q, nh, hd), kv_pool, block_tables,
-        (n_q,) * B, seq_lens, sm_scale=sm_scale, tile_q=n_q)
+        (n_q,) * B, seq_lens, sm_scale=sm_scale, tile_q=n_q,
+        kv_scales=kv_scales)
     return out.reshape(B, n_q, nh, hd)
 
 
 def paged_attention_prefill(q, kv_pool, block_tables, start_pos,
-                            sm_scale=None, tile_q=None):
+                            sm_scale=None, tile_q=None,
+                            kv_scales=None):
     """Chunked prefill: q [B, C, nh, hd] holds one prompt chunk per
     sequence, query i of row b at absolute position start_pos[b] + i.
     A ragged launch with q_lens = (C,)*B, kv_lens = start_pos + C and
@@ -405,19 +463,26 @@ def paged_attention_prefill(q, kv_pool, block_tables, start_pos,
     lens = jnp.asarray(start_pos, jnp.int32) + C
     out = paged_attention_ragged(
         q.reshape(B * C, nh, hd), kv_pool, block_tables, (C,) * B,
-        lens, sm_scale=sm_scale, tile_q=tile_q)
+        lens, sm_scale=sm_scale, tile_q=tile_q, kv_scales=kv_scales)
     return out.reshape(B, C, nh, hd)
 
 
 # --- references: ONE ragged reference, per-phase ones delegate --------
 
-def gather_pages(kv_pool, block_tables):
+def gather_pages(kv_pool, block_tables, kv_scales=None):
     """Pure-jnp page gather: materialize the block-table indirection as
     dense K/V. kv_pool: [NB, 2, nkv, bs, hd]; block_tables: int32
     [B, MB]. Returns (k, v) each [B, MB*bs, nkv, hd] — the layout
     decode_attention consumes. Positions past a sequence's length hold
-    whatever its (trash/stale) pages hold; callers mask by length."""
+    whatever its (trash/stale) pages hold; callers mask by length.
+    ``kv_scales`` ([NB, 2, nkv, bs], int8 pools) dequantizes the
+    gathered pages to float32 — the ONE place the fallback layout
+    learns quantization, shared by every CPU/jnp serving path."""
     pages = kv_pool[jnp.asarray(block_tables, jnp.int32)]
+    if kv_scales is not None:
+        sc = jnp.asarray(kv_scales)[jnp.asarray(block_tables,
+                                                jnp.int32)]
+        pages = pages.astype(jnp.float32) * sc[..., None]
     # [B, MB, 2, nkv, bs, hd] -> [B, MB, bs, nkv, hd] per K/V
     k = jnp.moveaxis(pages[:, :, 0], 2, 3)
     v = jnp.moveaxis(pages[:, :, 1], 2, 3)
@@ -427,12 +492,14 @@ def gather_pages(kv_pool, block_tables):
 
 
 def paged_attention_ragged_reference(q, kv_pool, block_tables, q_lens,
-                                     kv_lens, sm_scale=None):
+                                     kv_lens, sm_scale=None,
+                                     kv_scales=None):
     """jnp reference for the ragged kernel — and the ONE place the
     reference semantics live: the per-phase ``*_reference`` functions
     below are thin delegations, so kernel and reference can no longer
-    drift apart per phase. Gather pages dense, then per-sequence
-    masked softmax with each query at kv_lens[s] - q_lens[s] + i."""
+    drift apart per phase. Gather pages dense (dequantizing int8
+    pages through their scales), then per-sequence masked softmax
+    with each query at kv_lens[s] - q_lens[s] + i."""
     q_lens = tuple(int(x) for x in q_lens)
     R, nh, hd = q.shape
     if R == 0:
@@ -440,7 +507,8 @@ def paged_attention_ragged_reference(q, kv_pool, block_tables, q_lens,
     nkv = kv_pool.shape[2]
     g = nh // nkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
-    k, v = gather_pages(kv_pool, block_tables)   # [n_seq, S, nkv, hd]
+    k, v = gather_pages(kv_pool, block_tables,
+                        kv_scales=kv_scales)     # [n_seq, S, nkv, hd]
     S = k.shape[1]
     k = jnp.repeat(k, g, axis=2)                 # GQA: broadcast kv heads
     v = jnp.repeat(v, g, axis=2)
@@ -465,28 +533,30 @@ def paged_attention_ragged_reference(q, kv_pool, block_tables, q_lens,
 
 
 def paged_attention_reference(q, kv_pool, block_tables, seq_lens,
-                              sm_scale=None):
+                              sm_scale=None, kv_scales=None):
     """Decode reference = ragged reference at q_lens all 1."""
     return paged_attention_ragged_reference(
         q, kv_pool, block_tables, (1,) * q.shape[0], seq_lens,
-        sm_scale=sm_scale)
+        sm_scale=sm_scale, kv_scales=kv_scales)
 
 
 def paged_attention_multi_reference(q, kv_pool, block_tables, seq_lens,
-                                    sm_scale=None):
+                                    sm_scale=None, kv_scales=None):
     """Multi-query reference = ragged reference at uniform q_lens."""
     B, n_q, nh, hd = q.shape
     out = paged_attention_ragged_reference(
         q.reshape(B * n_q, nh, hd), kv_pool, block_tables,
-        (n_q,) * B, seq_lens, sm_scale=sm_scale)
+        (n_q,) * B, seq_lens, sm_scale=sm_scale, kv_scales=kv_scales)
     return out.reshape(B, n_q, nh, hd)
 
 
 def paged_attention_prefill_reference(q, kv_pool, block_tables,
-                                      start_pos, sm_scale=None):
+                                      start_pos, sm_scale=None,
+                                      kv_scales=None):
     """Prefill reference: a chunk at start S IS a multi-query sweep
     with seq_lens = S + C (its queries sit at lens - n_q + i)."""
     C = q.shape[1]
     lens = jnp.asarray(start_pos, jnp.int32) + C
     return paged_attention_multi_reference(q, kv_pool, block_tables,
-                                           lens, sm_scale=sm_scale)
+                                           lens, sm_scale=sm_scale,
+                                           kv_scales=kv_scales)

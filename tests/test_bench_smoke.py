@@ -221,6 +221,34 @@ def test_serving_cost_smoke_leg():
     assert res["accounted"]["tokens_per_sec"] > 0
 
 
+def test_serving_int8_smoke_leg():
+    res = bench_extra.bench_serving_int8(smoke=True)
+    assert res["metric"] == "serving_int8_equal_hbm_concurrency"
+    # the headline acceptance rode the bench: at EQUAL pool bytes the
+    # int8 pool admits >= 1.8x the concurrent requests of the bf16
+    # pool, and the ceiling was held while the queue was nonempty —
+    # blocked on admission, not correctness
+    assert res["int8"]["pool_bytes"] <= res["hbm_budget_bytes"]
+    assert res["baseline"]["pool_bytes"] <= res["hbm_budget_bytes"]
+    assert res["int8_vs_baseline_concurrency"] >= 1.8
+    assert res["int8"]["concurrent_at_backlog"] == \
+        res["int8"]["max_concurrent"]
+    assert res["baseline"]["concurrent_at_backlog"] == \
+        res["baseline"]["max_concurrent"]
+    # the ceilings are the deterministic block-budget bound
+    assert res["baseline"]["max_concurrent"] == \
+        (res["baseline"]["num_blocks"] - 1) // res["blocks_per_request"]
+    assert res["int8"]["max_concurrent"] == \
+        (res["int8"]["num_blocks"] - 1) // res["blocks_per_request"]
+    # density and correctness guarantees
+    assert res["kv_density_vs_baseline"] >= 1.8
+    assert res["token_agreement_pct"] >= 99.0
+    assert res["max_rel_step_divergence"] <= res["divergence_bound"]
+    # both runs actually served every requested token
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["int8"]["tokens_per_sec"] > 0
+
+
 def test_serving_monitor_smoke_leg():
     res = bench_extra.bench_serving_monitor(smoke=True)
     assert res["metric"] == "serving_health_monitoring"

@@ -1,0 +1,299 @@
+"""step_multi x prefill_token_budget composition + per-tenant FIFO
+sub-queues (the two scheduler residuals closed alongside quantized
+serving).
+
+Verify rows and prefill chunks now share one engine step: in
+token-budget mode ``step_multi`` first spends the budget advancing
+pending prompts (packed WITH the L-row verify into one ragged launch
+on the kernel path / under ``ragged_step="force"``), and slots
+mid-prefill — or freshly completed within the step — sit the verify
+out exactly as they sit out ``step``'s decode. Greedy speculative
+streams under a budget are bit-identical to synchronous admission.
+
+The admission queue is sharded into per-tenant FIFO sub-queues
+(Tenant.fifo): WFQ head selection reads one deque head per tenant —
+O(tenants), not O(queue) — while the global order contract
+(preempted-ahead-of-new, age-fair within) and the snapshot queue-order
+list are unchanged (``engine.queue`` materializes the merged view).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference import (PagedServingEngine,
+                                  SpeculativeEngine, TokenServingModel)
+
+DIM, HEADS, FFN, LAYERS, VOCAB = 64, 4, 128, 2, 50
+
+
+def make_model():
+    paddle.seed(0)
+    m = FusedMultiTransformer(DIM, HEADS, FFN, num_layers=LAYERS)
+    m.eval()
+    return m
+
+
+def make_tsm(model=None):
+    model = model or make_model()
+    emb = np.random.default_rng(0).standard_normal(
+        (VOCAB, DIM)).astype(np.float32)
+    return TokenServingModel(model, emb)
+
+
+def spec_serve(tsm, *, budget=None, k=2, n_req=5, prompt_len=11,
+               gen=8, max_batch=3):
+    eng = SpeculativeEngine(tsm, k=k, max_batch=max_batch,
+                            block_size=4, num_blocks=64,
+                            max_blocks_per_seq=6,
+                            prefill_token_budget=budget)
+    prompts = np.random.default_rng(1).integers(
+        0, VOCAB, (n_req, prompt_len))
+    rids = [eng.submit(list(p)) for p in prompts]
+    for _ in range(400):
+        eng.step()
+        if all(len(eng.generated(r)) >= gen for r in rids):
+            break
+    return {r: eng.generated(r)[:gen] for r in rids}, eng
+
+
+# ------------------------------------------- budget x verify composition
+
+def test_step_multi_no_longer_refuses_budget_mode():
+    eng = PagedServingEngine(make_model(), max_batch=2, block_size=4,
+                             num_blocks=32, prefill_token_budget=4)
+    rng = np.random.default_rng(2)
+    eng.submit(paddle.to_tensor(
+        rng.standard_normal((10, DIM)).astype(np.float32)))
+    x = paddle.to_tensor(rng.standard_normal(
+        (2, 2, DIM)).astype(np.float32))
+    # prompt still streaming: the verify step advances prefill chunks
+    # and returns None instead of raising
+    assert eng.step_multi(x) is None
+    assert eng.num_prefilling == 1
+    steps = 1
+    while eng.num_prefilling:
+        assert eng.step_multi(x) is None
+        steps += 1
+    assert steps >= 2                     # 10 tokens / budget-4 chunks
+    # the admission event fired from within a verify-kind step
+    (rid, slot, h) = eng.admitted.pop()
+    assert h is not None
+    # the fresh slot sat the completing step out: its length is the
+    # prompt, not prompt + L
+    assert int(eng.lens[slot]) == 10
+    out = eng.step_multi(x)
+    assert out is not None
+    assert int(eng.lens[slot]) == 12
+    eng.check_invariants()
+
+
+def test_spec_budget_streams_match_synchronous():
+    """Greedy speculative serving under a prefill token budget emits
+    BIT-IDENTICAL streams to synchronous admission — for both the
+    plain k=0 engine and a self-drafted k=2 engine."""
+    tsm = make_tsm()
+    for k in (0, 2):
+        sync, _ = spec_serve(tsm, budget=None, k=k)
+        bud, eng = spec_serve(tsm, budget=4, k=k)
+        assert bud == sync
+        # the budget path really streamed prompts across steps
+        assert eng.engine.prefill_stats.prefill_steps > 0
+        eng.check_invariants()
+
+
+def test_packed_verify_ragged_force_bit_identity():
+    """ragged_step="force" packs the step's prefill chunks WITH the
+    L-row verify into one ragged model call; on the CPU fallback the
+    packed batch decomposes into the per-phase executables, so hidden
+    outputs and admission events are bit-identical to the eager
+    (per-chunk + per-call) path."""
+    model = make_model()
+    rng = np.random.default_rng(3)
+    prompts = [rng.standard_normal((n, DIM)).astype(np.float32)
+               for n in (10, 6)]
+    xs = [rng.standard_normal((2, 2, DIM)).astype(np.float32)
+          for _ in range(10)]
+
+    def drive(ragged):
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=32,
+                                 prefill_token_budget=4,
+                                 ragged_step=ragged)
+        for p in prompts:
+            eng.submit(paddle.to_tensor(p))
+        outs, events = [], []
+        for x in xs:
+            o = eng.step_multi(paddle.to_tensor(x))
+            outs.append(None if o is None
+                        else np.asarray(o.numpy()).copy())
+            for rid, slot, h in eng.admitted:
+                events.append((rid, slot,
+                               np.asarray(h.numpy()).copy()))
+            eng.admitted.clear()
+        eng.check_invariants()
+        return outs, events, eng.lens.copy()
+
+    o_eager, e_eager, l_eager = drive(False)
+    o_force, e_force, l_force = drive("force")
+    assert np.array_equal(l_eager, l_force)
+    assert len(e_eager) == len(e_force) == 2
+    for (ra, sa, ha), (rb, sb, hb) in zip(e_eager, e_force):
+        assert (ra, sa) == (rb, sb)
+        assert np.array_equal(ha, hb)
+    for a, b in zip(o_eager, o_force):
+        assert (a is None) == (b is None)
+        if a is not None:
+            assert np.array_equal(a, b)
+
+
+def test_capacity_error_flushes_planned_chunks():
+    """Regression: in ragged (planned) budget mode, the over-capacity
+    ValueError fires AFTER the planning pass transitioned prefill
+    state — the recorded chunks must be flushed (pages written) before
+    the unwind, or a retry with clamped L would decode the mid-prefill
+    slot against pages the scheduler believes were written."""
+    model = make_model()
+    rng = np.random.default_rng(8)
+    p_long = rng.standard_normal((10, DIM)).astype(np.float32)
+    x1 = paddle.to_tensor(rng.standard_normal(
+        (2, 1, DIM)).astype(np.float32))
+    xL = paddle.to_tensor(rng.standard_normal(
+        (2, 2, DIM)).astype(np.float32))
+
+    def drive(ragged):
+        eng = PagedServingEngine(model, max_batch=2, block_size=4,
+                                 num_blocks=32, max_blocks_per_seq=3,
+                                 prefill_token_budget=4,
+                                 ragged_step=ragged)
+        eng.submit(paddle.to_tensor(p_long[:6]))     # slot 0
+        while eng.num_prefilling:                    # finish slot 0
+            eng.step_multi(x1)
+        eng.admitted.clear()
+        # drive slot 0 to one token below capacity (12), then submit a
+        # second prompt so a prefill chunk is pending when the
+        # over-capacity verify arrives
+        while int(eng.lens[0]) < 11:
+            eng.step_multi(x1)
+        eng.submit(paddle.to_tensor(p_long))         # slot 1 prefilling
+        with pytest.raises(ValueError):
+            eng.step_multi(xL)                       # 11 + 2 > 12
+        # the pending chunk's state advanced AND its pages exist:
+        # release the full slot, finish slot 1's prefill, and verify
+        # its stream — identical across eager and forced-ragged paths
+        eng.release(0)
+        while eng.num_prefilling:
+            eng.step_multi(x1)
+        outs = []
+        for _ in range(2):                # 10-token prompt, capacity 12
+            outs.append(np.asarray(
+                eng.step_multi(x1).numpy())[1].copy())
+        eng.check_invariants()
+        return outs
+
+    eager = drive(False)
+    forced = drive("force")
+    assert len(eager) == len(forced)
+    for a, b in zip(eager, forced):
+        assert np.array_equal(a, b)
+
+
+def test_mixed_verify_counts_as_mixed_step():
+    """A verify step that also advanced prefill chunks bumps
+    mixed_steps — the Sarathi packing signal now covers verify."""
+    eng = PagedServingEngine(make_model(), max_batch=2, block_size=4,
+                             num_blocks=32, prefill_token_budget=4)
+    rng = np.random.default_rng(4)
+    eng.submit(paddle.to_tensor(
+        rng.standard_normal((6, DIM)).astype(np.float32)))
+    x = paddle.to_tensor(rng.standard_normal(
+        (2, 2, DIM)).astype(np.float32))
+    while eng.num_prefilling:
+        eng.step_multi(x)
+    eng.admitted.clear()
+    eng.step_multi(x)                     # plain verify, slot active
+    eng.submit(paddle.to_tensor(
+        rng.standard_normal((9, DIM)).astype(np.float32)))
+    before = eng.prefill_stats.mixed_steps
+    eng.step_multi(x)                     # verify + prefill chunk
+    assert eng.prefill_stats.mixed_steps == before + 1
+
+
+# ------------------------------------------------ per-tenant sub-queues
+
+def test_subqueue_structure_and_merged_order():
+    eng = PagedServingEngine(make_model(), max_batch=1, block_size=4,
+                             num_blocks=64)
+    rng = np.random.default_rng(5)
+
+    def prompt():
+        return paddle.to_tensor(
+            rng.standard_normal((5, DIM)).astype(np.float32))
+
+    a1 = eng.submit(prompt(), tenant_id="a")     # admitted (slot 0)
+    a2 = eng.submit(prompt(), tenant_id="a")
+    b1 = eng.submit(prompt(), tenant_id="b")
+    a3 = eng.submit(prompt(), tenant_id="a")
+    assert [r.rid for r in eng.tenants["a"].fifo] == [a2, a3]
+    assert [r.rid for r in eng.tenants["b"].fifo] == [b1]
+    assert [r.rid for r in eng.queue] == [a2, b1, a3]
+    assert eng._queue_len == 3
+    # preempted requests ride ahead of never-admitted ones, in the
+    # preempted request's OWN tenant sub-queue
+    eng.preempt(0)
+    assert [r.rid for r in eng.tenants["a"].fifo] == [a1, a2, a3]
+    assert [r.rid for r in eng.queue][0] == a1
+    eng.check_invariants()
+
+
+def test_wfq_admission_order_weighted():
+    """Weighted fair admission over the sub-queue heads: weight-2
+    tenant admits twice per weight-1 admission under contention."""
+    eng = PagedServingEngine(
+        make_model(), max_batch=1, block_size=4, num_blocks=64,
+        tenants={"a": {"weight": 2.0}, "b": {"weight": 1.0}})
+    rng = np.random.default_rng(6)
+
+    def prompt():
+        return paddle.to_tensor(
+            rng.standard_normal((5, DIM)).astype(np.float32))
+
+    rids = {}
+    for i in range(4):
+        rids[eng.submit(prompt(), tenant_id="a")] = "a"
+    for i in range(2):
+        rids[eng.submit(prompt(), tenant_id="b")] = "b"
+    order = []
+    for _ in range(6):
+        (rid, slot, _) = eng.admitted.pop()
+        order.append(rids[rid])
+        eng.release(slot)
+    # rid 0 admits at submit (vclock 0 -> a at 0.5); then b (vtime 0)
+    # goes, and from there a's half-steps interleave one b per two a
+    assert order == ["a", "b", "a", "a", "b", "a"]
+    eng.check_invariants()
+
+
+def test_snapshot_queue_order_roundtrips_through_subqueues():
+    eng = PagedServingEngine(make_model(), max_batch=1, block_size=4,
+                             num_blocks=64)
+    rng = np.random.default_rng(7)
+
+    def prompt():
+        return paddle.to_tensor(
+            rng.standard_normal((5, DIM)).astype(np.float32))
+
+    eng.submit(prompt(), tenant_id="a")
+    q = [eng.submit(prompt(), tenant_id=t) for t in
+         ("a", "b", "a", "b", "c")]
+    eng.preempt(0)              # rid 0 requeues ahead of everything
+    want = [0] + q
+    assert [r.rid for r in eng.queue] == want
+    snap = eng.snapshot()
+    assert snap["queue"] == want
+    res = PagedServingEngine.restore(eng.model, snap)
+    assert [r.rid for r in res.queue] == want
+    for tid in ("a", "b", "c"):
+        assert [r.rid for r in res.tenants[tid].fifo] == \
+            [r.rid for r in eng.tenants[tid].fifo]
+    res.check_invariants()
