@@ -1364,6 +1364,167 @@ def bench_serving_recovery(smoke=False):
     }
 
 
+# --------------------------------------------------- disaggregated router
+def bench_serving_router(smoke=False):
+    """Disaggregated prefill/decode serving behind the fault-tolerant
+    prefix-aware router (inference/router.py): one prefill-role and
+    two decode-role workers (in-process transports of the SAME worker
+    harness the pipes rig runs) behind a Router that places by
+    longest-prefix-match, migrates finished prefills as PR 6 snapshot
+    slices, and owns the worker fault domain. Three configs over the
+    identical workload:
+
+      baseline   ONE engine (a worker's exact spec), uninterrupted —
+                 the stream oracle and the tokens/s denominator
+      router     the 3-worker fleet, no faults: the disaggregation
+                 tax (scrapes, migration exports/imports, resubmit
+                 hops) at equal total work
+      storm      a seeded kill storm — the prefill worker killed
+                 MID-MIGRATION (export leg), a decode worker killed
+                 MID-STREAM, the other decode worker hung through the
+                 circuit breaker — goodput vs the baseline, with the
+                 headline guarantees asserted in-bench: surviving
+                 streams BIT-IDENTICAL to the baseline, every outcome
+                 delivered exactly once, deep invariants on the
+                 surviving pools."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import (InProcWorker, RequestOutcome,
+                                      Router, RouterFaultInjector,
+                                      build_server_from_spec,
+                                      token_chain_hashes)
+
+    smoke = smoke or _SMOKE
+    if smoke:
+        dim, heads, ffn, layers = 32, 4, 64, 2
+        vocab, n_req, gen = 50, 5, 8
+    else:
+        dim, heads, ffn, layers = 256, 8, 1024, 2
+        vocab, n_req, gen = 512, 9, 24
+    block, prompt_len = 4, 8
+    mbps = -(-(prompt_len + gen + 2) // block) + 1
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_req)]
+    d = tempfile.mkdtemp(prefix="pt_router_bench_")
+
+    def spec(name):
+        return dict(d_model=dim, heads=heads, ffn=ffn, layers=layers,
+                    vocab=vocab, head_roll=1, block_size=block,
+                    num_blocks=4 * mbps + 2, max_blocks_per_seq=mbps,
+                    max_batch=4, monitor=True,
+                    journal_path=f"{d}/{name}.wal",
+                    snapshot_path=f"{d}/{name}.ckpt")
+
+    def run_baseline():
+        srv = build_server_from_spec(spec("solo"))
+        t0 = time.perf_counter()
+        rids = [srv.submit(p) for p in prompts]
+        done = {}
+        for _ in range(4000):
+            if len(done) == n_req:
+                break
+            srv.step()
+            for i, r in enumerate(rids):
+                if i not in done and \
+                        len(srv.engine.generated(r)) >= gen:
+                    done[i] = srv.engine.generated(r)[:gen]
+                    srv.release(r)
+        wall = time.perf_counter() - t0
+        model = srv.engine.target
+        srv.close()
+        assert len(done) == n_req
+        return wall, done, model
+
+    def run_router(model, tag, injector=None):
+        roles = {"pf": "prefill", "d1": "decode", "d2": "decode"}
+        workers = [InProcWorker(spec(f"{tag}_{n}"), name=n, role=ro)
+                   for n, ro in roles.items()]
+        r = Router(workers,
+                   hash_fn=lambda t: token_chain_hashes(model, t,
+                                                        block),
+                   injector=injector, backoff_ticks=1)
+        t0 = time.perf_counter()
+        rids = [r.submit(p, max_new_tokens=gen) for p in prompts]
+        ocs = []
+        for _ in range(4000):
+            r.step()
+            ocs += r.drain_outcomes()
+            if len(ocs) >= n_req:
+                break
+        wall = time.perf_counter() - t0
+        done = {i: r.generated(rid) for i, rid in enumerate(rids)}
+        r.check_invariants()
+        stats = r.stats
+        leftover = r.drain_outcomes()
+        r.close()
+        return wall, done, ocs + leftover, stats, rids
+
+    b_wall, b_done, model = run_baseline()
+    r_wall, r_done, r_ocs, r_stats, _ = run_router(model, "clean")
+    assert r_done == b_done, "router run diverged from baseline"
+
+    # the seeded storm: migration donor dies inside the export leg at
+    # the FIRST migration tick, a decode worker dies mid-stream, the
+    # other decode worker goes silent for two ticks mid-run
+    inj = RouterFaultInjector(
+        kill_at={1: {"pf": "export"}, 3: {"d1": "before_round"}},
+        hang_at={5: {"d2": 2}})
+    s_wall, s_done, s_ocs, s_stats, s_rids = run_router(
+        model, "storm", injector=inj)
+    shutil.rmtree(d, ignore_errors=True)
+
+    bit_identical = s_done == b_done
+    delivered = sorted(o.rid for o in s_ocs)
+    exactly_once = delivered == sorted(s_rids) and \
+        all(o.status == RequestOutcome.FINISHED for o in s_ocs)
+    total = n_req * gen
+    base_tps = total / b_wall
+    return {
+        "metric": "serving_router_kill_storm",
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "block_size": block, "requests": n_req,
+        "prompt_len": prompt_len, "gen_per_request": gen,
+        "workers": {"prefill": 1, "decode": 2},
+        "baseline": {
+            "wall_s": round(b_wall, 3),
+            "tokens_per_sec": round(base_tps, 1),
+        },
+        "router": {
+            "wall_s": round(r_wall, 3),
+            "tokens_per_sec": round(total / r_wall, 1),
+            "migrations": r_stats.migrations,
+            "migrated_blocks": r_stats.migrated_blocks,
+            "placed_prefix": r_stats.placed_prefix,
+        },
+        "kill_storm": {
+            "wall_s": round(s_wall, 3),
+            "goodput_tokens_per_sec": round(total / s_wall, 1),
+            "killed": inj.killed,
+            "hung_ops": inj.hung_ops,
+            "worker_deaths": s_stats.worker_deaths,
+            "worker_timeouts": s_stats.worker_timeouts,
+            "resubmissions": s_stats.resubmissions,
+            "migrations": s_stats.migrations,
+            "completed": len([o for o in s_ocs if o.status
+                              == RequestOutcome.FINISHED]),
+        },
+        "storm_goodput_vs_baseline": round(
+            (total / s_wall) / base_tps, 3),
+        "streams_bit_identical": bool(bit_identical),
+        "outcomes_exactly_once": bool(exactly_once),
+        "note": "3 worker harnesses (RecoverableServer each) behind "
+                "the router; placement by chain-hash longest-prefix "
+                "match, finished prefills migrated as content-"
+                "addressed snapshot slices and resumed via the "
+                "pending-token handoff; the storm kills the donor "
+                "mid-migration and a decode worker mid-stream "
+                "(tests/test_router.py proves the pipes variant with "
+                "real SIGKILLed processes)",
+    }
+
+
 # --------------------------------------------------------- chunked prefill
 def bench_serving_longprompt(smoke=False):
     """Chunked paged prefill vs the retired dense-scratch path on a
@@ -2434,6 +2595,7 @@ BENCHES = {
     "serving_faults": bench_serving_faults,
     "serving_tenants": bench_serving_tenants,
     "serving_recovery": bench_serving_recovery,
+    "serving_router": bench_serving_router,
     "serving_obs": bench_serving_obs,
     "serving_monitor": bench_serving_monitor,
     "serving_cost": bench_serving_cost,

@@ -46,7 +46,8 @@ from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedPrefillView,
                           chain_block_hashes, chain_hash)
 from .resilience import (CrashInjector, EngineCrash,  # noqa: F401
-                         FaultInjector, RequestOutcome)
+                         FaultInjector, RequestOutcome,
+                         RouterFaultInjector)
 from .scheduler import (DEFAULT_TENANT,  # noqa: F401
                         MIN_PREFILL_SUFFIX_ROWS,
                         PagedRequest, PagedServingEngine, Tenant,
@@ -57,6 +58,10 @@ from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
                        RecoverableServer, RecoveryError,
                        RequestJournal, SnapshotVersionError,
                        load_snapshot, read_journal, save_snapshot)
+from .router import (EngineWorker, InProcWorker,  # noqa: F401
+                     PipeWorker, Router, RouterStats, WorkerDied,
+                     WorkerError, WorkerTimeout,
+                     build_server_from_spec, token_chain_hashes)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "Alert", "ContinuousBatchingEngine",
@@ -75,7 +80,11 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "DEFAULT_TENANT",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
            "chain_block_hashes", "chain_hash", "load_snapshot",
-           "read_journal", "save_snapshot"]
+           "read_journal", "save_snapshot",
+           "EngineWorker", "InProcWorker", "PipeWorker", "Router",
+           "RouterFaultInjector", "RouterStats", "WorkerDied",
+           "WorkerError", "WorkerTimeout", "build_server_from_spec",
+           "token_chain_hashes"]
 
 
 class PrecisionType:

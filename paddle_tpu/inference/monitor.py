@@ -378,6 +378,22 @@ class HealthReport:
                 "verdict": self.verdict, "signals": self.signals,
                 "tenants": self.tenants, "alerts": self.alerts}
 
+    def placement(self) -> dict:
+        """Compact placement view — the handful of numbers a
+        disaggregated router (inference/router.py) needs from each
+        worker's scrape: overall verdict/score plus the last windowed
+        value of the load-bearing signals. Small enough to cross a
+        pipe every tick; the full report stays host-side."""
+        def last(name):
+            s = self.signals.get(name)
+            return None if not s else s.get("last")
+        return {"verdict": self.verdict, "score": self.score,
+                "step": self.step,
+                "pool_pressure": last("pool.pressure"),
+                "queue_depth": last("queue.depth"),
+                "shed_rate": last("shed_rate"),
+                "tokens_per_step": last("tokens_per_step")}
+
     def __repr__(self):
         return (f"HealthReport(step={self.step}, "
                 f"score={self.score:.2f}, {self.verdict}, "

@@ -1772,6 +1772,130 @@ class PagedKVCache:
             self._hash_to_block[h] = b
             self._block_hash[b] = h
 
+    # -- page migration (disaggregated serving) -----------------------
+    def export_slice(self, slot: int, hashes) -> Optional[dict]:
+        """Wire-format slice of ONE slot's finished prefix pages — the
+        page-MIGRATION payload a disaggregated router ships from a
+        prefill-heavy pool to a decode pool (inference/router.py).
+        ``hashes`` is the slot's chain-hash identity (one per FULL
+        block, ``PagedRequest.block_hashes``); the slice carries the
+        first ``min(len(hashes), blocks held)`` blocks as
+        content-addressed (hash, payload) pairs — exactly the
+        snapshot()'s per-block format, sliced to one slot — plus the
+        geometry the importer validates against. ONE fancy-index
+        gather per layer, no allocator state: export is a pure read.
+        Returns None when the slot holds no full indexed-identity
+        block yet (nothing migratable)."""
+        blocks = [int(b) for b in
+                  self.seq_blocks[slot][:len(hashes)]]
+        if not blocks:
+            return None
+        # gather ON DEVICE, transfer only the slice: pulling whole
+        # pools to host per export would cost O(pool) per migrated
+        # slot where the slice is a handful of blocks
+        ids = jnp.asarray(blocks, jnp.int32)
+        payload = np.stack([np.asarray(p.data[ids])
+                            for p in self.pools],
+                           axis=1)                # [n, L, 2, H, bs, D]
+        out = {
+            "kind": "kv_slice",
+            "geometry": {
+                "num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "block_size": self.block_size,
+                "dtype": self.dtype,
+            },
+            "hashes": list(hashes[:len(blocks)]),
+            "payload": payload,
+        }
+        if self.quantized:
+            out["scale_payload"] = np.stack(
+                [np.asarray(s.data[ids]) for s in self.scales],
+                axis=1)                           # [n, L, 2, H, bs]
+        return out
+
+    def import_slice(self, slc: dict) -> int:
+        """Adopt a migrated ``export_slice`` into THIS pool: each
+        (hash, page) lands as a CACHED-FREE hash-indexed block — the
+        same second-chance tier a released prefix parks in — so the
+        next ``adopt_prefix`` over the migrated request's chain
+        resurrects them and the suffix prefill skips the work the
+        source pool already did. Semantics:
+
+          * a hash already indexed here is SKIPPED (a colliding live
+            or cached prefix — by chain-hash identity the pool already
+            holds bit-identical content, and 1:1 block<->hash
+            bookkeeping must hold);
+          * blocks import in PREFIX ORDER and a pool that cannot hold
+            the next one stops early (an imported prefix is useful
+            exactly up to its first gap — match_prefix ends there);
+            allocation may LRU-reclaim older cached-free content,
+            the live allocator's normal policy;
+          * nothing is charged to any tenant (no table references) and
+            no slot state moves: the import is invisible to admission
+            until a request adopts it.
+
+        Returns the number of NEW blocks written. Raises ValueError on
+        a geometry/dtype mismatch (pages are raw pool rows — a wrong
+        shape would corrupt attention silently) or when this pool has
+        no prefix index to adopt into."""
+        if slc.get("kind") != "kv_slice":
+            raise ValueError(f"not a kv_slice: {slc.get('kind')!r}")
+        if not self.prefix_cache:
+            raise ValueError(
+                "import_slice needs prefix_cache=True — migrated "
+                "pages are adopted through the chain-hash index")
+        g = slc["geometry"]
+        mine = {"num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "block_size": self.block_size, "dtype": self.dtype}
+        if {k: g.get(k) for k in mine} != mine:
+            raise ValueError(
+                f"kv_slice geometry {g} does not match pool {mine}")
+        payload = np.asarray(slc["payload"])
+        if self.quantized and "scale_payload" not in slc:
+            raise ValueError(
+                "kv_slice carries no scales but this pool is int8 — "
+                "corrupt or hand-built slice")
+        spay = (np.asarray(slc["scale_payload"])
+                if self.quantized else None)
+        # resolve the importable set FIRST (collisions skipped, stop
+        # at the first allocation failure), then land it as ONE
+        # scatter per layer — not one dispatch per (block, layer)
+        landing: List[tuple] = []       # (pool block id, slice row)
+        for i, h in enumerate(slc["hashes"]):
+            if h in self._hash_to_block:
+                continue            # colliding prefix: already here
+            try:
+                b = self.allocator.alloc(1)[0]
+            except BlockOOM:
+                break               # pool full: keep the clean prefix
+            landing.append((b, i))
+        if not landing:
+            return 0
+        ids = jnp.asarray([b for b, _ in landing], jnp.int32)
+        rows = [i for _, i in landing]
+        for li in range(self.num_layers):
+            seg = jnp.asarray(payload[rows, li])
+            self.pools[li] = Tensor(
+                self.pools[li].data.at[ids].set(
+                    seg.astype(self.pools[li].data.dtype)))
+            if self.quantized:
+                self.scales[li] = Tensor(
+                    self.scales[li].data.at[ids].set(
+                        jnp.asarray(spay[rows, li], jnp.float32)))
+        for (b, i) in landing:
+            # fresh content: new audit epoch for the fingerprint
+            # check, then park cached-free in prefix (oldest-first
+            # LRU) order
+            self._audit_fp.pop(b, None)
+            self._hash_to_block[slc["hashes"][i]] = b
+            self._block_hash[b] = slc["hashes"][i]
+            self.allocator.free([b], to_cache=True)
+        return len(landing)
+
     # -- mixed ragged step --------------------------------------------
     def ragged_views(self, segments, tile_q=None,
                      tile_kv=None) -> List["PagedRaggedView"]:

@@ -207,10 +207,13 @@ class RequestJournal:
             # truncate) — and skips a second full scan
             recs, valid = (_scan_journal(path) if _scanned is None
                            else _scanned)
-            if valid is not None:
+            if valid is not None and valid < os.path.getsize(path):
                 # a torn tail record must be CUT before appending, or
                 # everything written after it would sit behind the
-                # break and never be read back
+                # break and never be read back. Only when there IS a
+                # torn tail: an intact journal reopens untouched, so
+                # repeated open/recover cycles never re-truncate (or
+                # even re-write) a clean file.
                 with open(path, "r+b") as f:
                     f.truncate(valid)
             if recs:
@@ -274,7 +277,15 @@ class RequestJournal:
         return max(0, before - len(data))
 
     def close(self) -> None:
-        self._f.close()
+        """Idempotent: closing a closed journal is a no-op, and the
+        append handle is released exactly once (no fd leak when a
+        host retires the same server twice)."""
+        if not self._f.closed:
+            self._f.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._f.closed
 
 
 def _scan_journal(path: str):
@@ -384,6 +395,7 @@ class RecoverableServer:
         # hot-path cost.
         self._snap_seq = 0          # journal.seq at the last snapshot
         self._snap_step = 0         # engine step at the last snapshot
+        self._closed = False
         engine.registry.attach("journal", self._journal_gauges)
         engine.registry.attach("snapshot", self._snapshot_gauges)
         if _fresh:
@@ -509,6 +521,25 @@ class RecoverableServer:
         self.journal.append("release", {"rid": int(rid)})
         self.engine.release(rid)
 
+    def export_slice(self, rid: int):
+        """Migration export (inference/router.py): ``rid``'s finished
+        prefix pages as a content-addressed kv_slice. A pure read —
+        nothing to journal; the SOURCE of a migration keeps serving
+        (or releasing) the request exactly as before."""
+        return self.engine.export_slice(rid)
+
+    def import_slice(self, slc: dict) -> int:
+        """Adopt a migrated slice into this server's target pool. The
+        slice is JOURNALED BEFORE the pool mutates, like a submit: a
+        crash after the append replays the import, so the pages a
+        replayed admission adopted are present again and the replayed
+        rounds re-emit identically. (The slice also becomes durable
+        here — a migration target that outlives its source still
+        holds the pages in its own lineage.)"""
+        self._flush_drains()
+        self.journal.append("import_slice", {"slice": slc})
+        return self.engine.import_slice(slc)
+
     def set_tenant(self, tenant_id: str, **cfg):
         """Journaled tenant registration/reconfiguration: the record
         replays after a crash, so quotas/weights/floors changed
@@ -536,10 +567,17 @@ class RecoverableServer:
 
     def close(self) -> None:
         """Clean shutdown: flush pending drain records and close the
-        journal fd. An incarnation abandoned after an ``EngineCrash``
-        does not need this — its handle is released when the object is
-        collected — but a host that cycles through many servers in one
-        process should close each one it retires."""
+        journal fd. IDEMPOTENT — a second close is a no-op (the flush
+        ran once, the fd was released once), so teardown paths that
+        cannot know whether the server was already retired (a router's
+        worker harness, test fixtures) may call it unconditionally.
+        An incarnation abandoned after an ``EngineCrash`` does not
+        need this — its handle is released when the object is
+        collected — but a host that cycles through many servers in
+        one process should close each one it retires."""
+        if self._closed:
+            return
+        self._closed = True
         self._flush_drains()
         self.journal.close()
 
@@ -639,6 +677,7 @@ class RecoverableServer:
             # incarnation observed live freeze; replay-born records
             # (and replayed steps a fresh ledger never saw) accumulate
             ledger.set_replay(True)
+        ok = False
         try:
             for seq, kind, payload in records:
                 if kind == "outcomes":
@@ -691,12 +730,23 @@ class RecoverableServer:
                         # over pool) before any mutation: no-op on
                         # replay too
                         pass
+                elif kind == "import_slice":
+                    # re-adopt the migrated pages the live call
+                    # imported: replayed admissions then adopt the
+                    # same prefix the live ones did. A ValueError
+                    # (geometry mismatch) was raised live before any
+                    # mutation — same no-op argument as submit.
+                    try:
+                        eng.import_slice(payload["slice"])
+                    except ValueError:
+                        pass
                 elif kind == "compact":
                     # a compaction marker reuses the covered seq, so
                     # the seq-gate above already skips it; belt and
                     # braces for a marker that somehow outran its
                     # snapshot
                     pass
+            ok = True
         finally:
             if injector is not None:
                 injector.arm(True)
@@ -706,6 +756,13 @@ class RecoverableServer:
                 monitor.set_replay(False)
             if ledger is not None:
                 ledger.set_replay(False)
+            if not ok:
+                # a failed replay (RecoveryError divergence) abandons
+                # this half-built server: release its journal append
+                # handle so the caller can retry recovery — or point a
+                # doctor at the files — without a leaked fd holding
+                # the journal open
+                journal.close()
         # outcomes regenerated by the replay that were already drained
         # pre-crash: drop them here, exactly-once stands
         eng.outcomes[:] = [oc for oc in eng.outcomes

@@ -64,7 +64,7 @@ import numpy as np
 from .paged_cache import BlockOOM
 
 __all__ = ["RequestOutcome", "FaultInjector", "CrashInjector",
-           "EngineCrash"]
+           "EngineCrash", "RouterFaultInjector"]
 
 
 class EngineCrash(RuntimeError):
@@ -93,9 +93,16 @@ class RequestOutcome:
     # Delivered as a terminal outcome, never an exception: submit()
     # still returns a rid and the verdict rides ``outcomes``.
     REJECTED_ADMISSION = "rejected_admission"
+    # router-level terminal verdict (inference/router.py): no live
+    # worker could take the request — every worker is dead/suspended
+    # (all-workers-down degrades to THIS, deterministically, instead
+    # of hanging) or every placement/retry attempt was exhausted.
+    # Engines never emit it; it exists so the fleet boundary speaks
+    # the same outcome taxonomy as the engines behind it.
+    FAILED_UNROUTABLE = "failed_unroutable"
 
     STATUSES = (FINISHED, FAILED_OOM, FAILED_NUMERIC, FAILED_DEADLINE,
-                REJECTED_ADMISSION)
+                REJECTED_ADMISSION, FAILED_UNROUTABLE)
 
     __slots__ = ("rid", "status", "reason", "tokens", "preemptions",
                  "step")
@@ -400,3 +407,122 @@ class CrashInjector(FaultInjector):
         return (f"CrashInjector(seed={self.seed}, round={self.round}, "
                 f"crashes={self.crashes}, oom={self.injected_oom}, "
                 f"nan={self.injected_nan})")
+
+
+class RouterFaultInjector(CrashInjector):
+    """CrashInjector extended one fault domain up: deterministic
+    WORKER kills and hangs, keyed by the router's TICK clock (one
+    tick per ``Router.step``; ``begin_tick`` is called at the top of
+    every tick, the router-level mirror of ``begin_round``). The
+    router consults ``on_worker_op(worker, point)`` immediately
+    before each operation it is about to issue to a worker:
+
+      "kill"  the worker dies AT that point — the handle is killed
+              (a pipes worker takes a real SIGKILL; an in-process
+              worker is abandoned) and the op fails with WorkerDied.
+              Each scheduled (tick, worker) kill fires at most once.
+      "hang"  the worker goes silent: this op (and every op until the
+              scheduled hang expires) fails with WorkerTimeout while
+              the worker itself stays alive and UNAWARE — exactly a
+              hung/partitioned process. The router's circuit breaker
+              owns the consequence.
+
+      kill_at   {tick: {worker_name: point}} — point is one of
+                ``ROUTER_POINTS`` ("submit", "before_round",
+                "after_round", "export", "import", "scrape", "ping"),
+                matched against the op the router is about to issue;
+                "before_round"/"after_round" bracket the worker's
+                serving round ("after_round" kills AFTER the round
+                call returned, so the round's emissions were seen —
+                the death is detected at the next tick's op).
+      hang_at   {tick: {worker_name: n_ticks}} — from that tick the
+                worker times out for n_ticks ticks, then answers
+                again (the stale-copy release path).
+
+    The engine-level schedules (``oom_at``/``nan_at``/``crash_at``)
+    are inherited but belong to PER-WORKER injectors — this object
+    rides the router, which never owns an engine step clock. Pure
+    schedule playback like every injector: zero overhead when absent,
+    identical storms run after run."""
+
+    ROUTER_POINTS = ("submit", "before_round", "after_round",
+                     "export", "import", "scrape", "ping")
+
+    def __init__(self, kill_at=None, hang_at=None, seed: int = 0,
+                 **fault_kw):
+        super().__init__(seed=seed, **fault_kw)
+        self.kill_at: Dict[int, dict] = {}
+        for t, m in (kill_at or {}).items():
+            for w, point in m.items():
+                if point not in self.ROUTER_POINTS:
+                    raise ValueError(
+                        f"unknown router kill point {point!r} (one "
+                        f"of {self.ROUTER_POINTS})")
+            self.kill_at[int(t)] = dict(m)
+        self.hang_at: Dict[int, dict] = {
+            int(t): {str(w): int(n) for w, n in m.items()}
+            for t, m in (hang_at or {}).items()}
+        self.tick = 0
+        self.killed = 0
+        self.hung_ops = 0
+        self._hang_until: Dict[str, int] = {}
+
+    @classmethod
+    def kill_storm(cls, seed: int, ticks: int, workers, *,
+                   kills: int = 2, hangs: int = 0,
+                   first_tick: int = 2,
+                   points=("before_round",)) -> "RouterFaultInjector":
+        """Seeded random router storm: ``kills`` worker deaths and
+        ``hangs`` transient silences at distinct ticks in
+        [first_tick, ticks), each aimed at a random worker. Same seed
+        -> same storm."""
+        rng = np.random.RandomState(seed)
+        workers = list(workers)
+        n = kills + hangs
+        if ticks - first_tick < n:
+            raise ValueError("not enough ticks for the router storm")
+        picks = rng.choice(np.arange(first_tick, ticks), size=n,
+                           replace=False)
+        kill_at = {int(t): {workers[rng.randint(len(workers))]:
+                            points[rng.randint(len(points))]}
+                   for t in picks[:kills]}
+        hang_at = {int(t): {workers[rng.randint(len(workers))]:
+                            int(rng.randint(1, 3))}
+                   for t in picks[kills:]}
+        return cls(kill_at=kill_at, hang_at=hang_at, seed=seed)
+
+    def begin_tick(self) -> None:
+        self.tick += 1
+
+    def on_worker_op(self, worker: str, point: str) -> Optional[str]:
+        """Verdict for the op the router is about to issue: None
+        (proceed), "kill" (kill the worker first), or "hang" (the op
+        times out; the worker never sees it)."""
+        if not self._armed:
+            return None
+        sched = self.hang_at.get(self.tick)
+        if sched and worker in sched:
+            self._hang_until[worker] = self.tick + sched.pop(worker)
+        until = self._hang_until.get(worker)
+        if until is not None:
+            if self.tick < until:
+                self.hung_ops += 1
+                return "hang"
+            del self._hang_until[worker]
+        sched = self.kill_at.get(self.tick)
+        if sched and sched.get(worker) == point:
+            del sched[worker]
+            self.killed += 1
+            return "kill"
+        return None
+
+    def as_dict(self) -> dict:
+        d = super().as_dict()
+        d.update({"tick": self.tick, "killed": self.killed,
+                  "hung_ops": self.hung_ops})
+        return d
+
+    def __repr__(self):
+        return (f"RouterFaultInjector(seed={self.seed}, "
+                f"tick={self.tick}, killed={self.killed}, "
+                f"hung_ops={self.hung_ops})")

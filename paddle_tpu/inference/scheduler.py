@@ -2146,6 +2146,46 @@ class PagedServingEngine:
         self.resilience_stats.audits += 1
         return True
 
+    # -- page migration (disaggregated serving) -----------------------
+    def export_request_slice(self, rid: int) -> Optional[dict]:
+        """Migration export (inference/router.py): the wire-format
+        slice of ``rid``'s finished prefix pages — its chain-hash
+        identity paired with the pool blocks that hold them
+        (``PagedKVCache.export_slice``). Only pages a different pool
+        could ADOPT ride along: full blocks the slot has actually
+        computed (an active slot's decoded extent, a mid-prefill
+        slot's chunk frontier). Returns None when the request is
+        unknown, still queued, or holds no full block yet — the
+        router then migrates cold (plain resubmission). A pure read:
+        no allocator or scheduler state moves."""
+        self._flush_history()
+        req = None
+        for r in self._requests:
+            if r is not None and r.rid == rid:
+                req = r
+                break
+        if req is None or req.slot is None:
+            return None
+        slot = int(req.slot)
+        if self.prefilling[slot]:
+            covered = int(self._prefills[slot]["pos"])
+        else:
+            covered = int(self.lens[slot])
+        n_full = covered // self.cache.block_size
+        if n_full <= 0:
+            return None
+        hashes = req.block_hashes(self.cache.block_size)[:n_full]
+        if not hashes:
+            return None
+        return self.cache.export_slice(slot, hashes)
+
+    def import_slice(self, slc: dict) -> int:
+        """Adopt a migrated slice into this engine's pool
+        (``PagedKVCache.import_slice``): pages land cached-free +
+        hash-indexed, so the migrated request's resubmission hits
+        them through the normal prefix-cache admission path."""
+        return self.cache.import_slice(slc)
+
     # -- checkpoint / restore -----------------------------------------
     @staticmethod
     def _stats_rec(st) -> dict:

@@ -372,22 +372,54 @@ class SpeculativeEngine:
                max_preemptions: Optional[int] = None,
                deadline_steps: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               tenant_id: Optional[str] = None) -> int:
+               tenant_id: Optional[str] = None,
+               resume: bool = False) -> int:
         """Queue a token-ID prompt; admission (now or later) samples
         the first token on-device and prefills the draft cache. The
         resilience and tenancy knobs pass straight through to the
         wrapped PagedServingEngine (see its ``submit``); terminal
         RequestOutcomes — including a health-based
-        ``REJECTED_ADMISSION`` — surface in ``outcomes``."""
+        ``REJECTED_ADMISSION`` — surface in ``outcomes``.
+
+        ``resume=True`` HANDS OFF a stream that was already running on
+        another engine (the disaggregated router's resubmission path,
+        inference/router.py): the LAST token of ``token_ids`` is an
+        already-sampled, not-yet-consumed PENDING token — exactly the
+        host-side state a preempted request carries — so admission
+        prefills only ``token_ids[:-1]`` and does NOT sample a first
+        token; the first round after admission consumes the pending
+        token through the normal decode path. This is what makes a
+        cross-engine handoff BIT-IDENTICAL to the uninterrupted run:
+        a fresh submit of prompt+generated would re-sample the
+        handoff token from a multi-row PREFILL hidden, while the
+        donor engine sampled it from a one-row DECODE hidden — the
+        two executables differ by accumulation order (the
+        MIN_PREFILL_SUFFIX_ROWS trap), so only the resume path keeps
+        the stream's bytes. Requires >= 2 tokens (a nonempty prompt
+        plus the pending token)."""
         toks = [int(t) for t in np.asarray(token_ids).reshape(-1)]
         if not toks:
             raise ValueError("empty prompt")
-        rid = self.engine.submit(self.target.embed(toks),
+        if resume and len(toks) < 2:
+            raise ValueError(
+                "resume=True needs >= 2 tokens: a nonempty consumed "
+                "prefix plus the pending (sampled, unconsumed) token")
+        prefix = toks[:-1] if resume else toks
+        rid = self.engine.submit(self.target.embed(prefix),
                                  max_preemptions=max_preemptions,
                                  deadline_steps=deadline_steps,
                                  deadline_s=deadline_s,
                                  tenant_id=tenant_id)
-        self._by_rid[rid] = _SpecSeq(rid, toks)
+        seq = _SpecSeq(rid, toks)
+        if resume:
+            # prompt_len counts the whole handed-off stream: THIS
+            # engine generated none of it, so generated(rid) reports
+            # only tokens minted here (the router owns the global
+            # stream record). started=True suppresses the admission
+            # sample — the pending token is toks[-1].
+            seq.prompt_len = len(toks)
+            seq.started = True
+        self._by_rid[rid] = seq
         self._handle_events()
         return rid
 
@@ -403,6 +435,18 @@ class SpeculativeEngine:
 
     def tenant_report(self):
         return self.engine.tenant_report()
+
+    def export_slice(self, rid: int) -> Optional[dict]:
+        """Migration export of ``rid``'s finished prefix pages from
+        the TARGET pool (the draft pool is derived state — a migrated
+        request's new host rebuilds it from the token stream like any
+        re-admission). See PagedServingEngine.export_request_slice."""
+        return self.engine.export_request_slice(rid)
+
+    def import_slice(self, slc: dict) -> int:
+        """Adopt a migrated slice into the TARGET pool (cached-free +
+        hash-indexed; the resubmitted request's admission adopts)."""
+        return self.engine.import_slice(slc)
 
     def tokens(self, rid: int) -> List[int]:
         """Full stream (prompt + generated) of a request."""

@@ -258,6 +258,17 @@ class MetricsRegistry:
             _flatten(prefix, d, out)
         return out
 
+    def scrape(self, prefixes) -> dict:
+        """``as_dict()`` filtered to keys under any of ``prefixes`` —
+        the per-worker WIRE payload a fleet router samples
+        (inference/router.py): the full flat dict drags along
+        per-request latency histograms and tenant detail a placement
+        decision has no use for, and scrape payloads cross a pipe
+        every tick."""
+        pref = tuple(str(p) for p in prefixes)
+        return {k: v for k, v in self.as_dict().items()
+                if k.startswith(pref)}
+
     def delta_since(self, prev: dict) -> dict:
         """Numeric differences between the current snapshot and a
         previous ``as_dict()`` (keys absent before count from 0);

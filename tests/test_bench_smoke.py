@@ -121,6 +121,35 @@ def test_serving_recovery_smoke_leg():
     assert res["snapshot_overhead_pct"] < 50
 
 
+def test_serving_router_smoke_leg():
+    res = bench_extra.bench_serving_router(smoke=True)
+    assert res["metric"] == "serving_router_kill_storm"
+    # the headline guarantees rode the bench: storm-surviving streams
+    # are BIT-IDENTICAL to the uninterrupted single-engine run and
+    # every outcome was delivered exactly once at the router
+    assert res["streams_bit_identical"] is True
+    assert res["outcomes_exactly_once"] is True
+    # the seeded storm really fired: the prefill donor died inside
+    # the migration export, a decode worker died mid-stream, and the
+    # remaining decode worker hung through the circuit breaker
+    storm = res["kill_storm"]
+    assert storm["killed"] == 2
+    assert storm["worker_deaths"] == 2
+    assert storm["hung_ops"] >= 1
+    assert storm["worker_timeouts"] >= 1
+    assert storm["resubmissions"] >= 1
+    assert storm["completed"] == res["requests"]
+    # the clean fleet really disaggregated: streams moved prefill ->
+    # decode with their pages, and repeat prefixes placed by match
+    assert res["router"]["migrations"] >= 1
+    assert res["router"]["migrated_blocks"] >= 1
+    # every config served every token (goodput ratios are asserted at
+    # bench scale only — smoke shapes are jitter-dominated)
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["router"]["tokens_per_sec"] > 0
+    assert storm["goodput_tokens_per_sec"] > 0
+
+
 def test_serving_tenants_smoke_leg():
     res = bench_extra.bench_serving_tenants(smoke=True)
     assert res["metric"] == "serving_tenant_isolation_noisy_neighbor"

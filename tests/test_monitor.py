@@ -945,6 +945,64 @@ class TestHealthReportTool:
         with open(p2, "w") as f:
             f.write("{nope")
         assert health_report.main([p2]) == 2
+        # several reports without --fleet is a usage error, not a
+        # silent first-file render
+        assert health_report.main([p, p]) == 2
+
+    def test_fleet_mode_aggregates_and_gates(self, tmp_path, capsys):
+        """Satellite: ``--fleet`` renders N workers' dumps as ONE
+        placement/verdict table (the router's scraped inputs) and
+        exits 1 when ANY worker is critical."""
+        from tools import health_report
+        # healthy worker
+        reg = MetricsRegistry()
+        ok = HealthMonitor()
+        ok.bind(reg)
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 2)
+        ok.on_step(1)
+        p_ok = str(tmp_path / "w_ok.json")
+        ok.save(p_ok)
+        # critical worker (pool pinned)
+        reg2 = MetricsRegistry()
+        bad = HealthMonitor()
+        bad.bind(reg2)
+        reg2.gauge("pool.usable", 10)
+        reg2.gauge("pool.active", 10)
+        bad.on_step(1)
+        p_bad = str(tmp_path / "w_bad.json")
+        bad.save(p_bad)
+
+        assert health_report.main(["--fleet", p_ok]) == 0
+        out = capsys.readouterr().out
+        assert "fleet: 1 worker(s)" in out and "w_ok" in out
+        rc = health_report.main(["--fleet", p_ok, p_bad])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "critical=1" in out and "w_bad" in out
+        # machine envelope: shared paddle_tpu.report.v1 schema
+        rc = health_report.main(["--fleet", "--json", p_ok, p_bad])
+        env = json.loads(capsys.readouterr().out)
+        assert rc == 1 and env["schema"] == "paddle_tpu.report.v1"
+        assert env["tool"] == "health_report" and not env["ok"]
+        assert [w["worker"] for w in env["data"]["fleet"]] == \
+            ["w_ok", "w_bad"]
+        assert env["data"]["fleet"][1]["verdict"] == "critical"
+        assert any("w_bad" in p for p in env["problems"])
+        # HealthReport.placement (the live scrape view) and the
+        # offline row are two renderings of the SAME field set —
+        # compare EVERY shared field so the copies cannot drift
+        # silently (the trace_report lesson from PR 11)
+        pl = bad.report().placement()
+        row = env["data"]["fleet"][1]
+        shared = set(pl) & set(row)
+        assert shared == {"verdict", "score", "step",
+                          "pool_pressure", "queue_depth",
+                          "shed_rate", "tokens_per_step"}
+        for k in shared:
+            assert pl[k] == row[k], f"placement/fleet drift on {k!r}"
+        assert pl["verdict"] == "critical"
+        assert pl["pool_pressure"] == 1.0
 
 
 class TestTraceReportSlo:

@@ -1,0 +1,868 @@
+"""Disaggregated prefill/decode serving behind the fault-tolerant,
+prefix-aware router (inference/router.py + the page-migration surgery
+in paged_cache.py / scheduler.py / speculative.py / recovery.py and
+RouterFaultInjector in resilience.py).
+
+The acceptance bar is KILL-STORM BIT-IDENTITY ACROSS PROCESS
+BOUNDARIES: under a seeded schedule of worker kills and hangs —
+decode workers dying mid-stream, prefill workers dying mid-migration,
+workers going silent behind the circuit breaker — every surviving
+stream is BIT-IDENTICAL to an uninterrupted single-engine run, every
+terminal outcome is delivered at the router exactly once, deep
+invariants hold on every surviving pool, and all-workers-down
+degrades to a deterministic terminal outcome instead of a hang."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (CrashInjector, EngineCrash,
+                                  InProcWorker, PipeWorker,
+                                  RecoverableServer, RequestOutcome,
+                                  Router, RouterFaultInjector,
+                                  WorkerDied,
+                                  build_server_from_spec,
+                                  read_journal, token_chain_hashes)
+
+pytestmark = pytest.mark.router
+
+VOCAB, BS = 50, 4
+# head_roll=1: greedy streams WALK the vocab instead of collapsing to
+# the tied readout's fixed point — a wrong handoff cannot hide inside
+# a constant stream (see build_server_from_spec)
+BASE = dict(head_roll=1, block_size=BS, num_blocks=80,
+            max_blocks_per_seq=10)
+
+_RNG = np.random.RandomState(77)
+PROMPTS = [[int(t) for t in _RNG.randint(0, VOCAB, 6)]
+           for _ in range(3)]
+
+
+def _spec(tmp_path, name, **kw):
+    d = dict(BASE, journal_path=str(tmp_path / f"{name}.wal"),
+             snapshot_path=str(tmp_path / f"{name}.ckpt"))
+    d.update(kw)
+    return d
+
+
+def _worker(tmp_path, name, role="mixed", **kw):
+    return InProcWorker(_spec(tmp_path, name, **kw), name=name,
+                        role=role)
+
+
+def _model_of(w):
+    return w.worker.server.engine.target
+
+
+def _tsm():
+    """The exact TokenServingModel ``build_server_from_spec`` builds
+    for BASE (same seeds, same rolled readout) — for tests that wire
+    an engine by hand but must stay stream-compatible."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference import TokenServingModel
+    paddle.seed(0)
+    core = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    emb = np.random.RandomState(1234).randn(VOCAB, 32).astype(
+        np.float32)
+    return TokenServingModel(core, emb,
+                             lm_head=np.roll(emb, -1, 0).T.copy())
+
+
+def _hash_fn(model):
+    return lambda toks: token_chain_hashes(model, toks, BS)
+
+
+# streams are a pure function of (prompts, n, spec knobs) — the
+# journal/snapshot paths do not shape them — so the baseline is
+# computed once per distinct workload, not once per test (the suite
+# reuses the same three prompts across most storms)
+_BASELINE_CACHE = {}
+
+
+def _single_engine_streams(tmp_path, prompts, n, **kw):
+    """Uninterrupted single-engine baseline: the streams every storm
+    survivor must reproduce bit-for-bit."""
+    key = (tuple(tuple(p) for p in prompts), n,
+           tuple(sorted(kw.items())))
+    if key in _BASELINE_CACHE:
+        return dict(_BASELINE_CACHE[key])
+    srv = build_server_from_spec(_spec(tmp_path, "solo", **kw))
+    rids = [srv.submit(p) for p in prompts]
+    done = {}
+    for _ in range(40 * len(prompts)):
+        if len(done) == len(rids):
+            break
+        srv.step()
+        for i, r in enumerate(rids):
+            if i not in done and len(srv.engine.generated(r)) >= n:
+                done[i] = srv.engine.generated(r)[:n]
+                srv.release(r)
+    srv.close()
+    assert len(done) == len(rids)
+    _BASELINE_CACHE[key] = dict(done)
+    return done
+
+
+def _drive(router, want_outcomes, max_ticks=80):
+    ocs = []
+    for _ in range(max_ticks):
+        router.step()
+        ocs += router.drain_outcomes()
+        if len(ocs) >= want_outcomes:
+            break
+    return ocs
+
+
+# ---------------------------------------------------------------------
+# migration wire format (export_slice / import_slice)
+# ---------------------------------------------------------------------
+
+class TestSliceWireFormat:
+    def test_export_import_round_trip_and_adoption(self, tmp_path):
+        """A slice exported from one server imports into another as
+        cached-free indexed pages, and a resume submission adopts
+        them: the suffix prefill skips the migrated work and the
+        continued stream is bit-identical to the donor's own."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        a = build_server_from_spec(_spec(tmp_path, "a"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(5):
+            a.step()
+        gen = a.engine.generated(ra)
+        assert len(gen) >= 4
+        slc = a.export_slice(ra)
+        assert slc is not None and slc["kind"] == "kv_slice"
+        assert len(slc["hashes"]) == slc["payload"].shape[0] > 0
+
+        b = build_server_from_spec(_spec(tmp_path, "b"))
+        cache = b.engine.engine.cache
+        imported = b.import_slice(slc)
+        assert imported == len(slc["hashes"])
+        for h in slc["hashes"]:
+            assert h in cache._hash_to_block
+        assert b.check_invariants()
+        # the import is invisible to tenancy/occupancy-active until a
+        # request adopts it: all imported pages sit cached-free
+        occ = cache.pool_occupancy(tiers_only=True)
+        assert occ["cached_free"] >= imported
+        handoff = PROMPTS[0] + gen[:4]
+        rb = b.submit(handoff, resume=True)
+        for _ in range(n):
+            b.step()
+        skipped = b.engine.engine.prefix_stats.tokens_skipped
+        assert skipped > 0, "migrated pages were not adopted"
+        assert (gen[:4] + b.engine.generated(rb))[:n] == base[0]
+        assert b.check_invariants()
+        a.close()
+        b.close()
+
+    def test_int8_slice_round_trip(self, tmp_path):
+        """Quantized pools migrate too: the slice carries the int8
+        payload AND its per-row scales, and adoption stays EXACT
+        (quantized bytes are a pure function of the token stream —
+        PR 12), so the migrated continuation matches the donor's own
+        bit-for-bit."""
+        a = build_server_from_spec(_spec(tmp_path, "a",
+                                         kv_dtype="int8"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(10):
+            a.step()
+        gen = a.engine.generated(ra)
+        slc = a.export_slice(ra)
+        assert "scale_payload" in slc
+        b = build_server_from_spec(_spec(tmp_path, "b",
+                                         kv_dtype="int8"))
+        assert b.import_slice(slc) == len(slc["hashes"])
+        rb = b.submit(PROMPTS[0] + gen[:4], resume=True)
+        for _ in range(6):
+            b.step()
+        cont = b.engine.generated(rb)
+        assert cont == gen[4:4 + len(cont)] and len(cont) >= 5
+        assert b.engine.engine.prefix_stats.tokens_skipped > 0
+        assert b.check_invariants()
+        # a float slice cannot land in an int8 pool (and vice versa)
+        c = build_server_from_spec(_spec(tmp_path, "c"))
+        with pytest.raises(ValueError, match="geometry"):
+            c.import_slice(slc)
+        a.close()
+        b.close()
+        c.close()
+
+    def test_import_guards(self, tmp_path):
+        a = build_server_from_spec(_spec(tmp_path, "a"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(4):
+            a.step()
+        slc = a.export_slice(ra)
+        # geometry mismatch is a named refusal, not corruption
+        b = build_server_from_spec(_spec(tmp_path, "b", d_model=48,
+                                         ffn=96))
+        with pytest.raises(ValueError, match="geometry"):
+            b.import_slice(slc)
+        with pytest.raises(ValueError, match="kv_slice"):
+            b.import_slice({"kind": "nonsense"})
+        # a pool without a prefix index cannot adopt
+        c = build_server_from_spec(_spec(tmp_path, "c",
+                                         prefix_cache=False))
+        with pytest.raises(ValueError, match="prefix_cache"):
+            c.import_slice(slc)
+        # unknown / queued rids export None (router migrates cold)
+        assert a.export_slice(10_000) is None
+        a.close()
+        b.close()
+        c.close()
+
+    def test_import_replays_after_crash(self, tmp_path):
+        """The imported slice is journaled: a crash after the import
+        replays it, so replayed admissions re-adopt the same pages
+        and the recovered stream continues bit-identically."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        a = build_server_from_spec(_spec(tmp_path, "a"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(5):
+            a.step()
+        gen = a.engine.generated(ra)
+        slc = a.export_slice(ra)
+        a.close()
+
+        inj = CrashInjector(crash_at={2: "begin"})
+        jp, sp = (str(tmp_path / "b.wal"), str(tmp_path / "b.ckpt"))
+        tsm = _tsm()
+        from paddle_tpu.inference import SpeculativeEngine
+        srv = RecoverableServer(
+            SpeculativeEngine(tsm, None, k=0, max_batch=2,
+                              block_size=BS, num_blocks=80,
+                              max_blocks_per_seq=10,
+                              prefix_cache=True, injector=inj),
+            journal_path=jp, snapshot_path=sp)
+        srv.import_slice(slc)
+        rb = srv.submit(PROMPTS[0] + gen[:4], resume=True)
+        crashed = False
+        out = []
+        for _ in range(20):
+            if len(out) >= 4:
+                break
+            try:
+                srv.step()
+            except EngineCrash:
+                crashed = True
+                srv = RecoverableServer.recover(
+                    tsm, None, journal_path=jp, snapshot_path=sp,
+                    injector=inj)
+                srv.check_invariants()
+            out = srv.engine.generated(rb)
+        assert crashed
+        assert (gen[:4] + out)[:n] == base[0]
+        kinds = [k for _, k, _ in read_journal(jp)]
+        assert "import_slice" in kinds
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------
+
+class TestPlacement:
+    def test_prefix_match_beats_load_and_fresh_prefers_prefill(
+            self, tmp_path):
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), migrate=False)
+        # fresh prompt -> the prefill-role worker
+        r1 = r.submit(PROMPTS[0], max_new_tokens=20)
+        assert r._reqs[r1].worker == "w1"
+        assert r.stats.placed_fresh == 1
+        for _ in range(3):
+            r.step()
+        # same prompt again: w1 advertises its chain hashes now, so
+        # the prefix match places it there even though w1 is busier
+        r2 = r.submit(PROMPTS[0], max_new_tokens=20)
+        assert r._reqs[r2].worker == "w1"
+        assert r.stats.placed_prefix == 1
+        # a different prompt has no match anywhere -> fresh placement
+        r3 = r.submit(PROMPTS[1], max_new_tokens=20)
+        assert r.stats.placed_fresh == 2
+        assert r._reqs[r3].worker == "w1"    # prefill-role preference
+        r.close()
+
+    def test_pressure_spillover(self, tmp_path):
+        """A best-match worker over the pressure threshold is passed
+        over for a cooler one: prefix affinity never overrides
+        overload."""
+        # w1 tiny: two streams pin its pool near full
+        w1 = _worker(tmp_path, "w1", role="mixed", num_blocks=9)
+        w2 = _worker(tmp_path, "w2", role="mixed")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), migrate=False,
+                   spill_pressure=0.5)
+        r.submit(PROMPTS[0], max_new_tokens=30)
+        r.submit(PROMPTS[1], max_new_tokens=30)
+        for _ in range(4):
+            r.step()
+        assert r._workers["w1"].pressure >= 0.5
+        rid = r.submit(PROMPTS[0], max_new_tokens=4)
+        assert r._reqs[rid].worker == "w2"
+        assert r.stats.spillovers >= 1
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# migration
+# ---------------------------------------------------------------------
+
+class TestMigration:
+    def test_prefill_to_decode_migration_bit_identical(self, tmp_path):
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model))
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert sorted(o.rid for o in ocs) == sorted(rids)
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        # the disaggregation actually happened: streams moved, pages
+        # moved with them, and the decode worker ADOPTED them (its
+        # suffix prefills skipped the donor's work)
+        assert r.stats.migrations >= len(rids)
+        assert r.stats.migrated_blocks > 0
+        dec = w2.worker.server.engine.engine
+        assert dec.prefix_stats.tokens_skipped > 0
+        assert r.check_invariants()
+        r.close()
+
+
+class TestMigrationEdgeCases:
+    def test_import_with_colliding_live_prefix(self, tmp_path):
+        """Importing a slice whose prefix already lives in the target
+        pool (another request computed the same prompt) skips the
+        colliding blocks — 1:1 hash<->block bookkeeping holds, the
+        deep audit stays green, and adoption still covers the full
+        migrated prefix."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        a = build_server_from_spec(_spec(tmp_path, "a"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(6):
+            a.step()
+        gen = a.engine.generated(ra)
+        slc = a.export_slice(ra)     # prompt + several decode blocks
+        b = build_server_from_spec(_spec(tmp_path, "b"))
+        rb0 = b.submit(PROMPTS[0])   # live colliding prefix on b
+        b.step()
+        cache = b.engine.engine.cache
+        pre = len(cache._hash_to_block)
+        imported = b.import_slice(slc)
+        # some blocks collided (the live prompt pages), some were new
+        assert 0 < imported < len(slc["hashes"])
+        assert len(cache._hash_to_block) == pre + imported
+        assert b.check_invariants()
+        rb = b.submit(PROMPTS[0] + gen[:5], resume=True)
+        for _ in range(n):
+            b.step()
+        assert (gen[:5] + b.engine.generated(rb))[:n] == base[0]
+        assert b.engine.engine.prefix_stats.tokens_skipped > 0
+        assert b.check_invariants()
+        b.release(rb0)
+        a.close()
+        b.close()
+
+    def test_slice_outlives_dead_source(self, tmp_path):
+        """The slice is self-contained: importing and adopting it
+        after the donor worker died works unchanged (at-least-once
+        handoff — the pages' content address is the content)."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        slc = None
+        gen = None
+        resp = w1.request("submit", {"tokens": PROMPTS[0]})
+        wrid = resp["rid"]
+        for _ in range(5):
+            w1.request("round", {})
+        gen = w1.worker.server.engine.generated(wrid)
+        slc = w1.request("export_slice", {"rid": wrid})["slice"]
+        w1.kill()                    # donor dies AFTER the export
+        with pytest.raises(WorkerDied):
+            w1.request("ping", {})
+        b = build_server_from_spec(_spec(tmp_path, "b"))
+        assert b.import_slice(slc) == len(slc["hashes"])
+        rb = b.submit(PROMPTS[0] + gen[:4], resume=True)
+        for _ in range(n):
+            b.step()
+        assert (gen[:4] + b.engine.generated(rb))[:n] == base[0]
+        assert b.engine.engine.prefix_stats.tokens_skipped > 0
+        assert b.check_invariants()
+        b.close()
+
+    def test_migrated_then_preempted_warm_resume(self, tmp_path):
+        """A migrated stream that later gets PREEMPTED on its new
+        host re-prefills WARM (adopting its own registered pages —
+        which include the migrated ones) and continues bit-exactly."""
+        n = 10
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        a = build_server_from_spec(_spec(tmp_path, "a"))
+        ra = a.submit(PROMPTS[0])
+        for _ in range(5):
+            a.step()
+        gen = a.engine.generated(ra)
+        slc = a.export_slice(ra)
+        a.close()
+        # small pool target: an older flood stream + ours forces the
+        # YOUNGEST (ours) out when the pool dries up
+        b = build_server_from_spec(_spec(tmp_path, "b",
+                                         num_blocks=10))
+        flood = b.submit([int(t) for t in
+                          np.random.RandomState(5).randint(
+                              0, VOCAB, 8)])
+        b.step()
+        assert b.import_slice(slc) > 0
+        rb = b.submit(PROMPTS[0] + gen[:4], resume=True)
+        eng = b.engine.engine
+        # the flood stream (older) grows until the pool busts; the
+        # YOUNGEST — our migrated stream — gets evicted (the wrapper
+        # consumes eng.preempted, so watch the tenant counter + the
+        # detached slot)
+        pstat = eng.tenants["default"].stats
+        for _ in range(40):
+            b.step()
+            if pstat.preemptions >= 1:
+                break
+        assert pstat.preemptions >= 1, \
+            "no preemption happened — resize pool"
+        assert b.engine._by_rid[rb].slot is None    # ours was evicted
+        b.release(flood)             # room again: ours re-admits warm
+        pre_skip = eng.prefix_stats.tokens_skipped
+        for _ in range(2 * n):
+            if len(b.engine.generated(rb)) + 4 >= n:
+                break
+            b.step()
+        assert eng.prefix_stats.tokens_skipped > 0
+        assert (gen[:4] + b.engine.generated(rb))[:n] == base[0]
+        assert pre_skip <= eng.prefix_stats.tokens_skipped
+        assert b.check_invariants()
+        b.close()
+
+
+# ---------------------------------------------------------------------
+# fault domain
+# ---------------------------------------------------------------------
+
+class TestFaultDomain:
+    def _fleet(self, tmp_path, injector, model_holder=None, **rkw):
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        w3 = _worker(tmp_path, "w3", role="decode")
+        model = _model_of(w1)
+        return Router([w1, w2, w3], hash_fn=_hash_fn(model),
+                      injector=injector, **rkw), (w1, w2, w3)
+
+    def test_decode_worker_killed_mid_stream(self, tmp_path):
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        inj = RouterFaultInjector(
+            kill_at={4: {"w2": "before_round"}})
+        r, _ = self._fleet(tmp_path, inj)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert inj.killed == 1
+        assert r.stats.worker_deaths == 1
+        assert r.stats.resubmissions >= 1
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert sorted(o.rid for o in ocs) == sorted(rids)
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.check_invariants()     # surviving pools audit deep
+        r.close()
+
+    def test_prefill_worker_killed_mid_migration(self, tmp_path):
+        """The donor dies INSIDE the export leg: the slice never
+        arrives, the stream resubmits cold to a survivor, and the
+        bytes still match the uninterrupted run."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        # tick 1 is the first migration pass (admission tokens arrive
+        # in the submit response, so streams are migratable at once)
+        inj = RouterFaultInjector(kill_at={1: {"w1": "export"}})
+        r, _ = self._fleet(tmp_path, inj)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert inj.killed == 1
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.check_invariants()
+        r.close()
+
+    def test_hung_worker_circuit_breaker_and_stale_release(
+            self, tmp_path):
+        """A hang is not a death: the circuit opens, the streams move,
+        and when the worker answers again its STALE copies are
+        released — no duplicate outcomes, no stuck pool."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        inj = RouterFaultInjector(hang_at={3: {"w2": 2}})
+        r, (w1, w2, w3) = self._fleet(tmp_path, inj,
+                                      backoff_ticks=1)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert r.stats.worker_timeouts >= 1
+        assert r.stats.worker_deaths == 0
+        assert w2.alive                      # hung, never dead
+        assert r._workers["w2"].status == "up"   # circuit re-closed
+        assert r._workers["w2"].stale == set()   # stale released
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        # exactly once per rid even though copies existed twice
+        assert sorted(o.rid for o in ocs) == sorted(rids)
+        assert r.check_invariants()
+        r.close()
+
+    def test_failed_oom_auto_resubmission(self, tmp_path):
+        """FAILED_OOM on a starved worker retries on another instead
+        of surfacing — bounded, and the stream still completes."""
+        n = 6
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], n)
+        # w1 big enough to ADMIT both streams but not to grow them:
+        # the youngest sheds FAILED_OOM with no retry budget
+        w1 = InProcWorker(_spec(tmp_path, "w1", num_blocks=6,
+                                max_preemptions=0),
+                          name="w1", role="mixed")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), migrate=False)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS[:2]]
+        assert all(r._reqs[x].worker == "w1" for x in rids)
+        ocs = _drive(r, len(rids))
+        assert r.stats.oom_resubmissions >= 1
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        r.close()
+
+    def test_failed_oom_bounded_delivery(self, tmp_path):
+        """With the retry budget at zero the failure is DELIVERED —
+        auto-resubmission is bounded, never a loop."""
+        w1 = InProcWorker(_spec(tmp_path, "w1", num_blocks=6,
+                                max_preemptions=0),
+                          name="w1", role="mixed")
+        model = _model_of(w1)
+        r = Router([w1], hash_fn=_hash_fn(model), migrate=False,
+                   max_oom_resubmissions=0)
+        rids = [r.submit(p, max_new_tokens=30) for p in PROMPTS[:2]]
+        ocs = _drive(r, 1, max_ticks=30)
+        assert any(o.status == RequestOutcome.FAILED_OOM
+                   for o in ocs)
+        assert all(o.rid in rids for o in ocs)
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# deadline correctness across resubmission
+# ---------------------------------------------------------------------
+
+class TestDeadlineAcrossResubmission:
+    def test_retry_carries_remaining_budget_not_a_fresh_clock(
+            self, tmp_path):
+        """THE satellite regression: a stream whose deadline only
+        holds if the retry RESET its clock must FAIL the deadline —
+        the resubmission carries ``deadline_steps - steps_used``,
+        rebased like PR 6's snapshot restore, never a fresh budget."""
+        n = 10
+        # needs ~n rounds; deadline 6 < that, so the deadline verdict
+        # is correct even uninterrupted — and a worker kill at tick 4
+        # leaves only 2 steps of budget. A fresh-clock bug would give
+        # the resubmitted copy 6 more steps, enough to FINISH.
+        inj = RouterFaultInjector(
+            kill_at={4: {"w1": "before_round"}})
+        w1 = _worker(tmp_path, "w1", role="mixed")
+        w2 = _worker(tmp_path, "w2", role="mixed")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), migrate=False,
+                   injector=inj)
+        rid = r.submit(PROMPTS[0], max_new_tokens=n,
+                       deadline_steps=6)
+        ocs = _drive(r, 1, max_ticks=30)
+        assert inj.killed == 1
+        oc = [o for o in ocs if o.rid == rid][0]
+        assert oc.status == RequestOutcome.FAILED_DEADLINE, \
+            "retry must not reset the deadline clock"
+        assert len(r.generated(rid)) < n
+        req = r._reqs[rid]
+        assert req.steps_used >= 6       # the budget really ran out
+        r.close()
+
+    def test_ample_deadline_survives_resubmission(self, tmp_path):
+        n = 6
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        inj = RouterFaultInjector(
+            kill_at={3: {"w1": "before_round"}})
+        w1 = _worker(tmp_path, "w1", role="mixed")
+        w2 = _worker(tmp_path, "w2", role="mixed")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), migrate=False,
+                   injector=inj)
+        rid = r.submit(PROMPTS[0], max_new_tokens=n,
+                       deadline_steps=40)
+        ocs = _drive(r, 1, max_ticks=40)
+        assert inj.killed == 1
+        assert ocs[0].status == RequestOutcome.FINISHED
+        assert r.generated(rid) == base[0]
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# unroutability and fleet-wide rejection
+# ---------------------------------------------------------------------
+
+class TestUnroutable:
+    def test_all_workers_down_is_deterministic_terminal(
+            self, tmp_path):
+        """All-workers-down degrades to FAILED_UNROUTABLE within the
+        patience — never a hang, never a lost rid."""
+        inj = RouterFaultInjector(
+            kill_at={2: {"w1": "scrape"},
+                     3: {"w2": "scrape", "w3": "scrape"}})
+        w = [_worker(tmp_path, f"w{i+1}",
+                     role=("prefill", "decode", "decode")[i])
+             for i in range(3)]
+        model = _model_of(w[0])
+        r = Router(w, hash_fn=_hash_fn(model), injector=inj)
+        rids = [r.submit(p, max_new_tokens=50) for p in PROMPTS]
+        ocs = _drive(r, len(rids), max_ticks=12)
+        assert r.tick <= 12                 # bounded, no hang
+        assert sorted(o.rid for o in ocs) == sorted(rids)
+        assert all(o.status == RequestOutcome.FAILED_UNROUTABLE
+                   for o in ocs)
+        assert r.stats.unroutable == len(rids)
+        # a submit AFTER the fleet died is immediately terminal
+        rid = r.submit(PROMPTS[0])
+        ocs = r.drain_outcomes()
+        assert [o.rid for o in ocs] == [rid]
+        assert ocs[0].status == RequestOutcome.FAILED_UNROUTABLE
+        r.close()
+
+    def test_rejected_admission_generalizes_across_hosts(
+            self, tmp_path):
+        """REJECTED_ADMISSION is delivered only when EVERY live
+        worker has proven the request unservable — and then it is,
+        deterministically, with no worker ever charged a block."""
+        tenants = {"capped": {"quota_blocks": 2}}
+        w1 = InProcWorker(_spec(tmp_path, "w1", tenants=tenants),
+                          name="w1")
+        w2 = InProcWorker(_spec(tmp_path, "w2", tenants=tenants),
+                          name="w2")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model))
+        # 12 tokens need 4 blocks > quota 2 on BOTH workers
+        long_prompt = [int(t) for t in
+                       np.random.RandomState(6).randint(0, VOCAB, 12)]
+        rid = r.submit(long_prompt, tenant_id="capped")
+        ocs = r.drain_outcomes()
+        assert [o.rid for o in ocs] == [rid]
+        assert ocs[0].status == RequestOutcome.REJECTED_ADMISSION
+        # an uncapped tenant's request still routes fine
+        rid2 = r.submit(long_prompt, max_new_tokens=2)
+        ocs = _drive(r, 1, max_ticks=20)
+        assert ocs[0].rid == rid2
+        assert ocs[0].status == RequestOutcome.FINISHED
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# the acceptance storm
+# ---------------------------------------------------------------------
+
+class TestKillStormBitIdentity:
+    def test_seeded_kill_storm_streams_bit_identical(self, tmp_path):
+        """ACCEPTANCE: a seeded storm — a decode worker killed
+        mid-stream, the prefill worker killed mid-migration, a third
+        worker hung through the circuit breaker — over 3 workers
+        behind the router. Every stream survives, BIT-IDENTICAL to
+        the uninterrupted single-engine run; every outcome is
+        delivered exactly once; deep invariants hold on every
+        surviving pool."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        inj = RouterFaultInjector(
+            kill_at={1: {"w1": "export"},        # donor, mid-migration
+                     4: {"w2": "before_round"}},  # decode, mid-stream
+            hang_at={6: {"w3": 2}})
+        w = [_worker(tmp_path, f"w{i+1}",
+                     role=("prefill", "decode", "decode")[i])
+             for i in range(3)]
+        model = _model_of(w[0])
+        r = Router(w, hash_fn=_hash_fn(model), injector=inj,
+                   backoff_ticks=1)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        all_ocs = _drive(r, len(rids))
+        # the storm really happened
+        assert inj.killed == 2
+        assert inj.hung_ops >= 1
+        assert r.stats.worker_deaths == 2
+        assert r.stats.resubmissions >= 2
+        # bit-identity + exactly once + invariants
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert sorted(o.rid for o in all_ocs) == sorted(rids)
+        assert all(o.status == RequestOutcome.FINISHED
+                   for o in all_ocs)
+        extra = r.drain_outcomes()
+        assert extra == []
+        assert r.check_invariants()
+        r.close()
+
+    def test_seeded_random_storm_constructor(self, tmp_path):
+        """RouterFaultInjector.kill_storm: same seed, same schedule —
+        and the storm composes with serving (survivor completes)."""
+        a = RouterFaultInjector.kill_storm(
+            11, 10, ["w1", "w2"], kills=1, hangs=1)
+        b = RouterFaultInjector.kill_storm(
+            11, 10, ["w1", "w2"], kills=1, hangs=1)
+        assert a.kill_at == b.kill_at and a.hang_at == b.hang_at
+        with pytest.raises(ValueError, match="not enough ticks"):
+            RouterFaultInjector.kill_storm(0, 3, ["w1"], kills=5)
+        with pytest.raises(ValueError, match="kill point"):
+            RouterFaultInjector(kill_at={1: {"w1": "nonsense"}})
+
+
+# ---------------------------------------------------------------------
+# router journal recovery (the router's own death)
+# ---------------------------------------------------------------------
+
+class TestRouterJournalRecovery:
+    def test_router_recover_resumes_streams_exactly_once(
+            self, tmp_path):
+        """Both directions of exactly-once across the ROUTER's own
+        death: a verdict the dead router's client DRAINED (and a
+        later call journaled) is NOT re-delivered; a verdict enqueued
+        but never drained IS — to the rebuilt client, whose
+        predecessor died holding nothing."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], n)
+        jp = str(tmp_path / "router.wal")
+        w1 = _worker(tmp_path, "w1")
+        model = _model_of(w1)
+        r = Router([w1], hash_fn=_hash_fn(model), journal_path=jp,
+                   migrate=False)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS[:2]]
+        # finish one stream pre-crash and DRAIN it; the next step()
+        # flushes the drain record into the WAL
+        delivered = []
+        for _ in range(40):
+            r.step()
+            delivered += r.drain_outcomes()
+            if delivered:
+                break
+        assert len(delivered) >= 1
+        r.step()                     # journals the drain record
+        mid = [x for x in rids
+               if x not in {o.rid for o in delivered}]
+        pre = {x: list(r._reqs[x].generated) for x in mid}
+        # the router process "dies": no close(), the workers die with
+        # the host — a COLD fleet restart recovers from the WAL alone
+        w1b = InProcWorker(_spec(tmp_path, "w1b"), name="w1")
+        r2 = Router.recover([w1b], journal_path=jp,
+                            hash_fn=_hash_fn(model), migrate=False)
+        assert r2.stats.submitted == len(rids)
+        ocs = _drive(r2, len(mid))
+        for i, x in enumerate(rids):
+            assert r2.generated(x) == base[i]
+        # drained verdicts stay delivered: only the mid-flight rids
+        # re-deliver
+        assert sorted(o.rid for o in ocs) == sorted(mid)
+        # mid-flight streams resumed from their recorded frontier,
+        # not from scratch
+        for x in mid:
+            assert r2._reqs[x].generated[:len(pre[x])] == pre[x]
+        # deadline ledger replayed exactly (tick records, not an
+        # emission guess): budgets stay spent across the death
+        for x in mid:
+            assert r2._reqs[x].steps_used > 0
+        r2.close()
+
+    def test_undrained_verdict_redelivers_after_router_death(
+            self, tmp_path):
+        """A verdict enqueued but never drained dies WITH the router
+        (it was never journaled): recovery re-derives it and delivers
+        it to the rebuilt client — delivered exactly once from every
+        observer that survives, the RecoverableServer contract one
+        level up."""
+        n = 6
+        jp = str(tmp_path / "router.wal")
+        w1 = _worker(tmp_path, "w1")
+        model = _model_of(w1)
+        r = Router([w1], hash_fn=_hash_fn(model), journal_path=jp,
+                   migrate=False)
+        rid = r.submit(PROMPTS[0], max_new_tokens=n)
+        for _ in range(40):
+            r.step()
+            if any(o.rid == rid for o in r.outcomes):
+                break
+        assert r._reqs[rid].terminal     # enqueued, NEVER drained
+        # router dies here; cold restart
+        w1b = InProcWorker(_spec(tmp_path, "w1b"), name="w1")
+        r2 = Router.recover([w1b], journal_path=jp,
+                            hash_fn=_hash_fn(model), migrate=False)
+        ocs = r2.drain_outcomes() + _drive(r2, 1, max_ticks=5)
+        got = [o for o in ocs if o.rid == rid]
+        assert len(got) == 1
+        assert got[0].status == RequestOutcome.FINISHED
+        assert r2.generated(rid) == \
+            _single_engine_streams(tmp_path, PROMPTS[:1], n)[0]
+        r2.close()
+
+
+# ---------------------------------------------------------------------
+# the honest rig: real processes over pipes
+# ---------------------------------------------------------------------
+
+class TestPipesTransport:
+    def test_two_processes_and_a_real_sigkill(self, tmp_path):
+        """N REAL worker processes (multiprocessing spawn) behind the
+        same router: streams over pipes are bit-identical to the
+        in-process single-engine run, and a raw SIGKILL of the decode
+        worker mid-stream recovers through resubmission — the honest
+        multi-process acceptance rig on one machine."""
+        n = 6
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], n)
+        model = _tsm()           # same weights the workers build
+        w1 = PipeWorker(_spec(tmp_path, "p1"), name="w1",
+                        role="prefill")
+        w2 = PipeWorker(_spec(tmp_path, "p2"), name="w2",
+                        role="decode")
+        try:
+            r = Router([w1, w2], hash_fn=_hash_fn(model))
+            rids = [r.submit(p, max_new_tokens=n)
+                    for p in PROMPTS[:2]]
+            ocs = _drive(r, len(rids), max_ticks=40)
+            assert {i: r.generated(rid)
+                    for i, rid in enumerate(rids)} == base
+            assert all(o.status == RequestOutcome.FINISHED
+                       for o in ocs)
+            assert r.stats.migrations >= 1    # pages crossed the pipe
+            # REAL process death mid-stream
+            rid3 = r.submit(PROMPTS[2], max_new_tokens=n)
+            r.step()
+            victim = r._reqs[rid3].worker or "w2"
+            {"w1": w1, "w2": w2}[victim].kill()      # SIGKILL
+            ocs = _drive(r, 1, max_ticks=40)
+            oc3 = [o for o in ocs if o.rid == rid3][0]
+            assert oc3.status == RequestOutcome.FINISHED
+            assert r.stats.worker_deaths == 1
+            third = _single_engine_streams(tmp_path, [PROMPTS[2]], n,
+                                           )[0]
+            assert r.generated(rid3) == third
+            assert r.check_invariants()
+            r.close()
+        finally:
+            for wk in (w1, w2):
+                try:
+                    wk.kill()
+                except Exception:
+                    pass

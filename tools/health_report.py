@@ -9,10 +9,18 @@ doctor, and its exit code is CI-gateable.
 
 Usage:
   python tools/health_report.py MONITOR.json [--alerts] [--tenant TID]
+  python tools/health_report.py --fleet W1.json W2.json ... [--json]
 
-Exit status: 0 healthy or degraded-but-warning, 1 the report's
-overall verdict is CRITICAL (gate on it), 2 unreadable / not a
-health-monitor dump.
+``--fleet`` is the FLEET doctor (disaggregated serving,
+inference/router.py): N workers' saved reports aggregate into one
+placement/verdict table — per worker the verdict, score, pool
+pressure, queue depth and fired-alert count, exactly the scraped
+inputs the router places by — under the shared
+``paddle_tpu.report.v1`` envelope with ``--json``.
+
+Exit status: 0 healthy or degraded-but-warning, 1 the overall (or,
+with --fleet, ANY worker's) verdict is CRITICAL (gate on it), 2
+unreadable / not a health-monitor dump.
 """
 from __future__ import annotations
 
@@ -102,10 +110,75 @@ def render(dump: dict, tenant: str = None,
     return "\n".join(lines)
 
 
+def _load_dump(path: str):
+    """(dump, None) or (None, problem string)."""
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"UNREADABLE: {e}"
+    if not isinstance(dump, dict) or \
+            dump.get("kind") != "health_monitor" or \
+            not isinstance(dump.get("report"), dict):
+        return None, ("UNREADABLE: not a HealthMonitor dump "
+                      "(expected kind='health_monitor' with a "
+                      "'report')")
+    return dump, None
+
+
+def _worker_row(name: str, dump: dict) -> dict:
+    """One fleet-table row: the placement inputs a router scrapes
+    (HealthReport.placement) recomputed from a saved dump."""
+    rep = dump["report"]
+    sig = rep.get("signals", {})
+
+    def last(k):
+        s = sig.get(k)
+        return None if not isinstance(s, dict) else s.get("last")
+    counts = rep.get("alerts", {}).get("counts", {})
+    return {"worker": name, "verdict": rep.get("verdict"),
+            "score": rep.get("score"), "step": rep.get("step"),
+            "samples": rep.get("samples"),
+            "pool_pressure": last("pool.pressure"),
+            "queue_depth": last("queue.depth"),
+            "shed_rate": last("shed_rate"),
+            "tokens_per_step": last("tokens_per_step"),
+            "alerts_fired": int(sum(counts.values())),
+            "active_alerts": rep.get("alerts", {}).get("active", [])}
+
+
+def render_fleet(rows) -> str:
+    cols = ("worker", "verdict", "score", "pool_pressure",
+            "queue_depth", "tokens_per_step", "alerts_fired")
+    table = [[("-" if r.get(c) is None else
+               (f"{r[c]:.4g}" if isinstance(r[c], float) else
+                str(r[c]))) for c in cols] for r in rows]
+    widths = [max(len(c), *(len(t[i]) for t in table))
+              for i, c in enumerate(cols)]
+    lines = [f"fleet: {len(rows)} worker(s), "
+             + ", ".join(f"{v}={sum(1 for r in rows if r['verdict'] == v)}"
+                         for v in ("ok", "warn", "critical"))]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r, t in zip(rows, table):
+        mark = _MARK.get(r["verdict"], "?")
+        lines.append("  ".join(v.ljust(w)
+                               for v, w in zip(t, widths)) + f"  [{mark}]")
+    for r in rows:
+        if r["active_alerts"]:
+            lines.append(f"  {r['worker']}: ACTIVE "
+                         f"{', '.join(r['active_alerts'])}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render a HealthMonitor JSON dump offline")
-    ap.add_argument("report")
+    ap.add_argument("report", nargs="+",
+                    help="HealthMonitor dump(s); several with --fleet")
+    ap.add_argument("--fleet", action="store_true",
+                    help="aggregate N workers' dumps into one "
+                         "placement/verdict table (exit 1 if ANY "
+                         "worker is critical)")
     ap.add_argument("--tenant", default=None,
                     help="show only this tenant's section")
     ap.add_argument("--alerts", action="store_true",
@@ -116,17 +189,33 @@ def main(argv=None) -> int:
                          "trace_report/cost_report)")
     args = ap.parse_args(argv)
 
-    try:
-        with open(args.report) as f:
-            dump = json.load(f)
-    except (OSError, ValueError) as e:
-        print(f"UNREADABLE: {e}")
+    if args.fleet:
+        rows = []
+        for path in args.report:
+            dump, problem = _load_dump(path)
+            if dump is None:
+                print(f"{path}: {problem}")
+                return 2
+            name = os.path.splitext(os.path.basename(path))[0]
+            rows.append(_worker_row(name, dump))
+        critical = [r["worker"] for r in rows
+                    if r["verdict"] == "critical"]
+        ok = not critical
+        if args.json:
+            emit_json(envelope(
+                "health_report", ok, 0 if ok else 1,
+                {"fleet": rows},
+                [f"worker {w!r} is critical" for w in critical]))
+        else:
+            print(render_fleet(rows))
+        return 0 if ok else 1
+
+    if len(args.report) > 1:
+        print("UNREADABLE: multiple reports need --fleet")
         return 2
-    if not isinstance(dump, dict) or \
-            dump.get("kind") != "health_monitor" or \
-            not isinstance(dump.get("report"), dict):
-        print("UNREADABLE: not a HealthMonitor dump "
-              "(expected kind='health_monitor' with a 'report')")
+    dump, problem = _load_dump(args.report[0])
+    if dump is None:
+        print(problem)
         return 2
 
     critical = dump["report"].get("verdict") == "critical"
