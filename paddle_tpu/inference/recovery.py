@@ -477,15 +477,20 @@ class RecoverableServer:
             inj.crash_point("pre_journal")
         # the durability phases ride the engine timeline as spans —
         # journal-append and snapshot cost is visible next to the
-        # model/prefill phases it competes with (a crash between the
-        # crash points cannot happen, so the bracket stays balanced)
+        # model/prefill phases it competes with. try/finally, not a
+        # bare bracket: injected crashes cannot fire between the
+        # crash points, but a REAL append/snapshot failure (disk
+        # full) could — and an unclosed span would skew the stack
+        # for every later step on this collector
         if col is not None:
             col.span_begin("journal")
-        self.journal.append("round", {
-            "emitted": {int(r): [int(t) for t in toks]
-                        for r, toks in emitted.items()}})
-        if col is not None:
-            col.span_end()
+        try:
+            self.journal.append("round", {
+                "emitted": {int(r): [int(t) for t in toks]
+                            for r, toks in emitted.items()}})
+        finally:
+            if col is not None:
+                col.span_end()
         if inj is not None:
             inj.crash_point("post_journal")
         self.rounds += 1
@@ -493,9 +498,11 @@ class RecoverableServer:
                 self.rounds % self.snapshot_every == 0:
             if col is not None:
                 col.span_begin("snapshot")
-            self.save_snapshot()
-            if col is not None:
-                col.span_end(bytes=self.snapshot_bytes)
+            try:
+                self.save_snapshot()
+            finally:
+                if col is not None:
+                    col.span_end(bytes=self.snapshot_bytes)
         return emitted
 
     def drain_outcomes(self) -> List[RequestOutcome]:

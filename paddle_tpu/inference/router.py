@@ -1424,9 +1424,20 @@ class Router:
                     self._pending.setdefault(req.rid, self.tick)
             else:
                 self._deliver(req, status, reason)
-        else:
+        elif status in (RequestOutcome.FAILED_NUMERIC,
+                        RequestOutcome.FAILED_DEADLINE,
+                        RequestOutcome.REJECTED_ADMISSION,
+                        RequestOutcome.FAILED_UNROUTABLE):
             # deadline / numeric / (late) rejection: the verdict is
-            # the worker's to make — forward it exactly once
+            # the worker's to make — forward it exactly once. Members
+            # are NAMED (not a catch-all) so a future outcome kind
+            # must be consciously routed here — enforced statically
+            # by tools/check_static.py (journal-coverage)
+            self._deliver(req, status, reason)
+        else:
+            # RequestOutcome.__init__ validates against STATUSES, so
+            # an unknown status cannot reach a worker outcome dict;
+            # forward defensively rather than hang the stream
             self._deliver(req, status, reason)
 
     def _deliver(self, req: _RouterReq, status: str,

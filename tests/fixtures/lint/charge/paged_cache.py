@@ -1,0 +1,26 @@
+"""Seeded charge-discipline violations (linter self-test)."""
+
+
+class MiniCache:
+    def __init__(self):
+        self.seq_blocks = [[] for _ in range(4)]
+        self._tenant_charge = {}
+
+    def _charge(self, slot, delta):
+        self._tenant_charge[slot] = \
+            self._tenant_charge.get(slot, 0) + delta
+
+    def good_extend(self, slot, new):
+        self.seq_blocks[slot].extend(new)
+        self._charge(slot, len(new))
+
+    def good_alias_drop(self, slot, keep):
+        have = self.seq_blocks[slot]
+        del have[keep:]
+        self._charge(slot, keep - len(have))
+
+    def bad_clear(self, slot):
+        self.seq_blocks[slot] = []         # FINDING: never charges
+
+    def hushed_swap(self, slot, b):
+        self.seq_blocks[slot][0] = b  # lint: ok(charge-discipline)

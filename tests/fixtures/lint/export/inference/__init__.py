@@ -1,0 +1,5 @@
+"""Seeded export-drift violations (linter self-test)."""
+
+from .serving import GoodStats, missing_name  # noqa: F401
+
+__all__ = ["GoodStats", "Ghost"]

@@ -1,0 +1,17 @@
+"""Seeded export-drift violations (linter self-test)."""
+
+
+class GoodStats:
+    pass
+
+
+class OrphanStats:     # FINDING: public Stats sibling not exported
+    pass
+
+
+class QuietStats:  # lint: ok(export-drift)
+    pass
+
+
+def helper():
+    pass

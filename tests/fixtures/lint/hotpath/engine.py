@@ -1,0 +1,23 @@
+"""Seeded hot-path-purity violations (linter self-test). The class
+name matches a HOT_CLASSES entry so its methods are hot by default."""
+import time
+
+
+class PagedServingEngine:
+    def __init__(self, collector=None, ledger=None):
+        self.collector = collector
+        self.ledger = ledger
+        self.wired = time.monotonic()      # ok: __init__ is cold
+
+    def step(self, x):
+        if self.collector is not None:
+            self.collector.on_step(x)      # ok: guarded
+        col = self.collector
+        depth = col.span_depth if col is not None else 0   # ok
+        self.collector.on_step(x)          # FINDING: unguarded touch
+        t = time.monotonic()               # FINDING: unguarded clock
+        self.ledger.on_rows(x)  # lint: ok(hot-path-purity)
+        return depth, t
+
+    def snapshot(self):
+        return {"t": time.time()}          # ok: cold method

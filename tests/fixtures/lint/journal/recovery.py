@@ -1,0 +1,16 @@
+"""Seeded journal-coverage violations (linter self-test)."""
+
+
+class Server:
+    def __init__(self, journal):
+        self.journal = journal
+
+    def round(self):
+        self.journal.append("round", {})
+        self.journal.append("orphan", {})  # FINDING: no replay handler
+        self.journal.append("hushed", {})  # lint: ok(journal-coverage)
+
+    def recover(self):
+        for seq, kind, payload in self.journal.records:
+            if kind == "round":
+                pass

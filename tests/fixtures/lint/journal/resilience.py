@@ -1,0 +1,9 @@
+"""Outcome taxonomy for the delivery-switch coverage self-test."""
+
+
+class RequestOutcome:
+    FINISHED = "finished"
+    FAILED_LOST = "failed_lost"    # FINDING: never named in router.py
+    FAILED_QUIET = "failed_quiet"  # lint: ok(journal-coverage)
+
+    STATUSES = (FINISHED, FAILED_LOST, FAILED_QUIET)

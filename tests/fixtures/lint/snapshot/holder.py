@@ -1,0 +1,31 @@
+"""Seeded snapshot-completeness violations (linter self-test).
+
+Never imported — tests/test_static_analysis.py parses it through
+tools/check_static.py and asserts the exact findings.
+"""
+
+
+class Holder:
+    def __init__(self):
+        self.kept = 1
+        self.leaky = 2          # FINDING: never read by snapshot()
+        self.hushed = 3  # lint: ok(snapshot-completeness)
+        self.knob = 4
+
+    def mutate(self):
+        self.kept += 1
+
+    def snapshot(self):
+        return {
+            "kind": "holder",
+            "kept": self.kept,
+            "config": {"knob": self.knob,
+                       "orphan": 0},    # FINDING: restore drops it
+        }
+
+    @classmethod
+    def restore(cls, snap):
+        h = cls()
+        h.kept = snap["kept"]
+        h.knob = snap["config"]["knob"]
+        return h

@@ -1,0 +1,24 @@
+"""Seeded Router.recover completeness violations (linter self-test)."""
+
+
+class _RouterReq:
+    def __init__(self, rid, tokens, lost=None, quiet=None):
+        self.rid = rid
+        self.tokens = list(tokens)
+        self.steps_used = 0
+        self.lost = lost        # FINDING: recover never rebuilds it
+        self.quiet = quiet  # lint: ok(snapshot-completeness)
+
+
+class Router:
+    def __init__(self):
+        self._reqs = {}
+
+    @classmethod
+    def recover(cls, records):
+        router = cls()
+        for rid, toks in records:
+            req = _RouterReq(rid, toks)
+            req.steps_used += 1
+            router._reqs[rid] = req
+        return router

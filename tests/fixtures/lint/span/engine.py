@@ -1,0 +1,49 @@
+"""Seeded span-safety violations (linter self-test)."""
+
+
+def good_finally(col):
+    col.span_begin("a")
+    try:
+        work()
+    finally:
+        col.span_end()
+
+
+def good_unwinding_except(col):
+    depth = col.span_depth
+    if col is not None:
+        col.span_begin("b")
+    try:
+        good_callee(col)
+    except BaseException:
+        col.span_unwind(depth, aborted=True)
+        raise
+    col.span_unwind(depth)
+
+
+def good_callee(col):
+    # called inside good_unwinding_except's protecting try (the
+    # step/_step_impl pattern): a BALANCED callee inherits that
+    # bracket
+    col.span_begin("c")
+    col.span_end()
+
+
+def bad(col):
+    col.span_begin("d")                # FINDING: leaks on exception
+    unprotected()
+    col.span_end()
+
+
+def hushed(col):
+    col.span_begin("e")  # lint: ok(span-safety)
+    unprotected()
+    col.span_end()
+
+
+def work():
+    pass
+
+
+def unprotected():
+    pass

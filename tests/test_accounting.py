@@ -1023,9 +1023,19 @@ class TestCostReportTool:
         assert trace_report.main([tp, "--json"]) == 0
         tenv = json.loads(capsys.readouterr().out)
 
+        # the contract linter's --json rides the SAME envelope (over
+        # its own inference-package run — the cheap subset here; the
+        # full-tree gate lives in tests/test_static_analysis.py)
+        from tools import check_static
+        inf = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "paddle_tpu", "inference")
+        assert check_static.main([inf, "--json"]) == 0
+        senv = json.loads(capsys.readouterr().out)
+
         for env_i, tool in ((env, "cost_report"),
                             (henv, "health_report"),
-                            (tenv, "trace_report")):
+                            (tenv, "trace_report"),
+                            (senv, "check_static")):
             assert env_i["schema"] == SCHEMA
             assert env_i["tool"] == tool
             assert env_i["ok"] is True and env_i["exit"] == 0
@@ -1036,6 +1046,7 @@ class TestCostReportTool:
         assert "breakdown" in env["data"]
         assert "report" in henv["data"]
         assert tenv["data"]["spans"]
+        assert senv["data"]["findings"] == []
 
     def test_trace_report_json_slo_violation_exits_one(
             self, tmp_path, capsys):

@@ -1,0 +1,412 @@
+"""Contract-linter self-tests + the tier-1 gate (tools/check_static.py).
+
+Three layers:
+
+  * fixture tests — one tiny synthetic module per pass under
+    tests/fixtures/lint/ with a seeded violation (and a suppressed
+    one) asserting the EXACT finding: path, line, pass id, and that
+    ``# lint: ok(<pass>)`` suppression works and is counted;
+  * the tier-1 gate — every pass over the real ``paddle_tpu/`` tree
+    must report ZERO unsuppressed findings, so a future PR that adds
+    an unserialized field, an unhandled journal kind, an unguarded
+    hook touch, an uncharged table mutation or a leaking span fails
+    CI the same day it lands, not three PRs later;
+  * mutation spot-checks — deleting a single snapshot field, journal
+    handler, ``_charge`` call, hook guard or span bracket from a COPY
+    of the real source flips the linter to exit 1 with a correct
+    ``path:line`` finding (the acceptance criterion).
+"""
+import json
+import os
+
+import pytest
+
+from tools import check_static as cs
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+FIX = os.path.join(REPO, "tests", "fixtures", "lint")
+INF = os.path.join(PKG, "inference")
+
+
+def run(root, passes=None):
+    kept, supp, problems, n = cs.run_passes(root, passes)
+    assert not problems, problems
+    assert n > 0
+    return kept, supp
+
+
+def lineno(path, needle, occurrence=1):
+    with open(path) as f:
+        hits = [i for i, line in enumerate(f, 1) if needle in line]
+    assert len(hits) >= occurrence, f"{needle!r} not in {path}"
+    return hits[occurrence - 1]
+
+
+def by_pass(findings, pass_id):
+    return [f for f in findings if f.pass_id == pass_id]
+
+
+# =====================================================================
+# fixture self-tests: exact findings + suppression, one per pass
+# =====================================================================
+
+class TestSnapshotFixture:
+    ROOT = os.path.join(FIX, "snapshot")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["snapshot-completeness"])
+        holder = os.path.join(self.ROOT, "holder.py")
+        router = os.path.join(self.ROOT, "router.py")
+        got = {(f.path, f.line) for f in kept}
+        assert got == {
+            (holder, lineno(holder, "self.leaky = 2")),
+            (holder, lineno(holder, '"orphan": 0')),
+            (router, lineno(router, "self.lost = lost")),
+        }
+        msgs = sorted(f.msg for f in kept)
+        assert any("Holder.leaky" in m for m in msgs)
+        assert any("'orphan'" in m for m in msgs)
+        assert any("_RouterReq.lost" in m for m in msgs)
+        assert all(f.pass_id == "snapshot-completeness" for f in kept)
+
+    def test_suppression(self):
+        kept, supp = run(self.ROOT, ["snapshot-completeness"])
+        assert {os.path.basename(f.path) for f in supp} == \
+            {"holder.py", "router.py"}
+        assert all("hushed" in f.msg or "quiet" in f.msg
+                   for f in supp)
+        assert not any("hushed" in f.msg or "quiet" in f.msg
+                       for f in kept)
+
+
+class TestHotPathFixture:
+    ROOT = os.path.join(FIX, "hotpath")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["hot-path-purity"])
+        eng = os.path.join(self.ROOT, "engine.py")
+        assert {(f.path, f.line) for f in kept} == {
+            (eng, lineno(eng, "self.collector.on_step(x)",
+                         occurrence=2)),
+            (eng, lineno(eng, "t = time.monotonic()")),
+        }
+        assert all(f.pass_id == "hot-path-purity" for f in kept)
+        # guarded touches, __init__ and the cold snapshot() are clean
+        assert len(kept) == 2
+
+    def test_suppression(self):
+        kept, supp = run(self.ROOT, ["hot-path-purity"])
+        assert len(supp) == 1 and "ledger" in supp[0].msg
+
+
+class TestJournalFixture:
+    ROOT = os.path.join(FIX, "journal")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["journal-coverage"])
+        rec = os.path.join(self.ROOT, "recovery.py")
+        res = os.path.join(self.ROOT, "resilience.py")
+        assert {(f.path, f.line) for f in kept} == {
+            (rec, lineno(rec, '"orphan"')),
+            (res, lineno(res, "FAILED_LOST")),
+        }
+        assert any("'orphan'" in f.msg for f in kept)
+        assert any("FAILED_LOST" in f.msg and "router.py" in f.msg
+                   for f in kept)
+
+    def test_suppression(self):
+        kept, supp = run(self.ROOT, ["journal-coverage"])
+        # BOTH suppression paths must work independently: the
+        # journal-kind one and the outcome-member one
+        assert any("'hushed'" in f.msg for f in supp)
+        assert any("FAILED_QUIET" in f.msg for f in supp)
+        assert len(supp) == 2
+
+
+class TestChargeFixture:
+    ROOT = os.path.join(FIX, "charge")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["charge-discipline"])
+        pc = os.path.join(self.ROOT, "paged_cache.py")
+        assert [(f.path, f.line) for f in kept] == \
+            [(pc, lineno(pc, "self.seq_blocks[slot] = []",
+                         occurrence=1))]
+        assert "MiniCache.bad_clear" in kept[0].msg
+        # charging methods (direct and via alias) are clean
+        assert len(supp) == 1
+
+
+class TestSpanFixture:
+    ROOT = os.path.join(FIX, "span")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["span-safety"])
+        eng = os.path.join(self.ROOT, "engine.py")
+        assert [(f.path, f.line) for f in kept] == \
+            [(eng, lineno(eng, 'col.span_begin("d")'))]
+        assert "bad" in kept[0].msg
+        assert len(supp) == 1
+
+
+class TestExportFixture:
+    ROOT = os.path.join(FIX, "export")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["export-drift"])
+        init = os.path.join(self.ROOT, "inference", "__init__.py")
+        srv = os.path.join(self.ROOT, "inference", "serving.py")
+        assert {(f.path, f.line) for f in kept} == {
+            (init, lineno(init, "missing_name")),
+            (init, lineno(init, "__all__")),
+            (srv, lineno(srv, "class OrphanStats")),
+        }
+        assert any("'Ghost'" in f.msg for f in kept)
+        assert any("missing_name" in f.msg for f in kept)
+        assert any("OrphanStats" in f.msg for f in kept)
+        assert len(supp) == 1 and "QuietStats" in supp[0].msg
+
+
+# =====================================================================
+# tier-1 gate: the real tree is clean under every pass
+# =====================================================================
+
+class TestRealTree:
+    def test_zero_findings_all_passes(self):
+        """THE gate: the shipped package carries no unsuppressed
+        contract violations. A new field/record-kind/lifecycle-op
+        that skips its protocol turns this red the day it lands."""
+        kept, supp, problems, n = cs.run_passes(PKG)
+        assert not problems, problems
+        assert n > 100      # the walker really saw the package
+        assert kept == [], "\n".join(repr(f) for f in kept)
+
+    def test_passes_engage_real_targets(self):
+        """Guard against the linter going vacuously green: each pass
+        must actually be analyzing the real contract carriers."""
+        files, _ = cs.walk_files(INF)
+        snap_classes = {c.name for sf in files for c in sf.classes()
+                        if "snapshot" in cs.methods_of(c)
+                        and "restore" in cs.methods_of(c)}
+        assert {"PagedKVCache", "PagedServingEngine",
+                "SpeculativeEngine"} <= snap_classes
+        jc = cs.JournalCoverage()
+        kinds = {}
+        for sf in files:
+            kinds[sf.base] = set(jc._written_kinds(sf))
+        assert {"submit", "round", "release", "import_slice",
+                "set_tenant", "outcomes", "compact"} <= \
+            kinds["recovery.py"]
+        assert {"submit", "emit", "tick", "delivered", "release"} <= \
+            kinds["router.py"]
+        # the outcome taxonomy is discovered, members and all
+        members = jc._outcome_members(files)
+        assert {"FINISHED", "FAILED_OOM", "FAILED_NUMERIC",
+                "FAILED_DEADLINE", "REJECTED_ADMISSION",
+                "FAILED_UNROUTABLE"} <= set(members)
+        # hot classes resolve in the real tree
+        hot = {c.name for sf in files for c in sf.classes()}
+        assert {"PagedServingEngine", "SpeculativeEngine",
+                "PagedKVCache"} <= hot
+        # the key-consumed-by-restore leg is NOT vacuous: each real
+        # snapshot() yields a non-trivial harvested key set (a
+        # refactor that hides the return dict from the harvester
+        # must turn this red, not silently vacate the check)
+        sc = cs.SnapshotCompleteness()
+        for sf in files:
+            for c in sf.classes():
+                m = cs.methods_of(c)
+                if "snapshot" in m and "restore" in m:
+                    keys = sc._snapshot_keys(m["snapshot"])
+                    assert len(keys) >= 5, (c.name, sorted(keys))
+
+    def test_allowlist_entries_all_load_bearing(self):
+        """Anti-rot: every SNAPSHOT_ATTR_ALLOW entry must be NEEDED —
+        removing it has to produce a finding. A redundant entry (attr
+        also read by snapshot()) would MASK the finding when someone
+        later deletes that attr's serialization line."""
+        files, _ = cs.walk_files(INF)
+        p = cs.SnapshotCompleteness()
+        for cls_name, allow in cs.SNAPSHOT_ATTR_ALLOW.items():
+            for attr in list(allow):
+                saved = allow.pop(attr)
+                try:
+                    kept = p.run(files)
+                finally:
+                    allow[attr] = saved
+                assert any(f"{cls_name}.{attr} " in f.msg
+                           for f in kept), (
+                    f"allowlist entry {cls_name}.{attr} is redundant "
+                    f"— it would mask a future deletion; remove it")
+
+
+# =====================================================================
+# mutation spot-checks (the acceptance criterion): deleting a single
+# protocol site from a COPY of the real source flips exit 0 -> 1 with
+# a correct path:line finding
+# =====================================================================
+
+def _mutate(tmp_path, src_name, old, new, subdir="m"):
+    src = os.path.join(INF, src_name)
+    with open(src) as f:
+        text = f.read()
+    assert old in text, f"mutation anchor gone from {src_name}: {old!r}"
+    d = tmp_path / subdir
+    d.mkdir(exist_ok=True)
+    out = d / src_name
+    out.write_text(text.replace(old, new))
+    return str(d), str(out)
+
+
+class TestMutations:
+    def test_deleted_snapshot_field(self, tmp_path):
+        root, path = _mutate(
+            tmp_path, "scheduler.py", '"vclock": self._vclock,', "")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self._vclock ="))]
+        assert "_vclock" in kept[0].msg
+
+    def test_deleted_journal_handler(self, tmp_path):
+        root, path = _mutate(
+            tmp_path, "recovery.py",
+            'kind == "release"', 'kind == "release_zzz"')
+        kept, _ = run(root, ["journal-coverage"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, 'self.journal.append("release"'))]
+        assert "'release'" in kept[0].msg
+
+    def test_deleted_charge_call(self, tmp_path):
+        root, path = _mutate(
+            tmp_path, "paged_cache.py",
+            "self._charge(slot, -len(drop))", "pass")
+        kept, _ = run(root, ["charge-discipline"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "del have[keep:]"))]
+        assert "truncate" in kept[0].msg
+
+    def test_deleted_hook_guard(self, tmp_path):
+        old = ("        if self.collector is not None:\n"
+               "            self.collector.begin_step("
+               "self._step_count, kind)")
+        new = ("        self.collector.begin_step("
+               "self._step_count, kind)")
+        root, path = _mutate(tmp_path, "scheduler.py", old, new)
+        kept, _ = run(root, ["hot-path-purity"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self.collector.begin_step"))]
+        assert "_begin_step" in kept[0].msg
+
+    def test_deleted_span_bracket(self, tmp_path):
+        old = ("        try:\n"
+               "            self.journal.append(\"round\", {\n"
+               "                \"emitted\": {int(r): [int(t) "
+               "for t in toks]\n"
+               "                            for r, toks in "
+               "emitted.items()}})\n"
+               "        finally:\n"
+               "            if col is not None:\n"
+               "                col.span_end()")
+        new = ("        self.journal.append(\"round\", {\n"
+               "            \"emitted\": {int(r): [int(t) "
+               "for t in toks]\n"
+               "                        for r, toks in "
+               "emitted.items()}})\n"
+               "        if col is not None:\n"
+               "            col.span_end()")
+        root, path = _mutate(tmp_path, "recovery.py", old, new)
+        kept, _ = run(root, ["span-safety"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, 'col.span_begin("journal")'))]
+
+    def test_deleted_export(self, tmp_path):
+        # renaming an exported name in its source module must trip
+        # the import leg of the drift audit
+        src_dir = tmp_path / "x" / "inference"
+        src_dir.mkdir(parents=True)
+        for name in ("__init__.py", "serving.py"):
+            with open(os.path.join(INF, name)) as f:
+                (src_dir / name).write_text(f.read())
+        text = (src_dir / "serving.py").read_text()
+        assert "class ContinuousBatchingEngine" in text
+        (src_dir / "serving.py").write_text(text.replace(
+            "class ContinuousBatchingEngine",
+            "class ContinuousBatchingEngineZZZ"))
+        kept, _, problems, _ = cs.run_passes(
+            str(tmp_path / "x"), ["export-drift"])
+        assert not problems
+        msgs = " | ".join(f.msg for f in kept)
+        assert "ContinuousBatchingEngine" in msgs
+
+
+# =====================================================================
+# CLI: exit codes, --json envelope, pass selection
+# =====================================================================
+
+class TestCLI:
+    def test_exit_0_on_clean_tree(self, capsys):
+        # the inference subtree (the full-tree gate is TestRealTree)
+        assert cs.main([INF]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out and "OK" in out
+
+    def test_exit_1_on_findings(self, capsys):
+        assert cs.main([os.path.join(FIX, "charge")]) == 1
+        assert "charge-discipline" in capsys.readouterr().out
+
+    def test_exit_2_on_missing_root(self, capsys):
+        assert cs.main([os.path.join(FIX, "no_such_dir")]) == 2
+        assert "UNREADABLE" in capsys.readouterr().out
+
+    def test_exit_2_on_syntax_error(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        assert cs.main([str(tmp_path)]) == 2
+        assert "unparseable" in capsys.readouterr().out
+
+    def test_pass_selection(self):
+        # the snapshot fixture is clean under every OTHER pass
+        kept, supp = run(os.path.join(FIX, "snapshot"),
+                         ["charge-discipline", "span-safety",
+                          "hot-path-purity", "journal-coverage",
+                          "export-drift"])
+        assert kept == [] and supp == []
+
+    def test_list_passes(self, capsys):
+        assert cs.main(["--list-passes"]) == 0
+        out = capsys.readouterr().out
+        for pid in cs.PASS_IDS:
+            assert pid in out
+        assert len(cs.PASS_IDS) == 6
+
+    def test_json_envelope_clean(self, capsys):
+        """--json speaks the shared paddle_tpu.report.v1 envelope
+        (tools/_report.py) — same schema the other report doctors
+        emit, so CI gates on this artifact identically."""
+        from tools._report import SCHEMA
+        assert cs.main([INF, "--json"]) == 0
+        env = json.loads(capsys.readouterr().out)
+        assert env["schema"] == SCHEMA
+        assert env["tool"] == "check_static"
+        assert env["ok"] is True and env["exit"] == 0
+        assert env["problems"] == []
+        assert env["data"]["findings"] == []
+        assert env["data"]["files_scanned"] > 5
+        assert set(env["data"]["passes"]) == set(cs.PASS_IDS)
+
+    def test_json_envelope_findings(self, capsys):
+        from tools._report import SCHEMA
+        assert cs.main([os.path.join(FIX, "span"), "--json"]) == 1
+        env = json.loads(capsys.readouterr().out)
+        assert env["schema"] == SCHEMA and env["ok"] is False
+        assert env["exit"] == 1
+        assert len(env["data"]["findings"]) == 1
+        f = env["data"]["findings"][0]
+        assert set(f) == {"pass", "path", "line", "message"}
+        assert f["pass"] == "span-safety"
+        assert env["problems"]     # human-readable mirror
+        # suppressed findings are reported, never silently dropped
+        assert len(env["data"]["suppressed"]) == 1
