@@ -2581,6 +2581,255 @@ def bench_serving_cost(smoke=False):
     }
 
 
+# ------------------------------------------------------ serving_sharded
+def _sharded_tsm(dim, heads, ffn, layers, vocab, seed=0):
+    """Deterministic TokenServingModel — SEED-reproducible across
+    processes, so the mp=2 subprocess rebuilds bit-identical weights
+    (the router bench's build_server_from_spec convention, with the
+    rolled readout so greedy streams walk the vocab instead of hiding
+    a sharding bug inside a fixed point)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.fused_transformer import \
+        FusedMultiTransformer
+    from paddle_tpu.inference import TokenServingModel
+    rng = np.random.RandomState(seed)
+    m = FusedMultiTransformer(dim, heads, ffn, num_layers=layers)
+    for blk in m.layers:
+        for name in ("qkv", "out_proj", "ffn1", "ffn2"):
+            lin = getattr(blk, name)
+            lin.weight.set_value(paddle.to_tensor(
+                (rng.randn(*lin.weight.shape) * 0.1)
+                .astype(np.float32)))
+            lin.bias.set_value(paddle.to_tensor(
+                (rng.randn(*lin.bias.shape) * 0.01)
+                .astype(np.float32)))
+    emb = (rng.randn(vocab, dim) * 0.3).astype(np.float32)
+    return TokenServingModel(m, emb,
+                             lm_head=np.roll(emb, -1, 0).T.copy())
+
+
+def _sharded_run(cfg, mp):
+    """One serving run of the sharded-bench workload (token-budget
+    mixed steps over the paged engine) at mesh width ``mp``; returns
+    streams + the contract counters."""
+    from paddle_tpu.inference import SpeculativeEngine
+    tsm = _sharded_tsm(cfg["dim"], cfg["heads"], cfg["ffn"],
+                       cfg["layers"], cfg["vocab"])
+    if mp > 1:
+        tsm = tsm.shard(mp)
+    eng = SpeculativeEngine(
+        tsm, k=0, max_batch=cfg["n_req"], block_size=cfg["block"],
+        num_blocks=cfg["num_blocks"], prefix_cache=True,
+        prefill_token_budget=cfg["budget"])
+    rng = np.random.RandomState(7)
+    prompts = [[int(t) for t in rng.randint(0, cfg["vocab"],
+                                            cfg["prompt_len"])]
+               for _ in range(cfg["n_req"])]
+    rids = [eng.submit(p) for p in prompts]
+    steps = 0
+    t0 = time.perf_counter()
+    while min(len(eng.generated(r)) for r in rids) < cfg["gen"]:
+        eng.step()
+        steps += 1
+        if steps > 40 * cfg["gen"]:
+            raise RuntimeError("sharded bench failed to converge")
+    wall = time.perf_counter() - t0
+    streams = {str(i): [int(t) for t in eng.tokens(r)]
+               for i, r in enumerate(rids)}
+    # token count captured BEFORE the contract step below: that extra
+    # step runs outside the timed wall, so its tokens must not ride
+    # the mp>1 numerator (it would bias tokens/s in mp's favor)
+    toks = sum(len(eng.generated(r)) for r in rids)
+    # the per-step contract, measured in isolation AFTER the compared
+    # streams are captured: ONE mixed step (k=0: one model call) must
+    # close with exactly num_layers all-reduces on the sharded path
+    one_step = 0
+    if mp > 1:
+        tsm.core.reset_allreduce_count()
+        eng.step()
+        one_step = tsm.core.allreduce_count
+    cache = eng.engine.cache
+    out = {
+        "streams": streams,
+        "tokens_per_sec": round(toks / wall, 1),
+        "engine_steps": steps,
+        "pool_bytes_per_shard": cache.pool_bytes(),
+        "pool_bytes_total": cache.pool_bytes_total(),
+        "mp": cache.mp,
+        "layers": cfg["layers"],
+        "allreduces_one_mixed_step": one_step,
+        "prefix_hits": eng.engine.prefix_stats.hit_blocks,
+    }
+    if mp > 1:
+        import jax
+        out["jax_devices"] = len(jax.devices())
+        out["distinct_shard_devices"] = len(
+            set(tsm.core.shard_devices))
+        out["qkv_shard"] = tsm.core.qkv_shard
+    eng.check_invariants()
+    return out
+
+
+def _sharded_worker_main(cfg_path, out_path):
+    """Subprocess entry (--sharded-worker): BOTH legs of the sharded
+    bench — mp=1 then mp=2 — in ONE process, on the forced-2-device
+    CPU client the parent's env sets up before jax loads here
+    (including --xla_cpu_parallel_codegen_split_count=1). XLA CPU at
+    larger serving widths is NOT bitwise run-to-run reproducible on
+    this host (the same HLO compiles/executes ~1ulp apart — measured
+    at dim >= 128; greedy argmax amplifies that into different
+    streams), so the legs share one process at dims below that
+    threshold, guarded by the self-determinism check below, and the
+    mp=2 activation path re-runs the exact replicated-projection
+    executables the mp=1 leg used. Same client, same executables:
+    mesh width is the only variable, so bit-identity tests the
+    sharded decomposition itself — the in-process proof pattern of
+    tests/test_sharded.py, here on a REAL 2-device mesh."""
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    from paddle_tpu.parallel.mesh import build_mesh
+    import jax
+    if len(jax.devices()) >= cfg["mp"]:
+        build_mesh(dp=1, mp=cfg["mp"])   # the training mesh, reused
+    # baseline SELF-DETERMINISM guard: a baseline that cannot
+    # reproduce ITSELF proves nothing about sharding. A loaded host
+    # occasionally wobbles even at these dims, so the baseline gets
+    # a bounded number of attempts to produce two CONSECUTIVE
+    # identical runs; only if it never does is the comparison void —
+    # an honest verdict instead of "mp=2 diverged".
+    prev = _sharded_run(cfg, 1)
+    mp1 = None
+    for _ in range(3):
+        cur = _sharded_run(cfg, 1)
+        if cur["streams"] == prev["streams"]:
+            mp1 = cur
+            break
+        prev = cur
+    if mp1 is None:
+        raise RuntimeError(
+            "single-chip baseline is not self-deterministic at "
+            "these dims on this host (XLA CPU compile/runtime "
+            "nondeterminism despite pinned parallel codegen) — "
+            "the bit-identity comparison is void here")
+    res = {"mp1": mp1, "mp2": _sharded_run(cfg, cfg["mp"])}
+    with open(out_path, "w") as f:
+        json.dump(res, f)
+
+
+def bench_serving_sharded(smoke=False):
+    """Tensor-parallel sharded paged serving (ShardedServingCore +
+    PagedKVCache(mp=2)) vs the single-chip engine, SAME workload
+    (token-budget mixed steps, prefix cache on):
+
+      mp1   single-chip run — the stream oracle
+      mp2   the same run on a real dp=1/mp=2 CPU mesh
+            (parallel.mesh.build_mesh(dp=1, mp=2)): pool shards on
+            two DISTINCT jax devices, per-layer all-reduce crossing
+            them
+
+    BOTH legs run inside ONE subprocess sharing one forced-2-device
+    client, at dims below this host's XLA-CPU reproducibility
+    threshold and guarded by a baseline self-determinism check — a
+    baseline that cannot reproduce itself proves nothing about
+    sharding (see _sharded_worker_main).
+
+    Headlines asserted in-bench: mp2 greedy streams BIT-IDENTICAL to
+    mp1, per-shard pool bytes exactly HALF of the single chip (the
+    HBM-headroom multiplication sharding buys), and exactly
+    num_layers all-reduces per mixed step. CPU proves protocol +
+    bit-identity; only TPU hardware proves the collective-bandwidth
+    economics (ROADMAP hardware leg)."""
+    import os
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    smoke = smoke or _SMOKE
+    if smoke:
+        dim, heads, ffn, layers = 32, 4, 64, 2
+        vocab, n_req, gen = 50, 3, 8
+    else:
+        # dim 64 is the widest config whose SINGLE-CHIP baseline is
+        # reliably bitwise self-deterministic on this host's XLA CPU
+        # (at dim >= 128 the same HLO compiles/executes to
+        # ~1ulp-different results run to run — twin engines in one
+        # process emit different greedy streams, measured; the
+        # worker's self-determinism guard is the arbiter). Width does
+        # not weaken the protocol proof — bytes halving, all-reduce
+        # count and bit-identity are width-independent claims, and
+        # the economics need the TPU leg regardless.
+        dim, heads, ffn, layers = 64, 8, 256, 2
+        vocab, n_req, gen = 512, 6, 24
+    block, prompt_len, budget = 4, 8, 8
+    mbps = -(-(prompt_len + gen + 6) // block) + 1
+    cfg = dict(dim=dim, heads=heads, ffn=ffn, layers=layers,
+               vocab=vocab, n_req=n_req, gen=gen, block=block,
+               prompt_len=prompt_len, budget=budget, mp=2,
+               num_blocks=n_req * mbps + 8)
+
+    d = tempfile.mkdtemp(prefix="pt_sharded_bench_")
+    # parallel_codegen_split_count=1 removes one measured
+    # nondeterminism source (XLA CPU's parallel LLVM codegen splits
+    # the same HLO load-dependently); it is NOT sufficient at large
+    # widths — the worker's self-determinism guard plus the dims
+    # chosen above are what make the comparison sound.
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2 "
+                         "--xla_cpu_parallel_codegen_split_count=1",
+               JAX_PLATFORMS="cpu")
+    cfg_path, out_path = f"{d}/cfg.json", f"{d}/legs.json"
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    # one child runs BOTH widths in one client (see docstring)
+    proc = subprocess.run(
+        [_sys.executable, os.path.abspath(__file__),
+         "--sharded-worker", cfg_path, out_path],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0 or not os.path.exists(out_path):
+        raise RuntimeError(
+            f"sharded mesh subprocess failed (exit "
+            f"{proc.returncode}): {proc.stderr[-800:]}")
+    with open(out_path) as f:
+        legs = json.load(f)
+    mp1, mp2 = legs["mp1"], legs["mp2"]
+
+    # the headline guarantees, asserted at bench scale
+    assert mp2["jax_devices"] >= 2, mp2
+    assert mp2["distinct_shard_devices"] == 2, mp2
+    streams_identical = mp2["streams"] == mp1["streams"]
+    assert streams_identical, "mp=2 streams diverged from single-chip"
+    assert mp2["pool_bytes_per_shard"] * 2 == mp1["pool_bytes_total"]
+    assert mp2["allreduces_one_mixed_step"] == layers
+
+    return {
+        "metric": "serving_tensor_parallel_sharded_mesh",
+        "config": {k: cfg[k] for k in ("dim", "heads", "ffn",
+                                       "layers", "vocab", "n_req",
+                                       "gen", "num_blocks")},
+        "mp1": {k: mp1[k] for k in ("tokens_per_sec", "engine_steps",
+                                    "pool_bytes_per_shard",
+                                    "prefix_hits")},
+        "mp2": {k: mp2[k] for k in ("tokens_per_sec", "engine_steps",
+                                    "pool_bytes_per_shard",
+                                    "jax_devices",
+                                    "distinct_shard_devices",
+                                    "allreduces_one_mixed_step",
+                                    "prefix_hits")},
+        "streams_bit_identical": bool(streams_identical),
+        "pool_bytes_per_shard_ratio": round(
+            mp2["pool_bytes_per_shard"]
+            / mp1["pool_bytes_per_shard"], 3),
+        "allreduces_per_mixed_step": mp2["allreduces_one_mixed_step"],
+        "num_layers": layers,
+        "relative_tokens_per_sec": round(
+            mp2["tokens_per_sec"] / mp1["tokens_per_sec"], 3),
+        "note": ("CPU mesh proves protocol + bit-identity + the "
+                 "per-shard HBM halving; collective bandwidth "
+                 "economics need the TPU leg"),
+    }
+
+
 BENCHES = {
     "resnet50_cifar": bench_resnet50,
     "bert_base_static": bench_bert_static,
@@ -2596,6 +2845,7 @@ BENCHES = {
     "serving_tenants": bench_serving_tenants,
     "serving_recovery": bench_serving_recovery,
     "serving_router": bench_serving_router,
+    "serving_sharded": bench_serving_sharded,
     "serving_obs": bench_serving_obs,
     "serving_monitor": bench_serving_monitor,
     "serving_cost": bench_serving_cost,
@@ -2606,6 +2856,12 @@ BENCHES = {
 
 def main():
     global _SMOKE
+    import sys as _sys
+    if len(_sys.argv) >= 4 and _sys.argv[1] == "--sharded-worker":
+        # mp=2 mesh child of bench_serving_sharded (its env carries
+        # the forced device count — jax must load fresh here)
+        _sharded_worker_main(_sys.argv[2], _sys.argv[3])
+        return
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None)
     ap.add_argument("--round", type=int, default=3)

@@ -33,7 +33,7 @@ from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       PrefillStats, PrefixCacheStats, ResilienceStats,
-                      SpecDecodeStats, TenantStats)
+                      ShardedServingCore, SpecDecodeStats, TenantStats)
 from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
                         TraceCollector)
 from .accounting import (CostLedger, WorkModel,  # noqa: F401
@@ -74,6 +74,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
            "RecoverableServer", "RecoveryError", "RequestJournal",
            "RequestOutcome", "ResilienceStats", "SNAPSHOT_VERSION",
+           "ShardedServingCore",
            "SnapshotVersionError", "SpecDecodeStats",
            "SpeculativeEngine", "StatsBase", "Tenant",
            "TenantStats", "TokenServingModel", "TraceCollector",

@@ -52,6 +52,23 @@ audit fingerprints payload + scales, and because each position's
 quantized bytes are a pure function of that token's K/V (see
 ``_quant_rows``), prefix adoption of a quantized page is EXACT.
 
+TENSOR-PARALLEL SHARDING (``mp`` > 1): the pool partitions over
+attention heads — shard s stores ``pools[layer * mp + s]`` of shape
+``[num_blocks, 2, H/mp, bs, D]`` on its own device (scale pages
+sharded identically on int8 pools), while the allocator, block
+tables, refcounts, chain-hash index, tenant charges and decode mask
+stay host-side and REPLICATED: block ids and lifecycle are
+shard-invariant, so admission, quotas, WFQ, prefix caching, COW,
+snapshots and the journal run byte-for-byte unchanged at any mesh
+width. Each layer's views grow a ``shard(s)`` accessor; the
+per-shard model (inference/serving.py ``ShardedServingCore``) drives
+shard s with its own head slice of q/k/v and closes each layer with
+ONE all-reduce. Snapshots and migration slices stay CANONICAL
+(full-head pages): shards concatenate on the head axis going out and
+re-slice coming in, which is what makes checkpoints and kv_slices
+portable across mesh widths (mp=N <-> mp=1) — the content address of
+a page never depends on how it is sharded.
+
 CRASH RECOVERY (``snapshot``/``restore``): because every block is
 content-addressed by its chain hash, a pool checkpoint is "serialize
 the live + cached-free pages plus the allocator's exact state"
@@ -426,19 +443,28 @@ class PagedLayerCache:
 
     is_paged = True
 
-    def __init__(self, cache: "PagedKVCache", layer: int):
+    def __init__(self, cache: "PagedKVCache", layer: int,
+                 shard: int = 0):
         self._cache = cache
         self._layer = layer
+        self._shard = int(shard)
+        self._pi = cache.pool_index(layer, self._shard)
+
+    def shard(self, s: int) -> "PagedLayerCache":
+        """This layer's view of mp shard ``s`` — the per-shard cache
+        object a ShardedServingCore drives with its own head slice of
+        q/k/v (replicated metadata, shard-local pages)."""
+        return PagedLayerCache(self._cache, self._layer, shard=s)
 
     @property
     def pool(self) -> Tensor:
-        return self._cache.pools[self._layer]
+        return self._cache.pools[self._pi]
 
     @property
     def kv_scales(self) -> Optional[Tensor]:
         """Per-page dequantization scales (int8 pools), else None."""
         c = self._cache
-        return c.scales[self._layer] if c.quantized else None
+        return c.scales[self._pi] if c.quantized else None
 
     @property
     def shape(self):
@@ -464,7 +490,17 @@ class PagedLayerCache:
         B, L = q.shape[0], q.shape[1]
         if B != c.max_seqs:
             raise ValueError(f"batch {B} != cache max_seqs {c.max_seqs}")
-        if self._layer == 0 and not isinstance(t, _jax.core.Tracer):
+        if c.mp > 1 and int(q.shape[2]) != c.heads_per_shard:
+            # a full-head call against a sharded pool would scatter
+            # H rows into an H/mp page (or, worse, read as GQA in the
+            # kernel): fail loudly with the fix
+            raise ValueError(
+                f"sharded pool (mp={c.mp}) expects the per-shard "
+                f"head slice ({c.heads_per_shard} heads), got "
+                f"{int(q.shape[2])} — drive a sharded cache through "
+                f"a ShardedServingCore (per-shard qkv), not a "
+                f"single-chip model")
+        if self._pi == 0 and not isinstance(t, _jax.core.Tracer):
             # eager: catch a forgotten ensure() — the write would land
             # in the shared trash block and silently corrupt this
             # row's attention (rows with NO blocks at t == 0 are
@@ -497,7 +533,7 @@ class PagedLayerCache:
                 impl, (self.pool, self.kv_scales, k, v, tt, bt),
                 op_name="paged_cache_kv_q" if L == 1
                 else "paged_cache_kv_multi_q")
-            c.scales[self._layer] = new_sc
+            c.scales[self._pi] = new_sc
         elif L == 1:
             new_pool = apply(_make_append(c.block_size),
                              (self.pool, k, v, tt, bt),
@@ -506,7 +542,7 @@ class PagedLayerCache:
             new_pool = apply(_make_append_multi(c.block_size, L),
                              (self.pool, k, v, tt, bt),
                              op_name="paged_cache_kv_multi")
-        c.pools[self._layer] = new_pool
+        c.pools[self._pi] = new_pool
 
         if use_kernel:
             if c.quantized:
@@ -613,23 +649,30 @@ class PagedPrefillView:
     is_paged = True
 
     def __init__(self, cache: "PagedKVCache", layer: int, slot: int,
-                 write_start: int = 0):
+                 write_start: int = 0, shard: int = 0):
         self._cache = cache
         self._layer = layer
         self._slot = slot
+        self._shard = int(shard)
+        self._pi = cache.pool_index(layer, self._shard)
         # positions below write_start are an adopted (possibly shared)
         # prefix whose pages already hold these exact K/V — recomputed
         # rows there attend but do not write (see _make_append_chunk)
         self._write_start = int(write_start)
 
+    def shard(self, s: int) -> "PagedPrefillView":
+        """This (layer, slot) chunk view of mp shard ``s``."""
+        return PagedPrefillView(self._cache, self._layer, self._slot,
+                                write_start=self._write_start, shard=s)
+
     @property
     def pool(self) -> Tensor:
-        return self._cache.pools[self._layer]
+        return self._cache.pools[self._pi]
 
     @property
     def kv_scales(self) -> Optional[Tensor]:
         c = self._cache
-        return c.scales[self._layer] if c.quantized else None
+        return c.scales[self._pi] if c.quantized else None
 
     @property
     def shape(self):
@@ -649,7 +692,13 @@ class PagedPrefillView:
         if B != 1:
             raise ValueError(
                 f"chunk prefill is a batch-1 call, got batch {B}")
-        if self._layer == 0 and not isinstance(t, _jax.core.Tracer):
+        if c.mp > 1 and int(q.shape[2]) != c.heads_per_shard:
+            raise ValueError(
+                f"sharded pool (mp={c.mp}) expects the per-shard "
+                f"head slice ({c.heads_per_shard} heads), got "
+                f"{int(q.shape[2])} — drive a sharded cache through "
+                f"a ShardedServingCore")
+        if self._pi == 0 and not isinstance(t, _jax.core.Tracer):
             pos = int(np.asarray(t).reshape(-1)[0])
             have = len(c.seq_blocks[self._slot])
             if c.blocks_needed(pos + C) > have:
@@ -666,12 +715,12 @@ class PagedPrefillView:
                 _make_append_chunk_q(c.block_size, C),
                 (self.pool, self.kv_scales, k, v, tt, bt, ws),
                 op_name="paged_prefill_chunk_kv_q")
-            c.scales[self._layer] = new_sc
+            c.scales[self._pi] = new_sc
         else:
             new_pool = apply(_make_append_chunk(c.block_size, C),
                              (self.pool, k, v, tt, bt, ws),
                              op_name="paged_prefill_chunk_kv")
-        c.pools[self._layer] = new_pool
+        c.pools[self._pi] = new_pool
 
         if use_kernel:
             if c.quantized:
@@ -837,19 +886,28 @@ class PagedRaggedView:
     is_paged = True
 
     def __init__(self, cache: "PagedKVCache", layer: int,
-                 layout: _RaggedLayout):
+                 layout: _RaggedLayout, shard: int = 0):
         self._cache = cache
         self._layer = layer
+        self._shard = int(shard)
+        self._pi = cache.pool_index(layer, self._shard)
         self._layout = layout
+
+    def shard(self, s: int) -> "PagedRaggedView":
+        """This layer's ragged view of mp shard ``s`` — the SAME
+        layout object rides along (the routing descriptors are
+        replicated metadata, shard-invariant by construction)."""
+        return PagedRaggedView(self._cache, self._layer, self._layout,
+                               shard=s)
 
     @property
     def pool(self) -> Tensor:
-        return self._cache.pools[self._layer]
+        return self._cache.pools[self._pi]
 
     @property
     def kv_scales(self) -> Optional[Tensor]:
         c = self._cache
-        return c.scales[self._layer] if c.quantized else None
+        return c.scales[self._pi] if c.quantized else None
 
     @property
     def shape(self):
@@ -867,18 +925,24 @@ class PagedRaggedView:
             raise ValueError(
                 f"ragged call expects [1, {lay.total_rows}, H, D], "
                 f"got {tuple(q.shape)}")
+        if c.mp > 1 and int(q.shape[2]) != c.heads_per_shard:
+            raise ValueError(
+                f"sharded pool (mp={c.mp}) expects the per-shard "
+                f"head slice ({c.heads_per_shard} heads), got "
+                f"{int(q.shape[2])} — drive a sharded cache through "
+                f"a ShardedServingCore")
         new_sc = None
         if c.quantized:
             new_pool, new_sc = apply(
                 _ragged_append_q,
                 (self.pool, self.kv_scales, k, v, lay.blk, lay.off),
                 op_name="paged_ragged_append_q")
-            c.scales[self._layer] = new_sc
+            c.scales[self._pi] = new_sc
         else:
             new_pool = apply(_ragged_append,
                              (self.pool, k, v, lay.blk, lay.off),
                              op_name="paged_ragged_append")
-        c.pools[self._layer] = new_pool
+        c.pools[self._pi] = new_pool
 
         if use_kernel:
             q_lens, tile_q, tile_kv = (lay.q_lens, lay.tile_q,
@@ -981,7 +1045,8 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  block_size: int, num_blocks: int, max_seqs: int,
                  max_blocks_per_seq: Optional[int] = None,
-                 dtype: str = "float32", prefix_cache: bool = False):
+                 dtype: str = "float32", prefix_cache: bool = False,
+                 mp: int = 1, shard_devices=None):
         import paddle_tpu as paddle
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
@@ -989,6 +1054,39 @@ class PagedKVCache:
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.max_seqs = int(max_seqs)
+        # TENSOR-PARALLEL SHARDING (``mp`` > 1): the pool is
+        # partitioned over attention heads — shard s stores
+        # [num_blocks, 2, H/mp, bs, D] (pools[layer * mp + s]), the
+        # head slice [s*H/mp, (s+1)*H/mp). EVERYTHING ELSE in this
+        # class — allocator, block tables, refcounts, chain-hash
+        # index, tenant charges, decode mask — is host-side metadata
+        # REPLICATED across shards: block ids and lifecycle are
+        # shard-invariant, so admission, quotas, WFQ, prefix hashing,
+        # COW, snapshots and the journal run byte-for-byte unchanged.
+        # Each shard's pages live on its own device
+        # (``shard_devices``, parallel/mesh.py serving_shard_devices)
+        # and only the per-shard model (ShardedServingCore) writes /
+        # reads them, with its own head-slice of q/k/v. The SNAPSHOT
+        # and MIGRATION wire formats stay CANONICAL (full-head pages,
+        # the mp=1 layout): shards concatenate on the head axis going
+        # out and re-slice coming in, which is what makes snapshots
+        # and kv_slices portable across mesh widths (mp=N <-> mp=1).
+        self.mp = int(mp)
+        if self.mp < 1:
+            raise ValueError(f"mp must be >= 1, got {mp}")
+        if self.num_heads % self.mp:
+            raise ValueError(
+                f"num_heads {self.num_heads} must divide evenly over "
+                f"mp={self.mp} tensor-parallel shards")
+        if self.mp > 1 and shard_devices is None:
+            from ..parallel.mesh import serving_shard_devices
+            shard_devices = serving_shard_devices(self.mp)
+        if shard_devices is not None and len(shard_devices) < self.mp:
+            raise ValueError(
+                f"need {self.mp} shard devices, got "
+                f"{len(shard_devices)}")
+        self.shard_devices = (list(shard_devices[:self.mp])
+                              if shard_devices is not None else None)
         if max_blocks_per_seq is None:
             max_blocks_per_seq = self.num_blocks - 1
         self.max_blocks_per_seq = int(max_blocks_per_seq)
@@ -1026,17 +1124,29 @@ class PagedKVCache:
         # hashed at audit time and re-verified while they stay in that
         # state; fork/adopt re-shares drop the entry (fresh epoch)
         self._audit_fp: Dict[int, bytes] = {}
+        # pool storage: ``pools[layer * mp + shard]`` — for mp == 1
+        # exactly the old one-entry-per-layer list (shape and device
+        # placement untouched), for mp > 1 each entry is one shard's
+        # head slice committed to its shard device. The flat list
+        # keeps every uniform whole-pool pass (COW copy, snapshot
+        # pull, deep-audit fingerprint) working unchanged over all
+        # layer x shard entries.
+        Hs = self.heads_per_shard
         self.pools: List[Tensor] = [
-            paddle.zeros([self.num_blocks, 2, self.num_heads,
-                          self.block_size, self.head_dim], dtype=dtype)
-            for _ in range(self.num_layers)]
+            self._place(paddle.zeros(
+                [self.num_blocks, 2, Hs, self.block_size,
+                 self.head_dim], dtype=dtype), pi)
+            for pi in range(self.num_layers * self.mp)]
         # per-page dequantization scales (int8 pools only):
-        # [num_blocks, 2, heads, block_size] float32 per layer —
-        # zero-init dequantizes to exact zeros, matching a zeroed pool
+        # [num_blocks, 2, heads/mp, block_size] float32 per
+        # layer x shard — zero-init dequantizes to exact zeros,
+        # matching a zeroed pool
         self.scales: Optional[List[Tensor]] = [
-            paddle.zeros([self.num_blocks, 2, self.num_heads,
-                          self.block_size], dtype="float32")
-            for _ in range(self.num_layers)] if self.quantized else None
+            self._place(paddle.zeros(
+                [self.num_blocks, 2, Hs, self.block_size],
+                dtype="float32"), pi)
+            for pi in range(self.num_layers * self.mp)] \
+            if self.quantized else None
         # all entries at the trash block until allocated
         self.block_tables = np.zeros(
             (self.max_seqs, self.max_blocks_per_seq), np.int32)
@@ -1072,12 +1182,39 @@ class PagedKVCache:
     def for_model(cls, model, block_size, num_blocks, max_seqs,
                   max_blocks_per_seq=None, dtype="float32",
                   prefix_cache=False):
+        """Build a pool matching ``model``'s geometry — INCLUDING its
+        tensor-parallel layout: a ShardedServingCore carries ``mp``
+        and ``shard_devices``, so the engines get a matching sharded
+        pool without a single signature change."""
         return cls(model.num_layers, model.num_heads, model.head_dim,
                    block_size, num_blocks, max_seqs,
                    max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
-                   prefix_cache=prefix_cache)
+                   prefix_cache=prefix_cache,
+                   mp=getattr(model, "mp", 1),
+                   shard_devices=getattr(model, "shard_devices", None))
+
+    def _place(self, t: Tensor, pi: int) -> Tensor:
+        """Commit a pool/scale entry to its shard's device (mp > 1);
+        the mp == 1 path is byte-for-byte the old single-chip one —
+        uncommitted, exactly as paddle.zeros made it."""
+        if self.mp == 1 or self.shard_devices is None:
+            return t
+        import jax as _jax
+        dev = self.shard_devices[pi % self.mp]
+        return Tensor(_jax.device_put(t.data, dev))
 
     # -- geometry -----------------------------------------------------
+    @property
+    def heads_per_shard(self) -> int:
+        """Attention heads each mp shard stores (== num_heads at
+        mp 1); shard s holds heads [s*H/mp, (s+1)*H/mp)."""
+        return self.num_heads // self.mp
+
+    def pool_index(self, layer: int, shard: int = 0) -> int:
+        """Index of (layer, shard)'s entry in the flat ``pools`` /
+        ``scales`` lists."""
+        return layer * self.mp + shard
+
     @property
     def capacity_per_seq(self) -> int:
         return self.max_blocks_per_seq * self.block_size
@@ -1090,10 +1227,22 @@ class PagedKVCache:
         return self.num_blocks - 1 - self.allocator.num_free
 
     def pool_bytes(self) -> int:
-        # itemsize off the array's own dtype: np.dtype(str(...)) has no
-        # parse for ml_dtypes names, so a bfloat16 pool would raise.
-        # Quantized pools count the scale metadata too — the honest
-        # byte model (a stale bf16 model would overstate density ~2x)
+        """PER-SHARD pool bytes — what ONE device's HBM actually
+        holds. At mp == 1 this is the whole pool (unchanged); on a
+        sharded pool each device holds 1/mp of the payload (the
+        headroom multiplication the sharding buys — a cost report
+        that summed all shards would overstate per-chip HBM by mp x;
+        ``pool_bytes_total()`` gives the whole-mesh sum).
+
+        itemsize off the array's own dtype: np.dtype(str(...)) has no
+        parse for ml_dtypes names, so a bfloat16 pool would raise.
+        Quantized pools count the scale metadata too — the honest
+        byte model (a stale bf16 model would overstate density ~2x)."""
+        return self.pool_bytes_total() // self.mp
+
+    def pool_bytes_total(self) -> int:
+        """Pool bytes summed across every mp shard (the whole-mesh
+        footprint; == pool_bytes() at mp 1)."""
         n = sum(int(np.prod(p.shape)) * p.data.dtype.itemsize
                 for p in self.pools)
         if self.quantized:
@@ -1102,15 +1251,19 @@ class PagedKVCache:
         return n
 
     def kv_bytes_per_token(self) -> int:
-        """HBM bytes one token's K/V occupies across every layer
-        (2 x heads x (head_dim x payload itemsize + scale bytes) x
-        layers) — the KV-traffic unit of the analytic work model
-        (inference/accounting.py); int8 pools carry 4 scale bytes per
-        (position, head, K|V) next to the int8 payload."""
+        """PER-SHARD HBM bytes one token's K/V occupies across every
+        layer (2 x heads/mp x (head_dim x payload itemsize + scale
+        bytes) x layers) — the KV-traffic unit of the analytic work
+        model (inference/accounting.py), per DEVICE: each shard reads
+        and writes only its own head slice, so MBU paired against one
+        chip's peak bandwidth must price one chip's traffic. int8
+        pools carry 4 scale bytes per (position, head, K|V) next to
+        the int8 payload."""
         per_head = self.head_dim * self.pools[0].data.dtype.itemsize
         if self.quantized:
             per_head += self.scales[0].data.dtype.itemsize
-        return int(2 * self.num_heads * per_head * self.num_layers)
+        return int(2 * self.heads_per_shard * per_head
+                   * self.num_layers)
 
     # -- tenant accounting --------------------------------------------
     def _charge(self, slot: int, delta: int) -> None:
@@ -1172,6 +1325,13 @@ class PagedKVCache:
             "free": len(a._free),
             "usable": self.num_blocks - 1,
         }
+        if self.mp > 1:
+            # sharded pools report bytes HONESTLY per shard: the
+            # metadata above is replicated (every shard sees the same
+            # tiers), the payload is divided — a reader summing
+            # per-worker reports must not count HBM mp x over
+            out["mp"] = self.mp
+            out["pool_bytes_per_shard"] = self.pool_bytes()
         if not tiers_only:
             out["blocks_per_slot"] = {
                 s: len(bl) for s, bl in enumerate(self.seq_blocks)
@@ -1370,6 +1530,15 @@ class PagedKVCache:
         keep = sorted({b for b in range(1, self.num_blocks)
                        if a.refcount[b] > 0} | set(cached_order))
         arrs = [np.asarray(p.numpy()) for p in self.pools]
+        if self.mp > 1:
+            # CANONICAL wire format: full-head pages, the mp=1 layout
+            # — shard slices concatenate back on the head axis, so a
+            # snapshot taken at mp=N restores at ANY width (mp=1
+            # included) and vice versa; content-addressing stays over
+            # the canonical bytes, identical across mesh widths
+            arrs = [np.concatenate(
+                arrs[i * self.mp:(i + 1) * self.mp], axis=2)
+                for i in range(self.num_layers)]
         if keep:
             # one fancy-index gather per layer, not a Python loop per
             # block — snapshots sit on the serving hot path
@@ -1387,6 +1556,10 @@ class PagedKVCache:
             # or different geometry) reproduces dequantized values
             # bit-exactly
             sarrs = [np.asarray(s.numpy()) for s in self.scales]
+            if self.mp > 1:
+                sarrs = [np.concatenate(
+                    sarrs[i * self.mp:(i + 1) * self.mp], axis=2)
+                    for i in range(self.num_layers)]
             if keep:
                 scale_payload = np.stack([a[keep] for a in sarrs],
                                          axis=1)   # [n, L, 2, H, bs]
@@ -1406,6 +1579,10 @@ class PagedKVCache:
                 "max_blocks_per_seq": self.max_blocks_per_seq,
                 "dtype": self.dtype,
                 "prefix_cache": self.prefix_cache,
+                # recorded so tooling names the source mesh width; the
+                # PAYLOAD is canonical (full heads) regardless, and
+                # restore(mp=...) re-slices for any target width
+                "mp": self.mp,
             },
             "refcount": {int(b): int(a.refcount[b]) for b in keep},
             "free_order": [int(b) for b in a._free],
@@ -1424,7 +1601,9 @@ class PagedKVCache:
 
     @classmethod
     def restore(cls, snap: dict, *,
-                num_blocks: Optional[int] = None) -> "PagedKVCache":
+                num_blocks: Optional[int] = None,
+                mp: Optional[int] = None,
+                shard_devices=None) -> "PagedKVCache":
         """Rebuild a pool from a ``snapshot`` dict. With the default
         (same ``num_blocks``) every block keeps its id and the
         allocator's free-list and LRU orders round-trip EXACTLY, so
@@ -1437,13 +1616,22 @@ class PagedKVCache:
         target cannot hold everything, exactly the LRU-reclaim policy
         the live allocator applies. A live set that cannot fit raises
         ``BlockOOM`` carrying the snapshot's occupancy breakdown.
-        Ends with the deep ``check_invariants`` audit."""
+
+        ``mp`` retargets the tensor-parallel width: the snapshot's
+        payload is canonical (full-head pages) whatever mesh it was
+        taken on, so a snapshot from an mp=N fleet restores onto a
+        single chip (mp=1) and vice versa — each target shard takes
+        its own head slice of every page. Default: the snapshot's
+        recorded width. Ends with the deep ``check_invariants``
+        audit."""
         g = snap["geometry"]
         nb = g["num_blocks"] if num_blocks is None else int(num_blocks)
+        mp_t = int(g.get("mp", 1)) if mp is None else int(mp)
         cache = cls(g["num_layers"], g["num_heads"], g["head_dim"],
                     g["block_size"], nb, g["max_seqs"],
                     max_blocks_per_seq=g["max_blocks_per_seq"],
-                    dtype=g["dtype"], prefix_cache=g["prefix_cache"])
+                    dtype=g["dtype"], prefix_cache=g["prefix_cache"],
+                    mp=mp_t, shard_devices=shard_devices)
         refcount = {int(b): int(n) for b, n in snap["refcount"].items()}
         cached = [int(b) for b in snap["cached_order"]]
         live = sorted(b for b, n in refcount.items() if n > 0)
@@ -1505,17 +1693,27 @@ class PagedKVCache:
             ids = jnp.asarray([remap[int(snap["blocks"][i])]
                                for i in rows], jnp.int32)
             payload = payload[rows]
+            Hs = cache.heads_per_shard
             for i in range(cache.num_layers):
-                seg = jnp.asarray(payload[:, i])
-                cache.pools[i] = Tensor(
-                    cache.pools[i].data.at[ids].set(
-                        seg.astype(cache.pools[i].data.dtype)))
+                for s in range(cache.mp):
+                    # each target shard takes its head slice of the
+                    # canonical page (the whole page at mp == 1)
+                    pi = cache.pool_index(i, s)
+                    seg = jnp.asarray(
+                        payload[:, i, :, s * Hs:(s + 1) * Hs])
+                    cache.pools[pi] = Tensor(
+                        cache.pools[pi].data.at[ids].set(
+                            seg.astype(cache.pools[pi].data.dtype)))
             if cache.quantized:
                 spay = np.asarray(snap["scale_payload"])[rows]
                 for i in range(cache.num_layers):
-                    cache.scales[i] = Tensor(
-                        cache.scales[i].data.at[ids].set(
-                            jnp.asarray(spay[:, i], jnp.float32)))
+                    for s in range(cache.mp):
+                        pi = cache.pool_index(i, s)
+                        cache.scales[pi] = Tensor(
+                            cache.scales[pi].data.at[ids].set(
+                                jnp.asarray(
+                                    spay[:, i, :, s * Hs:(s + 1) * Hs],
+                                    jnp.float32)))
         cache.peak_blocks_used = int(snap["peak_blocks_used"])
         cache._tables_dirty()
         cache.check_invariants(deep=True)
@@ -1792,11 +1990,22 @@ class PagedKVCache:
             return None
         # gather ON DEVICE, transfer only the slice: pulling whole
         # pools to host per export would cost O(pool) per migrated
-        # slot where the slice is a handful of blocks
+        # slot where the slice is a handful of blocks. Sharded pools
+        # emit the CANONICAL full-head page (per-shard gathers
+        # concatenated on the head axis) — the wire format is
+        # mesh-width-independent, so any pool can adopt any slice
         ids = jnp.asarray(blocks, jnp.int32)
-        payload = np.stack([np.asarray(p.data[ids])
-                            for p in self.pools],
-                           axis=1)                # [n, L, 2, H, bs, D]
+        if self.mp == 1:
+            payload = np.stack([np.asarray(p.data[ids])
+                                for p in self.pools],
+                               axis=1)            # [n, L, 2, H, bs, D]
+        else:
+            payload = np.stack(
+                [np.concatenate(
+                    [np.asarray(
+                        self.pools[self.pool_index(i, s)].data[ids])
+                     for s in range(self.mp)], axis=2)
+                 for i in range(self.num_layers)], axis=1)
         out = {
             "kind": "kv_slice",
             "geometry": {
@@ -1810,9 +2019,17 @@ class PagedKVCache:
             "payload": payload,
         }
         if self.quantized:
-            out["scale_payload"] = np.stack(
-                [np.asarray(s.data[ids]) for s in self.scales],
-                axis=1)                           # [n, L, 2, H, bs]
+            if self.mp == 1:
+                out["scale_payload"] = np.stack(
+                    [np.asarray(s.data[ids]) for s in self.scales],
+                    axis=1)                       # [n, L, 2, H, bs]
+            else:
+                out["scale_payload"] = np.stack(
+                    [np.concatenate(
+                        [np.asarray(self.scales[
+                            self.pool_index(i, s)].data[ids])
+                         for s in range(self.mp)], axis=2)
+                     for i in range(self.num_layers)], axis=1)
         return out
 
     def import_slice(self, slc: dict) -> int:
@@ -1877,15 +2094,26 @@ class PagedKVCache:
             return 0
         ids = jnp.asarray([b for b, _ in landing], jnp.int32)
         rows = [i for _, i in landing]
+        Hs = self.heads_per_shard
         for li in range(self.num_layers):
-            seg = jnp.asarray(payload[rows, li])
-            self.pools[li] = Tensor(
-                self.pools[li].data.at[ids].set(
-                    seg.astype(self.pools[li].data.dtype)))
-            if self.quantized:
-                self.scales[li] = Tensor(
-                    self.scales[li].data.at[ids].set(
-                        jnp.asarray(spay[rows, li], jnp.float32)))
+            # ONE fancy-index gather of the layer's canonical
+            # full-head pages; each local shard lands a view-slice of
+            # it (not mp re-gathers of the whole payload)
+            seg_full = payload[rows, li]
+            sfull = spay[rows, li] if self.quantized else None
+            for s in range(self.mp):
+                pi = self.pool_index(li, s)
+                seg = jnp.asarray(
+                    seg_full[:, :, s * Hs:(s + 1) * Hs])
+                self.pools[pi] = Tensor(
+                    self.pools[pi].data.at[ids].set(
+                        seg.astype(self.pools[pi].data.dtype)))
+                if self.quantized:
+                    self.scales[pi] = Tensor(
+                        self.scales[pi].data.at[ids].set(
+                            jnp.asarray(
+                                sfull[:, :, s * Hs:(s + 1) * Hs],
+                                jnp.float32)))
         for (b, i) in landing:
             # fresh content: new audit epoch for the fingerprint
             # check, then park cached-free in prefix (oldest-first
@@ -1946,19 +2174,25 @@ class PagedKVCache:
         hold projected K/V — e.g. migrating a dense cache row into
         pages chunk by chunk."""
         C = int(k.shape[1])
+        pi = self.pool_index(layer, 0)
+        if self.mp > 1:
+            raise ValueError(
+                "write_prefill_chunk takes full-head K/V; a sharded "
+                "pool's pages are written per shard through the "
+                "prefill views (ShardedServingCore)")
         tt = Tensor(jnp.asarray([start], jnp.int32))
         ws = Tensor(jnp.asarray([write_start], jnp.int32))
         bt = self.bt_row_tensor(slot)
         if self.quantized:
-            self.pools[layer], self.scales[layer] = apply(
+            self.pools[pi], self.scales[pi] = apply(
                 _make_append_chunk_q(self.block_size, C),
-                (self.pools[layer], self.scales[layer], k, v, tt, bt,
+                (self.pools[pi], self.scales[pi], k, v, tt, bt,
                  ws),
                 op_name="paged_prefill_chunk_kv_q")
         else:
-            self.pools[layer] = apply(
+            self.pools[pi] = apply(
                 _make_append_chunk(self.block_size, C),
-                (self.pools[layer], k, v, tt, bt, ws),
+                (self.pools[pi], k, v, tt, bt, ws),
                 op_name="paged_prefill_chunk_kv")
 
     def write_prefill(self, slot: int, row_caches, length: int,
@@ -1969,6 +2203,11 @@ class PagedKVCache:
         passes the number of adopted blocks so the shared prefix pages
         are neither rewritten nor COW-split. ensure(slot, length) must
         have run first."""
+        if self.mp > 1:
+            raise ValueError(
+                "write_prefill consumes dense full-head scratch rows; "
+                "a sharded pool streams prompts through prefill_views"
+                " / chunked_prefill (per-shard head slices)")
         n = self.blocks_needed(length)
         if n > len(self.seq_blocks[slot]):
             raise ValueError("ensure() the slot before write_prefill")

@@ -547,6 +547,22 @@ class RecoverableServer:
         self.journal.append("import_slice", {"slice": slc})
         return self.engine.import_slice(slc)
 
+    def export_slices(self, rids) -> dict:
+        """BATCHED migration export — the router's one-export-per-
+        worker-per-tick call (N finished-prefill slots ride one round
+        trip instead of N). {rid: slice-or-None}, each entry exactly
+        ``export_slice(rid)``; a pure read like its singular twin."""
+        return {int(r): self.engine.export_slice(int(r))
+                for r in rids}
+
+    def import_slices(self, slices) -> int:
+        """BATCHED migration import: every slice journals and lands
+        exactly as one ``import_slice`` — the journal record stream
+        (and therefore crash replay) is IDENTICAL to N singleton
+        imports, so batching changes round trips, never durability
+        semantics. Returns total new blocks written."""
+        return sum(self.import_slice(s) for s in slices)
+
     def set_tenant(self, tenant_id: str, **cfg):
         """Journaled tenant registration/reconfiguration: the record
         replays after a crash, so quotas/weights/floors changed

@@ -179,6 +179,10 @@ class EngineWorker:
       release        {rid}               -> {emitted, outcomes}
       export_slice   {rid}               -> {slice | None}
       import_slice   {slice}             -> {imported}
+      export_slices  {rids}              -> {slices: {rid: slice|None}}
+      import_slices  {slices}            -> {imported}   (sum; each
+                                            slice journals exactly as
+                                            one import_slice)
       scrape         {}                  -> placement inputs (prefix
                                             index, pressure, queue,
                                             health report view)
@@ -269,6 +273,10 @@ class EngineWorker:
             return {"slice": srv.export_slice(int(payload["rid"]))}
         if op == "import_slice":
             return {"imported": srv.import_slice(payload["slice"])}
+        if op == "export_slices":
+            return {"slices": srv.export_slices(payload["rids"])}
+        if op == "import_slices":
+            return {"imported": srv.import_slices(payload["slices"])}
         if op == "scrape":
             return self._scrape()
         if op == "audit":
@@ -527,6 +535,9 @@ class RouterStats(StatsBase):
                          a cooler worker instead
       migrations         streams moved prefill -> decode worker
       migrated_blocks    pages imported by migration targets
+      export_batches     batched export_slices ops issued (one per
+                         donor per tick, N slots per slice — the
+                         round-trip saving is migrations minus this)
       resubmissions      streams re-placed after a worker failure
       oom_resubmissions  FAILED_OOM outcomes retried elsewhere
       worker_deaths      workers detected dead
@@ -538,6 +549,7 @@ class RouterStats(StatsBase):
     __slots__ = FIELDS = (
         "submitted", "delivered", "placed_prefix", "placed_fresh",
         "spillovers", "migrations", "migrated_blocks",
+        "export_batches",
         "resubmissions", "oom_resubmissions", "worker_deaths",
         "worker_timeouts", "stale_released", "unroutable")
     REPR = ("submitted", "delivered", "migrations", "resubmissions",
@@ -1184,58 +1196,96 @@ class Router:
                     f"tick(s) (workers suspended or full)")
 
     def _migrate_pass(self) -> None:
+        """SLICE-BATCHED migration: per donor (prefill worker) per
+        tick, ONE ``export_slices`` op ships every finished-prefill
+        slot's pages (N slots, one round trip — not one export per
+        slot), destinations are chosen per stream exactly as before,
+        the slices bound for each destination land as ONE
+        ``import_slices`` op, and only then do the per-stream
+        resume-submit handoffs run. Failure semantics are unchanged
+        from the per-slot pass: a donor lost at export resubmits its
+        streams cold; a target lost at import leaves ITS streams on
+        the donor (other destinations and the remaining donors still
+        migrate the same tick); a target lost at a
+        handoff leaves the remaining streams on the donor for the
+        next tick."""
         targets = [ws for ws in self._live() if ws.role == "decode"
                    and not self._hot(ws)]
         if not targets:
             return
         for src in [ws for ws in self._live()
                     if ws.role == "prefill"]:
-            for wrid, rid in sorted(src.assigned.items()):
-                req = self._reqs[rid]
-                if req.terminal or not req.generated:
-                    continue          # prefill not proven done yet
+            moved = [(wrid, rid) for wrid, rid
+                     in sorted(src.assigned.items())
+                     if not self._reqs[rid].terminal
+                     and self._reqs[rid].generated]
+            if not moved:
+                continue
+            # one export per donor per tick — the whole batch of
+            # finished prefills rides a single round trip
+            try:
+                slices = self._op(
+                    src, "export_slices",
+                    {"rids": [int(w) for w, _ in moved]},
+                    point="export").get("slices", {})
+            except WorkerDied:
+                self._on_worker_failure(src, died=True)
+                continue
+            except WorkerTimeout:
+                self._on_worker_failure(src, died=False)
+                continue
+            self.stats.export_batches += 1
+            # destination per stream (the same least-loaded choice
+            # the per-slot pass made), then one import per chosen
+            # destination carrying all its slices
+            plan: List[tuple] = []      # (wrid, rid, slice, dst)
+            by_dst: Dict[str, List[dict]] = {}
+            for wrid, rid in moved:
                 live_targets = [ws for ws in targets
                                 if ws.status == "up"]
                 if not live_targets:
                     return
                 dst = sorted(live_targets,
                              key=lambda ws: (ws.load, ws.order))[0]
-                self._migrate(req, src, dst)
+                slc = slices.get(int(wrid))
+                plan.append((wrid, rid, slc, dst))
+                if slc is not None:
+                    by_dst.setdefault(dst.name, []).append(slc)
+            for dname, batch in by_dst.items():
+                dst = self._workers[dname]
+                try:
+                    got = self._op(dst, "import_slices",
+                                   {"slices": batch},
+                                   point="import")
+                    self.stats.migrated_blocks += int(
+                        got.get("imported", 0))
+                except WorkerDied:
+                    # this target's streams stay on the donor (its
+                    # handoffs are skipped below); other destinations
+                    # and the remaining donors still migrate this tick
+                    self._on_worker_failure(dst, died=True)
+                    continue
+                except WorkerTimeout:
+                    self._on_worker_failure(dst, died=False)
+                    continue
+                except WorkerError:
+                    pass              # e.g. geometry drift: go cold
+            for wrid, rid, _slc, dst in plan:
+                req = self._reqs[rid]
+                if req.terminal or dst.status != "up":
+                    continue
+                self._handoff(req, src, dst)
                 if src.status != "up":
-                    break             # src died mid-migration
+                    break             # src died mid-handoff
 
-    def _migrate(self, req: _RouterReq, src: _WorkerState,
+    def _handoff(self, req: _RouterReq, src: _WorkerState,
                  dst: _WorkerState) -> None:
-        """Move one stream prefill->decode: ship the page slice, then
-        hand the stream off with the pending-token resume submit, then
-        release the donor copy. Every leg can lose a worker — the
-        stream survives every case (the donor's death resubmits it
-        cold; the target's death leaves it on the donor)."""
+        """Hand one exported stream off: pending-token resume submit
+        on the target (whose pool already holds the imported pages),
+        then release the donor copy. Every leg can lose a worker —
+        the stream survives every case (the donor's death resubmits
+        it cold; the target's death leaves it on the donor)."""
         old_wrid = req.wrid
-        try:
-            slc = self._op(src, "export_slice",
-                           {"rid": int(old_wrid)},
-                           point="export").get("slice")
-        except WorkerDied:
-            self._on_worker_failure(src, died=True)
-            return
-        except WorkerTimeout:
-            self._on_worker_failure(src, died=False)
-            return
-        if slc is not None:
-            try:
-                got = self._op(dst, "import_slice", {"slice": slc},
-                               point="import")
-                self.stats.migrated_blocks += int(
-                    got.get("imported", 0))
-            except WorkerDied:
-                self._on_worker_failure(dst, died=True)
-                return
-            except WorkerTimeout:
-                self._on_worker_failure(dst, died=False)
-                return
-            except WorkerError:
-                pass                  # e.g. geometry drift: go cold
         resume_payload = {
             "tokens": list(req.tokens) + list(req.generated),
             "kw": self._submit_kw(req, resume=True)}

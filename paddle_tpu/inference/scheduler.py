@@ -1224,7 +1224,15 @@ class PagedServingEngine:
         (step_multi in token-budget mode): x is [max_batch, L, d] and
         each slot contributes L rows at positions lens .. lens+L-1.
         Returns the decode hidden [max_batch, L, d] when ``x`` rode
-        along, else None."""
+        along, else None.
+
+        SHARD-AWARE by construction: a ShardedServingCore model takes
+        the same single packed call and fans each layer out over the
+        ragged views' ``shard(s)`` accessor — one ragged launch per
+        layer PER SHARD on its own pool slice, closed by exactly one
+        all-reduce per layer (the mp=N mixed step stays
+        one-model-call, and its streams stay bit-identical to the
+        single-chip engine's)."""
         plan = self._ragged_plan
         segs = [s for s in plan if s["to"] > s["from"]]
         del plan[:]
@@ -2337,8 +2345,15 @@ class PagedServingEngine:
                   tile_kv=cfg.get("tile_kv"))
         # nb may differ from the cache snapshot's geometry (a resized
         # engine config, or the explicit override): the pool restore
-        # rehomes content-addressed blocks either way
-        eng.cache = PagedKVCache.restore(snap["cache"], num_blocks=nb)
+        # rehomes content-addressed blocks either way. The MESH WIDTH
+        # comes from the CALLER'S MODEL, not the snapshot — the pool
+        # payload is canonical (full-head pages), so a snapshot taken
+        # on an mp=N fleet restores behind a single-chip model and
+        # vice versa (tensor-parallel snapshot portability)
+        eng.cache = PagedKVCache.restore(
+            snap["cache"], num_blocks=nb,
+            mp=getattr(model, "mp", 1),
+            shard_devices=getattr(model, "shard_devices", None))
         if injector is not None:
             eng.cache.allocator.fault_hook = \
                 lambda n: injector.on_alloc("target", n)

@@ -28,8 +28,8 @@ from ..framework.tensor import Tensor
 from .telemetry import StatsBase
 
 __all__ = ["ContinuousBatchingEngine", "PrefillStats",
-           "PrefixCacheStats", "ResilienceStats", "SpecDecodeStats",
-           "TenantStats"]
+           "PrefixCacheStats", "ResilienceStats",
+           "ShardedServingCore", "SpecDecodeStats", "TenantStats"]
 
 # The five stats siblings below share ONE declarative base
 # (telemetry.StatsBase): each lists its counter FIELDS, the DERIVED
@@ -238,6 +238,323 @@ class SpecDecodeStats(StatsBase):
         if self.target_steps == 0:
             return 0.0
         return self.emitted / self.target_steps
+
+
+def _make_pad_heads(shard: int, heads_per_shard: int, num_heads: int):
+    import jax.numpy as jnp
+
+    def mp_pad_heads(a):
+        # a [b, l, H/mp, D] -> [b, l, H, D], zeros outside this
+        # shard's contiguous head slice: the shard's DISJOINT-support
+        # contribution to the layer all-reduce. Summing the mp padded
+        # contributions reconstructs the full-head attention output
+        # BITWISE (x + 0.0 is exact in IEEE for every normal x) — the
+        # property the sharded path's bit-identity proof rests on.
+        out = jnp.zeros(a.shape[:2] + (num_heads,) + a.shape[3:],
+                        a.dtype)
+        lo = shard * heads_per_shard
+        return out.at[:, :, lo:lo + heads_per_shard].set(a)
+    return mp_pad_heads
+
+
+class ShardedServingCore:
+    """Tensor-parallel (head-sharded) serving twin of a
+    FusedMultiTransformer core — the model half of sharded paged
+    serving (the pool half is ``PagedKVCache(mp=N)``,
+    inference/paged_cache.py). Megatron-style partition chosen so the
+    CPU-mesh proof can be BIT-IDENTICAL to the single chip:
+
+      * qkv projection: COLUMN-sharded by head — shard s owns
+        ``[d, 3 * H/mp * hd]`` (its q/k/v column groups, bias sliced
+        alike) — on the KERNEL (TPU) path. On the CPU proof path the
+        column-sliced GEMM is NOT bitwise column-stable at serving
+        widths (measured: XLA CPU matmul columns shift ~1 ulp with
+        the output width at d=256 — the same executable-shape trap
+        class as scheduler.MIN_PREFILL_SUFFIX_ROWS and PR 10's
+        row-count finding), so there the REPLICATED projection runs
+        once per layer — the exact single-chip executable — and each
+        shard slices ITS HEADS out of the result (slicing is exact at
+        every width). ``qkv_shard`` picks: "auto" (default — weights
+        on TPU, activations on CPU), "weights", "activations".
+      * attention: shard s appends to and attends over ITS pool slice
+        only (heads are independent — per-shard outputs are bitwise
+        the head slices of the full launch). One ragged kernel launch
+        per layer per shard on TPU; the jnp fallbacks inherit.
+      * the layer closes with ONE ALL-REDUCE: each shard contributes
+        its attention output zero-padded to full heads (disjoint
+        support), the sum reconstructs the full tensor exactly, and
+        the out projection + FFN + LayerNorms run REPLICATED on it —
+        the same executables, the same bytes, as the single chip.
+
+    That is exactly ``num_layers`` collectives per model call
+    (``allreduce_count`` is the acceptance counter), weights sharded
+    where memory matters (qkv columns; the KV pool is the real win —
+    per-request HBM headroom multiplies by the mesh width) and
+    replicated where exactness matters (out/ffn/ln).
+
+    Placement: ``devices`` (default
+    ``parallel.mesh.serving_shard_devices(mp)``) commits shard s's
+    qkv slices — and, through ``PagedKVCache.for_model``, its pool
+    slice — to device s. On a single-device host the shards are
+    LOGICAL (numerics and collective schedule identical, placement
+    degenerate), which is how tier-1 proves bit-identity in-process;
+    a real mesh (e.g. ``XLA_FLAGS=--xla_force_host_platform_device_
+    count=N``) places them on N distinct devices and the all-reduce
+    performs real cross-device transfers. This host-orchestrated
+    collective is the CPU-mesh PROOF vehicle; the TPU deployment leg
+    lowers the same schedule to jax.lax.psum under shard_map (ROADMAP
+    hardware residual).
+
+    The wrapper speaks the full FusedMultiTransformer serving
+    protocol (``model(x, caches=..., time_step=...)``) for PAGED
+    caches — decode, multi-token verify, chunked prefill and the
+    packed ragged mixed step all ride the per-shard views'
+    ``shard(s)`` accessor — so PagedServingEngine, SpeculativeEngine,
+    RecoverableServer and the router compose unchanged. Dense
+    (non-paged) caches are not served: sharding exists for the paged
+    pool. Weights are SNAPSHOTTED at wrap time (like
+    ``quantize_weights``): shard after the weights are final."""
+
+    def __init__(self, base, mp: int, devices=None,
+                 qkv_shard: str = "auto"):
+        import jax
+        import jax.numpy as jnp
+        if getattr(base, "_quantized", False):
+            raise ValueError(
+                "int8 cores drop their float weights at quantize "
+                "time — shard the float core first (int8 core "
+                "projections are a ROADMAP follow-up)")
+        self.base = base
+        self.mp = int(mp)
+        if self.mp < 1:
+            raise ValueError(f"mp must be >= 1, got {mp}")
+        if base.num_heads % self.mp:
+            raise ValueError(
+                f"num_heads {base.num_heads} must divide evenly over "
+                f"mp={self.mp} shards")
+        if devices is None:
+            from ..parallel.mesh import serving_shard_devices
+            devices = serving_shard_devices(self.mp)
+        if len(devices) < self.mp:
+            raise ValueError(f"need {self.mp} shard devices, got "
+                             f"{len(devices)}")
+        self.shard_devices = list(devices[:self.mp])
+        self._distinct = len(set(self.shard_devices)) > 1
+        if qkv_shard == "auto":
+            # the house rule (PR 10's ragged_step precedent): the
+            # memory-sharded executable engages where it wins (TPU);
+            # the CPU proof path keeps the decomposition that is
+            # bitwise exact at every width (see class docstring)
+            try:
+                on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+            except Exception:  # pragma: no cover
+                on_tpu = False
+            qkv_shard = "weights" if on_tpu else "activations"
+        if qkv_shard not in ("weights", "activations"):
+            raise ValueError(f"qkv_shard must be 'auto' | 'weights' |"
+                             f" 'activations', got {qkv_shard!r}")
+        self.qkv_shard = qkv_shard
+        E = base.embed_dim
+        Hs = self.heads_per_shard
+        hd = base.head_dim
+        # per-(layer, shard) qkv column slices, committed to the
+        # shard's device on a real mesh. Column index set of shard s:
+        # the q, k and v blocks' head-group columns — matches the
+        # base's split(qkv, 3)-then-reshape head slicing exactly.
+        # Built only on the weight-sharded path; the activation path
+        # runs the base module's replicated projection.
+        self._qkv_w: List[List[Tensor]] = []
+        self._qkv_b: List[List[Optional[Tensor]]] = []
+        if qkv_shard == "weights":
+            cols = {}
+            for s in range(self.mp):
+                c = np.concatenate(
+                    [np.arange(s * Hs * hd, (s + 1) * Hs * hd)
+                     + j * E for j in range(3)])
+                cols[s] = np.asarray(c, np.int32)
+            for blk in base.layers:
+                w = blk.qkv.weight.data
+                bia = None if blk.qkv.bias is None \
+                    else blk.qkv.bias.data
+                ws, bs = [], []
+                for s in range(self.mp):
+                    wsl = jnp.take(w, jnp.asarray(cols[s]), axis=1)
+                    bsl = None if bia is None else jnp.take(
+                        bia, jnp.asarray(cols[s]), axis=0)
+                    if self._distinct:
+                        dev = self.shard_devices[s]
+                        wsl = jax.device_put(wsl, dev)
+                        if bsl is not None:
+                            bsl = jax.device_put(bsl, dev)
+                    ws.append(Tensor(wsl))
+                    bs.append(None if bsl is None else Tensor(bsl))
+                self._qkv_w.append(ws)
+                self._qkv_b.append(bs)
+        # acceptance counter: ONE all-reduce per layer per model call
+        # on the sharded path (mp > 1); reset freely from tests
+        self.allreduce_count = 0
+
+    # -- geometry delegation (the protocol surface engines read) ------
+    @property
+    def num_layers(self):
+        return self.base.num_layers
+
+    @property
+    def num_heads(self):
+        return self.base.num_heads
+
+    @property
+    def head_dim(self):
+        return self.base.head_dim
+
+    @property
+    def embed_dim(self):
+        return self.base.embed_dim
+
+    @property
+    def heads_per_shard(self) -> int:
+        return self.base.num_heads // self.mp
+
+    @property
+    def layers(self):
+        return self.base.layers
+
+    @property
+    def normalize_before(self):
+        return self.base.normalize_before
+
+    @property
+    def _act_name(self):
+        return self.base._act_name
+
+    @property
+    def activation(self):
+        return self.base.activation
+
+    def gen_paged_cache(self, block_size, num_blocks, max_seqs,
+                        max_blocks_per_seq=None, dtype="float32",
+                        prefix_cache=False):
+        """Sharded pool matching this core's mesh layout (the engines
+        call PagedKVCache.for_model, which reads the same fields)."""
+        from .paged_cache import PagedKVCache
+        return PagedKVCache.for_model(
+            self, block_size, num_blocks, max_seqs,
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
+            prefix_cache=prefix_cache)
+
+    def reset_allreduce_count(self) -> None:
+        self.allreduce_count = 0
+
+    def _allreduce(self, parts: List[Tensor]) -> Tensor:
+        """THE one collective per layer: sum the shards' zero-padded
+        head contributions (disjoint support -> exact reconstruction,
+        see _make_pad_heads) in shard order. On a multi-device mesh
+        every contribution transfers to shard 0's device and the
+        reduced tensor is handed back replicated (host-staged here —
+        the CPU-proof emulation of reduce+broadcast; the TPU leg is
+        jax.lax.psum). Counted only when something actually crosses
+        shards (mp > 1)."""
+        if len(parts) == 1:
+            return parts[0]
+        self.allreduce_count += 1
+        total = parts[0]
+        if self._distinct:
+            import jax
+            import jax.numpy as jnp
+            d0 = self.shard_devices[0]
+            for p in parts[1:]:
+                total = total + Tensor(jax.device_put(p.data, d0))
+            # uncommitted replicated result: the out/ffn/ln ops that
+            # consume it stay free to colocate with the NEXT
+            # committed operand they meet (each shard's qkv slice)
+            return Tensor(jnp.asarray(np.asarray(total.data)))
+        for p in parts[1:]:
+            total = total + p
+        return total
+
+    def __call__(self, src, attn_mask=None, caches=None,
+                 time_step=None, **kwargs):
+        return self.forward(src, attn_mask=attn_mask, caches=caches,
+                            time_step=time_step, **kwargs)
+
+    def forward(self, src, attn_mask=None, caches=None,
+                time_step=None, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        from ..framework.op import apply
+        from ..incubate.nn.fused_transformer import _use_decode_kernel
+        from ..nn import functional as F
+        from ..ops.manipulation import reshape, split
+        from ..ops.pallas.paged_attention import head_slice
+        if caches is None or time_step is None or \
+                not getattr(caches[0], "is_paged", False):
+            raise NotImplementedError(
+                "ShardedServingCore serves the PAGED cache protocol "
+                "only (caches=PagedKVCache views + time_step) — "
+                "dense caches have no sharded pool to win")
+        cache_mp = getattr(caches[0], "_cache", None)
+        if cache_mp is None or cache_mp.mp != self.mp:
+            raise ValueError(
+                f"cache mesh width "
+                f"{getattr(cache_mp, 'mp', '?')} != model mp "
+                f"{self.mp} — build the pool via "
+                f"PagedKVCache.for_model(sharded_core, ...)")
+        x = src
+        b, l = x.shape[0], x.shape[1]
+        E, Hs, hd = self.embed_dim, self.heads_per_shard, self.head_dim
+        t = time_step.data if isinstance(time_step, Tensor) \
+            else jnp.asarray(time_step, jnp.int32)
+        t = jnp.broadcast_to(t.reshape(-1).astype(jnp.int32), (b,))
+        use_k = _use_decode_kernel()
+        new_caches = []
+        for i, blk in enumerate(self.base.layers):
+            residual = x
+            h = blk.ln(x) if self.normalize_before else x
+            qf = kf = vf = None
+            if self.qkv_shard == "activations":
+                # the replicated projection — the EXACT single-chip
+                # executable, run once per layer; shards slice their
+                # heads out of the result (exact at every width)
+                y = blk.qkv(h)
+                qf, kf, vf = split(y, 3, axis=-1)
+                qf = reshape(qf, [b, l, self.num_heads, hd])
+                kf = reshape(kf, [b, l, self.num_heads, hd])
+                vf = reshape(vf, [b, l, self.num_heads, hd])
+            parts = []
+            for s in range(self.mp):
+                if self.qkv_shard == "weights":
+                    y = F.linear(h, self._qkv_w[i][s],
+                                 self._qkv_b[i][s])
+                    q, k, v = split(y, 3, axis=-1)
+                    q = reshape(q, [b, l, Hs, hd])
+                    k = reshape(k, [b, l, Hs, hd])
+                    v = reshape(v, [b, l, Hs, hd])
+                else:
+                    q = Tensor(head_slice(qf.data, s, self.mp))
+                    k = Tensor(head_slice(kf.data, s, self.mp))
+                    v = Tensor(head_slice(vf.data, s, self.mp))
+                view = caches[i] if self.mp == 1 \
+                    else caches[i].shard(s)
+                attn_s = view.decode(q, k, v, t, use_kernel=use_k)
+                if self.mp == 1:
+                    parts.append(attn_s)
+                else:
+                    parts.append(apply(
+                        _make_pad_heads(s, Hs, self.num_heads),
+                        (attn_s,), op_name="mp_pad_heads"))
+            attn = self._allreduce(parts)
+            attn = blk.out_proj(reshape(attn, [b, l, E]))
+            x = residual + attn
+            if not self.normalize_before:
+                x = blk.ln(x)
+            residual = x
+            hh = blk.ffn_ln(x) if self.normalize_before else x
+            hh = blk.ffn2(self.activation(blk.ffn1(hh)))
+            x = residual + hh
+            if not self.normalize_before:
+                x = blk.ffn_ln(x)
+            new_caches.append(caches[i])
+        return x, new_caches
 
 
 class ContinuousBatchingEngine:

@@ -215,6 +215,33 @@ class TokenServingModel:
         toks = np.minimum(toks, p.shape[-1] - 1)
         return toks.reshape(p.shape[:-1]), p
 
+    # -- tensor-parallel construction ---------------------------------
+    def shard(self, mp: int, devices=None,
+              qkv_shard: str = "auto") -> "TokenServingModel":
+        """Head-sharded tensor-parallel twin of this serving surface
+        (inference/serving.py ShardedServingCore): the CORE's qkv
+        projections split by head over ``mp`` mesh shards and each
+        layer closes with one all-reduce, while the embedding table
+        and readout head stay REPLICATED (shared by reference — they
+        are row-independent and the engines sample from one replica).
+        Every engine built on the sharded twin gets a matching
+        sharded ``PagedKVCache`` automatically (``for_model`` reads
+        ``mp``/``shard_devices`` off the core) — pool HBM per device
+        drops by mp x, streams stay bit-identical to the single-chip
+        engine. A ``truncated_draft`` of the sharded twin is built
+        from the base float layers and stays UNSHARDED (the draft is
+        small by construction; sharding it would spend collectives
+        on proposals the target re-verifies anyway)."""
+        from .serving import ShardedServingCore
+        core = self.core.base if isinstance(self.core,
+                                            ShardedServingCore) \
+            else self.core
+        return TokenServingModel(
+            ShardedServingCore(core, mp, devices=devices,
+                               qkv_shard=qkv_shard),
+            self._embed_np, self.lm_head,
+            weight_dtype=self.weight_dtype)
+
     # -- draft construction -------------------------------------------
     def truncated_draft(self, num_layers: int) -> "TokenServingModel":
         """A draft that runs only the first ``num_layers`` of the core

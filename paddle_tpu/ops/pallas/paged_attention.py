@@ -54,6 +54,22 @@ per page is the indirection's price; tile over q to amortize it).
 ``tools/tile_report.py`` sizes both from recorded ``span.model``
 step-phase timings (PR 8/9) so real-TPU tuning is data-driven.
 
+TENSOR-PARALLEL DISPATCH (sharded pools, inference/paged_cache.py
+``mp`` > 1): the kernel itself is shard-oblivious — attention is
+head-independent, so a mesh shard simply launches it against ITS pool
+slice ``[num_blocks, 2, H/mp, bs, hd]`` with its own head slice of the
+packed queries (``head_slice``), under the SAME replicated block
+table / q_lens / kv_lens descriptors. One launch per layer PER SHARD,
+each on its own device; the per-shard outputs are disjoint head
+slices that the serving model's single per-layer all-reduce
+recombines (inference/serving.py ShardedServingCore). Every fallback
+(interpret, jnp reference, the model-level gather) inherits the same
+property for free — nothing in this file ever needs to know the mesh
+width. The one hazard is a FULL-head q against a sharded pool: nh/nkv
+would alias the GQA group ratio and silently misread — the paged
+views guard this (they know the mesh width; the kernel only rejects
+ratios that are not whole groups).
+
 QUANTIZED PAGES (``kv_scales``): an int8 KV pool rides the SAME block
 table with a per-page scale array [num_blocks, 2, nkv, block_size]
 (symmetric per-position-per-head scales — see
@@ -110,6 +126,23 @@ def reset_dispatch_count() -> None:
 def _interpret():
     # 'axon' is the tunneled TPU backend — same Mosaic compile path
     return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def head_slice(x, shard: int, mp: int, axis: int = -2):
+    """Shard ``shard``'s contiguous head slice of ``x`` along
+    ``axis`` (default: the nh axis of the kernel's [R, nh, hd]
+    packed-query layout). The tensor-parallel dispatch helper: a mesh
+    shard feeds the ragged kernel q = head_slice(q_full, s, mp)
+    against its pool slice — slicing is exact (each head's attention
+    is independent), so per-shard outputs are bitwise the head slices
+    of the single-chip launch."""
+    H = x.shape[axis]
+    if H % mp:
+        raise ValueError(f"{H} heads do not divide over mp={mp}")
+    hs = H // mp
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(shard * hs, (shard + 1) * hs)
+    return x[tuple(idx)]
 
 
 def _require_pltpu():
@@ -284,6 +317,12 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
     _DISPATCH["count"] += 1
     nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
     MB = block_tables.shape[1]
+    if nh % nkv:
+        raise ValueError(
+            f"query heads {nh} are not a multiple of the pool's kv "
+            f"heads {nkv} — neither a GQA group nor a matching "
+            f"tensor-parallel head slice (sharded pools take "
+            f"head_slice(q, shard, mp), one launch per shard)")
     g = nh // nkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
     if tile_q is None:

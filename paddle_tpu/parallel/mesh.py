@@ -62,6 +62,38 @@ def mesh_axis_size(axis: str) -> int:
     return m.shape[axis] if axis in m.shape else 1
 
 
+def serving_shard_devices(mp: int):
+    """Device list for ``mp`` tensor-parallel SERVING shards — the
+    reuse point between the training mesh and the sharded paged
+    serving stack (inference/serving.py ShardedServingCore +
+    inference/paged_cache.py sharded pools). Resolution order:
+
+      1. the installed global mesh's 'mp' axis when it is at least
+         ``mp`` wide (the dp=0/pp=0/... row — innermost axis, fastest
+         ICI links, exactly the communicator the training side uses);
+      2. ``jax.devices()`` when there are at least ``mp`` of them
+         (e.g. ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+         CPU meshes with no mesh installed yet);
+      3. otherwise the available devices CYCLED — LOGICAL shards:
+         several shards share one physical device. Numerics and the
+         collective schedule are identical to a real mesh (the
+         per-shard executables don't know their neighbors), only the
+         placement is degenerate — this is how the tier-1 in-process
+         bit-identity tests run mp=2 on a single-device CI host.
+    """
+    mp = int(mp)
+    if mp < 1:
+        raise ValueError(f"mp must be >= 1, got {mp}")
+    devs = list(jax.devices())
+    m = _global_mesh
+    if m is not None and m.shape.get("mp", 1) >= mp:
+        # the mp axis is last in AXIS_ORDER: reshape to [-1, mp_size]
+        # and take the first row's leading mp devices
+        arr = np.asarray(m.devices).reshape(-1, m.shape["mp"])
+        return [arr[0, i] for i in range(mp)]
+    return [devs[i % len(devs)] for i in range(mp)]
+
+
 def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(get_mesh(), PartitionSpec(*spec))
 

@@ -308,3 +308,25 @@ def test_serving_monitor_smoke_leg():
     # jit/jitter-dominated, so no timing assert rides tier-1)
     assert res["baseline"]["tokens_per_sec"] > 0
     assert res["monitored"]["tokens_per_sec"] > 0
+
+
+def test_serving_sharded_smoke_leg():
+    res = bench_extra.bench_serving_sharded(smoke=True)
+    assert res["metric"] == "serving_tensor_parallel_sharded_mesh"
+    # the tentpole guarantees rode the bench, on a REAL dp=1/mp=2 CPU
+    # mesh (a subprocess under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=2): greedy
+    # streams BIT-IDENTICAL to the single-chip engine, the pool
+    # payload split over two DISTINCT jax devices
+    assert res["streams_bit_identical"] is True
+    assert res["mp2"]["jax_devices"] >= 2
+    assert res["mp2"]["distinct_shard_devices"] == 2
+    # per-shard HBM exactly halved (replicated metadata excluded from
+    # the payload byte model by construction)
+    assert res["pool_bytes_per_shard_ratio"] == 0.5
+    # exactly num_layers all-reduces per mixed step on the sharded
+    # path — the one-collective-per-layer contract
+    assert res["allreduces_per_mixed_step"] == res["num_layers"]
+    # both legs actually served every requested token
+    assert res["mp1"]["tokens_per_sec"] > 0
+    assert res["mp2"]["tokens_per_sec"] > 0

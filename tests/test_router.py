@@ -336,6 +336,71 @@ class TestMigration:
         assert r.check_invariants()
         r.close()
 
+    def test_batched_slices_one_export_per_donor_per_tick(
+            self, tmp_path):
+        """Slice batching: N finished prefills on one donor ride ONE
+        ``export_slices`` op per tick (and their slices one
+        ``import_slices`` per destination) instead of N round trips —
+        with the streams still bit-identical to the single engine."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        calls = []
+        orig = w1.request
+
+        def spy(op, payload=None, timeout=None):
+            calls.append((op, payload))
+            return orig(op, payload, timeout)
+        w1.request = spy
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model))
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        # every migration ran, but the donor saw NO per-slot export
+        # ops — only batched ones, and the first batch carried every
+        # concurrently-finished slot in one payload
+        assert r.stats.migrations == len(rids)
+        exports = [(op, p) for op, p in calls
+                   if op in ("export_slice", "export_slices")]
+        assert all(op == "export_slices" for op, _ in exports)
+        assert max(len(p["rids"]) for _, p in exports) > 1
+        assert len(exports) == r.stats.export_batches
+        assert len(exports) < r.stats.migrations
+        assert r.check_invariants()
+        r.close()
+
+    def test_batched_export_killed_donor_streams_survive(
+            self, tmp_path):
+        """Bit-identity storm over the BATCHED path: the donor dies
+        inside the one export_slices op carrying every finished
+        prefill — all of its streams resubmit cold and the bytes
+        still match the uninterrupted run."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        inj = RouterFaultInjector(kill_at={1: {"w1": "export"}})
+        w1 = _worker(tmp_path, "w1", role="prefill")
+        w2 = _worker(tmp_path, "w2", role="decode")
+        w3 = _worker(tmp_path, "w3", role="decode")
+        model = _model_of(w1)
+        r = Router([w1, w2, w3], hash_fn=_hash_fn(model),
+                   injector=inj)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert inj.killed == 1
+        # the ONE batched export op took the donor down with every
+        # eligible slot aboard — all streams moved through the
+        # failure handler at once, none was lost
+        assert r.stats.resubmissions >= len(rids)
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.check_invariants()
+        r.close()
+
 
 class TestMigrationEdgeCases:
     def test_import_with_colliding_live_prefix(self, tmp_path):

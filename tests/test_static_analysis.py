@@ -207,10 +207,23 @@ class TestRealTree:
         assert {"FINISHED", "FAILED_OOM", "FAILED_NUMERIC",
                 "FAILED_DEADLINE", "REJECTED_ADMISSION",
                 "FAILED_UNROUTABLE"} <= set(members)
-        # hot classes resolve in the real tree
+        # hot classes resolve in the real tree (the sharded serving
+        # core included — mesh-era code inherits the purity contract)
         hot = {c.name for sf in files for c in sf.classes()}
         assert {"PagedServingEngine", "SpeculativeEngine",
-                "PagedKVCache"} <= hot
+                "PagedKVCache", "ShardedServingCore"} <= hot
+        assert "ShardedServingCore" in cs.HOT_CLASSES
+        # the sharded state holder's geometry really rides snapshots:
+        # the harvester sees the ``mp`` key on the REAL PagedKVCache
+        # (the mutation spot-check below then proves deleting its
+        # restore consumption turns the tree red)
+        scp = cs.SnapshotCompleteness()
+        for sf in files:
+            for c in sf.classes():
+                if c.name == "PagedKVCache":
+                    keys = scp._snapshot_keys(
+                        cs.methods_of(c)["snapshot"])
+                    assert "mp" in keys
         # the key-consumed-by-restore leg is NOT vacuous: each real
         # snapshot() yields a non-trivial harvested key set (a
         # refactor that hides the return dict from the harvester
@@ -269,6 +282,22 @@ class TestMutations:
         assert [(f.path, f.line) for f in kept] == \
             [(path, lineno(path, "self._vclock ="))]
         assert "_vclock" in kept[0].msg
+
+    def test_deleted_shard_geometry_field(self, tmp_path):
+        """The sharded-pool acceptance: the STRUCTURAL snapshot pass
+        engaged PagedKVCache's tensor-parallel state the day it
+        landed — a restore() that silently drops the recorded mesh
+        width (the ``mp`` geometry key) flips exit 0 -> 1 with the
+        finding anchored at the serialized key."""
+        root, path = _mutate(
+            tmp_path, "paged_cache.py",
+            'mp_t = int(g.get("mp", 1)) if mp is None else int(mp)',
+            "mp_t = 1 if mp is None else int(mp)")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, '"mp": self.mp'))]
+        assert "'mp'" in kept[0].msg
+        assert "never consumed" in kept[0].msg
 
     def test_deleted_journal_handler(self, tmp_path):
         root, path = _mutate(

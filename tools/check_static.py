@@ -229,6 +229,11 @@ class Finding:
 # only with a reason.
 SNAPSHOT_ATTR_ALLOW: Dict[str, Dict[str, str]] = {
     "PagedKVCache": {
+        "shard_devices": "runtime placement, not state — device "
+                         "handles are process-local and the restore "
+                         "target's mesh supplies its own "
+                         "(restore(shard_devices=...); the payload "
+                         "is canonical full-head pages either way)",
         "_block_hash": "inverse of hash_index — rebuilt by restore()",
         "_audit_fp": "content-audit memo — re-fingerprinted on demand",
         "views": "derived per-layer views over the live pool",
@@ -520,6 +525,10 @@ HOT_CLASSES: Dict[str, Set[str]] = {
     "PagedRaggedView": set(),
     "_RaggedLayout": set(),
     "BlockAllocator": set(),
+    # the tensor-parallel serving core sits inside every sharded model
+    # call (one visit per layer per shard): hot throughout — only
+    # construction (weight slicing/placement) is cold
+    "ShardedServingCore": {"__init__"},
 }
 
 # Files whose MODULE-LEVEL functions are hot (kernel launch paths).

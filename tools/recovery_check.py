@@ -158,8 +158,11 @@ def main(argv=None) -> int:
           f"{g['num_blocks']} x {g['block_size']}-token blocks, "
           f"{g['num_layers']} layers, prefix_cache={g['prefix_cache']}")
     try:
+        # the audit pool rebuilds at mp=1 (logical shards would only
+        # slow the doctor; the payload is canonical either way) — the
+        # source's mesh width is reported from the geometry below
         cache = PagedKVCache.restore(cache_snap,
-                                     num_blocks=args.num_blocks)
+                                     num_blocks=args.num_blocks, mp=1)
         print("deep audit: OK (check_invariants(deep=True) passed on "
               "restore)")
     except BlockOOM as e:
@@ -168,6 +171,16 @@ def main(argv=None) -> int:
     except AssertionError as e:
         print(f"AUDIT FAILED: {e}")
         return 1
+    src_mp = int(g.get("mp", 1))
+    if src_mp > 1:
+        # HONEST per-shard bytes: the payload divides over the mesh,
+        # the metadata replicates — a reader must not multiply one
+        # worker's report by the fleet and call it HBM
+        total = cache.pool_bytes_total()
+        print(f"  tensor-parallel source: mp={src_mp} shards, "
+              f"{total // src_mp} pool bytes per shard "
+              f"({total} across the mesh; allocator/table metadata "
+              f"replicated on every shard)")
     print(f"pool occupancy{cache._pool_context()}")
     print(f"  hash index: {len(cache._hash_to_block)} chained block "
           f"hash(es)")
