@@ -61,7 +61,10 @@ from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
 from .router import (EngineWorker, InProcWorker,  # noqa: F401
                      PipeWorker, Router, RouterStats, WorkerDied,
                      WorkerError, WorkerTimeout,
-                     build_server_from_spec, token_chain_hashes)
+                     build_model_from_spec, build_server_from_spec,
+                     token_chain_hashes)
+from .fleet import (FleetSupervisor, MigrationPolicy,  # noqa: F401
+                    SocketWorker)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "Alert", "ContinuousBatchingEngine",
@@ -84,8 +87,9 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "read_journal", "save_snapshot",
            "EngineWorker", "InProcWorker", "PipeWorker", "Router",
            "RouterFaultInjector", "RouterStats", "WorkerDied",
-           "WorkerError", "WorkerTimeout", "build_server_from_spec",
-           "token_chain_hashes"]
+           "WorkerError", "WorkerTimeout", "build_model_from_spec",
+           "build_server_from_spec", "token_chain_hashes",
+           "FleetSupervisor", "MigrationPolicy", "SocketWorker"]
 
 
 class PrecisionType:

@@ -199,6 +199,14 @@ class WorkModel:
         keys = (b * (b + 1) - a * (a + 1)) // 2
         return self.kv_token_bytes * (keys + (b - a))
 
+    def resident_kv_bytes(self, tokens: int) -> int:
+        """KV footprint of ``tokens`` STORED rows — pages at rest, not
+        traffic. This is the slice-transfer payload a fleet migration
+        ships (export_slices -> import_slices), i.e. the cost side of
+        ``MigrationPolicy``'s move/stay inequality
+        (inference/fleet.py)."""
+        return self.kv_token_bytes * max(0, int(tokens))
+
     def as_dict(self) -> dict:
         return {"num_layers": self.num_layers, "d_model": self.d_model,
                 "ffn_dim": self.ffn_dim,

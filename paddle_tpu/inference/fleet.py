@@ -1,0 +1,610 @@
+"""Self-healing serving fleet: supervisor respawn, socket transport,
+and cost-aware migration.
+
+The router (inference/router.py) is HALF a fleet: it detects worker
+death, opens circuit breakers, and resubmits in-flight streams — but
+capacity only ever shrinks (a dead worker stays dead), the transports
+end at one machine's pipes, and every finished prefill migrates
+unconditionally whether or not the move is worth its bytes. This
+module closes those three loops without changing the router's fault
+taxonomy:
+
+* ``FleetSupervisor`` — owns each worker's data-only spec plus its
+  journal/snapshot paths. On death detection it rebuilds the worker
+  via ``RecoverableServer.recover`` (``build_server_from_spec`` with
+  ``recover=True``: same seeds => bit-identical weights, snapshot +
+  journal replay => bit-identical serving state at the last journaled
+  round) and re-registers it through the router's circuit-breaker
+  rejoin path (``Router.register_respawn``: suspect first, ping
+  proves liveness, stale journal-replayed copies released at rejoin).
+  The router's journal-backed resubmission then drains load back — a
+  kill storm recovers toward 100% capacity instead of degrading
+  monotonically.
+
+      worker dies          supervisor.tick()        rejoin ping
+    up ───► dead ──────────► suspect(respawned) ──────► up
+         (streams            WAL: "respawn"/spawn     WAL: "respawn"/
+          resubmitted,        handle rebuilt via       rejoin; stale
+          copies stale-       RecoverableServer        copies released
+          marked)             .recover
+
+* ``SocketWorker`` — the ``EngineWorker`` op protocol over TCP with
+  the journal's length+CRC framing (``recovery.frame_message``). The
+  op dispatcher and fault domain were already transport-neutral; this
+  is the one-machine wall falling. A dead socket, a torn frame, or a
+  CRC mismatch all mean exactly what a dead pipe means: WorkerDied,
+  abandonment, resubmission. SIGKILL on the child is a REAL process
+  death.
+
+* ``MigrationPolicy`` — prices each candidate prefill→decode move
+  instead of taking it unconditionally. Move only when
+
+      span_flops(pos, pos + remaining) x (p_src - p_dst)
+          >  resident_kv_bytes(pos) x flops_per_byte
+
+  i.e. the stream's remaining decode work (``WorkModel``), weighted
+  by the scraped pressure delta between donor and the coolest live
+  target, must beat the slice-transfer payload expressed in
+  FLOP-equivalents. A declined move is decided BEFORE the export op
+  — zero slice bytes ship. Approved moves are journaled by the
+  router as "rebalance" records and replay deterministically through
+  ``Router.recover``.
+
+Observability rides the always-on registry: ``fleet.workers_live``,
+``fleet.respawns``, ``fleet.migrations.{forced,policy,skipped}`` — and
+a ``HealthMonitor`` bound to the supervisor's registry raises the
+edge-triggered ``capacity-degraded`` alert when the live fraction
+falls under its floor (dark when no supervisor exists: the fleet
+series simply never appears).
+"""
+from __future__ import annotations
+
+import socket as _socketlib
+import time as _time
+from typing import Dict, Optional
+
+from .accounting import WorkModel
+from .recovery import (FRAME_HEADER_SIZE, frame_body_size,
+                       frame_message, unframe_message)
+from .resilience import EngineCrash
+from .router import (EngineWorker, InProcWorker, WorkerDied,
+                     WorkerError, WorkerTimeout, WorkerHandle,
+                     build_server_from_spec)
+from .telemetry import MetricsRegistry
+
+__all__ = ["FleetSupervisor", "MigrationPolicy", "SocketWorker"]
+
+
+# ---------------------------------------------------------------------
+# cost-aware migration
+# ---------------------------------------------------------------------
+
+class MigrationPolicy:
+    """Move/stay pricing for the router's migration pass (wired as
+    ``Router(policy=...)``). The benefit of moving a stream is the
+    work it has LEFT, done on a cooler pool; the cost is the pages it
+    would ship. Both sides are priced by the same ``WorkModel`` the
+    goodput ledger uses, so the decision and the ledger agree on what
+    a FLOP is.
+
+      work            WorkModel of the served core
+      flops_per_byte  exchange rate between slice-transfer bytes and
+                      compute: how many FLOPs of remaining work one
+                      shipped byte must buy. Higher = stickier
+                      streams (transfers are expensive); 0 = every
+                      finished prefill moves (the pre-policy router,
+                      minus the pressure-delta gate)
+      horizon         assumed remaining tokens for streams with no
+                      max_new_tokens budget
+      min_delta       pressure delta at or below which a move is
+                      never worth it (a balanced fleet stays put)
+    """
+
+    def __init__(self, work: WorkModel, *, flops_per_byte: float = 32.0,
+                 horizon: int = 32, min_delta: float = 0.0):
+        self.work = work
+        self.flops_per_byte = float(flops_per_byte)
+        self.horizon = int(horizon)
+        self.min_delta = float(min_delta)
+        self.approved = 0
+        self.declined = 0
+
+    @classmethod
+    def for_model(cls, model, **kw) -> "MigrationPolicy":
+        """Price against a live model (or TokenServingModel)."""
+        return cls(WorkModel.for_model(model), **kw)
+
+    def price(self, *, position: int, remaining: Optional[int],
+              src_pressure: float, dst_pressure: float):
+        """(benefit_flops, cost_flops) of one candidate move."""
+        rem = self.horizon if remaining is None else max(0,
+                                                         int(remaining))
+        pos = int(position)
+        delta = float(src_pressure) - float(dst_pressure)
+        benefit = (self.work.span_flops(pos, pos + rem)
+                   * max(0.0, delta))
+        cost = (self.work.resident_kv_bytes(pos)
+                * self.flops_per_byte)
+        return benefit, cost
+
+    def should_move(self, *, position: int, remaining: Optional[int],
+                    src_pressure: float, dst_pressure: float) -> bool:
+        delta = float(src_pressure) - float(dst_pressure)
+        if delta <= self.min_delta:
+            self.declined += 1
+            return False
+        benefit, cost = self.price(
+            position=position, remaining=remaining,
+            src_pressure=src_pressure, dst_pressure=dst_pressure)
+        ok = benefit > cost
+        if ok:
+            self.approved += 1
+        else:
+            self.declined += 1
+        return ok
+
+
+# ---------------------------------------------------------------------
+# socket transport
+# ---------------------------------------------------------------------
+
+def _read_exact(sock, n: int) -> bytes:
+    """Exactly ``n`` bytes off a blocking socket; EOF mid-read raises
+    ``ConnectionError`` — a torn frame is a dead peer, never data."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 16, n - got))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _socket_worker_main(host: str, port: int, spec: dict) -> None:
+    """Child-process entry (multiprocessing spawn target): connect
+    back to the parent FIRST (cheap, so the parent's accept returns
+    before the model build), then build the server from the data-only
+    spec and answer framed ops until EOF / close / EngineCrash. Same
+    error surface as the pipe child: application errors return as
+    ``{"_err": ...}``, ``EngineCrash`` reports ``{"_died": True}``
+    and exits — the engine must be abandoned, and over a socket an
+    exit IS the abandonment (the parent reads EOF)."""
+    sock = _socketlib.create_connection((host, int(port)))
+    try:
+        try:
+            worker = EngineWorker(build_server_from_spec(spec),
+                                  name=spec.get("name", "worker"),
+                                  role=spec.get("role", "mixed"))
+            sock.sendall(frame_message({"ready": True}))
+        except Exception as e:     # surface build failures loudly
+            try:
+                sock.sendall(frame_message(
+                    {"_err": f"{type(e).__name__}: {e}",
+                     "_died": True}))
+            except OSError:
+                pass
+            return
+        while True:
+            try:
+                head = _read_exact(sock, FRAME_HEADER_SIZE)
+                body = _read_exact(sock, frame_body_size(head))
+                msg = unframe_message(head, body)
+            except Exception:      # EOF / torn frame / bad CRC:
+                break              # the parent is gone or lying
+            if msg is None:
+                break
+            seq, op, payload = msg
+            try:
+                out = worker.handle(op, payload or {})
+            except EngineCrash as e:
+                try:
+                    sock.sendall(frame_message(
+                        {"_err": f"EngineCrash: {e}", "_died": True,
+                         "_seq": seq}))
+                except OSError:
+                    pass
+                break
+            except Exception as e:
+                out = {"_err": f"{type(e).__name__}: {e}"}
+            try:
+                sock.sendall(frame_message(dict(out, _seq=seq)))
+            except OSError:
+                break
+            if op == "close":
+                break
+    finally:
+        sock.close()
+
+
+class SocketWorker(WorkerHandle):
+    """A REAL worker process speaking the ``EngineWorker`` op protocol
+    over TCP (127.0.0.1 by default — the same class serves a remote
+    bind address) with the journal's length+CRC framing. Fault
+    mapping is the whole point: a closed socket, EOF mid-frame, or a
+    CRC mismatch is ``WorkerDied`` (dead socket == dead pipe == same
+    abandonment semantics); only a silent peer inside its deadline is
+    ``WorkerTimeout``. ``kill()`` is a genuine SIGKILL."""
+
+    def __init__(self, spec: dict, *, name: str, role: str = "mixed",
+                 timeout: float = 120.0, start_method: str = "spawn",
+                 wait_ready: bool = True, host: str = "127.0.0.1"):
+        import multiprocessing as mp
+        ctx = mp.get_context(start_method)
+        self.name = str(name)
+        self.role = role
+        self.timeout = float(timeout)
+        lsock = _socketlib.socket(_socketlib.AF_INET,
+                                  _socketlib.SOCK_STREAM)
+        try:
+            lsock.bind((host, 0))
+            lsock.listen(1)
+            bound_host, port = lsock.getsockname()[:2]
+            self.proc = ctx.Process(
+                target=_socket_worker_main,
+                args=(bound_host, port,
+                      dict(spec, name=name, role=role)),
+                daemon=True)
+            self.proc.start()
+            # the child connects before building its model, so this
+            # accept only waits out the interpreter spawn + import
+            lsock.settimeout(self.timeout)
+            try:
+                self._sock, _ = lsock.accept()
+            except _socketlib.timeout:
+                self.proc.kill()
+                raise WorkerDied(f"worker {self.name!r} never "
+                                 f"connected back") from None
+        finally:
+            lsock.close()
+        self._buf = b""
+        self._killed = False
+        self._seq = 0
+        self._ready = False
+        if wait_ready:
+            self._handshake()
+
+    def _handshake(self) -> None:
+        ready = self._recv(self.timeout, want_seq=None)
+        if not ready.get("ready"):
+            self._killed = True
+            raise WorkerDied(f"worker {self.name!r} failed to "
+                             f"build: {ready.get('_err')}")
+        self._ready = True
+
+    def _pop_msg(self) -> Optional[dict]:
+        """One complete framed message off the receive buffer, or
+        None if a full frame has not arrived yet. An undecodable
+        frame (CRC / unpickling) kills the transport — a peer whose
+        bytes cannot be trusted is indistinguishable from a dead
+        one."""
+        if len(self._buf) < FRAME_HEADER_SIZE:
+            return None
+        head = self._buf[:FRAME_HEADER_SIZE]
+        n = frame_body_size(head)
+        if len(self._buf) < FRAME_HEADER_SIZE + n:
+            return None
+        body = self._buf[FRAME_HEADER_SIZE:FRAME_HEADER_SIZE + n]
+        self._buf = self._buf[FRAME_HEADER_SIZE + n:]
+        try:
+            return unframe_message(head, body)
+        except Exception as e:
+            self._killed = True
+            raise WorkerDied(f"worker {self.name!r} sent a torn/"
+                             f"corrupt frame: {e}") from e
+
+    def _recv(self, timeout: float, want_seq) -> dict:
+        """Response to op ``want_seq``, discarding stale answers —
+        same protocol-desync defence as the pipe transport (a
+        timed-out op's late answer must never be read as the next
+        op's reply). ``want_seq=None`` accepts anything (the build
+        handshake)."""
+        deadline = _time.monotonic() + timeout
+        self._sock.settimeout(0.05)
+        while True:
+            msg = self._pop_msg()
+            if msg is not None:
+                if want_seq is None or msg.get("_seq") == want_seq:
+                    return msg
+                continue               # stale late answer
+            try:
+                chunk = self._sock.recv(1 << 16)
+                if not chunk:          # EOF: peer gone (SIGKILL too)
+                    raise WorkerDied(
+                        f"worker {self.name!r} socket closed "
+                        f"(exitcode {self.proc.exitcode})")
+                self._buf += chunk
+                continue
+            except _socketlib.timeout:
+                pass
+            except (ConnectionError, OSError) as e:
+                raise WorkerDied(
+                    f"worker {self.name!r} socket error: {e}") from e
+            if _time.monotonic() > deadline:
+                raise WorkerTimeout(
+                    f"worker {self.name!r}: no answer in {timeout}s")
+
+    def request(self, op, payload=None, timeout=None) -> dict:
+        if self._killed:
+            raise WorkerDied(f"worker {self.name!r} is dead")
+        if not self._ready:
+            self._handshake()          # deferred-build handshake
+        self._seq += 1
+        try:
+            self._sock.sendall(
+                frame_message((self._seq, op, payload or {})))
+        except (BrokenPipeError, ConnectionError, OSError) as e:
+            raise WorkerDied(
+                f"worker {self.name!r} socket broken: {e}") from e
+        resp = self._recv(timeout if timeout is not None
+                          else self.timeout, want_seq=self._seq)
+        resp.pop("_seq", None)
+        if resp.get("_died"):
+            self._killed = True
+            raise WorkerDied(f"worker {self.name!r}: {resp['_err']}")
+        if "_err" in resp:
+            raise WorkerError(resp["_err"])
+        return resp
+
+    def kill(self) -> None:
+        self._killed = True
+        if self.proc.is_alive():
+            self.proc.kill()           # SIGKILL — real process death
+        self.proc.join(timeout=10)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if not self._killed and self.proc.is_alive():
+            try:
+                self.request("close", timeout=self.timeout)
+            except (WorkerDied, WorkerTimeout, WorkerError):
+                pass
+        if self.proc.is_alive():
+            self.proc.terminate()
+        self.proc.join(timeout=10)
+        self._killed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    @property
+    def alive(self) -> bool:
+        return not self._killed and self.proc.is_alive()
+
+
+# ---------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------
+
+class FleetSupervisor:
+    """Closes the loop the router leaves open: a worker the router
+    marks DEAD gets rebuilt from its own files and rejoins through
+    the circuit breaker. Drive it with one ``tick()`` after each
+    ``router.step()`` — the supervisor is control plane only and
+    never touches the data path (placement, rounds, emissions stay
+    the router's).
+
+      router            the Router whose fleet this supervises
+      specs             {worker_name: build_server_from_spec dict} —
+                        MUST be the same specs the live workers were
+                        built from (same seeds/paths), or the respawn
+                        breaks the bit-identity contract. A spec may
+                        carry ``transport``: "inproc" (default) or
+                        "socket" to override the fleet-wide default.
+      transport         default respawn transport
+      registry          MetricsRegistry for the ``fleet.*`` gauges
+                        (fresh one if None — always on either way)
+      monitor           optional HealthMonitor: bound to the fleet
+                        registry, stepped per tick — its
+                        ``capacity-degraded`` detector lights up only
+                        through this wiring
+      max_respawns      respawn ATTEMPTS per worker before the corpse
+                        is left for the coroner (bounds the
+                        crash-loop: a corrupt snapshot must not buy
+                        an infinite rebuild cycle)
+      checkpoint_every  take a fleet checkpoint of every live
+                        in-process worker's pool each N ticks: full
+                        ``PagedKVCache.snapshot()`` the first time,
+                        ``snapshot(base=...)`` DELTAS after — the
+                        periodic cost scales with dirtied pages, not
+                        pool size. 0 disables. (Socket/pipe workers
+                        self-checkpoint via their own
+                        ``snapshot_every``; a supervisor cannot reach
+                        through a process boundary for pages and does
+                        not try.)
+      socket_timeout    per-op timeout handed to respawned
+                        SocketWorkers
+    """
+
+    def __init__(self, router, specs: Dict[str, dict], *,
+                 transport: str = "inproc", registry=None,
+                 monitor=None, max_respawns: int = 4,
+                 checkpoint_every: int = 0,
+                 socket_timeout: float = 120.0):
+        if transport not in ("inproc", "socket"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self.specs = {str(n): dict(s) for n, s in specs.items()}
+        unknown = sorted(set(self.specs) - set(router._workers))
+        if unknown:
+            raise ValueError(f"specs name workers the router does "
+                             f"not have: {unknown}")
+        self.router = router
+        self.transport = transport
+        self.registry = (MetricsRegistry() if registry is None
+                         else registry)
+        self.registry.attach("fleet", self._fleet_gauges)
+        self.monitor = monitor
+        if monitor is not None:
+            monitor.bind(self.registry)
+        self.max_respawns = int(max_respawns)
+        self.checkpoint_every = int(checkpoint_every)
+        self.socket_timeout = float(socket_timeout)
+        self.respawn_counts: Dict[str, int] = {}
+        self.respawns_total = 0
+        self.failed_respawns = 0
+        self.last_error: Optional[str] = None
+        # fleet checkpoint archive: {name: {"base": full_snap,
+        # "delta": latest_delta_or_None}} — in-memory, re-seeded from
+        # the next full checkpoint after a restore
+        self._checkpoints: Dict[str, dict] = {}
+        self.checkpoint_full_bytes = 0
+        self.checkpoint_delta_bytes = 0
+
+    # -- gauges -------------------------------------------------------
+    def _fleet_gauges(self) -> dict:
+        r = self.router
+        live = sum(1 for ws in r._workers.values()
+                   if ws.status == "up")
+        return {
+            "workers_total": len(r._workers),
+            "workers_live": live,
+            "respawns": r.stats.respawns,
+            "migrations.forced": (r.stats.migrations
+                                  - r.stats.rebalances),
+            "migrations.policy": r.stats.rebalances,
+            "migrations.skipped": r.stats.migrations_skipped,
+        }
+
+    # -- the control loop ---------------------------------------------
+    def tick(self) -> int:
+        """One supervisor pass (call after ``router.step()``): respawn
+        every corpse still inside its attempt budget, take the
+        periodic fleet checkpoint, advance the fleet monitor.
+        Returns the number of respawns registered this pass."""
+        r = self.router
+        respawned = 0
+        for name in sorted(r._workers):
+            if r._workers[name].status != "dead":
+                continue
+            spec = self.specs.get(name)
+            if spec is None:
+                continue               # not ours to resurrect
+            if self.respawn_counts.get(name, 0) >= self.max_respawns:
+                continue               # crash-looping: leave it dead
+            if self.respawn(name):
+                respawned += 1
+        if self.checkpoint_every and r.tick and \
+                r.tick % self.checkpoint_every == 0:
+            self.checkpoint()
+        if self.monitor is not None:
+            self.monitor.on_step(r.tick)
+        return respawned
+
+    def respawn(self, name: str) -> bool:
+        """Rebuild one dead worker from its spec + on-disk state and
+        re-register it. The rebuild is ``RecoverableServer.recover``
+        under the hood (``recover=True`` in the spec): snapshot
+        restore + journal replay, the bit-identity contract. A failed
+        rebuild (corrupt snapshot, diverged journal, vanished files)
+        leaves the worker dead, burns one attempt, and records the
+        error — the control plane must survive every data-plane
+        corpse."""
+        ws = self.router._workers[name]
+        if ws.status != "dead":
+            raise ValueError(f"worker {name!r} is {ws.status!r} — "
+                             f"only corpses respawn")
+        spec = dict(self.specs[name], recover=True)
+        transport = spec.pop("transport", self.transport)
+        self.respawn_counts[name] = \
+            self.respawn_counts.get(name, 0) + 1
+        try:
+            if transport == "socket":
+                # wait_ready=False: the rebuild (model + snapshot +
+                # journal replay) proceeds in the child while the
+                # router ticks on; the rejoin ping pays the handshake
+                handle = SocketWorker(spec, name=name, role=ws.role,
+                                      timeout=self.socket_timeout,
+                                      wait_ready=False)
+            else:
+                handle = InProcWorker(spec, name=name, role=ws.role)
+        except Exception as e:
+            self.failed_respawns += 1
+            self.last_error = f"{name}: {type(e).__name__}: {e}"
+            return False
+        self.router.register_respawn(name, handle)
+        self.respawns_total += 1
+        return True
+
+    # -- fleet checkpoints (delta snapshots) --------------------------
+    def checkpoint(self) -> Dict[str, dict]:
+        """Snapshot every live IN-PROCESS worker's pool into the
+        fleet archive: the first checkpoint per worker is full, later
+        ones are ``snapshot(base=...)`` deltas carrying only pages
+        whose content changed since the base — the periodic cost
+        stops scaling with pool size. Returns {name: snapshot} for
+        the workers captured this pass."""
+        out: Dict[str, dict] = {}
+        for name in sorted(self.router._workers):
+            ws = self.router._workers[name]
+            if ws.status != "up":
+                continue
+            harness = getattr(ws.handle, "worker", None)
+            if harness is None:
+                continue               # process worker: self-managed
+            cache = harness.server.engine.engine.cache
+            entry = self._checkpoints.get(name)
+            if entry is None:
+                snap = cache.snapshot()
+                self._checkpoints[name] = {"base": snap,
+                                           "delta": None}
+                self.checkpoint_full_bytes += snap["payload"].nbytes
+            else:
+                snap = cache.snapshot(base=entry["base"])
+                entry["delta"] = snap
+                self.checkpoint_delta_bytes += snap["payload"].nbytes
+            out[name] = snap
+        return out
+
+    # -- durable state ------------------------------------------------
+    def snapshot(self) -> dict:
+        """The supervisor's durable control-plane state: specs,
+        budgets, attempt history, checkpoint accounting. Live wiring
+        (router, registry, monitor) and the in-memory checkpoint
+        archive are reconstructed at restore."""
+        return {
+            "kind": "fleet_supervisor",
+            "specs": {n: dict(s) for n, s in self.specs.items()},
+            "transport": self.transport,
+            "max_respawns": self.max_respawns,
+            "checkpoint_every": self.checkpoint_every,
+            "socket_timeout": self.socket_timeout,
+            "respawn_counts": dict(self.respawn_counts),
+            "counters": {
+                "respawns_total": self.respawns_total,
+                "failed_respawns": self.failed_respawns,
+                "checkpoint_full_bytes": self.checkpoint_full_bytes,
+                "checkpoint_delta_bytes": self.checkpoint_delta_bytes,
+            },
+            "last_error": self.last_error,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict, router, *, registry=None,
+                monitor=None) -> "FleetSupervisor":
+        """Rebuild a supervisor around a (possibly itself recovered)
+        router. Attempt budgets survive — a worker that crash-looped
+        before the control plane died does not get a fresh budget
+        just because the supervisor moved."""
+        if snap.get("kind") != "fleet_supervisor":
+            raise ValueError(f"not a fleet_supervisor snapshot "
+                             f"(kind={snap.get('kind')!r})")
+        sup = cls(router, snap["specs"],
+                  transport=snap["transport"],
+                  registry=registry, monitor=monitor,
+                  max_respawns=snap["max_respawns"],
+                  checkpoint_every=snap["checkpoint_every"],
+                  socket_timeout=snap["socket_timeout"])
+        sup.respawn_counts = {str(k): int(v) for k, v
+                              in snap["respawn_counts"].items()}
+        c = snap["counters"]
+        sup.respawns_total = int(c["respawns_total"])
+        sup.failed_respawns = int(c["failed_respawns"])
+        sup.checkpoint_full_bytes = int(c["checkpoint_full_bytes"])
+        sup.checkpoint_delta_bytes = int(c["checkpoint_delta_bytes"])
+        sup.last_error = snap["last_error"]
+        return sup

@@ -45,6 +45,9 @@ timings for kernel tile sizing:
     journal-lag          records appended since the last snapshot
                          crossed the lag bound (RecoverableServer's
                          durability gauges)
+    capacity-degraded    the fleet's live-worker fraction fell under
+                         its floor (FleetSupervisor-fed; dark when no
+                         supervisor registry is bound)
     slo-burn             a tenant's error-budget burn rate crossed the
                          alerting bound
 
@@ -428,6 +431,11 @@ class HealthMonitor:
         "queue_growth_min": 3,
         # journal records appended since the last snapshot
         "journal_lag_high": 256,
+        # fleet capacity: live-worker fraction under the floor fires;
+        # hysteresis: stays active until back above clear (a respawned
+        # fleet must actually rejoin before the alert re-arms)
+        "capacity_degraded_floor": 0.75,
+        "capacity_degraded_clear": 0.99,
         # goodput-collapse: the share of the window's TOTAL ledger
         # work not known-wasted ((work - waste) / work) fell through
         # the floor (CostLedger-fed; dark without a ledger)
@@ -569,6 +577,12 @@ class HealthMonitor:
         if "snapshot.age_steps" in cur:
             self._push("snapshot.age", step,
                        num(cur, "snapshot.age_steps"))
+        if "fleet.workers_total" in cur:
+            total = num(cur, "fleet.workers_total") or 1.0
+            self._push("fleet.capacity", step,
+                       num(cur, "fleet.workers_live") / total)
+            self._push("fleet.respawns", step,
+                       num(cur, "fleet.respawns"))
 
         # interval deltas — the first sample is baseline only
         if prev is not None:
@@ -741,6 +755,20 @@ class HealthMonitor:
                 else th["journal_lag_high"]
             self._fire("journal-lag", v >= bound, step, "journal.lag",
                        v, th["journal_lag_high"])
+        # 5b. capacity-degraded (FleetSupervisor-fed: the live-worker
+        #     fraction fell under the floor. Dark without a fleet —
+        #     the series simply never appears. Hysteresis: a storm's
+        #     respawns must carry capacity back above _clear before
+        #     the alert re-arms, so one kill storm is one alert.)
+        sb = self._series.get("fleet.capacity")
+        if sb is not None:
+            v = sb.last()
+            bound = th["capacity_degraded_clear"] \
+                if ("capacity-degraded", None) in self._active \
+                else th["capacity_degraded_floor"]
+            self._fire("capacity-degraded", v < bound, step,
+                       "fleet.capacity", v,
+                       th["capacity_degraded_floor"])
         # 6. slo-burn (per tenant, deterministic order)
         if self.slo is not None:
             status = self.slo.status()
@@ -794,6 +822,15 @@ class HealthMonitor:
             if ("journal-lag", None) in self._active:
                 return "critical"
             if (sb.last() or 0.0) >= th["journal_lag_high"] / 2:
+                return "warn"
+        elif name == "fleet.capacity":
+            # 0.0 is a REAL capacity reading (every worker dead) —
+            # never `or`-default this one
+            if ("capacity-degraded", None) in self._active:
+                return "critical"
+            last = sb.last()
+            if last is not None and \
+                    last < th["capacity_degraded_clear"]:
                 return "warn"
         return "ok"
 

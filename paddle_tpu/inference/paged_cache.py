@@ -278,6 +278,37 @@ class BlockAllocator:
 KV_QMAX = 127.0
 
 
+def _merge_delta_snapshot(snap: dict, base: dict,
+                          referenced: List[int]) -> dict:
+    """Reconstitute a FULL snapshot from a delta and the base it was
+    taken against: the delta's own (dirty) payload rows plus the
+    base's rows for every ``base_blocks`` id. Refuses a mismatched
+    base (different geometry, or missing a referenced block) — a
+    wrong base would scatter wrong bytes under valid block ids, the
+    exact corruption content addressing exists to prevent."""
+    if base.get("geometry") != snap["geometry"]:
+        raise ValueError("delta snapshot: base geometry mismatch")
+    row_of = {int(b): i for i, b in enumerate(base["blocks"])}
+    missing = [b for b in referenced if b not in row_of]
+    if missing:
+        raise ValueError(f"delta snapshot references block(s) "
+                         f"{missing} the base does not carry — "
+                         f"wrong base checkpoint")
+    take = [row_of[b] for b in referenced]
+    merged = dict(snap)
+    merged["blocks"] = [int(b) for b in snap["blocks"]] + \
+        [int(b) for b in referenced]
+    merged["payload"] = np.concatenate(
+        [np.asarray(snap["payload"]),
+         np.asarray(base["payload"])[take]], axis=0)
+    if "scale_payload" in snap:
+        merged["scale_payload"] = np.concatenate(
+            [np.asarray(snap["scale_payload"]),
+             np.asarray(base["scale_payload"])[take]], axis=0)
+    merged["base_blocks"] = []
+    return merged
+
+
 def _quant_rows(x):
     """x [..., D] float -> (int8 payload [..., D], float32 scale
     [...]): symmetric round-to-nearest at amax/127 per row. All-zero
@@ -1514,7 +1545,7 @@ class PagedKVCache:
         return True
 
     # -- checkpoint / restore -----------------------------------------
-    def snapshot(self) -> dict:
+    def snapshot(self, base: Optional[dict] = None) -> dict:
         """Host-side checkpoint of the whole pool: geometry, the
         allocator's EXACT state (refcounts, free-list order,
         cached-free LRU order), block tables, the chain-hash index,
@@ -1524,11 +1555,54 @@ class PagedKVCache:
         here and therefore never rides a snapshot. ONE device->host
         pull per layer pool, independent of the live-block count.
         The result is a plain picklable dict (numpy + ints + bytes);
-        ``restore`` rebuilds an identical pool from it."""
+        ``restore`` rebuilds an identical pool from it.
+
+        ``base`` (a previous snapshot of the SAME pool geometry)
+        makes this a DELTA: pages whose content the base provably
+        already carries — the block is chain-hash indexed, the base's
+        index binds the same hash to the same block id, and the base
+        holds that block's payload row — ride as ``base_blocks`` ids
+        only, no bytes. The content address justifies the skip:
+        indexed blocks are immutable in place (the deep audit
+        enforces it), so same (id, hash) == same bytes. Unhashed
+        blocks (open tails, mid-prefill pages) are always dirty and
+        always ship. All ALLOCATOR metadata stays complete either
+        way — only payload rows are elided — and ``restore(...,
+        base=...)`` reconstitutes the full pool."""
         a = self.allocator
         cached_order = [int(b) for b in a._cached]
         keep = sorted({b for b in range(1, self.num_blocks)
                        if a.refcount[b] > 0} | set(cached_order))
+        geometry = {
+            "num_layers": self.num_layers,
+            "num_heads": self.num_heads,
+            "head_dim": self.head_dim,
+            "block_size": self.block_size,
+            "num_blocks": self.num_blocks,
+            "max_seqs": self.max_seqs,
+            "max_blocks_per_seq": self.max_blocks_per_seq,
+            "dtype": self.dtype,
+            "prefix_cache": self.prefix_cache,
+            # recorded so tooling names the source mesh width; the
+            # PAYLOAD is canonical (full heads) regardless, and
+            # restore(mp=...) re-slices for any target width
+            "mp": self.mp,
+        }
+        clean = set()
+        if base is not None:
+            if base.get("geometry") != geometry:
+                raise ValueError(
+                    "delta snapshot: base comes from a different "
+                    "pool geometry — content addresses do not "
+                    "transfer across geometries")
+            base_rows = {int(b) for b in base["blocks"]}
+            base_index = base.get("hash_index", {})
+            for b in keep:
+                h = self._block_hash.get(b)
+                if h is not None and base_index.get(h) == b \
+                        and b in base_rows:
+                    clean.add(b)
+        dirty = [b for b in keep if b not in clean]
         arrs = [np.asarray(p.numpy()) for p in self.pools]
         if self.mp > 1:
             # CANONICAL wire format: full-head pages, the mp=1 layout
@@ -1539,10 +1613,10 @@ class PagedKVCache:
             arrs = [np.concatenate(
                 arrs[i * self.mp:(i + 1) * self.mp], axis=2)
                 for i in range(self.num_layers)]
-        if keep:
+        if dirty:
             # one fancy-index gather per layer, not a Python loop per
             # block — snapshots sit on the serving hot path
-            payload = np.stack([arr[keep] for arr in arrs],
+            payload = np.stack([arr[dirty] for arr in arrs],
                                axis=1)                 # [n, L, 2, H, bs, D]
         else:
             payload = np.zeros((0, self.num_layers, 2, self.num_heads,
@@ -1560,8 +1634,8 @@ class PagedKVCache:
                 sarrs = [np.concatenate(
                     sarrs[i * self.mp:(i + 1) * self.mp], axis=2)
                     for i in range(self.num_layers)]
-            if keep:
-                scale_payload = np.stack([a[keep] for a in sarrs],
+            if dirty:
+                scale_payload = np.stack([a[dirty] for a in sarrs],
                                          axis=1)   # [n, L, 2, H, bs]
             else:
                 scale_payload = np.zeros(
@@ -1569,21 +1643,7 @@ class PagedKVCache:
                      self.block_size), np.float32)
         return {
             "kind": "paged_kv_cache",
-            "geometry": {
-                "num_layers": self.num_layers,
-                "num_heads": self.num_heads,
-                "head_dim": self.head_dim,
-                "block_size": self.block_size,
-                "num_blocks": self.num_blocks,
-                "max_seqs": self.max_seqs,
-                "max_blocks_per_seq": self.max_blocks_per_seq,
-                "dtype": self.dtype,
-                "prefix_cache": self.prefix_cache,
-                # recorded so tooling names the source mesh width; the
-                # PAYLOAD is canonical (full heads) regardless, and
-                # restore(mp=...) re-slices for any target width
-                "mp": self.mp,
-            },
+            "geometry": geometry,
             "refcount": {int(b): int(a.refcount[b]) for b in keep},
             "free_order": [int(b) for b in a._free],
             "cached_order": cached_order,       # oldest (LRU) first
@@ -1593,8 +1653,12 @@ class PagedKVCache:
                            for bl in self.seq_blocks],
             "seq_tenant": list(self.seq_tenant),
             "peak_blocks_used": int(self.peak_blocks_used),
-            "blocks": [int(b) for b in keep],
+            "blocks": [int(b) for b in dirty],
             "payload": payload,
+            # content the BASE checkpoint already carries (empty on a
+            # full snapshot): restore(base=...) pulls these rows from
+            # the base instead of the wire
+            "base_blocks": sorted(int(b) for b in clean),
             **({"scale_payload": scale_payload}
                if scale_payload is not None else {}),
         }
@@ -1603,7 +1667,8 @@ class PagedKVCache:
     def restore(cls, snap: dict, *,
                 num_blocks: Optional[int] = None,
                 mp: Optional[int] = None,
-                shard_devices=None) -> "PagedKVCache":
+                shard_devices=None,
+                base: Optional[dict] = None) -> "PagedKVCache":
         """Rebuild a pool from a ``snapshot`` dict. With the default
         (same ``num_blocks``) every block keeps its id and the
         allocator's free-list and LRU orders round-trip EXACTLY, so
@@ -1623,7 +1688,22 @@ class PagedKVCache:
         single chip (mp=1) and vice versa — each target shard takes
         its own head slice of every page. Default: the snapshot's
         recorded width. Ends with the deep ``check_invariants``
-        audit."""
+        audit.
+
+        A DELTA snapshot (non-empty ``base_blocks``; see
+        ``snapshot(base=...)``) additionally needs ``base`` — the
+        checkpoint it was taken against — to reconstitute the elided
+        payload rows; restoring one without its base refuses rather
+        than silently dropping pages. Pre-delta snapshots carry no
+        ``base_blocks`` key and restore exactly as before."""
+        referenced = [int(b) for b in snap.get("base_blocks", ())]
+        if referenced:
+            if base is None:
+                raise ValueError(
+                    f"delta snapshot references {len(referenced)} "
+                    f"block(s) from its base checkpoint — restore "
+                    f"needs base=...")
+            snap = _merge_delta_snapshot(snap, base, referenced)
         g = snap["geometry"]
         nb = g["num_blocks"] if num_blocks is None else int(num_blocks)
         mp_t = int(g.get("mp", 1)) if mp is None else int(mp)

@@ -63,7 +63,8 @@ from .speculative import SpeculativeEngine
 
 __all__ = ["SNAPSHOT_VERSION", "SnapshotVersionError", "RecoveryError",
            "save_snapshot", "load_snapshot", "RequestJournal",
-           "read_journal", "RecoverableServer"]
+           "read_journal", "RecoverableServer", "FRAME_HEADER_SIZE",
+           "frame_message", "frame_body_size", "unframe_message"]
 
 SNAPSHOT_MAGIC = b"PTSNAP"
 SNAPSHOT_VERSION = 1
@@ -333,6 +334,42 @@ def read_journal(path: str) -> List[tuple]:
     Mid-file damage (a broken record with intact data behind it)
     raises ``RecoveryError`` rather than silently losing the rest."""
     return _scan_journal(path)[0]
+
+
+# -- wire framing ------------------------------------------------------
+#
+# The journal's (length, CRC32) frame doubles as the fleet's SOCKET
+# wire format (inference/fleet.py): one framing discipline everywhere a
+# torn byte stream must be DETECTED rather than guessed at. A frame
+# that fails its CRC over TCP means the peer died mid-write — exactly
+# the torn-tail case on disk — and maps to the same abandonment
+# semantics (dead socket == dead pipe).
+
+FRAME_HEADER_SIZE = RequestJournal._HDR.size
+
+
+def frame_message(obj) -> bytes:
+    """One framed message: 8-byte (length, CRC32) header + pickled
+    body — byte-compatible with a journal record frame."""
+    blob = pickle.dumps(obj, protocol=4)
+    return RequestJournal._HDR.pack(
+        len(blob), zlib.crc32(blob) & 0xFFFFFFFF) + blob
+
+
+def frame_body_size(head: bytes) -> int:
+    """Body length announced by an 8-byte frame header."""
+    return RequestJournal._HDR.unpack(head)[0]
+
+
+def unframe_message(head: bytes, body: bytes):
+    """Decode one framed message from its header + body. Raises
+    ``ValueError`` on a CRC mismatch (torn frame) and refuses
+    non-allowlisted globals like every other journal load — a socket
+    peer gets no more unpickling power than a journal file does."""
+    _n, crc = RequestJournal._HDR.unpack(head)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise ValueError("framed message CRC mismatch (torn frame)")
+    return _restricted_loads(body)
 
 
 # -- recoverable serving host -----------------------------------------

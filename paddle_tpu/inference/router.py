@@ -73,7 +73,8 @@ from .telemetry import StatsBase
 
 __all__ = ["Router", "RouterStats", "EngineWorker", "InProcWorker",
            "PipeWorker", "WorkerDied", "WorkerTimeout", "WorkerError",
-           "build_server_from_spec", "token_chain_hashes"]
+           "build_model_from_spec", "build_server_from_spec",
+           "token_chain_hashes"]
 
 
 class WorkerDied(RuntimeError):
@@ -111,27 +112,16 @@ def token_chain_hashes(model, token_ids, block_size: int):
     return chain_block_hashes(model.embed(toks), block_size)
 
 
-def build_server_from_spec(spec: dict) -> RecoverableServer:
-    """Construct a worker's ``RecoverableServer`` from a PICKLABLE,
-    data-only spec — the one constructor both transports share, so a
-    spawned child process builds bit-identical weights from the same
-    seeds the parent (or a single-engine baseline) uses.
-
-    Keys (defaults in parens): model dims ``d_model`` (32), ``heads``
-    (4), ``ffn`` (64), ``layers`` (2), ``vocab`` (50), seeds
-    ``model_seed`` (0) / ``embed_seed`` (1234), ``head_roll`` (0 —
-    see the note at the readout below); engine knobs ``k``
-    (0), ``max_batch`` (2), ``block_size`` (4), ``num_blocks`` (60),
-    ``max_blocks_per_seq`` (10), ``prefix_cache`` (True),
-    ``chunk_tokens``, ``prefill_token_budget``, ``kv_dtype``,
-    ``tenants``, ``max_preemptions``; ``monitor`` (False) wires a
-    ``HealthMonitor`` (the scrape's health verdict source); host
-    knobs ``journal_path`` / ``snapshot_path`` (required) and
-    ``snapshot_every`` (0)."""
+def build_model_from_spec(spec: dict):
+    """The MODEL half of ``build_server_from_spec``: bit-identical
+    weights from the spec's seeds alone. Factored out so a fleet
+    supervisor can rebuild a dead worker's model for
+    ``RecoverableServer.recover`` — recovery needs the weights and the
+    on-disk journal/snapshot, never a live object from the dead
+    incarnation."""
     import paddle_tpu as paddle
     from ..incubate.nn import FusedMultiTransformer
-    from .monitor import HealthMonitor
-    from .speculative import SpeculativeEngine, TokenServingModel
+    from .speculative import TokenServingModel
 
     paddle.seed(int(spec.get("model_seed", 0)))
     core = FusedMultiTransformer(
@@ -149,7 +139,41 @@ def build_server_from_spec(spec: dict) -> RecoverableServer:
     # hide inside a bit-identity assertion, a walking one cannot.
     roll = int(spec.get("head_roll", 0))
     head = (np.roll(embed, -roll, axis=0).T.copy() if roll else None)
-    tsm = TokenServingModel(core, embed, lm_head=head)
+    return TokenServingModel(core, embed, lm_head=head)
+
+
+def build_server_from_spec(spec: dict) -> RecoverableServer:
+    """Construct a worker's ``RecoverableServer`` from a PICKLABLE,
+    data-only spec — the one constructor both transports share, so a
+    spawned child process builds bit-identical weights from the same
+    seeds the parent (or a single-engine baseline) uses.
+
+    Keys (defaults in parens): model dims ``d_model`` (32), ``heads``
+    (4), ``ffn`` (64), ``layers`` (2), ``vocab`` (50), seeds
+    ``model_seed`` (0) / ``embed_seed`` (1234), ``head_roll`` (0 —
+    see the note at the readout below); engine knobs ``k``
+    (0), ``max_batch`` (2), ``block_size`` (4), ``num_blocks`` (60),
+    ``max_blocks_per_seq`` (10), ``prefix_cache`` (True),
+    ``chunk_tokens``, ``prefill_token_budget``, ``kv_dtype``,
+    ``tenants``, ``max_preemptions``; ``monitor`` (False) wires a
+    ``HealthMonitor`` (the scrape's health verdict source); host
+    knobs ``journal_path`` / ``snapshot_path`` (required) and
+    ``snapshot_every`` (0).
+
+    ``recover=True`` (the fleet supervisor's respawn path) rebuilds
+    the server FROM ITS FILES instead of fresh: same seeds, then
+    ``RecoverableServer.recover`` restores the last snapshot and
+    replays the journal suffix — the respawned incarnation holds
+    bit-identical state to the dead one at its last journaled round."""
+    from .monitor import HealthMonitor
+    from .speculative import SpeculativeEngine
+
+    tsm = build_model_from_spec(spec)
+    if spec.get("recover"):
+        return RecoverableServer.recover(
+            tsm, None, journal_path=spec["journal_path"],
+            snapshot_path=spec["snapshot_path"],
+            monitor=HealthMonitor() if spec.get("monitor") else None)
     eng = SpeculativeEngine(
         tsm, None, k=int(spec.get("k", 0)),
         max_batch=int(spec.get("max_batch", 2)),
@@ -544,6 +568,14 @@ class RouterStats(StatsBase):
       worker_timeouts    calls that timed out (circuit-breaker opens)
       stale_released     stale copies released on a worker's rejoin
       unroutable         FAILED_UNROUTABLE verdicts delivered
+      respawns           dead workers re-registered by a supervisor
+                         (``register_respawn``)
+      rebalances         policy-approved migrations, journaled as
+                         "rebalance" records ``Router.recover``
+                         replays (0 with no policy — pre-fleet
+                         journals stay byte-identical)
+      migrations_skipped streams a ``MigrationPolicy`` priced and
+                         declined to move (zero slice bytes shipped)
     """
 
     __slots__ = FIELDS = (
@@ -551,7 +583,8 @@ class RouterStats(StatsBase):
         "spillovers", "migrations", "migrated_blocks",
         "export_batches",
         "resubmissions", "oom_resubmissions", "worker_deaths",
-        "worker_timeouts", "stale_released", "unroutable")
+        "worker_timeouts", "stale_released", "unroutable",
+        "respawns", "rebalances", "migrations_skipped")
     REPR = ("submitted", "delivered", "migrations", "resubmissions",
             "worker_deaths", "unroutable")
 
@@ -592,7 +625,8 @@ class _RouterReq:
 class _WorkerState:
     __slots__ = ("handle", "name", "role", "order", "status",
                  "backoff", "retry_at", "assigned", "by_rid", "stale",
-                 "index", "pressure", "queued", "active", "health")
+                 "index", "pressure", "queued", "active", "health",
+                 "respawned")
 
     def __init__(self, handle: WorkerHandle, order: int,
                  backoff: int):
@@ -611,6 +645,10 @@ class _WorkerState:
         self.queued = 0
         self.active = 0
         self.health: Optional[dict] = None
+        # set by Router.register_respawn: this incarnation was rebuilt
+        # by a supervisor and its first successful ping IS the rejoin
+        # (journaled so a WAL reader can pair spawn <-> rejoin)
+        self.respawned = False
 
     @property
     def load(self):
@@ -639,6 +677,13 @@ class Router:
       migrate             move streams off prefill-role workers onto
                           decode-role workers once their prefill is
                           done (needs both roles present)
+      policy              MigrationPolicy (inference/fleet.py): price
+                          each candidate move — remaining work x
+                          pressure delta vs slice-transfer cost —
+                          BEFORE any export op, so a skipped move
+                          ships zero slice bytes. None (default)
+                          keeps the unconditional
+                          every-finished-prefill behaviour
       max_oom_resubmissions  FAILED_OOM retries per request before
                           the failure is delivered
       max_resubmissions   worker-failure resubmissions per request
@@ -656,7 +701,7 @@ class Router:
 
     def __init__(self, workers, *, hash_fn: Optional[Callable] = None,
                  injector=None, journal_path: Optional[str] = None,
-                 migrate: bool = True,
+                 migrate: bool = True, policy=None,
                  max_oom_resubmissions: int = 2,
                  max_resubmissions: int = 4,
                  unroutable_after: int = 4,
@@ -676,6 +721,7 @@ class Router:
         self.hash_fn = hash_fn
         self.injector = injector
         self.migrate = migrate
+        self.policy = policy
         self.max_oom_resubmissions = int(max_oom_resubmissions)
         self.max_resubmissions = int(max_resubmissions)
         self.unroutable_after = int(unroutable_after)
@@ -915,6 +961,20 @@ class Router:
                     req.terminal = True
                     req.status = RequestOutcome.FINISHED
                     router._delivered.add(req.rid)
+            elif kind == "respawn":
+                # fleet lifecycle (supervisor spawn / circuit-breaker
+                # rejoin pairs): placement is per-incarnation — the
+                # rebuilt router starts from the workers it was GIVEN
+                # — but the respawn count replays so capacity history
+                # survives the router's own death
+                if payload.get("event") == "spawn":
+                    router.stats.respawns += 1
+            elif kind == "rebalance":
+                # policy/migration decisions replay into the ledger
+                # deterministically; the moves themselves are
+                # per-incarnation (the recovered streams resubmit
+                # through the normal placement pass)
+                router.stats.rebalances += 1
         for req in router._reqs.values():
             if req.terminal:
                 continue
@@ -1088,6 +1148,12 @@ class Router:
         if died:
             ws.status = "dead"
             self.stats.worker_deaths += 1
+            # a respawned incarnation's journal replay rebuilds these
+            # very copies — stale-marked NOW so the rejoin ping
+            # releases them (their streams are resubmitted elsewhere
+            # below); workers that never come back simply keep an
+            # inert stale set
+            ws.stale.update(ws.assigned.keys())
             try:
                 ws.handle.kill()
             except Exception:
@@ -1116,6 +1182,36 @@ class Router:
                 continue
             self._try_place(req)
 
+    def register_respawn(self, name: str, handle) -> None:
+        """A supervisor rebuilt a DEAD worker (same name, fresh
+        process/handle, state recovered from its journal+snapshot):
+        swap the handle in and route the incarnation through the
+        circuit-breaker rejoin path — suspect first, pinged next
+        tick, stale copies (journal-replayed duplicates of streams
+        already resubmitted elsewhere) released at rejoin. The router
+        never trusts a respawn blindly: a corpse that cannot answer
+        the rejoin ping goes straight back to dead."""
+        ws = self._workers.get(name)
+        if ws is None:
+            raise KeyError(f"unknown worker {name!r}")
+        if ws.status != "dead":
+            raise ValueError(f"worker {name!r} is {ws.status!r}, not "
+                             f"dead — respawn replaces corpses only")
+        ws.handle = handle
+        ws.status = "suspect"
+        ws.backoff = self.backoff_ticks
+        ws.retry_at = self.tick + 1
+        ws.respawned = True
+        # scraped placement signals are from the dead incarnation:
+        # zero them until the rejoined worker is scraped for real
+        ws.index = set()
+        ws.pressure = 0.0
+        ws.queued = ws.active = 0
+        ws.health = None
+        self.stats.respawns += 1
+        self._jrec("respawn", {"worker": name, "event": "spawn",
+                               "tick": self.tick})
+
     def _retry_suspects(self) -> None:
         for ws in self._workers.values():
             if ws.status != "suspect" or self.tick < ws.retry_at:
@@ -1135,6 +1231,14 @@ class Router:
             # ones so they stop consuming its pool
             ws.status = "up"
             ws.backoff = self.backoff_ticks
+            if ws.respawned:
+                # a supervisor-rebuilt incarnation just answered its
+                # first ping: THIS is the rejoin — journaled so the
+                # WAL pairs it with the earlier "spawn" record
+                ws.respawned = False
+                self._jrec("respawn", {"worker": ws.name,
+                                       "event": "rejoin",
+                                       "tick": self.tick})
             self._release_stale(ws)
 
     def _release_stale(self, ws: _WorkerState) -> None:
@@ -1165,6 +1269,16 @@ class Router:
                 self._on_worker_failure(ws, died=True)
                 continue
             except WorkerTimeout:
+                self._on_worker_failure(ws, died=False)
+                continue
+            except WorkerError:
+                # a worker dying BETWEEN the ping and the scrape can
+                # surface as a transport-wrapped application error
+                # (half-dead harness, torn response) rather than a
+                # clean WorkerDied — it must NOT escape into the
+                # placement pass. Scrape is a pure read, so the
+                # circuit breaker owns the verdict: suspect now, and
+                # the rejoin ping resolves dead-vs-alive next tick.
                 self._on_worker_failure(ws, died=False)
                 continue
             ws.index = set(resp.get("index", ()))
@@ -1221,6 +1335,35 @@ class Router:
                      and self._reqs[rid].generated]
             if not moved:
                 continue
+            if self.policy is not None:
+                # price every candidate BEFORE the export op: a
+                # declined move never ships a byte. The benefit side
+                # is the stream's remaining decode work weighted by
+                # the scraped pressure delta toward the coolest live
+                # target (the same worker the per-stream choice below
+                # would pick this tick).
+                live_targets = [ws for ws in targets
+                                if ws.status == "up"]
+                if not live_targets:
+                    return
+                dst0 = sorted(live_targets,
+                              key=lambda ws: (ws.load, ws.order))[0]
+                priced = []
+                for wrid, rid in moved:
+                    req = self._reqs[rid]
+                    pos = len(req.tokens) + len(req.generated)
+                    rem = (None if req.max_new_tokens is None else
+                           req.max_new_tokens - len(req.generated))
+                    if self.policy.should_move(
+                            position=pos, remaining=rem,
+                            src_pressure=src.pressure,
+                            dst_pressure=dst0.pressure):
+                        priced.append((wrid, rid))
+                    else:
+                        self.stats.migrations_skipped += 1
+                moved = priced
+                if not moved:
+                    continue
             # one export per donor per tick — the whole batch of
             # finished prefills rides a single round trip
             try:
@@ -1274,7 +1417,19 @@ class Router:
                 req = self._reqs[rid]
                 if req.terminal or dst.status != "up":
                     continue
+                before = self.stats.migrations
                 self._handoff(req, src, dst)
+                if self.policy is not None and \
+                        self.stats.migrations > before:
+                    # the policy's decision became a real move:
+                    # journal it so Router.recover replays the
+                    # rebalance ledger deterministically (no-policy
+                    # routers journal nothing here — pre-fleet WALs
+                    # stay byte-identical)
+                    self.stats.rebalances += 1
+                    self._jrec("rebalance",
+                               {"rid": int(rid), "src": src.name,
+                                "dst": dst.name, "tick": self.tick})
                 if src.status != "up":
                     break             # src died mid-handoff
 

@@ -150,6 +150,52 @@ def test_serving_router_smoke_leg():
     assert storm["goodput_tokens_per_sec"] > 0
 
 
+def test_serving_fleet_smoke_leg():
+    res = bench_extra.bench_serving_fleet(smoke=True)
+    assert res["metric"] == "serving_fleet_self_healing"
+    # the headline guarantees rode the bench: identical seeded storm
+    # in both configs, every stream bit-identical to the baseline
+    assert res["streams_bit_identical"] is True
+    off, on = res["storm_no_respawn"], res["storm_respawn"]
+    assert off["worker_deaths"] == on["worker_deaths"] == 2
+    # without a supervisor capacity only ever shrinks; with one the
+    # fleet ends FULL — two spawn/rejoin pairs through the breaker
+    assert off["end_capacity"] < 1.0 and off["respawns"] == 0
+    assert on["end_capacity"] == 1.0
+    assert on["respawns"] == 2 and on["failed_respawns"] == 0
+    assert on["respawn_events"].count("w0:rejoin") == 1
+    assert on["respawn_events"].count("w1:rejoin") == 1
+    # the capacity trajectory tells the story: the no-respawn run is
+    # a monotone staircase down, the respawn run dips and recovers
+    caps_off = [c for _, c in off["capacity_trajectory"]]
+    assert caps_off == sorted(caps_off, reverse=True)
+    assert on["capacity_trajectory"][-1][1] == 1.0
+    # deterministic goodput proxy (wall-clock ratios are asserted at
+    # bench scale only): the rebuilt fleet drains wave 2 in fewer
+    # ticks than the lone survivor
+    assert res["ticks_saved_by_respawn"] > 0
+    assert on["ticks"] < off["ticks"]
+    assert (on["goodput_tokens_per_tick"]
+            > off["goodput_tokens_per_tick"])
+    # the supervisor's periodic checkpoints went DELTA after the
+    # first full one per worker
+    assert on["checkpoint_full_bytes"] > 0
+    assert on["checkpoint_delta_bytes"] > 0
+    # the capacity-degraded alert is edge-triggered per dip
+    assert on["capacity_degraded_alerts"] >= 1
+    # cost-aware migration: cheap moves approve + count as
+    # rebalances, a prohibitive exchange rate ships ZERO slice bytes
+    assert res["policy_rebalance"]["rebalances"] >= 1
+    assert res["policy_rebalance"]["policy_approved"] >= 1
+    assert res["policy_decline"]["export_batches"] == 0
+    assert res["policy_decline"]["migrated_blocks"] == 0
+    assert res["policy_decline"]["migrations_skipped"] >= 1
+    # every config actually served tokens
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert off["goodput_tokens_per_sec"] > 0
+    assert on["goodput_tokens_per_sec"] > 0
+
+
 def test_serving_tenants_smoke_leg():
     res = bench_extra.bench_serving_tenants(smoke=True)
     assert res["metric"] == "serving_tenant_isolation_noisy_neighbor"

@@ -1,0 +1,809 @@
+"""Self-healing serving fleet (inference/fleet.py + the respawn /
+rebalance surgery in router.py, the delta-snapshot path in
+paged_cache.py, the wire framing in recovery.py and the
+capacity-degraded detector in monitor.py).
+
+The acceptance bar extends the router suite's: a seeded kill storm
+WITH a supervisor ends at FULL capacity (every corpse rebuilt via
+``RecoverableServer.recover`` and rejoined through the circuit
+breaker) with every stream still bit-identical to the uninterrupted
+single-engine run — including over ``SocketWorker`` with REAL
+processes where the kill is a raw SIGKILL. Migration becomes a priced
+decision: a ``MigrationPolicy`` decline ships ZERO slice bytes, an
+approved move journals a "rebalance" record that replays through
+``Router.recover`` deterministically."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (FleetSupervisor, HealthMonitor,
+                                  InProcWorker, MetricsRegistry,
+                                  MigrationPolicy, RequestOutcome,
+                                  Router, RouterFaultInjector,
+                                  SocketWorker, WorkModel, WorkerError,
+                                  build_server_from_spec, read_journal,
+                                  token_chain_hashes)
+from paddle_tpu.inference.paged_cache import PagedKVCache
+
+pytestmark = pytest.mark.fleet
+
+VOCAB, BS = 50, 4
+# head_roll=1: greedy streams WALK the vocab instead of collapsing to
+# the tied readout's fixed point — a wrong respawn cannot hide inside
+# a constant stream (see build_server_from_spec)
+BASE = dict(head_roll=1, block_size=BS, num_blocks=80,
+            max_blocks_per_seq=10)
+
+_RNG = np.random.RandomState(77)
+PROMPTS = [[int(t) for t in _RNG.randint(0, VOCAB, 6)]
+           for _ in range(3)]
+
+
+def _spec(tmp_path, name, **kw):
+    d = dict(BASE, journal_path=str(tmp_path / f"{name}.wal"),
+             snapshot_path=str(tmp_path / f"{name}.ckpt"))
+    d.update(kw)
+    return d
+
+
+def _fleet(tmp_path, names, **kw):
+    """({name: spec}, [InProcWorker]) — specs and live workers built
+    from the SAME dicts, the supervisor's bit-identity precondition."""
+    specs = {n: _spec(tmp_path, n, **kw) for n in names}
+    return specs, [InProcWorker(specs[n], name=n, role="mixed")
+                   for n in names]
+
+
+def _model_of(w):
+    return w.worker.server.engine.target
+
+
+def _hash_fn(model):
+    return lambda toks: token_chain_hashes(model, toks, BS)
+
+
+_BASELINE_CACHE = {}
+
+
+def _single_engine_streams(tmp_path, prompts, n, **kw):
+    """Uninterrupted single-engine baseline: the streams every storm
+    survivor must reproduce bit-for-bit."""
+    key = (tuple(tuple(p) for p in prompts), n,
+           tuple(sorted(kw.items())))
+    if key in _BASELINE_CACHE:
+        return dict(_BASELINE_CACHE[key])
+    srv = build_server_from_spec(_spec(tmp_path, "solo", **kw))
+    rids = [srv.submit(p) for p in prompts]
+    done = {}
+    for _ in range(40 * len(prompts)):
+        if len(done) == len(rids):
+            break
+        srv.step()
+        for i, r in enumerate(rids):
+            if i not in done and len(srv.engine.generated(r)) >= n:
+                done[i] = srv.engine.generated(r)[:n]
+                srv.release(r)
+    srv.close()
+    assert len(done) == len(rids)
+    _BASELINE_CACHE[key] = dict(done)
+    return done
+
+
+def _drive(router, want_outcomes, max_ticks=80, supervisor=None):
+    ocs = []
+    for _ in range(max_ticks):
+        router.step()
+        if supervisor is not None:
+            supervisor.tick()
+        ocs += router.drain_outcomes()
+        if len(ocs) >= want_outcomes:
+            break
+    return ocs
+
+
+def _respawn_events(journal_path):
+    """[(worker, event, tick)] in WAL order."""
+    return [(p["worker"], p["event"], p["tick"])
+            for _, k, p in read_journal(journal_path)
+            if k == "respawn"]
+
+
+# ---------------------------------------------------------------------
+# migration policy (pure pricing)
+# ---------------------------------------------------------------------
+
+class TestMigrationPolicy:
+    def _policy(self, **kw):
+        wm = WorkModel(num_layers=2, d_model=32, ffn_dim=64)
+        return MigrationPolicy(wm, **kw)
+
+    def test_inequality_both_sides(self):
+        """benefit = remaining-work FLOPs x pressure delta; cost =
+        resident KV bytes x the exchange rate. The verdict is exactly
+        benefit > cost — checked against hand-computed sides."""
+        pol = self._policy(flops_per_byte=1.0)
+        b, c = pol.price(position=10, remaining=8,
+                         src_pressure=0.8, dst_pressure=0.2)
+        assert b == pytest.approx(
+            pol.work.span_flops(10, 18) * 0.6)
+        assert c == pytest.approx(pol.work.resident_kv_bytes(10))
+        assert pol.should_move(position=10, remaining=8,
+                               src_pressure=0.8,
+                               dst_pressure=0.2) == (b > c)
+
+    def test_no_pressure_delta_never_moves(self):
+        """A balanced (or inverted) fleet keeps its streams: delta at
+        or below min_delta declines BEFORE pricing."""
+        pol = self._policy(flops_per_byte=0.0)
+        for src, dst in ((0.5, 0.5), (0.2, 0.8)):
+            assert not pol.should_move(position=10, remaining=8,
+                                       src_pressure=src,
+                                       dst_pressure=dst)
+        assert pol.declined == 2 and pol.approved == 0
+
+    def test_expensive_transfer_declines(self):
+        """Cranking flops_per_byte makes every stream sticky; zeroing
+        it restores move-on-any-positive-delta."""
+        sticky = self._policy(flops_per_byte=1e9)
+        free = self._policy(flops_per_byte=0.0)
+        kw = dict(position=10, remaining=8,
+                  src_pressure=0.9, dst_pressure=0.1)
+        assert not sticky.should_move(**kw)
+        assert free.should_move(**kw)
+
+    def test_horizon_prices_unbounded_streams(self):
+        """remaining=None streams are priced at the horizon, not
+        skipped and not priced at zero."""
+        pol = self._policy(flops_per_byte=1.0, horizon=16)
+        b_none, _ = pol.price(position=10, remaining=None,
+                              src_pressure=0.8, dst_pressure=0.2)
+        b_16, _ = pol.price(position=10, remaining=16,
+                            src_pressure=0.8, dst_pressure=0.2)
+        assert b_none == pytest.approx(b_16) and b_none > 0
+
+    def test_for_model_matches_workmodel(self, tmp_path):
+        srv = build_server_from_spec(_spec(tmp_path, "m"))
+        model = srv.engine.target
+        pol = MigrationPolicy.for_model(model)
+        wm = WorkModel.for_model(model)
+        assert pol.work.span_flops(0, 8) == wm.span_flops(0, 8)
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# cost-aware migration through the router
+# ---------------------------------------------------------------------
+
+class TestPolicyRouting:
+    def _disagg(self, tmp_path, policy):
+        w1 = InProcWorker(_spec(tmp_path, "w1"), name="w1",
+                          role="prefill")
+        w2 = InProcWorker(_spec(tmp_path, "w2"), name="w2",
+                          role="decode")
+        model = _model_of(w1)
+        r = Router([w1, w2], hash_fn=_hash_fn(model), policy=policy,
+                   journal_path=str(tmp_path / "router.wal"))
+        return r, w1, w2, model
+
+    def test_imbalanced_fleet_rebalances_and_journals(self, tmp_path):
+        """Cheap transfers + a hot donor: policy-approved moves
+        happen, are counted as ``rebalances`` (not forced), journal
+        "rebalance" records — and the streams stay bit-identical."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        pol = MigrationPolicy.for_model(
+            build_server_from_spec(_spec(tmp_path, "pm")).engine.target,
+            flops_per_byte=0.0)
+        r, _, _, _ = self._disagg(tmp_path, pol)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.stats.rebalances >= 1
+        assert r.stats.rebalances == r.stats.migrations  # none forced
+        assert pol.approved == r.stats.rebalances
+        recs = [(p["rid"], p["src"], p["dst"])
+                for _, k, p in read_journal(str(tmp_path /
+                                                "router.wal"))
+                if k == "rebalance"]
+        assert len(recs) == r.stats.rebalances
+        assert all(src == "w1" and dst == "w2" for _, src, dst in recs)
+        r.close()
+
+    def test_policy_decline_ships_zero_bytes(self, tmp_path):
+        """A declined move is decided BEFORE the export op: no slice
+        batches, no migrated blocks — and the stream finishes on its
+        donor, still bit-identical (a prefill worker CAN decode; the
+        policy just judged the handoff not worth its bytes)."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        pol = MigrationPolicy.for_model(
+            build_server_from_spec(_spec(tmp_path, "pm")).engine.target,
+            flops_per_byte=1e9)
+        r, _, _, _ = self._disagg(tmp_path, pol)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids))
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.stats.migrations_skipped >= len(rids)
+        assert r.stats.migrations == 0
+        assert r.stats.rebalances == 0
+        assert r.stats.export_batches == 0      # zero transfer bytes
+        assert r.stats.migrated_blocks == 0
+        assert pol.approved == 0 and pol.declined > 0
+        kinds = {k for _, k, _ in
+                 read_journal(str(tmp_path / "router.wal"))}
+        assert "rebalance" not in kinds
+        r.close()
+
+    def test_no_policy_journals_no_rebalance(self, tmp_path):
+        """The pre-fleet router (policy=None) migrates every finished
+        prefill and journals NOTHING new: its WALs keep the exact
+        record-kind alphabet older tooling expects."""
+        n = 6
+        r, _, _, _ = self._disagg(tmp_path, None)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        _drive(r, len(rids))
+        assert r.stats.migrations >= 1
+        assert r.stats.rebalances == 0
+        assert r.stats.migrations_skipped == 0
+        kinds = {k for _, k, _ in
+                 read_journal(str(tmp_path / "router.wal"))}
+        assert kinds <= {"submit", "emit", "tick", "delivered",
+                         "release"}
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# supervisor respawn: the self-healing loop
+# ---------------------------------------------------------------------
+
+class TestSupervisorRespawn:
+    def test_kill_storm_recovers_to_full_capacity(self, tmp_path):
+        """The headline: a seeded kill mid-storm WITH a supervisor
+        ends at 100% capacity — the corpse is rebuilt from its own
+        snapshot+journal, rejoins through the circuit breaker, the
+        WAL pairs its "spawn" with a "rejoin", and every stream is
+        bit-identical to the uninterrupted single-engine run. The
+        respawned worker then proves it is REALLY serving by taking a
+        second wave of streams."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        specs, workers = _fleet(tmp_path, ("w0", "w1"),
+                                snapshot_every=2)
+        model = _model_of(workers[0])
+        inj = RouterFaultInjector(
+            kill_at={3: {"w0": "before_round"}}, seed=1)
+        r = Router(workers, hash_fn=_hash_fn(model), injector=inj,
+                   journal_path=str(tmp_path / "router.wal"),
+                   backoff_ticks=1)
+        monitor = HealthMonitor()
+        sup = FleetSupervisor(r, specs, monitor=monitor)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        ocs = _drive(r, len(rids), supervisor=sup)
+        assert r.stats.worker_deaths >= 1          # the storm was real
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        # FULL capacity: the dead worker is back up, not just replaced
+        assert {ws.status for ws in r._workers.values()} == {"up"}
+        assert r.stats.respawns == sup.respawns_total == 1
+        assert sup.failed_respawns == 0
+        ev = _respawn_events(str(tmp_path / "router.wal"))
+        assert [(w, e) for w, e, _ in ev] == \
+            [("w0", "spawn"), ("w0", "rejoin")]
+        g = sup.registry.as_dict()
+        assert g["fleet.workers_live"] == g["fleet.workers_total"] == 2
+        assert g["fleet.respawns"] == 1
+        # capacity-degraded fired during the outage and CLEARED at
+        # full recovery (hysteresis: one storm, one alert)
+        assert monitor.alert_counts.get("capacity-degraded") == 1
+        assert monitor.report().alerts["active"] == []
+        # the respawned incarnation serves the second wave
+        rids2 = [r.submit(p, max_new_tokens=4) for p in PROMPTS]
+        ocs2 = _drive(r, len(rids2), supervisor=sup)
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs2)
+        assert r.check_invariants()
+        r.close()
+
+    def test_respawn_budget_bounds_crash_loop(self, tmp_path):
+        """A corpse whose rebuild keeps failing (vanished snapshot)
+        burns its attempt budget and STAYS dead — the control plane
+        survives, records the error, and the fleet monitor holds the
+        capacity-degraded alert active."""
+        specs, workers = _fleet(tmp_path, ("w0", "w1"))
+        model = _model_of(workers[0])
+        inj = RouterFaultInjector(kill_at={2: {"w0": "scrape"}},
+                                  seed=3)
+        r = Router(workers, hash_fn=_hash_fn(model), injector=inj,
+                   backoff_ticks=1)
+        monitor = HealthMonitor()
+        sup = FleetSupervisor(r, specs, monitor=monitor,
+                              max_respawns=2)
+        # sabotage the rebuild: the snapshot path no longer exists
+        sup.specs["w0"]["snapshot_path"] = \
+            str(tmp_path / "void" / "missing.ckpt")
+        rid = r.submit(PROMPTS[0], max_new_tokens=6)
+        for _ in range(8):
+            r.step()
+            sup.tick()
+        assert r._workers["w0"].status == "dead"
+        assert sup.respawn_counts["w0"] == 2       # budget, then stop
+        assert sup.failed_respawns == 2
+        assert sup.respawns_total == 0
+        assert "w0" in sup.last_error
+        assert r.stats.respawns == 0               # none REGISTERED
+        g = sup.registry.as_dict()
+        assert g["fleet.workers_live"] == 1
+        assert "capacity-degraded" in \
+            monitor.report().alerts["active"]
+        # the stream still finished on the survivor (router contract)
+        assert len(r.generated(rid)) == 6
+        r.close()
+
+    def test_respawn_refuses_non_corpse(self, tmp_path):
+        specs, workers = _fleet(tmp_path, ("w0", "w1"))
+        r = Router(workers, hash_fn=_hash_fn(_model_of(workers[0])))
+        sup = FleetSupervisor(r, specs)
+        with pytest.raises(ValueError, match="only corpses"):
+            sup.respawn("w0")
+        r.close()
+
+    def test_specs_must_name_router_workers(self, tmp_path):
+        specs, workers = _fleet(tmp_path, ("w0",))
+        r = Router(workers, hash_fn=_hash_fn(_model_of(workers[0])))
+        with pytest.raises(ValueError, match="ghost"):
+            FleetSupervisor(r, {"ghost": specs["w0"]})
+        r.close()
+
+    def test_router_recover_replays_fleet_wal(self, tmp_path):
+        """The ROUTER dies after a storm: ``Router.recover`` replays
+        the WAL's respawn/rebalance records into the stats ledger —
+        capacity and rebalance history survive the router's own
+        death, deterministically."""
+        n = 8
+        specs, workers = _fleet(tmp_path, ("w0", "w1"),
+                                snapshot_every=2)
+        model = _model_of(workers[0])
+        inj = RouterFaultInjector(
+            kill_at={3: {"w0": "before_round"}}, seed=1)
+        wal = str(tmp_path / "router.wal")
+        r = Router(workers, hash_fn=_hash_fn(model), injector=inj,
+                   journal_path=wal, backoff_ticks=1)
+        sup = FleetSupervisor(r, specs)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        _drive(r, len(rids), supervisor=sup)
+        assert r.stats.respawns == 1
+        r.close()
+        specs2, workers2 = _fleet(tmp_path, ("v0", "v1"))
+        r2 = Router.recover(workers2, journal_path=wal,
+                            hash_fn=_hash_fn(model))
+        assert r2.stats.respawns == 1
+        assert r2.stats.rebalances == 0
+        r2.close()
+
+    def test_supervisor_snapshot_round_trip(self, tmp_path):
+        """Control-plane durability: budgets, attempt history and
+        checkpoint byte accounting round-trip ``snapshot`` →
+        ``restore``; a crash-looped worker does NOT get a fresh
+        budget just because the supervisor moved."""
+        specs, workers = _fleet(tmp_path, ("w0", "w1"))
+        r = Router(workers, hash_fn=_hash_fn(_model_of(workers[0])))
+        sup = FleetSupervisor(r, specs, max_respawns=3,
+                              checkpoint_every=5, socket_timeout=7.0)
+        sup.respawn_counts["w0"] = 3
+        sup.failed_respawns = 2
+        sup.last_error = "w0: boom"
+        snap = sup.snapshot()
+        assert snap["kind"] == "fleet_supervisor"
+        sup2 = FleetSupervisor.restore(snap, r)
+        assert sup2.specs == sup.specs
+        assert sup2.max_respawns == 3
+        assert sup2.checkpoint_every == 5
+        assert sup2.socket_timeout == 7.0
+        assert sup2.respawn_counts == {"w0": 3}
+        assert sup2.failed_respawns == 2
+        assert sup2.last_error == "w0: boom"
+        # the exhausted budget still binds: w0 stays dead if it dies
+        with pytest.raises(ValueError):
+            FleetSupervisor.restore({"kind": "nope"}, r)
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# death mid-scrape (the regression satellite)
+# ---------------------------------------------------------------------
+
+class _ScrapeBomb:
+    """Transport wrapper: ping answers fine, then the NEXT scrape
+    surfaces as a WorkerError — the worker died between the two ops
+    and its torn response decoded as an application error (the bug:
+    this used to escape the router's placement pass)."""
+
+    def __init__(self, inner, arm_at_call: int):
+        self._inner = inner
+        self._scrapes = 0
+        self._arm = arm_at_call
+        self.name = inner.name
+        self.role = inner.role
+
+    def request(self, op, payload=None, timeout=None):
+        if op == "scrape":
+            self._scrapes += 1
+            if self._scrapes == self._arm:
+                raise WorkerError(
+                    f"worker {self.name!r} died between ping and "
+                    f"scrape: response stream torn")
+        return self._inner.request(op, payload, timeout)
+
+    def kill(self):
+        self._inner.kill()
+
+    def close(self):
+        self._inner.close()
+
+    @property
+    def alive(self):
+        return self._inner.alive
+
+
+class TestScrapeDeathRegression:
+    def test_worker_error_mid_scrape_goes_suspect(self, tmp_path):
+        """A WorkerError out of the scrape op must open the circuit
+        breaker (suspect), NOT escape ``Router.step()`` — and the
+        next clean ping rejoins the worker with every stream intact
+        and bit-identical."""
+        n = 8
+        base = _single_engine_streams(tmp_path, PROMPTS, n)
+        w0 = _ScrapeBomb(InProcWorker(_spec(tmp_path, "w0"),
+                                      name="w0", role="mixed"),
+                         arm_at_call=4)
+        w1 = InProcWorker(_spec(tmp_path, "w1"), name="w1",
+                          role="mixed")
+        model = _model_of(w1)
+        r = Router([w0, w1], hash_fn=_hash_fn(model), backoff_ticks=1)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS]
+        statuses = []
+        ocs = []
+        for _ in range(60):
+            r.step()                  # must NOT raise WorkerError
+            statuses.append(r._workers["w0"].status)
+            ocs += r.drain_outcomes()
+            if len(ocs) >= len(rids):
+                break
+        assert "suspect" in statuses  # breaker opened on the error
+        assert r._workers["w0"].status == "up"    # ...and re-closed
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        assert r.stats.worker_deaths == 0   # error path, not death
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# delta snapshots
+# ---------------------------------------------------------------------
+
+def _assert_caches_equal(a: PagedKVCache, b: PagedKVCache):
+    sa, sb = a.snapshot(), b.snapshot()
+    assert sa["geometry"] == sb["geometry"]
+    assert sa["blocks"] == sb["blocks"]
+    assert np.array_equal(sa["payload"], sb["payload"])
+    assert sa["hash_index"] == sb["hash_index"]
+    assert sa["seq_blocks"] == sb["seq_blocks"]
+
+
+class TestDeltaSnapshots:
+    def _served_cache(self, tmp_path, name, ticks):
+        srv = build_server_from_spec(_spec(tmp_path, name))
+        for p in PROMPTS:
+            srv.submit(p)
+        for _ in range(ticks):
+            srv.step()
+        return srv, srv.engine.engine.cache
+
+    def test_delta_restore_equals_full_restore(self, tmp_path):
+        """base + delta rebuilds the EXACT pool a full snapshot at
+        the same instant rebuilds — content-addressing is allowed to
+        skip a page only when the base provably still holds its
+        bytes."""
+        srv, cache = self._served_cache(tmp_path, "d", 4)
+        basesnap = cache.snapshot()
+        for _ in range(4):                    # dirty some pages
+            srv.step()
+        full = cache.snapshot()
+        delta = cache.snapshot(base=basesnap)
+        assert delta["base_blocks"]           # something was skipped
+        assert len(delta["blocks"]) < len(full["blocks"])
+        ra = PagedKVCache.restore(full)
+        rb = PagedKVCache.restore(delta, base=basesnap)
+        _assert_caches_equal(ra, rb)
+        srv.close()
+
+    def test_delta_payload_shrinks(self, tmp_path):
+        """The whole point: the delta's payload carries only dirtied
+        pages, so periodic checkpoints stop scaling with pool size —
+        measured against a FULL snapshot of the same instant (the
+        pool also grows between checkpoints; the saving is the base's
+        still-valid indexed pages)."""
+        srv, cache = self._served_cache(tmp_path, "s", 6)
+        basesnap = cache.snapshot()
+        srv.step()
+        delta = cache.snapshot(base=basesnap)
+        full = cache.snapshot()
+        assert len(delta["blocks"]) < len(full["blocks"])
+        assert delta["payload"].nbytes < full["payload"].nbytes
+        assert set(delta["blocks"]) | set(delta["base_blocks"]) == \
+            set(full["blocks"])
+        srv.close()
+
+    def test_unhashed_tail_pages_always_dirty(self, tmp_path):
+        """Open-tail pages (no chain hash yet) can mutate in place,
+        so they may NEVER be delta-skipped — even in a back-to-back
+        delta with zero intervening steps."""
+        srv, cache = self._served_cache(tmp_path, "t", 4)
+        basesnap = cache.snapshot()
+        delta = cache.snapshot(base=basesnap)   # no steps between
+        indexed = set(basesnap["hash_index"].values())
+        assert set(delta["blocks"]).isdisjoint(indexed)
+        live = set()
+        for blocks in basesnap["seq_blocks"]:
+            live.update(blocks)
+        assert set(delta["blocks"]) == live - indexed
+        srv.close()
+
+    def test_delta_without_base_refuses(self, tmp_path):
+        srv, cache = self._served_cache(tmp_path, "r", 4)
+        basesnap = cache.snapshot()
+        srv.step()
+        delta = cache.snapshot(base=basesnap)
+        with pytest.raises(ValueError, match="base"):
+            PagedKVCache.restore(delta)
+        srv.close()
+
+    def test_supervisor_checkpoints_go_delta(self, tmp_path):
+        """The supervisor's periodic fleet checkpoint: first capture
+        per worker is full, later ones are deltas — and the byte
+        accounting shows the delta lane strictly cheaper."""
+        specs, workers = _fleet(tmp_path, ("w0",))
+        r = Router(workers, hash_fn=_hash_fn(_model_of(workers[0])))
+        sup = FleetSupervisor(r, specs)
+        r.submit(PROMPTS[0], max_new_tokens=12)
+        for _ in range(4):
+            r.step()
+        first = sup.checkpoint()
+        assert "base_blocks" not in first["w0"] or \
+            not first["w0"]["base_blocks"]
+        assert sup.checkpoint_full_bytes > 0
+        assert sup.checkpoint_delta_bytes == 0
+        for _ in range(2):
+            r.step()
+        second = sup.checkpoint()
+        assert second["w0"]["base_blocks"]        # delta, not full
+        assert 0 < sup.checkpoint_delta_bytes < \
+            sup.checkpoint_full_bytes
+        r.close()
+
+
+# ---------------------------------------------------------------------
+# socket transport: real processes, real SIGKILL
+# ---------------------------------------------------------------------
+
+class TestSocketTransport:
+    def test_op_protocol_over_tcp(self, tmp_path):
+        """The EngineWorker op alphabet answers over a framed TCP
+        socket exactly as it does over a pipe."""
+        w = SocketWorker(_spec(tmp_path, "s0"), name="s0",
+                         timeout=180.0)
+        try:
+            assert w.request("ping") == {}
+            sub = w.request("submit", {"tokens": PROMPTS[0]})
+            assert sub["rid"] == 0
+            out = w.request("round", {})
+            assert "emitted" in out
+            scrape = w.request("scrape")
+            assert "pressure" in scrape
+            assert w.request("audit")["ok"]
+            with pytest.raises(WorkerError):
+                w.request("definitely_not_an_op")
+            assert w.alive
+        finally:
+            w.close()
+        assert not w.alive
+
+    def test_sigkill_storm_respawns_over_sockets(self, tmp_path):
+        """The acceptance rig: real worker PROCESSES over TCP, a raw
+        SIGKILL mid-stream (EOF on the socket == dead pipe ==
+        abandonment), and a supervisor respawning over the SAME
+        socket transport — back to full capacity with every stream
+        bit-identical to the single-engine run."""
+        n = 6
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], n)
+        specs = {name: _spec(tmp_path, name, snapshot_every=2)
+                 for name in ("s0", "s1")}
+        w0 = SocketWorker(specs["s0"], name="s0", timeout=180.0)
+        w1 = SocketWorker(specs["s1"], name="s1", timeout=180.0)
+        try:
+            # stream-compatible weights without a third build
+            from tests.test_router import _tsm
+            model = _tsm()
+            r = Router([w0, w1], hash_fn=_hash_fn(model),
+                       journal_path=str(tmp_path / "router.wal"),
+                       backoff_ticks=1)
+            sup = FleetSupervisor(r, specs, transport="socket",
+                                  socket_timeout=180.0)
+            rids = [r.submit(p, max_new_tokens=n)
+                    for p in PROMPTS[:2]]
+            r.step()
+            victim = r._reqs[rids[0]].worker or "s0"
+            {"s0": w0, "s1": w1}[victim].proc.kill()   # raw SIGKILL
+            ocs = _drive(r, len(rids), max_ticks=60, supervisor=sup)
+            assert r.stats.worker_deaths >= 1
+            assert {i: r.generated(rid)
+                    for i, rid in enumerate(rids)} == base
+            assert all(o.status == RequestOutcome.FINISHED
+                       for o in ocs)
+            assert sup.respawns_total == 1
+            # capacity fully restored THROUGH the socket transport:
+            # drive until the rebuilt child finishes its handshake
+            # and answers the rejoin ping
+            for _ in range(120):
+                if {ws.status
+                        for ws in r._workers.values()} == {"up"}:
+                    break
+                r.step()
+                sup.tick()
+            assert {ws.status for ws in r._workers.values()} == {"up"}
+            ev = _respawn_events(str(tmp_path / "router.wal"))
+            assert [(w, e) for w, e, _ in ev] == \
+                [(victim, "spawn"), (victim, "rejoin")]
+            # and the respawned worker is a REAL live process
+            respawned = r._workers[victim].handle
+            assert isinstance(respawned, SocketWorker)
+            assert respawned.proc.is_alive()
+            r.close()
+        finally:
+            for wk in (w0, w1):
+                try:
+                    wk.kill()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------
+# fleet observability is dark without a supervisor
+# ---------------------------------------------------------------------
+
+class TestFleetObservabilityDark:
+    def test_no_supervisor_no_fleet_series(self):
+        """A monitor over a plain engine registry grows NO fleet
+        series and can never fire capacity-degraded — the detector
+        is dark exactly when no supervisor exists."""
+        reg = MetricsRegistry()
+        reg.gauge("pool.active", 3)
+        reg.gauge("pool.usable", 10)
+        m = HealthMonitor()
+        m.bind(reg)
+        for step in range(1, 6):
+            m.on_step(step)
+        assert m.series("fleet.capacity") is None
+        assert m.series("fleet.respawns") is None
+        assert "capacity-degraded" not in m.alert_counts
+        assert all(a.kind != "capacity-degraded" for a in m.alerts)
+
+    def test_capacity_detector_hysteresis(self):
+        """Synthetic capacity trace: one dip is ONE alert, which
+        stays active through partial recovery and clears only at
+        full capacity (the _clear bound)."""
+        reg = MetricsRegistry()
+        fleet = {"workers_total": 4, "workers_live": 4, "respawns": 0}
+        reg.attach("fleet", lambda: dict(fleet))
+        m = HealthMonitor()
+        m.bind(reg)
+        m.on_step(1)
+        assert m.alert_counts.get("capacity-degraded") is None
+        fleet["workers_live"] = 2                  # 0.5 < floor
+        m.on_step(2)
+        assert m.alert_counts["capacity-degraded"] == 1
+        fleet["workers_live"] = 3                  # 0.75: not clear
+        m.on_step(3)
+        assert ("capacity-degraded", None) in m._active
+        assert m.alert_counts["capacity-degraded"] == 1   # no re-fire
+        fleet["workers_live"] = 4                  # full: clears
+        m.on_step(4)
+        assert ("capacity-degraded", None) not in m._active
+        fleet["workers_live"] = 1                  # second storm
+        m.on_step(5)
+        assert m.alert_counts["capacity-degraded"] == 2
+        assert m.report().signals["fleet.capacity"]["verdict"] == \
+            "critical"
+
+
+# ---------------------------------------------------------------------
+# the WAL doctor
+# ---------------------------------------------------------------------
+
+class TestWalDoctor:
+    def _storm_wal(self, tmp_path, stop_after=None):
+        specs, workers = _fleet(tmp_path, ("w0", "w1"),
+                                snapshot_every=2)
+        model = _model_of(workers[0])
+        inj = RouterFaultInjector(
+            kill_at={3: {"w0": "before_round"}}, seed=1)
+        wal = str(tmp_path / "router.wal")
+        r = Router(workers, hash_fn=_hash_fn(model), injector=inj,
+                   journal_path=wal, backoff_ticks=1)
+        sup = FleetSupervisor(r, specs)
+        rids = [r.submit(p, max_new_tokens=8) for p in PROMPTS]
+        if stop_after is None:
+            _drive(r, len(rids), supervisor=sup)
+        else:
+            for _ in range(stop_after):
+                r.step()
+                sup.tick()
+        r.close()
+        return wal
+
+    def test_healthy_fleet_wal_passes(self, tmp_path, capsys):
+        from tools import recovery_check
+        wal = self._storm_wal(tmp_path)
+        assert recovery_check.main(["--journal", wal]) == 0
+        out = capsys.readouterr().out
+        assert "1 respawn(s), 1 rejoin(s)" in out
+        assert "UNMATCHED" not in out
+
+    def test_unmatched_spawn_fails(self, tmp_path, capsys):
+        """A WAL that ends between the spawn and the rejoin records a
+        rebuild that never came back — the doctor flags it and exits
+        1."""
+        from tools import recovery_check
+        # tick 3 kills w0 and the supervisor respawns in the same
+        # pass; stopping right there leaves the spawn unmatched
+        wal = self._storm_wal(tmp_path, stop_after=3)
+        assert recovery_check.main(["--journal", wal]) == 1
+        assert "UNMATCHED" in capsys.readouterr().out
+
+    def test_pre_fleet_wal_is_silent(self, tmp_path, capsys):
+        """A journal with no fleet-era kinds gets NO fleet section —
+        older WALs keep their exact doctor output."""
+        from tools import recovery_check
+        specs, workers = _fleet(tmp_path, ("w0",))
+        wal = str(tmp_path / "old.wal")
+        r = Router(workers, hash_fn=_hash_fn(_model_of(workers[0])),
+                   journal_path=wal)
+        r.submit(PROMPTS[0], max_new_tokens=4)
+        _drive(r, 1, max_ticks=20)
+        r.close()
+        assert recovery_check.main(["--journal", wal]) == 0
+        out = capsys.readouterr().out
+        assert "respawn(s)" not in out
+        assert "rebalance" not in out
+        assert "resubmit" not in out
+
+    def test_rebalance_lanes_summarized(self, tmp_path, capsys):
+        from tools import recovery_check
+        pol = MigrationPolicy.for_model(
+            build_server_from_spec(
+                _spec(tmp_path, "pm")).engine.target,
+            flops_per_byte=0.0)
+        w1 = InProcWorker(_spec(tmp_path, "w1"), name="w1",
+                          role="prefill")
+        w2 = InProcWorker(_spec(tmp_path, "w2"), name="w2",
+                          role="decode")
+        wal = str(tmp_path / "router.wal")
+        r = Router([w1, w2], hash_fn=_hash_fn(_model_of(w1)),
+                   policy=pol, journal_path=wal)
+        rids = [r.submit(p, max_new_tokens=6) for p in PROMPTS]
+        _drive(r, len(rids))
+        moved = r.stats.rebalances
+        assert moved >= 1
+        r.close()
+        assert recovery_check.main(["--journal", wal]) == 0
+        out = capsys.readouterr().out
+        assert f"rebalances ({moved} policy move(s))" in out
+        assert "w1 -> w2" in out
+
+    def test_requires_snapshot_or_journal(self, capsys):
+        from tools import recovery_check
+        assert recovery_check.main([]) == 2

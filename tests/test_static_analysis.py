@@ -192,7 +192,8 @@ class TestRealTree:
                         if "snapshot" in cs.methods_of(c)
                         and "restore" in cs.methods_of(c)}
         assert {"PagedKVCache", "PagedServingEngine",
-                "SpeculativeEngine"} <= snap_classes
+                "SpeculativeEngine", "FleetSupervisor"} <= \
+            snap_classes
         jc = cs.JournalCoverage()
         kinds = {}
         for sf in files:
@@ -200,8 +201,8 @@ class TestRealTree:
         assert {"submit", "round", "release", "import_slice",
                 "set_tenant", "outcomes", "compact"} <= \
             kinds["recovery.py"]
-        assert {"submit", "emit", "tick", "delivered", "release"} <= \
-            kinds["router.py"]
+        assert {"submit", "emit", "tick", "delivered", "release",
+                "respawn", "rebalance"} <= kinds["router.py"]
         # the outcome taxonomy is discovered, members and all
         members = jc._outcome_members(files)
         assert {"FINISHED", "FAILED_OOM", "FAILED_NUMERIC",
@@ -307,6 +308,54 @@ class TestMutations:
         assert [(f.path, f.line) for f in kept] == \
             [(path, lineno(path, 'self.journal.append("release"'))]
         assert "'release'" in kept[0].msg
+
+    def test_deleted_respawn_replay_handler(self, tmp_path):
+        """The fleet WAL acceptance: a ``Router.recover`` that stops
+        replaying "respawn" records flips exit 0 -> 1, anchored at
+        the (first) write site — capacity history must never be
+        journaled-but-dropped."""
+        root, path = _mutate(
+            tmp_path, "router.py",
+            'kind == "respawn"', 'kind == "respawn_zzz"')
+        kept, _ = run(root, ["journal-coverage"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, 'self._jrec("respawn"'))]
+        assert "'respawn'" in kept[0].msg
+
+    def test_deleted_rebalance_replay_handler(self, tmp_path):
+        root, path = _mutate(
+            tmp_path, "router.py",
+            'kind == "rebalance"', 'kind == "rebalance_zzz"')
+        kept, _ = run(root, ["journal-coverage"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, 'self._jrec("rebalance"'))]
+        assert "'rebalance'" in kept[0].msg
+
+    def test_deleted_supervisor_snapshot_field(self, tmp_path):
+        """The structural snapshot pass engaged ``FleetSupervisor``
+        the day it landed: dropping one serialized control-plane
+        field (the per-worker attempt history) flips exit 0 -> 1
+        anchored at the field's mutation site."""
+        root, path = _mutate(
+            tmp_path, "fleet.py",
+            '"respawn_counts": dict(self.respawn_counts),', "")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self.respawn_counts: Dict"))]
+        assert "respawn_counts" in kept[0].msg
+
+    def test_deleted_supervisor_restore_consumption(self, tmp_path):
+        """...and the key-consumed-by-restore leg: a restore() that
+        silently drops the serialized transport flips red at the
+        serialized key."""
+        root, path = _mutate(
+            tmp_path, "fleet.py",
+            'transport=snap["transport"],', "transport='inproc',")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, '"transport": self.transport,'))]
+        assert "'transport'" in kept[0].msg
+        assert "never consumed" in kept[0].msg
 
     def test_deleted_charge_call(self, tmp_path):
         root, path = _mutate(

@@ -234,7 +234,6 @@ SNAPSHOT_ATTR_ALLOW: Dict[str, Dict[str, str]] = {
                          "target's mesh supplies its own "
                          "(restore(shard_devices=...); the payload "
                          "is canonical full-head pages either way)",
-        "_block_hash": "inverse of hash_index — rebuilt by restore()",
         "_audit_fp": "content-audit memo — re-fingerprinted on demand",
         "views": "derived per-layer views over the live pool",
         "_bt_cached": "device block-table mirror — _tables_dirty()",
@@ -268,6 +267,21 @@ SNAPSHOT_ATTR_ALLOW: Dict[str, Dict[str, str]] = {
         "_draft_lens": "derived — draft rebuild recomputes them",
         "max_batch": "restored from the wrapped engine's config "
                      "section (single source of truth)",
+    },
+    "FleetSupervisor": {
+        "router": "live wiring — restore() takes the (recovered) "
+                  "router as an argument, it is not serializable "
+                  "state",
+        "registry": "live wiring — gauges are attach()ed closures "
+                    "over the router; a restored supervisor "
+                    "re-attaches to a fresh/supplied registry",
+        "monitor": "live wiring — monitor state is DERIVED, never "
+                   "snapshotted (the recovery contract monitor.py "
+                   "documents); restore() rebinds a supplied one",
+        "_checkpoints": "in-memory page archive — re-seeded from the "
+                        "next full checkpoint after a restore (the "
+                        "workers' own snapshot files are the durable "
+                        "copy; byte counters DO round-trip)",
     },
 }
 
@@ -320,7 +334,9 @@ class SnapshotCompleteness:
             frontier = nxt
         return reads
 
-    def _collect_dict(self, d: ast.Dict, out: Dict[str, int]) -> None:
+    def _collect_dict(self, d: ast.Dict, out: Dict[str, int],
+                      dict_vars: Optional[Dict[str, ast.Dict]] = None,
+                      ) -> None:
         for k, v in zip(d.keys, d.values):
             if k is None:
                 # ``**({...} if cond else {})`` merge: the starred
@@ -338,13 +354,20 @@ class SnapshotCompleteness:
             out.setdefault(k.value, k.lineno)
             # only the named sections are key-checked one level down:
             # a new config/geometry knob MUST be consumed by restore,
-            # while other nested records may be consumed wholesale
-            if k.value in SNAPSHOT_KEY_SECTIONS and \
-                    isinstance(v, ast.Dict):
-                for kk in v.keys:
-                    if isinstance(kk, ast.Constant) and \
-                            isinstance(kk.value, str):
-                        out.setdefault(kk.value, kk.lineno)
+            # while other nested records may be consumed wholesale.
+            # A section staged in a local (``geometry = {...}`` then
+            # ``"geometry": geometry``) is followed to its literal —
+            # snapshot() building the section early (e.g. to compare
+            # against a delta base) must not vacate the key check.
+            if k.value in SNAPSHOT_KEY_SECTIONS:
+                if isinstance(v, ast.Name) and dict_vars and \
+                        v.id in dict_vars:
+                    v = dict_vars[v.id]
+                if isinstance(v, ast.Dict):
+                    for kk in v.keys:
+                        if isinstance(kk, ast.Constant) and \
+                                isinstance(kk.value, str):
+                            out.setdefault(kk.value, kk.lineno)
 
     def _snapshot_keys(self, func: ast.AST) -> Dict[str, int]:
         """{key: line} for the snapshot RETURN dict's literal keys
@@ -371,11 +394,12 @@ class SnapshotCompleteness:
             if not isinstance(n, ast.Return) or n.value is None:
                 continue
             if isinstance(n.value, ast.Dict):
-                self._collect_dict(n.value, out)
+                self._collect_dict(n.value, out, dict_vars)
             elif isinstance(n.value, ast.Name):
                 name = n.value.id
                 if name in dict_vars:
-                    self._collect_dict(dict_vars[name], out)
+                    self._collect_dict(dict_vars[name], out,
+                                       dict_vars)
                 for k, ln in sub_keys.get(name, {}).items():
                     out.setdefault(k, ln)
         return out

@@ -6,14 +6,27 @@ model weights (a snapshot holds serving state, not parameters).
 Usage:
   python tools/recovery_check.py SNAPSHOT [--journal REQ.WAL]
                                  [--num-blocks N]
+  python tools/recovery_check.py --journal ROUTER.WAL
 
 Accepts any snapshot the stack writes: a ``RecoverableServer``
 checkpoint, a bare ``SpeculativeEngine``/``PagedServingEngine``
 snapshot, or a raw ``PagedKVCache`` one — it walks the nesting down to
 the pool either way. ``--num-blocks`` dry-runs the
 restore-into-a-different-pool path (rehoming succeeds or prints the
-precise BlockOOM a real recovery would raise). Exit status: 0 clean,
-1 audit/restore failure, 2 unreadable snapshot.
+precise BlockOOM a real recovery would raise).
+
+A journal may also be audited ALONE (the second form): the router has
+no snapshot — its WAL is the durable state — so the doctor reads the
+record stream directly. Journals carrying fleet lifecycle records
+("respawn"/"rebalance", PR 16+) get a fleet section: policy rebalances
+per src->dst lane, the non-terminal streams a ``Router.recover`` would
+resubmit, and per-worker spawn/rejoin pairing — a WAL whose LAST
+respawn event for some worker is a "spawn" with no later "rejoin"
+records a rebuild that never rejoined (crash-loop, lost ping) and
+fails the check. Pre-fleet journals print no fleet section at all.
+
+Exit status: 0 clean, 1 audit/restore failure or unmatched respawn,
+2 unreadable snapshot / bad invocation.
 """
 from __future__ import annotations
 
@@ -124,14 +137,72 @@ def _tenant_summary(eng_snap: dict, cache_snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _fleet_journal_summary(recs, kinds) -> int:
+    """Fleet-era WAL section (router/supervisor lifecycle): rebalance
+    lanes, would-resubmit streams, and respawn spawn<->rejoin pairing.
+    Returns the section's exit contribution (1 = a worker's last
+    respawn event is an unmatched "spawn"). Callers gate on the fleet
+    kinds being present — pre-fleet journals never reach here."""
+    lanes = {}
+    events = {}                 # worker -> ordered respawn events
+    terminal = set()
+    submitted = []
+    for _seq, kind, p in recs:
+        if kind == "submit":
+            submitted.append(p["rid"])
+        elif kind == "delivered":
+            terminal.update(rid for rid, _status in p["rids"])
+        elif kind == "release":
+            terminal.add(p["rid"])
+        elif kind == "rebalance":
+            lane = (p["src"], p["dst"])
+            lanes[lane] = lanes.get(lane, 0) + 1
+        elif kind == "respawn":
+            events.setdefault(p["worker"], []).append(
+                (p.get("event"), p.get("tick")))
+    if lanes:
+        print(f"  rebalances ({sum(lanes.values())} policy move(s)):")
+        for (src, dst), n in sorted(lanes.items()):
+            print(f"    {src} -> {dst}: {n}")
+    open_rids = [rid for rid in submitted if rid not in terminal]
+    print(f"  streams: {len(submitted)} submitted, "
+          f"{len(terminal & set(submitted))} terminal, "
+          f"{len(open_rids)} would resubmit on recover"
+          + (f" (rids {open_rids})" if open_rids else ""))
+    rc = 0
+    for worker in sorted(events):
+        evs = events[worker]
+        spawns = sum(1 for e, _ in evs if e == "spawn")
+        rejoins = sum(1 for e, _ in evs if e == "rejoin")
+        line = (f"  worker {worker!r}: {spawns} respawn(s), "
+                f"{rejoins} rejoin(s)")
+        if evs[-1][0] == "spawn":
+            print(line + f" — UNMATCHED: last respawn (tick "
+                         f"{evs[-1][1]}) never rejoined (crash-loop "
+                         f"or lost ping; the rebuilt worker is not "
+                         f"serving)")
+            rc = 1
+        else:
+            print(line)
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="audit a serving snapshot (+ journal) offline")
-    ap.add_argument("snapshot")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="serving snapshot (optional when --journal "
+                         "is given: a router WAL has no snapshot)")
     ap.add_argument("--journal", default=None)
     ap.add_argument("--num-blocks", type=int, default=None,
                     help="dry-run rehoming the pool into this size")
     args = ap.parse_args(argv)
+
+    if args.snapshot is None and not args.journal:
+        ap.print_usage(sys.stderr)
+        print("recovery_check: need a SNAPSHOT, a --journal, or both",
+              file=sys.stderr)
+        return 2
 
     if sys.flags.optimize:
         # the deep audit is assert-based; under -O / PYTHONOPTIMIZE
@@ -145,71 +216,80 @@ def main(argv=None) -> int:
     from paddle_tpu.inference.recovery import (SnapshotVersionError,
                                                load_snapshot,
                                                read_journal)
-    try:
-        snap = load_snapshot(args.snapshot)
-        cache_snap, eng_snap, spec_snap = _unwrap(snap)
-    except (SnapshotVersionError, ValueError, OSError) as e:
-        print(f"UNREADABLE: {e}")
-        return 2
+    snap = None
+    if args.snapshot is not None:
+        try:
+            snap = load_snapshot(args.snapshot)
+            cache_snap, eng_snap, spec_snap = _unwrap(snap)
+        except (SnapshotVersionError, ValueError, OSError) as e:
+            print(f"UNREADABLE: {e}")
+            return 2
 
-    from paddle_tpu.inference.paged_cache import BlockOOM, PagedKVCache
-    g = cache_snap["geometry"]
-    print(f"snapshot {args.snapshot}: kind={snap.get('kind')}, pool "
-          f"{g['num_blocks']} x {g['block_size']}-token blocks, "
-          f"{g['num_layers']} layers, prefix_cache={g['prefix_cache']}")
-    try:
-        # the audit pool rebuilds at mp=1 (logical shards would only
-        # slow the doctor; the payload is canonical either way) — the
-        # source's mesh width is reported from the geometry below
-        cache = PagedKVCache.restore(cache_snap,
-                                     num_blocks=args.num_blocks, mp=1)
-        print("deep audit: OK (check_invariants(deep=True) passed on "
-              "restore)")
-    except BlockOOM as e:
-        print(f"REHOME FAILED: {e}")
-        return 1
-    except AssertionError as e:
-        print(f"AUDIT FAILED: {e}")
-        return 1
-    src_mp = int(g.get("mp", 1))
-    if src_mp > 1:
-        # HONEST per-shard bytes: the payload divides over the mesh,
-        # the metadata replicates — a reader must not multiply one
-        # worker's report by the fleet and call it HBM
-        total = cache.pool_bytes_total()
-        print(f"  tensor-parallel source: mp={src_mp} shards, "
-              f"{total // src_mp} pool bytes per shard "
-              f"({total} across the mesh; allocator/table metadata "
-              f"replicated on every shard)")
-    print(f"pool occupancy{cache._pool_context()}")
-    print(f"  hash index: {len(cache._hash_to_block)} chained block "
-          f"hash(es)")
+        from paddle_tpu.inference.paged_cache import (BlockOOM,
+                                                      PagedKVCache)
+        g = cache_snap["geometry"]
+        print(f"snapshot {args.snapshot}: kind={snap.get('kind')}, "
+              f"pool {g['num_blocks']} x {g['block_size']}-token "
+              f"blocks, {g['num_layers']} layers, "
+              f"prefix_cache={g['prefix_cache']}")
+        try:
+            # the audit pool rebuilds at mp=1 (logical shards would
+            # only slow the doctor; the payload is canonical either
+            # way) — the source's mesh width is reported below
+            cache = PagedKVCache.restore(
+                cache_snap, num_blocks=args.num_blocks, mp=1)
+            print("deep audit: OK (check_invariants(deep=True) "
+                  "passed on restore)")
+        except BlockOOM as e:
+            print(f"REHOME FAILED: {e}")
+            return 1
+        except AssertionError as e:
+            print(f"AUDIT FAILED: {e}")
+            return 1
+        src_mp = int(g.get("mp", 1))
+        if src_mp > 1:
+            # HONEST per-shard bytes: the payload divides over the
+            # mesh, the metadata replicates — a reader must not
+            # multiply one worker's report by the fleet, call it HBM
+            total = cache.pool_bytes_total()
+            print(f"  tensor-parallel source: mp={src_mp} shards, "
+                  f"{total // src_mp} pool bytes per shard "
+                  f"({total} across the mesh; allocator/table "
+                  f"metadata replicated on every shard)")
+        print(f"pool occupancy{cache._pool_context()}")
+        print(f"  hash index: {len(cache._hash_to_block)} chained "
+              f"block hash(es)")
 
-    if eng_snap is not None:
-        print(_engine_summary(eng_snap))
-        tsum = _tenant_summary(eng_snap, cache_snap)
-        if tsum:
-            print(tsum)
-    if spec_snap is not None:
-        st = spec_snap["stats"]
-        print(f"  speculative: k={spec_snap['config']['k']}, "
-              f"{len(spec_snap['seqs'])} tracked stream(s), "
-              f"emitted {st['emitted']}, dirty draft slots "
-              f"{spec_snap['draft_dirty']}")
+        if eng_snap is not None:
+            print(_engine_summary(eng_snap))
+            tsum = _tenant_summary(eng_snap, cache_snap)
+            if tsum:
+                print(tsum)
+        if spec_snap is not None:
+            st = spec_snap["stats"]
+            print(f"  speculative: k={spec_snap['config']['k']}, "
+                  f"{len(spec_snap['seqs'])} tracked stream(s), "
+                  f"emitted {st['emitted']}, dirty draft slots "
+                  f"{spec_snap['draft_dirty']}")
 
+    rc = 0
     if args.journal:
         recs = read_journal(args.journal)
         kinds = {}
         for _, kind, _p in recs:
             kinds[kind] = kinds.get(kind, 0) + 1
-        covered = snap.get("journal_seq")
+        covered = snap.get("journal_seq") if snap is not None else None
         print(f"journal {args.journal}: {len(recs)} record(s) "
               f"{kinds or '{}'}, last seq "
               f"{recs[-1][0] if recs else 0}"
               + (f", snapshot covers seq <= {covered} "
                  f"({sum(1 for s, _, _ in recs if s > covered)} to "
                  f"replay)" if covered is not None else ""))
-    return 0
+        if "respawn" in kinds or "rebalance" in kinds:
+            # fleet-era WAL (PR 16+): pre-fleet journals carry
+            # neither kind and print no fleet section at all
+            rc = max(rc, _fleet_journal_summary(recs, kinds))
+    return rc
 
 
 if __name__ == "__main__":
