@@ -2875,32 +2875,44 @@ def _sharded_tsm(dim, heads, ffn, layers, vocab, seed=0):
                              lm_head=np.roll(emb, -1, 0).T.copy())
 
 
-def _sharded_run(cfg, mp):
+def _sharded_run(cfg, mp, compiled_step=False, warmup=False):
     """One serving run of the sharded-bench workload (token-budget
     mixed steps over the paged engine) at mesh width ``mp``; returns
-    streams + the contract counters."""
+    streams + the contract counters. ``compiled_step`` selects the
+    one-jitted-shard_map-program-per-step path (False keeps the
+    host-staged legacy protocol this bench historically measured);
+    ``warmup`` runs the whole workload once untimed first, so the
+    timed pass measures steady-state dispatch rather than tracing —
+    the compiled path's programs live in the runner's cache across
+    engines on the same sharded core."""
     from paddle_tpu.inference import SpeculativeEngine
     tsm = _sharded_tsm(cfg["dim"], cfg["heads"], cfg["ffn"],
                        cfg["layers"], cfg["vocab"])
     if mp > 1:
-        tsm = tsm.shard(mp)
-    eng = SpeculativeEngine(
-        tsm, k=0, max_batch=cfg["n_req"], block_size=cfg["block"],
-        num_blocks=cfg["num_blocks"], prefix_cache=True,
-        prefill_token_budget=cfg["budget"])
+        tsm = tsm.shard(mp, compiled_step=compiled_step)
     rng = np.random.RandomState(7)
     prompts = [[int(t) for t in rng.randint(0, cfg["vocab"],
                                             cfg["prompt_len"])]
                for _ in range(cfg["n_req"])]
-    rids = [eng.submit(p) for p in prompts]
-    steps = 0
-    t0 = time.perf_counter()
-    while min(len(eng.generated(r)) for r in rids) < cfg["gen"]:
-        eng.step()
-        steps += 1
-        if steps > 40 * cfg["gen"]:
-            raise RuntimeError("sharded bench failed to converge")
-    wall = time.perf_counter() - t0
+
+    def _one():
+        eng = SpeculativeEngine(
+            tsm, k=0, max_batch=cfg["n_req"], block_size=cfg["block"],
+            num_blocks=cfg["num_blocks"], prefix_cache=True,
+            prefill_token_budget=cfg["budget"])
+        rids = [eng.submit(p) for p in prompts]
+        steps = 0
+        t0 = time.perf_counter()
+        while min(len(eng.generated(r)) for r in rids) < cfg["gen"]:
+            eng.step()
+            steps += 1
+            if steps > 40 * cfg["gen"]:
+                raise RuntimeError("sharded bench failed to converge")
+        return eng, rids, steps, time.perf_counter() - t0
+
+    if warmup:
+        _one()
+    eng, rids, steps, wall = _one()
     streams = {str(i): [int(t) for t in eng.tokens(r)]
                for i, r in enumerate(rids)}
     # token count captured BEFORE the contract step below: that extra
@@ -2933,6 +2945,7 @@ def _sharded_run(cfg, mp):
         out["distinct_shard_devices"] = len(
             set(tsm.core.shard_devices))
         out["qkv_shard"] = tsm.core.qkv_shard
+        out["sharded_metrics"] = tsm.core.sharded_metrics()
     eng.check_invariants()
     return out
 
@@ -3097,6 +3110,164 @@ def bench_serving_sharded(smoke=False):
     }
 
 
+# --------------------------------------------- serving_sharded_compiled
+def _sharded_compiled_worker_main(cfg_path, out_path):
+    """Subprocess entry (--sharded-compiled-worker): THREE legs in ONE
+    forced-2-device process — the mp=1 oracle, mp=2 HOST-STAGED
+    (compiled_step=False: the per-shard eager loop with num_layers
+    device_put all-reduces per step), and mp=2 COMPILED (one jitted
+    shard_map program per step, per-layer psums inside the program).
+    Same client and same deterministic weights for all three, with the
+    mp=1 self-determinism guard of _sharded_worker_main; every leg
+    runs the workload once untimed first so the timed pass compares
+    steady-state dispatch, not tracing."""
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    from paddle_tpu.parallel.mesh import build_mesh
+    import jax
+    if len(jax.devices()) >= cfg["mp"]:
+        build_mesh(dp=1, mp=cfg["mp"])
+    prev = _sharded_run(cfg, 1, warmup=True)
+    mp1 = None
+    for _ in range(3):
+        cur = _sharded_run(cfg, 1, warmup=True)
+        if cur["streams"] == prev["streams"]:
+            mp1 = cur
+            break
+        prev = cur
+    if mp1 is None:
+        raise RuntimeError(
+            "single-chip baseline is not self-deterministic at "
+            "these dims on this host — the bit-identity comparison "
+            "is void here")
+    res = {"mp1": mp1,
+           "mp2_staged": _sharded_run(cfg, cfg["mp"],
+                                      compiled_step=False,
+                                      warmup=True),
+           "mp2_compiled": _sharded_run(cfg, cfg["mp"],
+                                        compiled_step=True,
+                                        warmup=True)}
+    with open(out_path, "w") as f:
+        json.dump(res, f)
+
+
+def bench_serving_sharded_compiled(smoke=False):
+    """Compiled collectives: ONE jitted shard_map program per sharded
+    serving step vs the host-staged legacy loop vs the single chip,
+    SAME workload as serving_sharded (token-budget mixed steps,
+    prefix cache on), all three legs in one forced-2-device
+    subprocess:
+
+      mp1           single-chip run — the stream oracle
+      mp2_staged    legacy ShardedServingCore: per-shard eager loop,
+                    num_layers host-staged all-reduces per step
+      mp2_compiled  the compiled path: pools donated to one jitted
+                    program, exactly num_layers psums INSIDE it,
+                    one dispatch per engine step
+
+    Headlines asserted in-bench: BOTH mp=2 legs bit-identical to the
+    oracle; the staged leg keeps its num_layers-all-reduces-per-step
+    contract while the compiled leg never calls _allreduce at all
+    (its collectives live in the program: psums_per_call ==
+    num_layers, dispatches_per_step == 1, retraces bounded by the
+    bucket count). CPU proves protocol + bit-identity + dispatch-count
+    economics; collective bandwidth needs the TPU leg (ROADMAP)."""
+    import os
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    smoke = smoke or _SMOKE
+    if smoke:
+        dim, heads, ffn, layers = 32, 4, 64, 2
+        vocab, n_req, gen = 50, 3, 8
+    else:
+        # dim 64: widest reliably self-deterministic single-chip
+        # config on this host's XLA CPU (see bench_serving_sharded)
+        dim, heads, ffn, layers = 64, 8, 256, 2
+        vocab, n_req, gen = 512, 6, 24
+    block, prompt_len, budget = 4, 8, 8
+    mbps = -(-(prompt_len + gen + 6) // block) + 1
+    cfg = dict(dim=dim, heads=heads, ffn=ffn, layers=layers,
+               vocab=vocab, n_req=n_req, gen=gen, block=block,
+               prompt_len=prompt_len, budget=budget, mp=2,
+               num_blocks=n_req * mbps + 8)
+
+    d = tempfile.mkdtemp(prefix="pt_sharded_compiled_bench_")
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2 "
+                         "--xla_cpu_parallel_codegen_split_count=1",
+               JAX_PLATFORMS="cpu")
+    cfg_path, out_path = f"{d}/cfg.json", f"{d}/legs.json"
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    proc = subprocess.run(
+        [_sys.executable, os.path.abspath(__file__),
+         "--sharded-compiled-worker", cfg_path, out_path],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0 or not os.path.exists(out_path):
+        raise RuntimeError(
+            f"sharded compiled subprocess failed (exit "
+            f"{proc.returncode}): {proc.stderr[-800:]}")
+    with open(out_path) as f:
+        legs = json.load(f)
+    mp1, mps, mpc = legs["mp1"], legs["mp2_staged"], \
+        legs["mp2_compiled"]
+
+    # the headline guarantees, asserted at bench scale
+    assert mpc["jax_devices"] >= 2, mpc
+    assert mpc["distinct_shard_devices"] == 2, mpc
+    identical = (mpc["streams"] == mp1["streams"]
+                 and mps["streams"] == mp1["streams"])
+    assert identical, "sharded streams diverged from single-chip"
+    assert mpc["pool_bytes_per_shard"] * 2 == mp1["pool_bytes_total"]
+    # staged leg: the legacy contract is untouched
+    assert mps["allreduces_one_mixed_step"] == layers, mps
+    assert not mps["sharded_metrics"]["compiled"], mps
+    # compiled leg: collectives live INSIDE the one program
+    cm = mpc["sharded_metrics"]
+    assert mpc["allreduces_one_mixed_step"] == 0, mpc
+    assert cm["compiled"] and cm["allreduce_count"] == 0, cm
+    assert cm["dispatches_per_step"] == 1, cm
+    assert cm["psums_per_call"] == layers, cm
+    assert cm["retraces"] <= 16, cm
+
+    return {
+        "metric": "serving_sharded_compiled_collectives",
+        "config": {k: cfg[k] for k in ("dim", "heads", "ffn",
+                                       "layers", "vocab", "n_req",
+                                       "gen", "num_blocks")},
+        "mp1": {k: mp1[k] for k in ("tokens_per_sec",
+                                    "engine_steps")},
+        "mp2_staged": {
+            "tokens_per_sec": mps["tokens_per_sec"],
+            "allreduces_per_mixed_step":
+                mps["allreduces_one_mixed_step"],
+        },
+        "mp2_compiled": {
+            "tokens_per_sec": mpc["tokens_per_sec"],
+            "jax_devices": mpc["jax_devices"],
+            "distinct_shard_devices": mpc["distinct_shard_devices"],
+            **{k: cm[k] for k in ("jit_calls", "retraces",
+                                  "dispatches_per_step",
+                                  "psums_per_call")},
+        },
+        "streams_bit_identical": bool(identical),
+        "pool_bytes_per_shard_ratio": round(
+            mpc["pool_bytes_per_shard"]
+            / mp1["pool_bytes_per_shard"], 3),
+        "num_layers": layers,
+        "relative_tokens_per_sec": round(
+            mpc["tokens_per_sec"] / mp1["tokens_per_sec"], 3),
+        "speedup_vs_host_staged": round(
+            mpc["tokens_per_sec"] / mps["tokens_per_sec"], 3),
+        "note": ("CPU mesh proves protocol + bit-identity + the "
+                 "one-dispatch-per-step economics; collective "
+                 "bandwidth needs the TPU leg"),
+    }
+
+
 BENCHES = {
     "resnet50_cifar": bench_resnet50,
     "bert_base_static": bench_bert_static,
@@ -3114,6 +3285,7 @@ BENCHES = {
     "serving_router": bench_serving_router,
     "serving_fleet": bench_serving_fleet,
     "serving_sharded": bench_serving_sharded,
+    "serving_sharded_compiled": bench_serving_sharded_compiled,
     "serving_obs": bench_serving_obs,
     "serving_monitor": bench_serving_monitor,
     "serving_cost": bench_serving_cost,
@@ -3129,6 +3301,11 @@ def main():
         # mp=2 mesh child of bench_serving_sharded (its env carries
         # the forced device count — jax must load fresh here)
         _sharded_worker_main(_sys.argv[2], _sys.argv[3])
+        return
+    if len(_sys.argv) >= 4 and \
+            _sys.argv[1] == "--sharded-compiled-worker":
+        # three-leg mesh child of bench_serving_sharded_compiled
+        _sharded_compiled_worker_main(_sys.argv[2], _sys.argv[3])
         return
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None)

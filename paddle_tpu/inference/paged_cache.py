@@ -809,7 +809,8 @@ class _RaggedLayout:
     the decode mask first."""
 
     __slots__ = ("segs", "q_lens", "blk", "off", "kv_lens", "bt_all",
-                 "tile_q", "tile_kv", "total_rows")
+                 "tile_q", "tile_kv", "total_rows", "blk_np",
+                 "off_np")
 
     def __init__(self, cache: "PagedKVCache", segments, tile_q=None,
                  tile_kv=None):
@@ -882,8 +883,14 @@ class _RaggedLayout:
                 raise ValueError(f"unknown ragged segment kind {kind!r}")
         self.total_rows = lo
         self.q_lens = tuple(q_lens)
-        self.blk = Tensor(jnp.asarray(np.concatenate(blk), jnp.int32))
-        self.off = Tensor(jnp.asarray(np.concatenate(off), jnp.int32))
+        # host copies of the scatter routing, kept for the compiled
+        # sharded step: it re-packs them with bucket-pad rows (routed
+        # to the trash block) BEFORE feeding them in as operands, so
+        # the padding never touches device data
+        self.blk_np = np.concatenate(blk).astype(np.int32)
+        self.off_np = np.concatenate(off).astype(np.int32)
+        self.blk = Tensor(jnp.asarray(self.blk_np))
+        self.off = Tensor(jnp.asarray(self.off_np))
         self.kv_lens = Tensor(jnp.asarray(kv_lens, jnp.int32))
         self.bt_all = Tensor(jnp.asarray(np.stack(bt_rows), jnp.int32))
         self.tile_q = tile_q
@@ -1245,6 +1252,32 @@ class PagedKVCache:
         """Index of (layer, shard)'s entry in the flat ``pools`` /
         ``scales`` lists."""
         return layer * self.mp + shard
+
+    def rebind_shard_pools(self, layer: int, global_pool,
+                           global_scales=None) -> None:
+        """Rebind this layer's per-shard pool entries from a GLOBAL
+        head-sharded array (the compiled step's donated output on the
+        serving ``Mesh(("mp",))``). Zero-copy both directions: the
+        global array's addressable shards ARE per-device buffers, so
+        unwrapping them back into the flat ``pools`` list hands every
+        eager path between compiled calls (COW block splits, prefill
+        scatters, snapshot/export readback) ordinary committed
+        per-shard arrays — the device-resident pool protocol with
+        host readback only at those boundaries. MUST run immediately
+        after the compiled call: donation invalidated the previous
+        buffers. Shards sort by their head-axis slice start so entry
+        ``pool_index(layer, s)`` always holds heads [s*Hs, (s+1)*Hs).
+        """
+        shards = sorted(global_pool.addressable_shards,
+                        key=lambda sh: sh.index[2].start or 0)
+        for s, sh in enumerate(shards):
+            self.pools[self.pool_index(layer, s)] = Tensor(sh.data)
+        if global_scales is not None:
+            sshards = sorted(global_scales.addressable_shards,
+                             key=lambda sh: sh.index[2].start or 0)
+            for s, sh in enumerate(sshards):
+                self.scales[self.pool_index(layer, s)] = \
+                    Tensor(sh.data)
 
     @property
     def capacity_per_seq(self) -> int:

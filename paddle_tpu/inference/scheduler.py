@@ -484,6 +484,11 @@ class PagedServingEngine:
             lambda: dict(self.cache.pool_occupancy(tiers_only=True),
                          peak=self.cache.peak_blocks_used))
         self.registry.attach("queue", self._queue_gauges)
+        # sharded cores export their dispatch instrumentation (jit
+        # calls, retraces, psums per call) next to allreduce_count —
+        # the monitor's recompile-storm alert surface
+        if hasattr(model, "sharded_metrics"):
+            self.registry.attach("sharded", model.sharded_metrics)
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,
@@ -1546,6 +1551,11 @@ class PagedServingEngine:
         if not self.ragged_step or self.prefill_token_budget is None:
             return False
         if self.ragged_step == "force":
+            return True
+        # a compiled sharded core amortizes best when the whole mixed
+        # batch rides its ONE jitted packed program — take the ragged
+        # plan whenever it's legal, kernel or not
+        if getattr(self.model, "prefers_packed_step", False):
             return True
         from ..incubate.nn.fused_transformer import _use_decode_kernel
         return _use_decode_kernel()

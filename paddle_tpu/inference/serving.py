@@ -257,6 +257,25 @@ def _make_pad_heads(shard: int, heads_per_shard: int, num_heads: int):
     return mp_pad_heads
 
 
+def _uncommitted(arr):
+    """Rebind a (possibly committed) jax array as UNCOMMITTED without
+    leaving the device: downstream ops stay free to colocate with the
+    next committed operand they meet instead of dragging everything to
+    this array's device. The ArrayImpl rewrap is a zero-copy metadata
+    op (same buffers, committed=False); if a jax upgrade moves the
+    class, fall back to the legacy host round-trip rather than break
+    serving."""
+    try:
+        from jax._src.array import ArrayImpl
+        return ArrayImpl(arr.aval, arr.sharding,
+                         [s.data for s in arr.addressable_shards],
+                         committed=False)
+    except Exception:  # pragma: no cover - jax-internal API drift
+        import jax.numpy as jnp
+        # survival fallback only — reached iff ArrayImpl moved
+        return jnp.asarray(np.asarray(arr))  # lint: ok(compiled-step-purity)
+
+
 class ShardedServingCore:
     """Tensor-parallel (head-sharded) serving twin of a
     FusedMultiTransformer core — the model half of sharded paged
@@ -316,7 +335,8 @@ class ShardedServingCore:
     ``quantize_weights``): shard after the weights are final."""
 
     def __init__(self, base, mp: int, devices=None,
-                 qkv_shard: str = "auto"):
+                 qkv_shard: str = "auto", compiled_step="auto",
+                 out_shard: str = "auto"):
         import jax
         import jax.numpy as jnp
         if getattr(base, "_quantized", False):
@@ -340,15 +360,15 @@ class ShardedServingCore:
                              f"{len(devices)}")
         self.shard_devices = list(devices[:self.mp])
         self._distinct = len(set(self.shard_devices)) > 1
+        try:
+            on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:  # pragma: no cover
+            on_tpu = False
         if qkv_shard == "auto":
             # the house rule (PR 10's ragged_step precedent): the
             # memory-sharded executable engages where it wins (TPU);
             # the CPU proof path keeps the decomposition that is
             # bitwise exact at every width (see class docstring)
-            try:
-                on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-            except Exception:  # pragma: no cover
-                on_tpu = False
             qkv_shard = "weights" if on_tpu else "activations"
         if qkv_shard not in ("weights", "activations"):
             raise ValueError(f"qkv_shard must be 'auto' | 'weights' |"
@@ -393,6 +413,34 @@ class ShardedServingCore:
         # acceptance counter: ONE all-reduce per layer per model call
         # on the sharded path (mp > 1); reset freely from tests
         self.allreduce_count = 0
+        # -- compiled step (one jitted shard_map program per call) ----
+        if out_shard == "auto":
+            # rows = the true Megatron second GEMM (K-split partial
+            # sums) — exact only where the GEMM is column-stable
+            # (TPU); the CPU proof path psums the zero-padded head
+            # sums and runs the out projection replicated, bitwise
+            # the single-chip executable
+            out_shard = "rows" if on_tpu else "replicated"
+        if out_shard not in ("rows", "replicated"):
+            raise ValueError(f"out_shard must be 'auto' | 'rows' | "
+                             f"'replicated', got {out_shard!r}")
+        self.out_shard = out_shard
+        fully_distinct = len(set(self.shard_devices)) == self.mp
+        if compiled_step == "auto":
+            compiled_step = self.mp > 1 and fully_distinct
+        if compiled_step not in (True, False):
+            raise ValueError(f"compiled_step must be 'auto' | True | "
+                             f"False, got {compiled_step!r}")
+        if compiled_step and (self.mp < 2 or not fully_distinct):
+            raise ValueError(
+                "compiled_step=True needs mp >= 2 distinct shard "
+                "devices (a real Mesh); logical same-device shards "
+                "serve on the legacy host-staged path")
+        self.compiled_step = compiled_step
+        self._compiled = None
+        if compiled_step:
+            from .compiled_step import CompiledStepRunner
+            self._compiled = CompiledStepRunner(self)
 
     # -- geometry delegation (the protocol surface engines read) ------
     @property
@@ -445,6 +493,29 @@ class ShardedServingCore:
     def reset_allreduce_count(self) -> None:
         self.allreduce_count = 0
 
+    @property
+    def prefers_packed_step(self) -> bool:
+        """Scheduler hint: the compiled step amortizes best when the
+        whole mixed batch rides ONE packed ragged program, so the
+        scheduler should take the ragged plan whenever it's legal
+        rather than only when per-slot staging would be slower."""
+        return self._compiled is not None
+
+    def sharded_metrics(self) -> dict:
+        """MetricsRegistry source (attached as ``sharded.*`` by the
+        scheduler): dispatch-count instrumentation for the compiled
+        step next to the legacy all-reduce counter. A recompile storm
+        shows up as ``retraces`` growing past the bucket count."""
+        out = {"allreduce_count": self.allreduce_count,
+               "mp": self.mp,
+               "compiled": 1 if self._compiled is not None else 0}
+        if self._compiled is not None:
+            out.update(self._compiled.metrics())
+        else:
+            out.update({"jit_calls": 0, "retraces": 0,
+                        "dispatches_per_step": 0, "psums_per_call": 0})
+        return out
+
     def _allreduce(self, parts: List[Tensor]) -> Tensor:
         """THE one collective per layer: sum the shards' zero-padded
         head contributions (disjoint support -> exact reconstruction,
@@ -460,14 +531,20 @@ class ShardedServingCore:
         total = parts[0]
         if self._distinct:
             import jax
-            import jax.numpy as jnp
             d0 = self.shard_devices[0]
             for p in parts[1:]:
-                total = total + Tensor(jax.device_put(p.data, d0))
+                # the legacy collective IS a transfer: host-staged
+                # reduce-to-shard-0 — the compiled path replaces it
+                # with an in-program psum
+                total = total + Tensor(
+                    jax.device_put(p.data, d0))  # lint: ok(compiled-step-purity)
             # uncommitted replicated result: the out/ffn/ln ops that
             # consume it stay free to colocate with the NEXT
-            # committed operand they meet (each shard's qkv slice)
-            return Tensor(jnp.asarray(np.asarray(total.data)))
+            # committed operand they meet (each shard's qkv slice).
+            # The rebind stays ON DEVICE — the old np.asarray round-
+            # trip was the per-layer host pull the compiled step
+            # exists to kill; the legacy path shouldn't pay it either
+            return Tensor(_uncommitted(total.data))
         for p in parts[1:]:
             total = total + p
         return total
@@ -499,6 +576,10 @@ class ShardedServingCore:
                 f"{getattr(cache_mp, 'mp', '?')} != model mp "
                 f"{self.mp} — build the pool via "
                 f"PagedKVCache.for_model(sharded_core, ...)")
+        if self._compiled is not None:
+            res = self._compiled.forward(src, caches, time_step)
+            if res is not None:
+                return res
         x = src
         b, l = x.shape[0], x.shape[1]
         E, Hs, hd = self.embed_dim, self.heads_per_shard, self.head_dim

@@ -216,8 +216,9 @@ class TokenServingModel:
         return toks.reshape(p.shape[:-1]), p
 
     # -- tensor-parallel construction ---------------------------------
-    def shard(self, mp: int, devices=None,
-              qkv_shard: str = "auto") -> "TokenServingModel":
+    def shard(self, mp: int, devices=None, qkv_shard: str = "auto",
+              compiled_step="auto",
+              out_shard: str = "auto") -> "TokenServingModel":
         """Head-sharded tensor-parallel twin of this serving surface
         (inference/serving.py ShardedServingCore): the CORE's qkv
         projections split by head over ``mp`` mesh shards and each
@@ -238,7 +239,9 @@ class TokenServingModel:
             else self.core
         return TokenServingModel(
             ShardedServingCore(core, mp, devices=devices,
-                               qkv_shard=qkv_shard),
+                               qkv_shard=qkv_shard,
+                               compiled_step=compiled_step,
+                               out_shard=out_shard),
             self._embed_np, self.lm_head,
             weight_dtype=self.weight_dtype)
 

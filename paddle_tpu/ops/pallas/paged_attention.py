@@ -314,6 +314,20 @@ def paged_attention_ragged(q, kv_pool, block_tables, q_lens, kv_lens,
                          f"{sum(q_lens)}")
     if R == 0:
         return q         # nothing to score — no launch, not counted
+    from ...parallel.mesh import inside_spmd_region
+    if _interpret() and inside_spmd_region("mp"):
+        # callable under shard_map: the interpret-mode launch builds
+        # its tile layout from static host metadata, but the pallas
+        # interpreter's emulated grid does not trace under a manual
+        # mesh axis — inside an ``mp`` spmd region (the compiled
+        # sharded step's body, a training shard_map) the launch
+        # delegates to the jnp reference, which is pure traced ops.
+        # Counted as a dispatch either way; on TPU the real kernel
+        # traces fine and takes the normal path below.
+        _DISPATCH["count"] += 1
+        return paged_attention_ragged_reference(
+            q, kv_pool, block_tables, q_lens, kv_lens,
+            sm_scale=sm_scale, kv_scales=kv_scales)
     _DISPATCH["count"] += 1
     nkv, block_s = kv_pool.shape[2], kv_pool.shape[3]
     MB = block_tables.shape[1]

@@ -94,6 +94,24 @@ def serving_shard_devices(mp: int):
     return [devs[i % len(devs)] for i in range(mp)]
 
 
+def serving_mesh(mp: int, devices=None) -> Optional[Mesh]:
+    """One-axis ``Mesh(("mp",))`` over the serving shard devices —
+    the mesh the compiled sharded step (inference/compiled_step.py)
+    jits its shard_map program over. Returns None when the resolved
+    devices are not ``mp`` DISTINCT physical devices: jax refuses a
+    Mesh with repeats, and logical same-device shards belong on the
+    host-staged legacy path anyway (nothing to compile across)."""
+    mp = int(mp)
+    if mp < 1:
+        raise ValueError(f"mp must be >= 1, got {mp}")
+    devs = list(devices) if devices is not None \
+        else serving_shard_devices(mp)
+    devs = devs[:mp]
+    if len(devs) < mp or len(set(devs)) < mp:
+        return None
+    return Mesh(np.array(devs), ("mp",))
+
+
 def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(get_mesh(), PartitionSpec(*spec))
 

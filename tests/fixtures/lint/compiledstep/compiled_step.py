@@ -1,0 +1,34 @@
+"""Fixture for the compiled-step-purity pass: a miniature
+compiled_step.py whose hot path pulls device data to host (seeded
+violations), whose setup boundary legitimately places weights
+(allowlisted), and whose metadata feed uses jnp.asarray (allowed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n):
+    return max(2, n)
+
+
+def _pull(x):
+    return np.asarray(x)
+
+
+class CompiledStepRunner:
+    def __init__(self, core):
+        # placement at the setup boundary is the allowlisted idiom
+        self.mesh = core.mesh
+        self.bias = jax.device_put(core.bias)
+
+    def _setup_weights(self):
+        self.w = jax.device_put(self.mesh)
+
+    def _dispatch(self, pool, t, ops):
+        pool.block_until_ready()
+        n = t.item()  # lint: ok(compiled-step-purity)
+        meta = jnp.asarray(ops)   # host metadata feeds IN: clean
+        return _bucket(n), meta
+
+    def forward(self, src):
+        return np.array(src)

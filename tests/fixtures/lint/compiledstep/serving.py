@@ -1,0 +1,26 @@
+"""Fixture serving.py for compiled-step-purity: only the hand-off
+scope (ShardedServingCore.forward/__call__/_allreduce and the module
+function _uncommitted) is hot; snapshot/export readback is not."""
+import numpy as np
+
+
+def _uncommitted(arr):
+    return np.asarray(arr)  # lint: ok(compiled-step-purity)
+
+
+def _cold_helper(arr):
+    return np.asarray(arr)   # module functions outside scope: clean
+
+
+class ShardedServingCore:
+    def forward(self, src):
+        return src.tolist()
+
+    def snapshot(self):
+        # readback at the snapshot boundary is out of scope: clean
+        return np.asarray(self._x)
+
+
+class OtherCore:
+    def forward(self, src):
+        return np.asarray(src)   # class outside scope: clean

@@ -165,8 +165,13 @@ class TestAllReduceContract:
     def test_exactly_num_layers_allreduces_per_mixed_step(self):
         """The tentpole contract: ONE all-reduce per layer per model
         call — a token-budget mixed step (prefill chunks packed with
-        the verify rows) is one model call, so exactly num_layers."""
-        tsm = _tsm().shard(2)
+        the verify rows) is one model call, so exactly num_layers.
+        This is the HOST-STAGED legacy path's contract, so it pins
+        compiled_step=False: on a multi-device client the default
+        auto-engages the compiled program, whose collectives live
+        inside the jitted call (allreduce_count stays 0 there —
+        tests/test_sharded_compiled.py owns that contract)."""
+        tsm = _tsm().shard(2, compiled_step=False)
         eng = SpeculativeEngine(tsm, k=2, max_batch=3, block_size=BS,
                                 num_blocks=40, prefill_token_budget=8)
         rids = [eng.submit(p) for p in PROMPTS]
@@ -189,7 +194,8 @@ class TestAllReduceContract:
         eng.check_invariants()
 
     def test_plain_decode_one_allreduce_per_layer(self):
-        tsm = _tsm().shard(2)
+        # legacy host-staged path (see docstring above)
+        tsm = _tsm().shard(2, compiled_step=False)
         eng = SpeculativeEngine(tsm, k=0, max_batch=3, block_size=BS,
                                 num_blocks=40)
         rids = [eng.submit(p) for p in PROMPTS]

@@ -170,6 +170,39 @@ class TestExportFixture:
         assert len(supp) == 1 and "QuietStats" in supp[0].msg
 
 
+class TestCompiledStepFixture:
+    ROOT = os.path.join(FIX, "compiledstep")
+
+    def test_exact_findings(self):
+        kept, supp = run(self.ROOT, ["compiled-step-purity"])
+        cst = os.path.join(self.ROOT, "compiled_step.py")
+        srv = os.path.join(self.ROOT, "serving.py")
+        assert {(f.path, f.line) for f in kept} == {
+            (cst, lineno(cst, "np.asarray(x)")),
+            (cst, lineno(cst, "pool.block_until_ready()")),
+            (cst, lineno(cst, "np.array(src)")),
+            (srv, lineno(srv, "src.tolist()")),
+        }
+        assert all(f.pass_id == "compiled-step-purity" for f in kept)
+        msgs = " | ".join(f.msg for f in kept)
+        # the scope labels name the offending function/method
+        assert "_pull" in msgs
+        assert "CompiledStepRunner._dispatch" in msgs
+        assert "ShardedServingCore.forward" in msgs
+        # setup boundary (__init__/_setup_weights device_put), the
+        # jnp.asarray metadata feed, cold helpers, snapshot readback
+        # and out-of-scope classes are all clean
+        assert len(kept) == 4
+
+    def test_suppression(self):
+        kept, supp = run(self.ROOT, ["compiled-step-purity"])
+        assert {os.path.basename(f.path) for f in supp} == \
+            {"compiled_step.py", "serving.py"}
+        assert any("item()" in f.msg for f in supp)
+        assert any("_uncommitted" in f.msg for f in supp)
+        assert len(supp) == 2
+
+
 # =====================================================================
 # tier-1 gate: the real tree is clean under every pass
 # =====================================================================
@@ -236,6 +269,18 @@ class TestRealTree:
                 if "snapshot" in m and "restore" in m:
                     keys = sc._snapshot_keys(m["snapshot"])
                     assert len(keys) >= 5, (c.name, sorted(keys))
+        # the compiled-step purity pass really engages the compiled
+        # runner and the serving hand-off: the real tree's two
+        # legitimate host hops (legacy _allreduce device_put +
+        # _uncommitted's fallback pull) surface as SUPPRESSED
+        # findings, never silently out of scope
+        kept, supp, problems, _ = cs.run_passes(
+            INF, ["compiled-step-purity"])
+        assert not problems and kept == []
+        assert {os.path.basename(f.path) for f in supp} == \
+            {"serving.py"}
+        assert len(supp) == 2
+        assert any("compiled_step.py" == sf.base for sf in files)
 
     def test_allowlist_entries_all_load_bearing(self):
         """Anti-rot: every SNAPSHOT_ATTR_ALLOW entry must be NEEDED —
@@ -400,6 +445,34 @@ class TestMutations:
         assert [(f.path, f.line) for f in kept] == \
             [(path, lineno(path, 'col.span_begin("journal")'))]
 
+    def test_host_pull_in_compiled_dispatch(self, tmp_path):
+        """The compiled-collectives acceptance: a host pull sneaking
+        onto the per-step dispatch path of the compiled runner — the
+        exact regression that re-serializes every step on the host —
+        flips exit 0 -> 1 anchored at the offending call."""
+        root, path = _mutate(
+            tmp_path, "compiled_step.py",
+            "pools_g, scales_g = self._assemble(cache)",
+            "pools_g, scales_g = self._assemble(cache); "
+            "np.asarray(ops)")
+        kept, _ = run(root, ["compiled-step-purity"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "np.asarray(ops)"))]
+        assert "CompiledStepRunner._dispatch" in kept[0].msg
+
+    def test_host_pull_in_sharded_forward(self, tmp_path):
+        """...and on the serving hand-off: ShardedServingCore.forward
+        pulling activations to host is flagged the same way."""
+        root, path = _mutate(
+            tmp_path, "serving.py",
+            "res = self._compiled.forward(src, caches, time_step)",
+            "res = self._compiled.forward(src, caches, time_step); "
+            "src.tolist()")
+        kept, _ = run(root, ["compiled-step-purity"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "src.tolist()"))]
+        assert "ShardedServingCore.forward" in kept[0].msg
+
     def test_deleted_export(self, tmp_path):
         # renaming an exported name in its source module must trip
         # the import leg of the drift audit
@@ -450,7 +523,7 @@ class TestCLI:
         kept, supp = run(os.path.join(FIX, "snapshot"),
                          ["charge-discipline", "span-safety",
                           "hot-path-purity", "journal-coverage",
-                          "export-drift"])
+                          "export-drift", "compiled-step-purity"])
         assert kept == [] and supp == []
 
     def test_list_passes(self, capsys):
@@ -458,7 +531,7 @@ class TestCLI:
         out = capsys.readouterr().out
         for pid in cs.PASS_IDS:
             assert pid in out
-        assert len(cs.PASS_IDS) == 6
+        assert len(cs.PASS_IDS) == 7
 
     def test_json_envelope_clean(self, capsys):
         """--json speaks the shared paddle_tpu.report.v1 envelope

@@ -1287,11 +1287,103 @@ class ExportDrift:
 
 
 # =====================================================================
+# pass 7: compiled-step-purity
+# =====================================================================
+
+# The compiled sharded step's contract (inference/compiled_step.py
+# module docstring): nothing on the per-step call path may pull
+# device data to host or hop devices — the whole point of the one-
+# jitted-program design is that pools and activations stay resident.
+# Host metadata flows IN via jnp.asarray (allowed); placement happens
+# once at setup (allowlisted); snapshot/export/slice readback lives
+# in paged_cache.py outside this scope. A violation that slips in
+# silently re-serializes every step on the host — exactly the
+# regression PR 15's 0.443x ratio measured — so it is a lint error,
+# not a code-review nicety.
+
+# every function in compiled_step.py is hot except the setup boundary
+COMPILED_STEP_FILE = "compiled_step.py"
+COMPILED_SETUP_ALLOW = {"__init__", "_setup_weights"}
+# the per-step call path in serving.py that hands off to the runner
+COMPILED_SERVING_SCOPE = {
+    "classes": {"ShardedServingCore": {"forward", "__call__",
+                                       "_allreduce"}},
+    "functions": {"_uncommitted"},
+}
+# host hops by exact dotted chain (numpy pulls) ...
+_HOST_HOP_EXACT = {"np.asarray", "numpy.asarray", "np.array",
+                   "numpy.array"}
+# ... and by chain tail (method/function spellings that force a
+# device sync or transfer whatever the receiver is called)
+_HOST_HOP_LAST = {"device_put", "device_get", "block_until_ready",
+                  "copy_to_host_async", "item", "tolist"}
+
+
+class CompiledStepPurity:
+    id = "compiled-step-purity"
+    doc = ("no host pulls (np.asarray/.item/.tolist/device_get) or "
+           "device hops (device_put) on the compiled sharded step's "
+           "per-step call path; setup boundaries allowlisted")
+
+    def _scan(self, sf: SourceFile, fname: str,
+              fn) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            c = call_chain(node)
+            if not c:
+                continue
+            last = c.split(".")[-1]
+            if c in _HOST_HOP_EXACT or last in _HOST_HOP_LAST:
+                out.append(Finding(
+                    self.id, sf.path, node.lineno,
+                    f"{c}() on the compiled-step hot path {fname} — "
+                    f"per-step code must stay device-resident (host "
+                    f"metadata feeds IN via jnp.asarray; placement "
+                    f"belongs in setup; readback belongs at "
+                    f"snapshot/export/slice boundaries)"))
+        return out
+
+    def run(self, files: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files:
+            if sf.base == COMPILED_STEP_FILE:
+                for n in sf.tree.body:
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        findings += self._scan(sf, n.name, n)
+                for cls in sf.classes():
+                    for name, m in methods_of(cls).items():
+                        if name in COMPILED_SETUP_ALLOW:
+                            continue
+                        findings += self._scan(
+                            sf, f"{cls.name}.{name}", m)
+            elif sf.base == "serving.py":
+                scope = COMPILED_SERVING_SCOPE
+                for n in sf.tree.body:
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                            n.name in scope["functions"]:
+                        findings += self._scan(sf, n.name, n)
+                for cls in sf.classes():
+                    hot = scope["classes"].get(cls.name)
+                    if not hot:
+                        continue
+                    for name, m in methods_of(cls).items():
+                        if name in hot:
+                            findings += self._scan(
+                                sf, f"{cls.name}.{name}", m)
+        return findings
+
+
+# =====================================================================
 # framework
 # =====================================================================
 
 PASSES = [SnapshotCompleteness(), HotPathPurity(), JournalCoverage(),
-          ChargeDiscipline(), SpanSafety(), ExportDrift()]
+          ChargeDiscipline(), SpanSafety(), ExportDrift(),
+          CompiledStepPurity()]
 PASS_IDS = [p.id for p in PASSES]
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*ok\(([^)]*)\)")
