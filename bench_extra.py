@@ -3268,6 +3268,134 @@ def bench_serving_sharded_compiled(smoke=False):
     }
 
 
+# --------------------------------------------------------- MoE serving
+def bench_serving_moe(smoke=False):
+    """MoE decode serving (inference/moe_serving.py MoeServingCore)
+    vs a dense baseline at EQUAL ACTIVE FLOPs per routed row: the
+    dense FFN width is top_k * expert_ffn, so both models spend the
+    same per-token FFN compute per forward — what MoE buys at that
+    row price is E/top_k times the FFN parameters (conditional
+    capacity). Three legs, one workload (token-ID paged decode,
+    walking-vocab readout so a routing bug cannot hide in a constant
+    stream):
+
+      dense     FusedMultiTransformer, ffn = top_k * expert_ffn
+      moe       MoeServingCore, E experts, top-k GShard routing —
+                run twice, streams must be bit-identical run to run
+      moe_ep2   the same core after shard_experts(2) — streams must
+                equal the unsharded moe leg bitwise
+
+    Reports tokens/s per leg plus the per-expert load histogram and
+    the overflow (residual-bypass) rate straight off the engine's
+    ``moe.*`` registry namespace — the exact feed the monitor's
+    expert-collapse detector samples."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference import (MoeServingCore, SpeculativeEngine,
+                                      TokenServingModel)
+
+    smoke = smoke or _SMOKE
+    E, K = 4, 2
+    if smoke:
+        dim, heads, ffn, layers = 32, 4, 64, 2
+        vocab, gen = 50, 8
+    else:
+        dim, heads, ffn, layers = 64, 8, 128, 2
+        vocab, gen = 256, 24
+    slots, block, prompt_len = 3, 4, 7
+    per_seq = -(-(prompt_len + gen + 1) // block) + 1
+    num_blocks = slots * per_seq + 4
+    rng = np.random.default_rng(0)
+    emb = (rng.standard_normal((vocab, dim)) * 0.3).astype(np.float32)
+    lm_head = np.roll(emb, -1, 0).T.copy()   # walking-vocab readout
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(slots)]
+
+    def build(kind):
+        paddle.seed(0)
+        if kind == "dense":
+            core = FusedMultiTransformer(dim, heads, K * ffn,
+                                         num_layers=layers)
+        else:
+            core = MoeServingCore(dim, heads, ffn, num_experts=E,
+                                  top_k=K, num_layers=layers)
+            if kind == "moe_ep2":
+                core.shard_experts(2)
+        core.eval()
+        return TokenServingModel(core, emb, lm_head=lm_head)
+
+    def run(kind):
+        eng = SpeculativeEngine(build(kind), k=0, max_batch=slots,
+                                block_size=block, num_blocks=num_blocks)
+        rids = [eng.submit(p) for p in prompts]
+        t0 = time.perf_counter()
+        for _ in range(gen):
+            eng.step()
+        wall = time.perf_counter() - t0
+        streams = {i: tuple(eng.tokens(r)) for i, r in enumerate(rids)}
+        return wall, streams, dict(eng.engine.registry.as_dict())
+
+    reps = 1 if smoke else 3
+    if not smoke:                       # warm per-kind dispatch caches
+        run("dense"), run("moe"), run("moe_ep2")
+    d_wall, d_streams, _ = min((run("dense") for _ in range(reps)),
+                               key=lambda r: r[0])
+    m_wall, m_streams, m_reg = min((run("moe") for _ in range(reps)),
+                                   key=lambda r: r[0])
+    _, m_streams2, _ = run("moe")
+    ep_wall, ep_streams, ep_reg = min((run("moe_ep2")
+                                       for _ in range(reps)),
+                                      key=lambda r: r[0])
+
+    assert m_streams == m_streams2, "moe streams diverged run-to-run"
+    assert ep_streams == m_streams, "ep=2 diverged from unsharded moe"
+    assert int(ep_reg["moe.ep"]) == 2
+    load = [int(m_reg[f"moe.load.{e}"]) for e in range(E)]
+    overflow = [int(m_reg[f"moe.overflow.{e}"]) for e in range(E)]
+    assert sum(load) == int(m_reg["moe.routed_tokens"])
+
+    total_tokens = slots * gen
+    dense_ffn_params = layers * 2 * dim * (K * ffn)
+    moe_ffn_params = layers * E * 2 * dim * ffn
+    return {
+        "metric": "serving_moe_vs_dense_equal_active_flops",
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "num_experts": E, "top_k": K,
+        "expert_ffn": ffn, "dense_ffn": K * ffn,
+        "requests": slots, "gen_per_request": gen,
+        "dense": {
+            "wall_s": round(d_wall, 3),
+            "tokens_per_sec": round(total_tokens / d_wall, 1),
+            "ffn_params": dense_ffn_params,
+        },
+        "moe": {
+            "wall_s": round(m_wall, 3),
+            "tokens_per_sec": round(total_tokens / m_wall, 1),
+            "ffn_params": moe_ffn_params,
+            "expert_load_histogram": load,
+            "expert_overflow_histogram": overflow,
+            "routed_tokens": int(m_reg["moe.routed_tokens"]),
+            "dropped_tokens": int(m_reg["moe.dropped_tokens"]),
+            "overflow_rate": round(float(m_reg["moe.overflow_rate"]), 4),
+        },
+        "moe_ep2": {
+            "wall_s": round(ep_wall, 3),
+            "tokens_per_sec": round(total_tokens / ep_wall, 1),
+            "streams_match_unsharded": True,
+        },
+        "ffn_capacity_ratio": round(moe_ffn_params / dense_ffn_params,
+                                    2),
+        "streams_bit_identical_run_to_run": True,
+        "note": ("equal ACTIVE FLOPs per row (dense ffn = top_k * "
+                 "expert ffn): the tokens/s gap is pure routing/"
+                 "dispatch overhead, the E/top_k params ratio is the "
+                 "conditional capacity MoE buys at that row price; "
+                 "load/overflow histograms come off the moe.* "
+                 "registry namespace the expert-collapse detector "
+                 "samples"),
+    }
+
+
 BENCHES = {
     "resnet50_cifar": bench_resnet50,
     "bert_base_static": bench_bert_static,
@@ -3290,6 +3418,7 @@ BENCHES = {
     "serving_monitor": bench_serving_monitor,
     "serving_cost": bench_serving_cost,
     "serving_int8": bench_serving_int8,
+    "serving_moe": bench_serving_moe,
     "long_context": bench_long_context,
 }
 

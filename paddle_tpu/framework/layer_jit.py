@@ -57,6 +57,18 @@ def enabled() -> bool:
     return bool(get_flag("FLAGS_eager_layer_jit"))
 
 
+def mark_unsafe(layer) -> None:
+    """Permanently exclude ``layer`` from whole-forward capture; it (and
+    only it — children still capture individually) runs per-op eager.
+
+    For layers whose forward is side-effectful by design (e.g.
+    inference/moe_serving.py accumulates per-expert load counters into
+    layer attributes): the capture would trace once, detect the tracer
+    leak, and fall back anyway — opting out up front skips the wasted
+    trace AND keeps the leak from ever poisoning the attribute state."""
+    _cache[layer] = {"execs": {}, "all": _UNSAFE}
+
+
 def _trace_clean() -> bool:
     try:
         return jax.core.trace_state_clean()

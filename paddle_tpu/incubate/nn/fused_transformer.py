@@ -184,6 +184,22 @@ class FusedMultiTransformer(nn.Layer):
         """Linear-projection hook; the int8 subclass overrides this."""
         return getattr(blk, name)(x)
 
+    def _ffn_block(self, i, blk, x):
+        """Post-attention FFN sub-block (residual + LN wrapping included).
+
+        Overridable seam: the attention/cache schedule in forward() is
+        shared by every serving mode, so a subclass that only changes the
+        FFN (e.g. inference.moe_serving.MoeServingCore's routed expert
+        FFN) inherits all paged/prefix/speculative cache behavior."""
+        residual = x
+        h = blk.ffn_ln(x) if self.normalize_before else x
+        h = self._proj(i, blk, "ffn2", self.activation(
+            self._proj(i, blk, "ffn1", h)))
+        x = residual + h
+        if not self.normalize_before:
+            x = blk.ffn_ln(x)
+        return x
+
     def forward(self, src, attn_mask=None, caches=None, time_step=None,
                 **kwargs):
         from ...ops.manipulation import reshape, split, transpose
@@ -340,13 +356,7 @@ class FusedMultiTransformer(nn.Layer):
             x = residual + attn
             if not self.normalize_before:
                 x = blk.ln(x)
-            residual = x
-            h = blk.ffn_ln(x) if self.normalize_before else x
-            h = self._proj(i, blk, "ffn2", self.activation(
-                self._proj(i, blk, "ffn1", h)))
-            x = residual + h
-            if not self.normalize_before:
-                x = blk.ffn_ln(x)
+            x = self._ffn_block(i, blk, x)
         if caches is not None:
             return x, new_caches
         return x

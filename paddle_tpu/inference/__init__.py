@@ -54,6 +54,8 @@ from .scheduler import (DEFAULT_TENANT,  # noqa: F401
                         chunked_prefill)
 from .speculative import (SpeculativeEngine,  # noqa: F401
                           TokenServingModel)
+from .moe_serving import (MoeServingCore,  # noqa: F401
+                          moe_capacity)
 from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
                        RecoverableServer, RecoveryError,
                        RequestJournal, SnapshotVersionError,
@@ -72,7 +74,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "BlockOOM", "CrashInjector", "EngineCrash", "FaultInjector",
            "HealthMonitor", "HealthReport", "SeriesBuffer",
            "SloPolicy", "SloTracker",
-           "MetricsRegistry", "PagedKVCache",
+           "MetricsRegistry", "MoeServingCore", "moe_capacity",
+           "PagedKVCache",
            "PagedLayerCache", "PagedPrefillView", "PagedRequest",
            "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
            "RecoverableServer", "RecoveryError", "RequestJournal",

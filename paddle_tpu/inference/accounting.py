@@ -125,16 +125,28 @@ class WorkModel:
 
     __slots__ = ("num_layers", "d_model", "ffn_dim", "itemsize",
                  "weight_itemsize", "kv_token_bytes", "weight_bytes",
-                 "_row_linear")
+                 "_row_linear", "num_experts", "top_k")
 
     def __init__(self, num_layers: int, d_model: int, ffn_dim: int,
                  kv_token_bytes: Optional[int] = None,
                  itemsize: int = 4,
-                 weight_itemsize: Optional[int] = None):
+                 weight_itemsize: Optional[int] = None,
+                 num_experts: int = 0, top_k: int = 0):
         self.num_layers = int(num_layers)
         self.d_model = int(d_model)
         self.ffn_dim = int(ffn_dim)
         self.itemsize = int(itemsize)
+        # MoE routing spec (moe_serving.MoeServingCore.moe_spec):
+        # num_experts=0 means dense. A routed row PRICES k experts'
+        # FFN — what it computes — while weight RESIDENCY counts all E
+        # expert tables: the gap between the two is exactly the
+        # serving argument for MoE (capacity decoupled from per-token
+        # FLOPs), and pricing E here would erase it.
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        if self.num_experts and not (0 < self.top_k <= self.num_experts):
+            raise ValueError(f"top_k={top_k} must be in "
+                             f"[1, {num_experts}]")
         # int8-weight serving streams 1-byte weights (w8a16): a
         # distinct weight itemsize keeps MBU honest there — pricing an
         # int8 pass at 4-byte traffic would overstate MBU ~4x, the
@@ -150,22 +162,47 @@ class WorkModel:
         self.kv_token_bytes = (int(kv_token_bytes)
                                if kv_token_bytes is not None
                                else 2 * d * self.itemsize * L)
-        # qkv [d,3d]+[3d], out [d,d]+[d], ffn1 [d,f]+[f], ffn2 [f,d]+
-        # [d], two LayerNorms [2d] each — the bytes one model call
-        # streams through the weights once
-        self.weight_bytes = L * self.weight_itemsize * (
-            4 * d * d + 2 * d * f + 9 * d + f)
-        # position-independent FLOPs of one row: the four projections
-        # (2*m*n per matmul row)
-        self._row_linear = L * (8 * d * d + 4 * d * f)
+        if self.num_experts:
+            E, k = self.num_experts, self.top_k
+            # qkv [d,3d]+[3d], out [d,d]+[d], gate [d,E]+[E], E expert
+            # FFN pairs ([d,f]+[f], [f,d]+[d]), two LayerNorms [2d]
+            # each — RESIDENCY streams every expert table (they all
+            # must be HBM-resident for the router to pick any)
+            self.weight_bytes = L * self.weight_itemsize * (
+                4 * d * d + E * (2 * d * f + f + d) + d * E + E
+                + 8 * d)
+            # a routed row computes the gate projection plus its k
+            # ROUTED experts' FFNs — not E (routed-FLOPs; overflow
+            # bypass rows still get priced at k, the capacity they
+            # were admitted to spend)
+            self._row_linear = L * (8 * d * d + 2 * d * E
+                                    + k * 4 * d * f)
+        else:
+            # qkv [d,3d]+[3d], out [d,d]+[d], ffn1 [d,f]+[f], ffn2
+            # [f,d]+[d], two LayerNorms [2d] each — the bytes one
+            # model call streams through the weights once
+            self.weight_bytes = L * self.weight_itemsize * (
+                4 * d * d + 2 * d * f + 9 * d + f)
+            # position-independent FLOPs of one row: the four
+            # projections (2*m*n per matmul row)
+            self._row_linear = L * (8 * d * d + 4 * d * f)
 
     @classmethod
     def for_model(cls, model, itemsize: int = 4,
                   kv_token_bytes: Optional[int] = None,
                   weight_itemsize: Optional[int] = None) -> "WorkModel":
         """Build from a FusedMultiTransformer-protocol core (or a
-        TokenServingModel wrapping one)."""
+        TokenServingModel wrapping one). MoE cores advertise their
+        routing spec via ``moe_spec`` (they have no dense ffn1)."""
         core = getattr(model, "core", model)
+        spec = getattr(core, "moe_spec", None)
+        if spec is not None:
+            return cls(core.num_layers, core.embed_dim,
+                       int(spec["ffn_dim"]),
+                       kv_token_bytes=kv_token_bytes, itemsize=itemsize,
+                       weight_itemsize=weight_itemsize,
+                       num_experts=int(spec["num_experts"]),
+                       top_k=int(spec["top_k"]))
         return cls(core.num_layers, core.embed_dim,
                    int(core.layers[0].ffn1.weight.shape[1]),
                    kv_token_bytes=kv_token_bytes, itemsize=itemsize,
@@ -210,6 +247,7 @@ class WorkModel:
     def as_dict(self) -> dict:
         return {"num_layers": self.num_layers, "d_model": self.d_model,
                 "ffn_dim": self.ffn_dim,
+                "num_experts": self.num_experts, "top_k": self.top_k,
                 "kv_token_bytes": self.kv_token_bytes,
                 "weight_bytes": self.weight_bytes,
                 "weight_itemsize": self.weight_itemsize,

@@ -448,6 +448,15 @@ class HealthMonitor:
         # window occupancy before burn is judged at all
         "slo_burn_high": 2.0,
         "slo_min_samples": 8,
+        # expert-collapse (MoE-fed, dark for dense models — the moe.*
+        # registry namespace never appears): the top expert's share of
+        # the interval's routed assignments at/above the fraction
+        # fires; hysteresis: re-arms below _clear. Intervals routing
+        # fewer than _min_routed assignments are not judged (a 2-row
+        # step trivially routes 100% to one expert).
+        "expert_collapse_frac": 0.8,
+        "expert_collapse_clear": 0.5,
+        "expert_collapse_min_routed": 8,
     }
 
     def __init__(self, slo=None, *, sample_every: int = 1,
@@ -583,6 +592,9 @@ class HealthMonitor:
                        num(cur, "fleet.workers_live") / total)
             self._push("fleet.respawns", step,
                        num(cur, "fleet.respawns"))
+        if "moe.routed_tokens" in cur:
+            self._push("moe.overflow_rate", step,
+                       num(cur, "moe.overflow_rate"))
 
         # interval deltas — the first sample is baseline only
         if prev is not None:
@@ -622,6 +634,23 @@ class HealthMonitor:
                 if tot > 0:
                     self._push("goodput_fraction", step,
                                max(0.0, (tot - waste) / tot))
+            # MoE per-expert load skew over the interval (MoE-fed;
+            # dense models never surface moe.* keys and the series
+            # stays dark). Thin intervals (fewer routed assignments
+            # than the judging floor) are NOT pushed — a near-empty
+            # step trivially routes everything to one expert and must
+            # not read as a collapse or a recovery.
+            if "moe.routed_tokens" in cur:
+                E = int(num(cur, "moe.experts"))
+                loads = [num(cur, f"moe.load.{e}")
+                         - num(prev, f"moe.load.{e}") for e in range(E)]
+                routed = sum(loads)
+                if routed >= self.thresholds[
+                        "expert_collapse_min_routed"]:
+                    self._push("moe.top_frac", step,
+                               max(loads) / routed)
+                    self._push("moe.routed_per_step", step,
+                               routed / dstep)
 
         # per-phase step-span durations (collector-side wall clock —
         # observational, feeds kernel tile sizing, never a detector)
@@ -769,6 +798,20 @@ class HealthMonitor:
             self._fire("capacity-degraded", v < bound, step,
                        "fleet.capacity", v,
                        th["capacity_degraded_floor"])
+        # 5c. expert-collapse (MoE-fed: the top expert's share of the
+        #     interval's routed assignments pinned high — the router
+        #     has stopped spreading and E-1 expert tables are dead
+        #     HBM. Dark for dense models: the moe.* namespace never
+        #     appears, so the series is never pushed. Hysteresis: the
+        #     alert re-arms only after the share falls under _clear.)
+        sb = self._series.get("moe.top_frac")
+        if sb is not None and sb.total > 0:
+            v = sb.last()
+            bound = th["expert_collapse_clear"] \
+                if ("expert-collapse", None) in self._active \
+                else th["expert_collapse_frac"]
+            self._fire("expert-collapse", v >= bound, step,
+                       "moe.top_frac", v, th["expert_collapse_frac"])
         # 6. slo-burn (per tenant, deterministic order)
         if self.slo is not None:
             status = self.slo.status()
@@ -831,6 +874,11 @@ class HealthMonitor:
             last = sb.last()
             if last is not None and \
                     last < th["capacity_degraded_clear"]:
+                return "warn"
+        elif name == "moe.top_frac":
+            if ("expert-collapse", None) in self._active:
+                return "critical"
+            if (sb.last() or 0.0) >= th["expert_collapse_clear"]:
                 return "warn"
         return "ok"
 

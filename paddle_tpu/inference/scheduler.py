@@ -489,6 +489,12 @@ class PagedServingEngine:
         # the monitor's recompile-storm alert surface
         if hasattr(model, "sharded_metrics"):
             self.registry.attach("sharded", model.sharded_metrics)
+        # MoE cores export per-expert load / overflow / routing totals
+        # (moe_serving.MoeServingCore.moe_metrics) — the expert-collapse
+        # detector's sampling surface; dense models leave the namespace
+        # absent and the detector dark
+        if hasattr(model, "moe_metrics"):
+            self.registry.attach("moe", model.moe_metrics)
         self.cache = PagedKVCache.for_model(
             model, block_size, num_blocks, max_seqs=max_batch,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype,

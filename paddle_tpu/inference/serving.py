@@ -344,6 +344,12 @@ class ShardedServingCore:
                 "int8 cores drop their float weights at quantize "
                 "time — shard the float core first (int8 core "
                 "projections are a ROADMAP follow-up)")
+        if hasattr(base, "moe_spec"):
+            raise ValueError(
+                "MoE cores shard over EXPERTS, not attention heads — "
+                "use MoeServingCore.shard_experts(ep) "
+                "(inference/moe_serving.py); composing ep x mp is a "
+                "ROADMAP follow-up")
         self.base = base
         self.mp = int(mp)
         if self.mp < 1:

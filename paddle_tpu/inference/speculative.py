@@ -256,6 +256,13 @@ class TokenServingModel:
         m = self.core
         if num_layers >= m.num_layers:
             raise ValueError("draft must be shallower than the target")
+        if hasattr(m, "truncated"):
+            # cores that know how to truncate themselves (MoE: routed
+            # expert blocks, not dense ffn1/ffn2) hand back a
+            # weight-sharing twin of their first layers
+            return TokenServingModel(m.truncated(num_layers),
+                                     self._embed_np, self.lm_head,
+                                     weight_dtype=self.weight_dtype)
         d = FusedMultiTransformer(
             m.embed_dim, m.num_heads,
             m.layers[0].ffn1.weight.shape[1],

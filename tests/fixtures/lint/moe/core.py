@@ -1,0 +1,45 @@
+"""Seeded MoE serving-core violations (linter self-test). The class
+name matches the real HOT_CLASSES / SNAPSHOT_ATTR_ALLOW entries, so
+the routing/dispatch methods are hot by default, the admin surface is
+cold, and the ep placement attrs ride the allowlist.
+
+Never imported — tests/test_static_analysis.py parses it through
+tools/check_static.py and asserts the exact findings.
+"""
+import time
+
+
+class MoeServingCore:
+    def __init__(self, collector=None):
+        self.collector = collector  # lint: ok(snapshot-completeness)
+        self.num_experts = 4
+        self._calls = 0
+        self._ep_devices = None        # ok: allowlisted (placement)
+        self._ep_weights = None        # ok: allowlisted (derived)
+        self.gate_cache = None         # FINDING: never read by snapshot()
+        self.scratch = None  # lint: ok(snapshot-completeness)
+
+    def route(self, x):
+        self._calls += 1
+        self.collector.on_step(x)      # FINDING: unguarded hook touch
+        t = time.monotonic()           # FINDING: unguarded clock read
+        if self.collector is not None:
+            self.collector.on_step(x)  # ok: guarded
+        self.collector.note(x)  # lint: ok(hot-path-purity)
+        return t
+
+    def moe_metrics(self):
+        return {"calls": self._calls,
+                "stamp": time.time()}  # ok: cold scrape
+
+    def snapshot(self):
+        return {
+            "kind": "moe_serving_core",
+            "config": {"num_experts": self.num_experts,
+                       "gate_dtype": "f32"},  # FINDING: restore drops it
+            "counters": {"calls": self._calls},
+        }
+
+    def restore(self, snap):
+        self.num_experts = snap["config"]["num_experts"]
+        self._calls = snap["counters"]["calls"]

@@ -182,6 +182,106 @@ class TestWorkModel:
         assert "import time" not in open(acc_mod.__file__).read()
 
 
+class TestMoeWorkModel:
+    """Satellite: MoE routed-FLOPs pricing. A routed row is priced at
+    the gate projection plus its top-k experts' FFNs — what it
+    COMPUTES — while weight residency counts every expert table (all E
+    must be HBM-resident for the router to pick any). The E-vs-k gap
+    is the serving argument for MoE; pricing rows at E would erase it."""
+
+    E, K = 4, 2
+
+    def _moe_tsm(self):
+        from paddle_tpu.inference import MoeServingCore
+        paddle.seed(0)
+        core = MoeServingCore(D, HEADS, FFN, num_experts=self.E,
+                              top_k=self.K, num_layers=LAYERS)
+        return TokenServingModel(core, _EMBED)
+
+    def test_row_flops_price_k_not_E(self):
+        wm = WorkModel(LAYERS, D, FFN, num_experts=self.E,
+                       top_k=self.K)
+        # L*(8d^2 + 2dE gate + k*4df routed FFNs) linear + attention
+        lin = LAYERS * (8 * D * D + 2 * D * self.E
+                        + self.K * 4 * D * FFN)
+        assert wm.row_flops(0) == lin + LAYERS * 4 * D * 1
+        assert wm.row_flops(9) == lin + LAYERS * 4 * D * 10
+        # dense-FFN-equivalent at top_k == num_experts: only the gate
+        # separates the two prices — k IS the knob, never E alone
+        all_on = WorkModel(LAYERS, D, FFN, num_experts=self.E,
+                           top_k=self.E)
+        dense = WorkModel(LAYERS, D, FFN)
+        gate = LAYERS * 2 * D * self.E
+        assert all_on.row_flops(0) - (self.E - 1) * LAYERS * 4 * D \
+            * FFN != dense.row_flops(0)  # E*4df vs 4df differ...
+        assert all_on.row_flops(5) - dense.row_flops(5) == \
+            gate + (self.E - 1) * LAYERS * 4 * D * FFN
+
+    def test_weight_residency_counts_every_expert(self):
+        wm = WorkModel(LAYERS, D, FFN, num_experts=self.E,
+                       top_k=self.K)
+        per_expert = 2 * D * FFN + FFN + D
+        assert wm.weight_bytes == LAYERS * 4 * (
+            4 * D * D + self.E * per_expert + D * self.E + self.E
+            + 8 * D)
+        # residency grows with E at FIXED row price: the decoupling
+        wide = WorkModel(LAYERS, D, FFN, num_experts=8, top_k=self.K)
+        assert wide.weight_bytes > wm.weight_bytes
+        assert wide.row_flops(3) - wm.row_flops(3) == \
+            LAYERS * 2 * D * (8 - self.E)  # only the gate widens
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="top_k"):
+            WorkModel(LAYERS, D, FFN, num_experts=4, top_k=0)
+        with pytest.raises(ValueError, match="top_k"):
+            WorkModel(LAYERS, D, FFN, num_experts=4, top_k=5)
+
+    def test_for_model_reads_moe_spec(self):
+        wm = WorkModel.for_model(self._moe_tsm())   # unwraps .core
+        assert (wm.num_layers, wm.d_model, wm.ffn_dim) == \
+            (LAYERS, D, FFN)
+        assert (wm.num_experts, wm.top_k) == (self.E, self.K)
+        d = wm.as_dict()
+        assert d["num_experts"] == self.E and d["top_k"] == self.K
+        # dense models keep the fields at 0 — the dump stays
+        # byte-compatible and the report banner stays dark
+        assert WorkModel.for_model(_tsm()).as_dict()["num_experts"] == 0
+
+    def test_conservation_under_moe_spec_rollback(self):
+        """The load-bearing identity holds when the priced rows are
+        ROUTED rows being speculatively rolled back: goodput +
+        spec_rejected + pending == total, rows AND FLOPs exactly (the
+        per-row price is a position-pure integer whatever k is)."""
+        tsm = self._moe_tsm()
+        led = CostLedger()
+        done, _, eng = _drive(tsm, _prompts(12, n=3), 6, ledger=led,
+                              draft=tsm.truncated_draft(1), k=3,
+                              injector=_reject_injector())
+        _assert_conserved(led, pending=0)
+        bd = led.waste_breakdown()
+        assert eng.stats.rolled_back > 0, "draft never disagreed"
+        assert bd["waste"]["spec_rejected"] > 0
+        assert led.work.num_experts == self.E
+        assert led.draft_work.num_experts == self.E
+        # the registry shows routed traffic moved during the run
+        assert eng.engine.registry.as_dict()["moe.routed_tokens"] > 0
+
+    def test_cost_report_shows_moe_pricing(self, tmp_path, capsys):
+        """The offline doctor prints the MoE pricing banner off the
+        dump's work_model pass-through — no live engine needed."""
+        led = CostLedger()
+        _drive(self._moe_tsm(), _prompts(9, n=2), 4, ledger=led)
+        path = str(tmp_path / "moe_ledger.json")
+        led.save(path)
+        from tools import cost_report
+        assert cost_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "MoE pricing: 4 expert(s), top-2 routed FLOPs" in out
+        assert cost_report.main([path, "--json"]) == 0
+        env = json.loads(capsys.readouterr().out)
+        assert env["data"]["work_model"]["num_experts"] == self.E
+
+
 # ---------------------------------------------------------------------
 # conservation: the load-bearing identity, across every serving mode
 # ---------------------------------------------------------------------

@@ -404,3 +404,32 @@ def test_serving_sharded_compiled_smoke_leg():
     assert res["mp1"]["tokens_per_sec"] > 0
     assert res["mp2_staged"]["tokens_per_sec"] > 0
     assert res["mp2_compiled"]["tokens_per_sec"] > 0
+
+
+def test_serving_moe_smoke_leg():
+    res = bench_extra.bench_serving_moe(smoke=True)
+    assert res["metric"] == "serving_moe_vs_dense_equal_active_flops"
+    # the tentpole guarantees rode the bench: greedy streams are
+    # bit-identical run-to-run and shard_experts(2) matches the
+    # unsharded core bitwise (asserted inside the leg — reaching the
+    # report dict means both held)
+    assert res["streams_bit_identical_run_to_run"] is True
+    assert res["moe_ep2"]["streams_match_unsharded"] is True
+    # equal ACTIVE FLOPs per row: dense ffn = top_k * expert_ffn,
+    # while MoE holds E/top_k times the dense FFN parameters
+    assert res["dense_ffn"] == res["top_k"] * res["expert_ffn"]
+    assert res["ffn_capacity_ratio"] == \
+        res["num_experts"] / res["top_k"]
+    # the moe.* registry namespace fed the report: one load bucket
+    # per expert, conservation between histogram and routed total,
+    # overflow tokens took the residual bypass (never vanished)
+    load = res["moe"]["expert_load_histogram"]
+    assert len(load) == res["num_experts"]
+    assert sum(load) == res["moe"]["routed_tokens"]
+    assert sum(res["moe"]["expert_overflow_histogram"]) == \
+        res["moe"]["dropped_tokens"]
+    assert 0.0 <= res["moe"]["overflow_rate"] < 1.0
+    # all three legs actually served every requested token
+    assert res["dense"]["tokens_per_sec"] > 0
+    assert res["moe"]["tokens_per_sec"] > 0
+    assert res["moe_ep2"]["tokens_per_sec"] > 0

@@ -686,6 +686,150 @@ def _drive_recoverable(tsm, prompts, n_gen, jp, sp, injector, monitor,
     return done, monitors
 
 
+class TestExpertCollapse:
+    """Satellite: the MoE expert-collapse detector — the top expert's
+    share of an interval's routed assignments pinned at/above
+    ``expert_collapse_frac`` fires once per crossing (hysteresis
+    re-arms below ``_clear``); intervals routing fewer than
+    ``_min_routed`` assignments are never judged; and dense models —
+    whose registries never surface the ``moe.*`` namespace — keep the
+    detector, the series and the report signal completely dark."""
+
+    E = 4
+
+    def _moe_world(self):
+        """Synthetic MoE registry: a cumulative per-expert load feed
+        shaped like MoeServingCore.moe_metrics."""
+        state = {"load": [0] * self.E, "routed": 0, "dropped": 0}
+
+        def moe_metrics():
+            d = {"experts": self.E, "top_k": 2, "ep": 0, "calls": 1,
+                 "rows": 1, "routed_tokens": state["routed"],
+                 "dropped_tokens": state["dropped"],
+                 "overflow_rate": 0.0}
+            for e, v in enumerate(state["load"]):
+                d[f"load.{e}"] = v
+                d[f"overflow.{e}"] = 0
+            return d
+
+        reg = MetricsRegistry()
+        reg.attach("moe", moe_metrics)
+        mon = HealthMonitor()
+        mon.bind(reg)
+
+        def step(n, loads):
+            for e, v in enumerate(loads):
+                state["load"][e] += v
+            state["routed"] += sum(loads)
+            mon.on_step(n)
+
+        return mon, step
+
+    # the seeded scenario both determinism runs replay: balanced ->
+    # collapse (fires) -> still hot (no re-fire) -> above clear
+    # (stays active) -> balanced (re-arms) -> THIN interval (ignored)
+    # -> collapse again (second alert)
+    SCENARIO = [(1, (4, 4, 4, 4)), (2, (4, 4, 4, 4)),
+                (3, (14, 1, 1, 0)), (4, (13, 1, 1, 1)),
+                (5, (10, 2, 2, 2)), (6, (4, 4, 4, 4)),
+                (7, (2, 1, 0, 0)), (8, (14, 1, 1, 0))]
+
+    def test_edge_hysteresis_and_thin_interval_gate(self):
+        mon, step = self._moe_world()
+        for n, loads in self.SCENARIO:
+            step(n, loads)
+        assert [(a.step, a.kind) for a in mon.alerts] == \
+            [(3, "expert-collapse"), (8, "expert-collapse")]
+        a = mon.alerts[0]
+        assert a.signal == "moe.top_frac"
+        assert a.value == pytest.approx(14 / 16)
+        # the thin step-7 interval (3 routed < min 8) was never judged:
+        # 7 intervals sampled, 6 pushed
+        sb = mon.series("moe.top_frac")
+        steps, _ = sb.window()
+        assert sb.total == 6 and 7 not in steps and steps[-1] == 8
+
+    def test_two_seeded_runs_identical_ordered_alerts(self):
+        runs = []
+        for _ in range(2):
+            mon, step = self._moe_world()
+            for n, loads in self.SCENARIO:
+                step(n, loads)
+            runs.append(mon)
+        a, b = ([x.sig() for x in m.alerts] for m in runs)
+        assert a == b and a, "must match and be non-empty"
+        assert runs[0].alert_counts == runs[1].alert_counts
+        assert runs[0].report().as_dict() == runs[1].report().as_dict()
+
+    def test_verdict_surfaces_in_report(self):
+        mon, step = self._moe_world()
+        step(1, (4, 4, 4, 4))
+        step(2, (14, 1, 1, 0))          # firing -> critical
+        rep = mon.report().as_dict()
+        assert rep["signals"]["moe.top_frac"]["verdict"] == "critical"
+        step(3, (7, 3, 3, 3))           # 0.4375 < clear -> re-armed, ok
+        rep = mon.report().as_dict()
+        assert rep["signals"]["moe.top_frac"]["verdict"] == "ok"
+        step(4, (10, 2, 2, 2))          # 0.625: clear..frac band -> warn
+        rep = mon.report().as_dict()
+        assert rep["signals"]["moe.top_frac"]["verdict"] == "warn"
+
+    def test_dense_runs_stay_dark(self):
+        """A dense registry (no moe.* namespace) must never grow the
+        series, fire the detector, or show the signal in the report —
+        the ISSUE's dark-for-dense clause."""
+        reg = MetricsRegistry()
+        reg.gauge("pool.usable", 10)
+        reg.gauge("pool.active", 2)
+        mon = HealthMonitor()
+        mon.bind(reg)
+        for n in range(1, 10):
+            mon.on_step(n)
+        assert mon.series("moe.top_frac") is None
+        assert mon.series("moe.overflow_rate") is None
+        assert "expert-collapse" not in [a.kind for a in mon.alerts]
+        assert "moe.top_frac" not in mon.report().as_dict()["signals"]
+
+    def test_threshold_knobs_are_registered(self):
+        """Unknown threshold keys are refused, so the three collapse
+        knobs must be DEFAULTS members — and tunable."""
+        mon = HealthMonitor(thresholds={"expert_collapse_frac": 0.9,
+                                        "expert_collapse_clear": 0.6,
+                                        "expert_collapse_min_routed": 4})
+        assert mon.thresholds["expert_collapse_frac"] == 0.9
+        with pytest.raises(ValueError):
+            HealthMonitor(thresholds={"expert_collapse_nope": 1})
+
+    def test_live_moe_engine_feeds_the_series(self):
+        """End-to-end: a monitored MoE engine pushes moe.overflow_rate
+        and moe.top_frac off its own registry scrape — no synthetic
+        feed — and two identical runs sample identical series."""
+        from paddle_tpu.inference import MoeServingCore
+
+        def run():
+            paddle.seed(0)
+            core = MoeServingCore(D, HEADS, FFN, num_experts=4,
+                                  top_k=2, num_layers=LAYERS)
+            mon = HealthMonitor()
+            eng = SpeculativeEngine(
+                TokenServingModel(core, _EMBED), k=0, max_batch=3,
+                block_size=4, num_blocks=40, monitor=mon)
+            rids = [eng.submit(list(range(5 + i, 12 + i)))
+                    for i in range(3)]
+            for _ in range(6):
+                eng.step()
+            del rids
+            return mon
+
+        m1, m2 = run(), run()
+        sb = m1.series("moe.overflow_rate")
+        assert sb is not None and sb.total > 0
+        tf = m1.series("moe.top_frac")
+        assert tf is not None and tf.total > 0
+        assert 0.0 < tf.last() <= 1.0
+        assert m1.report().as_dict() == m2.report().as_dict()
+
+
 class TestRecoveryDerived:
     N_GEN = 8
 

@@ -222,6 +222,53 @@ class TestGroupedGemm:
         assert list(be) == [0, 1, 3]
 
 
+class TestGroupedGemmExactParity:
+    """BIT-EXACT gmm parity — the MoE serving contract
+    (inference/moe_serving.py): the grouped-GEMM dispatch path and the
+    per-expert reference fold must produce byte-equal streams, which
+    holds only if gmm itself is bit-equal to a plain per-expert matmul
+    at serving dims. Interpret mode runs the same one-m-block row
+    tiling as Mosaic; XLA CPU's row-count-invariant GEMM makes each
+    block's dot bitwise equal to the corresponding rows of the full
+    matmul — so these asserts are exact, not allclose."""
+
+    def _parity(self, sizes, K=32, N=48, bm=8, seed=0):
+        r = np.random.default_rng(seed)
+        E = len(sizes)
+        offsets, block_expert, M = make_group_metadata(sizes, block_m=bm)
+        lhs = jnp.asarray(r.standard_normal((M, K)), jnp.float32)
+        rhs = jnp.asarray(r.standard_normal((E, K, N)), jnp.float32)
+        out = gmm(lhs, rhs, block_expert, block_m=bm)
+        ref = gmm_reference(lhs, rhs, block_expert, block_m=bm)
+        assert np.array_equal(np.asarray(out), np.asarray(ref))
+        for e in range(E):
+            lo, hi = offsets[e], offsets[e] + sizes[e]
+            if sizes[e]:
+                assert np.array_equal(np.asarray(out[lo:hi]),
+                                      np.asarray(lhs[lo:hi] @ rhs[e])), e
+
+    def test_empty_experts(self):
+        self._parity([5, 0, 1, 10])
+        self._parity([0, 0, 0, 3])
+
+    def test_single_token_groups(self):
+        # one row per expert: every m-block is rows [token, padding]
+        self._parity([1, 1, 1, 1])
+
+    def test_uniform_full_blocks(self):
+        self._parity([8, 8, 8, 8])
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_randomized_group_sizes(self, seed):
+        r = np.random.default_rng(100 + seed)
+        E = int(r.integers(2, 6))
+        sizes = [int(r.integers(0, 17)) for _ in range(E)]
+        if not any(sizes):
+            sizes[0] = 1
+        self._parity(sizes, K=int(r.integers(8, 48)),
+                     N=int(r.integers(8, 64)), seed=seed)
+
+
 class TestPagedAttention:
     """Ragged paged-attention decode: KV pages gathered through a block
     table (PAPERS.md arxiv 2604.15464). Same online softmax as

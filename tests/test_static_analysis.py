@@ -203,6 +203,41 @@ class TestCompiledStepFixture:
         assert len(supp) == 2
 
 
+class TestMoeFixture:
+    """Satellite: both contract passes engage a MoE serving core —
+    the fixture class shares the real MoeServingCore's name, so it
+    inherits the HOT_CLASSES cold-set and the SNAPSHOT_ATTR_ALLOW
+    placement entries exactly like the real module does."""
+
+    ROOT = os.path.join(FIX, "moe")
+
+    def test_exact_findings(self):
+        core = os.path.join(self.ROOT, "core.py")
+        kept, supp = run(self.ROOT,
+                         ["snapshot-completeness", "hot-path-purity"])
+        assert {(f.path, f.line) for f in kept} == {
+            (core, lineno(core, "self.gate_cache = None")),
+            (core, lineno(core, '"gate_dtype": "f32"')),
+            (core, lineno(core, "self.collector.on_step(x)")),
+            (core, lineno(core, "t = time.monotonic()")),
+        }
+        msgs = " | ".join(f.msg for f in kept)
+        assert "MoeServingCore.gate_cache" in msgs
+        assert "'gate_dtype'" in msgs
+        assert "MoeServingCore.route" in msgs
+        # the allowlisted ep placement attrs and the cold moe_metrics
+        # clock read produce nothing
+        assert "_ep_devices" not in msgs and "_ep_weights" not in msgs
+        assert "moe_metrics" not in msgs
+
+    def test_suppression(self):
+        kept, supp = run(self.ROOT,
+                         ["snapshot-completeness", "hot-path-purity"])
+        assert len(supp) == 3
+        assert {f.pass_id for f in supp} == \
+            {"snapshot-completeness", "hot-path-purity"}
+
+
 # =====================================================================
 # tier-1 gate: the real tree is clean under every pass
 # =====================================================================
@@ -225,8 +260,8 @@ class TestRealTree:
                         if "snapshot" in cs.methods_of(c)
                         and "restore" in cs.methods_of(c)}
         assert {"PagedKVCache", "PagedServingEngine",
-                "SpeculativeEngine", "FleetSupervisor"} <= \
-            snap_classes
+                "SpeculativeEngine", "FleetSupervisor",
+                "MoeServingCore"} <= snap_classes
         jc = cs.JournalCoverage()
         kinds = {}
         for sf in files:
@@ -245,8 +280,15 @@ class TestRealTree:
         # core included — mesh-era code inherits the purity contract)
         hot = {c.name for sf in files for c in sf.classes()}
         assert {"PagedServingEngine", "SpeculativeEngine",
-                "PagedKVCache", "ShardedServingCore"} <= hot
+                "PagedKVCache", "ShardedServingCore",
+                "MoeServingCore"} <= hot
         assert "ShardedServingCore" in cs.HOT_CLASSES
+        # the MoE core's routing/dispatch path is hot by default: the
+        # cold set names only the admin surface, so _moe_ffn /
+        # _combine_fold / _ffn_block inherit the purity contract
+        assert "MoeServingCore" in cs.HOT_CLASSES
+        assert not {"_ffn_block", "_moe_ffn", "_combine_fold"} & \
+            cs.HOT_CLASSES["MoeServingCore"]
         # the sharded state holder's geometry really rides snapshots:
         # the harvester sees the ``mp`` key on the REAL PagedKVCache
         # (the mutation spot-check below then proves deleting its
@@ -472,6 +514,42 @@ class TestMutations:
         assert [(f.path, f.line) for f in kept] == \
             [(path, lineno(path, "src.tolist()"))]
         assert "ShardedServingCore.forward" in kept[0].msg
+
+    def test_deleted_moe_snapshot_field(self, tmp_path):
+        """MoE engagement acceptance: dropping the routed-row counter
+        from MoeServingCore.snapshot() flips exit 0 -> 1 the day it
+        happens, anchored at the counter's birth."""
+        root, path = _mutate(
+            tmp_path, "moe_serving.py", '"rows": self._rows,', "")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self._rows = 0"))]
+        assert "MoeServingCore._rows" in kept[0].msg
+
+    def test_deleted_moe_restore_consumption(self, tmp_path):
+        """...and a restore() that silently drops the serialized
+        kernel-path switch is caught at the serialization site."""
+        root, path = _mutate(
+            tmp_path, "moe_serving.py",
+            'self._use_kernel = cfg["use_kernel"]', "pass")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, '"use_kernel": self._use_kernel,'))]
+        assert "'use_kernel'" in kept[0].msg
+        assert "never consumed" in kept[0].msg
+
+    def test_unguarded_hook_in_moe_dispatch(self, tmp_path):
+        """An unguarded hook touch slipped into the per-layer MoE
+        dispatch — the hottest loop in the module — is a purity
+        finding at the touch site."""
+        root, path = _mutate(
+            tmp_path, "moe_serving.py",
+            "logits = blk.gate(x2)",
+            "logits = blk.gate(x2); self.collector.on_step(0)")
+        kept, _ = run(root, ["hot-path-purity"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self.collector.on_step(0)"))]
+        assert "MoeServingCore._moe_ffn" in kept[0].msg
 
     def test_deleted_export(self, tmp_path):
         # renaming an exported name in its source module must trip

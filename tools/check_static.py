@@ -268,6 +268,16 @@ SNAPSHOT_ATTR_ALLOW: Dict[str, Dict[str, str]] = {
         "max_batch": "restored from the wrapped engine's config "
                      "section (single source of truth)",
     },
+    "MoeServingCore": {
+        "_ep_devices": "runtime placement, not state — device handles "
+                       "are process-local; restore() re-derives them "
+                       "by re-running shard_experts(ep) off the "
+                       "snapshot's config.ep",
+        "_ep_weights": "derived per-shard views: device_put slices of "
+                       "the stacked expert Parameters (which ride "
+                       "state_dict like any weight) — rebuilt by "
+                       "shard_experts during restore",
+    },
     "FleetSupervisor": {
         "router": "live wiring — restore() takes the (recovered) "
                   "router as an argument, it is not serializable "
@@ -553,6 +563,14 @@ HOT_CLASSES: Dict[str, Set[str]] = {
     # call (one visit per layer per shard): hot throughout — only
     # construction (weight slicing/placement) is cold
     "ShardedServingCore": {"__init__"},
+    # the MoE serving core's routing/dispatch/combine runs inside every
+    # model call (per layer): hot by default — construction, expert
+    # sharding and the snapshot/metrics scrapes are the cold admin
+    # surface (moe_metrics is the registry's attach() target, pulled
+    # only when a cold consumer scrapes the registry)
+    "MoeServingCore": {"__init__", "snapshot", "restore",
+                       "shard_experts", "moe_metrics", "truncated",
+                       "moe_spec"},
 }
 
 # Files whose MODULE-LEVEL functions are hot (kernel launch paths).

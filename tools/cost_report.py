@@ -168,6 +168,16 @@ def render(rep: dict, tenant=None) -> str:
                      f"{sav.get('prefix_saved_tokens', 0)} prefix-hit "
                      f"+ {sav.get('replay_saved_tokens', 0)} "
                      f"warm-resume token(s)")
+    wm = rep.get("work_model") or {}
+    if wm.get("num_experts"):
+        # MoE pricing banner: rows were priced at routed-FLOPs (top-k
+        # experts per row), while weight residency counts every expert
+        lines.append(
+            f"  MoE pricing: {wm['num_experts']} expert(s), "
+            f"top-{wm['top_k']} routed FLOPs per row "
+            f"({_fmt_flops(wm.get('row_linear_flops', 0))} linear), "
+            f"all-expert residency "
+            f"{wm.get('weight_bytes', 0)} B")
     if rep["phases"]:
         lines.append("  per-phase model work:")
         for kind, ph in rep["phases"].items():
