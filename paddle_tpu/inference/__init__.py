@@ -32,8 +32,9 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 from .serving import (ContinuousBatchingEngine,  # noqa: F401
-                      PrefillStats, PrefixCacheStats, ResilienceStats,
-                      ShardedServingCore, SpecDecodeStats, TenantStats)
+                      ParallelStats, PrefillStats, PrefixCacheStats,
+                      ResilienceStats, ShardedServingCore,
+                      SpecDecodeStats, TenantStats)
 from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
                         TraceCollector)
 from .accounting import (CostLedger, WorkModel,  # noqa: F401
@@ -53,7 +54,8 @@ from .scheduler import (DEFAULT_TENANT,  # noqa: F401
                         PagedRequest, PagedServingEngine, Tenant,
                         chunked_prefill)
 from .speculative import (SpeculativeEngine,  # noqa: F401
-                          TokenServingModel)
+                          TokenServingModel, branch_lane_seed,
+                          logit_mask_fn, register_logit_mask)
 from .moe_serving import (MoeServingCore,  # noqa: F401
                           moe_capacity)
 from .recovery import (SNAPSHOT_VERSION,  # noqa: F401
@@ -77,7 +79,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "MetricsRegistry", "MoeServingCore", "moe_capacity",
            "PagedKVCache",
            "PagedLayerCache", "PagedPrefillView", "PagedRequest",
-           "PagedServingEngine", "PrefillStats", "PrefixCacheStats",
+           "PagedServingEngine", "ParallelStats", "PrefillStats",
+           "PrefixCacheStats",
            "RecoverableServer", "RecoveryError", "RequestJournal",
            "RequestOutcome", "ResilienceStats", "SNAPSHOT_VERSION",
            "ShardedServingCore",
@@ -86,6 +89,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "TenantStats", "TokenServingModel", "TraceCollector",
            "DEFAULT_TENANT",
            "MIN_PREFILL_SUFFIX_ROWS", "chunked_prefill",
+           "branch_lane_seed", "logit_mask_fn", "register_logit_mask",
            "chain_block_hashes", "chain_hash", "load_snapshot",
            "read_journal", "save_snapshot",
            "EngineWorker", "InProcWorker", "PipeWorker", "Router",

@@ -108,13 +108,17 @@ __all__ = ["WorkModel", "CostLedger", "WASTE_CAUSES"]
 
 # the exhaustive waste taxonomy: every wasted row names exactly one
 WASTE_CAUSES = ("spec_rejected", "replay", "draft_oom", "shed",
-                "numeric", "deadline")
+                "numeric", "deadline", "bestof_pruned")
 
 # RequestOutcome status -> retroactive waste cause for a failed
 # request's pending work (FINISHED resolves to goodput; a rejected
-# request never did any work)
+# request never did any work). CANCELLED is a deliberate early stop
+# (best-of-n loser pruning / beam cuts): the pruned branch's pending
+# rows were real work that will never reach a delivered stream, so
+# they resolve to their own cause instead of inflating "shed".
 _FAIL_CAUSE = {"failed_oom": "shed", "failed_numeric": "numeric",
-               "failed_deadline": "deadline"}
+               "failed_deadline": "deadline",
+               "cancelled": "bestof_pruned"}
 
 
 class WorkModel:
@@ -500,6 +504,20 @@ class CostLedger:
                 self.evicted_records += 1
         self._recs[rid] = _LedgerRec(rid, tenant,
                                      replayed=self._replay)
+
+    def on_fork(self, rid: int, tokens: int) -> None:
+        """Branch ``rid`` was COW-forked at stream length ``tokens``:
+        its prompt rows were computed ONCE under the group lead and
+        are already in the ledger there — raising the branch's target
+        high-water mark to ``tokens`` WITHOUT adding pending rows is
+        what keeps the shared prefill priced exactly once no matter
+        how many branches finish. A later re-prefill of the branch
+        (post-preemption, when the COW sharing is lost) then honestly
+        lands below the mark and counts as replay waste."""
+        rec = self._rec(rid)
+        if rec is None:
+            return
+        rec.target.hwm = max(rec.target.hwm, int(tokens))
 
     def on_prefill_skip(self, rid: int, n: int) -> None:
         """``n`` prompt rows adopted from the prefix cache instead of

@@ -595,6 +595,14 @@ class HealthMonitor:
         if "moe.routed_tokens" in cur:
             self._push("moe.overflow_rate", step,
                        num(cur, "moe.overflow_rate"))
+        # fork-shared parallel decoding gauges (dark until the first
+        # submit(n>1)/fork_stream — the parallel.* namespace stays all
+        # zero for plain serving and the series are never pushed)
+        if num(cur, "parallel.groups") > 0:
+            self._push("parallel.branches_per_group", step,
+                       num(cur, "parallel.branches_per_group"))
+            self._push("parallel.shared_blocks", step,
+                       num(cur, "parallel.shared_blocks"))
 
         # interval deltas — the first sample is baseline only
         if prev is not None:

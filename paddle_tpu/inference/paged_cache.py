@@ -1983,6 +1983,43 @@ class PagedKVCache:
         self.block_tables[dst, :len(shared)] = shared
         self._tables_dirty()
 
+    def share_report(self, slots) -> dict:
+        """Fork-sharing introspection for a branch group (or any slot
+        set): which pool blocks the given slots' tables reference, how
+        many of the slots reference each (``multiplicity``), and the
+        allocator's refcount per block. A pure read — the group audit
+        (scheduler._audit_groups), the parallel-sampling tests and the
+        ``serving_parallel`` bench all read the same numbers:
+
+          shared_blocks   blocks referenced by >= 2 of the slots (the
+                          COW-shared prompt pages)
+          private_blocks  blocks referenced by exactly one slot (each
+                          branch's divergent tail)
+          multiplicity    {block: how many of the slots reference it}
+          refcount        {block: allocator refcount} (>= multiplicity;
+                          the prefix cache may hold more references)
+          bytes_saved     whole-mesh pool bytes the sharing avoided
+                          allocating: (multiplicity - 1) block copies
+                          summed over shared blocks, priced at
+                          kv_bytes_per_token() x block_size x mp
+        """
+        mult: dict = {}
+        for slot in slots:
+            for b in self.seq_blocks[int(slot)]:
+                b = int(b)
+                mult[b] = mult.get(b, 0) + 1
+        shared = sorted(b for b, m in mult.items() if m >= 2)
+        bpb = self.kv_bytes_per_token() * self.block_size * self.mp
+        return {
+            "shared_blocks": shared,
+            "private_blocks": sorted(b for b, m in mult.items()
+                                     if m == 1),
+            "multiplicity": mult,
+            "refcount": {b: int(self.allocator.refcount[b])
+                         for b in mult},
+            "bytes_saved": sum(mult[b] - 1 for b in shared) * bpb,
+        }
+
     def _copy_block(self, slot: int, bpos: int, copy: bool = True) -> None:
         """Copy-on-write: give slot a private block at table position
         bpos. copy=False skips the pool copy for callers about to

@@ -565,6 +565,18 @@ class RecoverableServer:
         self.journal.append("release", {"rid": int(rid)})
         self.engine.release(rid)
 
+    def cancel(self, rid: int) -> bool:
+        """Journaled early stop (best-of loser pruning, beam cuts,
+        caller cancel): the record lands BEFORE the engine mutates,
+        like a submit, so a crash after the append replays the
+        cancellation and the replayed rounds serve the same surviving
+        streams. Unknown/terminal rids return False live AND on
+        replay (the engine's cancel is a no-op for them) — nothing to
+        special-case."""
+        self._flush_drains()
+        self.journal.append("cancel", {"rid": int(rid)})
+        return self.engine.cancel(rid)
+
     def export_slice(self, rid: int):
         """Migration export (inference/router.py): ``rid``'s finished
         prefix pages as a content-addressed kv_slice. A pure read —
@@ -781,6 +793,11 @@ class RecoverableServer:
                         # mutation, same determinism argument as the
                         # submit case above
                         pass
+                elif kind == "cancel":
+                    # deterministic bool return, never raises: an
+                    # unknown/terminal rid was a no-op live and is a
+                    # no-op here
+                    eng.cancel(payload["rid"])
                 elif kind == "set_tenant":
                     try:
                         eng.set_tenant(payload["tenant_id"],

@@ -100,9 +100,15 @@ class RequestOutcome:
     # Engines never emit it; it exists so the fleet boundary speaks
     # the same outcome taxonomy as the engines behind it.
     FAILED_UNROUTABLE = "failed_unroutable"
+    # deliberate early stop (best-of-n loser pruning, beam-search
+    # branch cuts, caller cancel): the stream was healthy but the
+    # caller no longer wants it. NOT a failure in the health sense —
+    # the ledger attributes its pending work to ``bestof_pruned``
+    # waste, and resilience stats count it separately from sheds.
+    CANCELLED = "cancelled"
 
     STATUSES = (FINISHED, FAILED_OOM, FAILED_NUMERIC, FAILED_DEADLINE,
-                REJECTED_ADMISSION, FAILED_UNROUTABLE)
+                REJECTED_ADMISSION, FAILED_UNROUTABLE, CANCELLED)
 
     __slots__ = ("rid", "status", "reason", "tokens", "preemptions",
                  "step")

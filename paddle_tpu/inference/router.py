@@ -1632,7 +1632,8 @@ class Router:
         elif status in (RequestOutcome.FAILED_NUMERIC,
                         RequestOutcome.FAILED_DEADLINE,
                         RequestOutcome.REJECTED_ADMISSION,
-                        RequestOutcome.FAILED_UNROUTABLE):
+                        RequestOutcome.FAILED_UNROUTABLE,
+                        RequestOutcome.CANCELLED):
             # deadline / numeric / (late) rejection: the verdict is
             # the worker's to make — forward it exactly once. Members
             # are NAMED (not a catch-all) so a future outcome kind

@@ -134,8 +134,8 @@ from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from .paged_cache import BlockOOM, PagedKVCache, chain_block_hashes
 from .resilience import RequestOutcome
-from .serving import (PrefillStats, PrefixCacheStats, ResilienceStats,
-                      TenantStats)
+from .serving import (ParallelStats, PrefillStats, PrefixCacheStats,
+                      ResilienceStats, TenantStats)
 from .telemetry import MetricsRegistry
 
 __all__ = ["PagedRequest", "PagedServingEngine", "Tenant",
@@ -328,6 +328,14 @@ class PagedRequest:
         self.deadline_steps: Optional[int] = None
         self.deadline_time: Optional[float] = None   # monotonic clock
         self.submit_step = 0
+        # fork-shared parallel decoding (branch groups): ``gid`` is the
+        # group id (the LEAD request's rid) for every member, ``branch``
+        # the lane index within it. ``group_n`` > 1 marks a lead whose
+        # branches have NOT forked yet (submit sets it; the fork clears
+        # it, so a post-fork preemption re-prefills a normal request).
+        self.gid: Optional[int] = None
+        self.branch = 0
+        self.group_n = 1
 
     @property
     def history(self) -> np.ndarray:
@@ -369,6 +377,92 @@ class PagedRequest:
 
     def __len__(self):
         return self._len
+
+
+class _GroupTable:
+    """Engine-side registry of fork-shared branch groups (parallel
+    sampling: ``submit(..., n=4)``). One record per live group:
+
+      n         branch count the group was admitted for
+      rids      member rids in branch order (rids[0] == gid == the
+                lead's rid; branch rids land here AT FORK TIME — they
+                are minted from the engine's rid counter then, so a
+                journal replay reproduces them exactly)
+      live      member rids without a terminal outcome yet (the group
+                outcome-aggregation unit: the group is done when this
+                empties)
+      reserved  slot indices held for the pending branches while the
+                lead's prompt streams (token-budget mode only): marked
+                ``prefilling`` with no request/prefill state so the
+                admission pass cannot hand them out; emptied by the
+                fork (or by a lead drop)
+      forked    whether the COW fork has run
+
+    The table is ENGINE-BEHAVIORAL state (admission gating, fork
+    targets, outcome aggregation), so it snapshots/restores with the
+    engine — tools/check_static.py's snapshot-completeness pass audits
+    it like any other state holder."""
+
+    def __init__(self):
+        self.groups: Dict[int, dict] = {}
+        self._by_rid: Dict[int, int] = {}
+
+    def create(self, gid: int, n: int) -> dict:
+        g = {"n": int(n), "rids": [gid], "live": [gid],
+             "reserved": [], "forked": False}
+        self.groups[gid] = g
+        self._by_rid[gid] = gid
+        return g
+
+    def add_branch(self, gid: int, rid: int) -> None:
+        g = self.groups[gid]
+        g["rids"].append(rid)
+        g["live"].append(rid)
+        self._by_rid[rid] = gid
+
+    def gid_of(self, rid: int) -> Optional[int]:
+        return self._by_rid.get(rid)
+
+    def group_of(self, rid: int) -> Optional[dict]:
+        gid = self._by_rid.get(rid)
+        return None if gid is None else self.groups.get(gid)
+
+    def reserved_slots(self) -> set:
+        return {s for g in self.groups.values() for s in g["reserved"]}
+
+    def on_terminal(self, rid: int) -> Optional[dict]:
+        """Mark a member terminal; drop the record once every member
+        is. Returns the (now possibly dead) group record, or None for
+        a non-member rid."""
+        gid = self._by_rid.get(rid)
+        if gid is None:
+            return None
+        g = self.groups[gid]
+        if rid in g["live"]:
+            g["live"].remove(rid)
+        if not g["live"]:
+            for r in g["rids"]:
+                self._by_rid.pop(r, None)
+            del self.groups[gid]
+        return g
+
+    def snapshot(self) -> dict:
+        return {"groups": [dict(g, gid=gid, rids=list(g["rids"]),
+                                live=list(g["live"]),
+                                reserved=list(g["reserved"]))
+                           for gid, g in self.groups.items()],
+                "by_rid": dict(self._by_rid)}
+
+    def restore(self, rec: dict) -> None:
+        self.groups = {}
+        for g in rec.get("groups", []):
+            self.groups[int(g["gid"])] = {
+                "n": int(g["n"]), "rids": list(g["rids"]),
+                "live": list(g["live"]),
+                "reserved": [int(s) for s in g["reserved"]],
+                "forked": bool(g["forked"])}
+        self._by_rid = {int(r): int(gid)
+                        for r, gid in rec.get("by_rid", {}).items()}
 
 
 class PagedServingEngine:
@@ -434,6 +528,9 @@ class PagedServingEngine:
                               if numeric_guard is None
                               else bool(numeric_guard))
         self.resilience_stats = ResilienceStats()
+        # fork-shared parallel decoding (branch groups): group/branch
+        # counters next to the resilience siblings
+        self.parallel_stats = ParallelStats()
         self.outcomes: List[RequestOutcome] = []
         self._step_count = 0
         self._has_deadlines = False
@@ -473,6 +570,7 @@ class PagedServingEngine:
         self.registry.attach("prefix_cache", self.prefix_stats)
         self.registry.attach("prefill", self.prefill_stats)
         self.registry.attach("resilience", self.resilience_stats)
+        self.registry.attach("parallel", self.parallel_stats)
         self.registry.attach("tenants", self.tenant_report)
         # tiers_only: the registry's pool namespace is the per-step /
         # per-sample scrape surface (router, HealthMonitor) and must
@@ -549,6 +647,11 @@ class PagedServingEngine:
         self._pending_history: List[Tuple[Tensor, np.ndarray]] = []
         self._next_rid = 0
         self._next_admit_seq = 0
+        # fork-shared parallel decoding: branch-group registry (the
+        # group is the unit of admission, fork and outcome
+        # aggregation; a forked branch is a NORMAL slot everywhere
+        # else — growth, preemption, shed)
+        self.groups = _GroupTable()
         # event queues the caller drains
         self.admitted: List[Tuple[int, int, Tensor]] = []
         self.finished: List[Tuple[int, int, int]] = []
@@ -729,7 +832,8 @@ class PagedServingEngine:
     def submit(self, prompt, *, max_preemptions: Optional[int] = None,
                deadline_steps: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               tenant_id: Optional[str] = None) -> int:
+               tenant_id: Optional[str] = None,
+               n: int = 1) -> int:
         """Queue a prompt ([T, d_model] embeddings) and try to admit.
         Returns the request id; if admission succeeded an
         ``(rid, slot, last_hidden)`` event is in ``admitted``. With
@@ -763,7 +867,19 @@ class PagedServingEngine:
         ``deadline_steps`` / ``deadline_s`` fail the request
         (FAILED_DEADLINE) once that many engine steps / seconds have
         passed since submission, whether it is running, mid-prefill or
-        still queued. Terminal outcomes surface in ``outcomes``."""
+        still queued. Terminal outcomes surface in ``outcomes``.
+
+        FORK-SHARED PARALLEL DECODING (``n`` > 1): ONE request is
+        queued whose prompt prefills ONCE; when the last chunk lands
+        the engine COW-forks n-1 branch slots whose block tables
+        reference the same prompt pages (each branch charged per
+        reference — the PR 7 policy), every branch gets its own fresh
+        rid and its own ``(rid, slot, last_hidden)`` admitted event
+        sharing the lead's prefill hidden, and from then on each
+        branch is a normal slot (growth COW-splits the written block;
+        preemption degrades a branch to an independent re-prefill).
+        Admission requires n free slots; the group is the admission
+        unit. The return value is the LEAD's rid == the group id."""
         arr = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
                          else prompt, np.float32)
         if arr.shape[0] == 0:
@@ -772,10 +888,19 @@ class PagedServingEngine:
             raise ValueError(
                 f"prompt length {arr.shape[0]} > per-seq page capacity "
                 f"{self.max_len}")
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if n > self.max_batch:
+            raise ValueError(
+                f"n={n} branches exceed max_batch={self.max_batch}")
         ten = self._resolve_tenant(tenant_id)
         req = PagedRequest(self._next_rid, arr)
         self._next_rid += 1
         req.tenant = ten.tid
+        if n > 1:
+            req.gid = req.rid
+            req.group_n = n
         req.max_preemptions = (self.max_preemptions
                                if max_preemptions is None
                                else int(max_preemptions))
@@ -785,7 +910,8 @@ class PagedServingEngine:
         if deadline_s is not None:
             req.deadline_time = time.monotonic() + float(deadline_s)
         if self.collector is not None:
-            self.collector.on_submit(req.rid, ten.tid, arr.shape[0])
+            self.collector.on_submit(req.rid, ten.tid, arr.shape[0],
+                                     gid=req.gid)
         if self.ledger is not None:
             self.ledger.on_submit(req.rid, ten.tid, arr.shape[0])
         reject = self._admission_health(req, ten)
@@ -795,6 +921,9 @@ class PagedServingEngine:
             return req.rid
         if deadline_steps is not None or deadline_s is not None:
             self._has_deadlines = True
+        if n > 1:
+            self.groups.create(req.rid, n)
+            self.parallel_stats.groups += 1
         self._bump_vtime(ten.tid)
         self._enqueue(req)
         self._try_admit()
@@ -814,10 +943,19 @@ class PagedServingEngine:
         # health check one block looser would queue it to stall at the
         # admission gate forever)
         need = self.cache.blocks_needed(min(len(req) + 1, self.max_len))
-        if ten.quota_blocks is not None and need > ten.quota_blocks:
-            return (f"prompt needs {need} block(s) through its first "
-                    f"decode token but tenant {ten.tid!r} quota is "
-                    f"{ten.quota_blocks} — can never be admitted")
+        # a branch group charges its tenant per REFERENCE (every
+        # branch table references the shared prompt blocks), while the
+        # PHYSICAL pool holds the prompt once plus each extra branch's
+        # COW-split write page — both horizons must be coverable
+        charge_need = need * req.group_n
+        phys_need = need + max(0, req.group_n - 1)
+        if ten.quota_blocks is not None and \
+                charge_need > ten.quota_blocks:
+            return (f"prompt needs {charge_need} charged block(s) "
+                    f"through its first decode token "
+                    f"(x{req.group_n} branch references) but tenant "
+                    f"{ten.tid!r} quota is {ten.quota_blocks} — can "
+                    f"never be admitted")
         # the permanent bound subtracts other tenants' FULL reserved
         # floors, not the currently-unmet remainder: free minus unmet
         # can never exceed usable minus reserved (free <= usable -
@@ -830,9 +968,9 @@ class PagedServingEngine:
             if tid != ten.tid)
         room = self.cache.num_blocks - 1 - self.watermark_blocks \
             - reserved_others
-        if need > room:
-            return (f"prompt needs {need} block(s) through its first "
-                    f"decode token but only {room} can ever be "
+        if phys_need > room:
+            return (f"prompt needs {phys_need} block(s) through its "
+                    f"first decode token but only {room} can ever be "
                     f"available past other tenants' reserved floors "
                     f"and the watermark")
         if req.deadline_steps is not None and \
@@ -889,6 +1027,12 @@ class PagedServingEngine:
                                             order.get(t, len(order))))
             ten = self.tenants[tid]
             req = ten.fifo[0]
+            # a branch group admits as ONE unit: the lead's prompt
+            # plus a slot per branch — without all n slots the fork at
+            # prefill completion could not land, so the group waits
+            # head-of-line (same no-starvation rule as pool pressure)
+            if req.group_n > self.free_slots:
+                return
             if self.prefill_token_budget is None:
                 # cover the prompt AND the first decode token's page —
                 # admitting with zero headroom would re-preempt a
@@ -900,9 +1044,12 @@ class PagedServingEngine:
             need = self.cache.blocks_needed(horizon)
             # tenant quota gates the FULL reference count (shared
             # prefix hits are charged per reference — the policy note
-            # in PagedKVCache.__init__), unlike the pool draw below
+            # in PagedKVCache.__init__), unlike the pool draw below;
+            # a branch group's fork multiplies every prompt-block
+            # reference by n, so the quota gate scales with it
+            quota_need = need * req.group_n
             if ten.quota_blocks is not None and \
-                    self.cache.tenant_charge(tid) + need \
+                    self.cache.tenant_charge(tid) + quota_need \
                     > ten.quota_blocks:
                 ten.stats.quota_hits += 1
                 skipped.add(tid)
@@ -916,7 +1063,11 @@ class PagedServingEngine:
                     req.block_hashes(self.cache.block_size))
                 rc = self.cache.allocator.refcount
                 need -= sum(1 for b in matched if rc[b] > 0)
-            draw = max(need, 0) + self.watermark_blocks
+            # physical pool draw: the prompt pages land ONCE however
+            # many branches will reference them; each extra branch
+            # only needs headroom for its first COW-split write page
+            draw = max(need, 0) + max(0, req.group_n - 1) \
+                + self.watermark_blocks
             if draw > self.free_blocks:
                 return  # head-of-line pool pressure blocks the pass
             if draw > self.free_blocks - self._unmet_floors(tid):
@@ -996,6 +1147,18 @@ class PagedServingEngine:
         if self.collector is not None:
             self.collector.on_admitted(req.rid, slot,
                                        retry=req.preemptions > 0)
+        if req.group_n > 1 and self.prefill_token_budget is not None:
+            # token-budget mode: the lead's prompt streams over many
+            # steps while admission keeps running — hold the branch
+            # slots NOW (prefilling, no request/prefill state) so the
+            # fork at prefill completion still has its n-1 targets.
+            # The admission gate guaranteed free_slots >= group_n.
+            g = self.groups.groups[req.gid]
+            for _ in range(req.group_n - 1):
+                rs = int(np.flatnonzero(~self.active
+                                        & ~self.prefilling)[0])
+                self.prefilling[rs] = True
+                g["reserved"].append(rs)
         return slot
 
     def _complete_prefill(self, slot: int, last_hidden) -> None:
@@ -1016,6 +1179,7 @@ class PagedServingEngine:
             # the admitted event's last hidden is what the caller
             # samples the FIRST TOKEN from — TTFT's defining moment
             self.collector.on_first_token(req.rid)
+        self._fork_group(slot, last_hidden)
         self._crash("post_prefill")
 
     def _chunk_registrar(self, slot: int, st: dict):
@@ -1122,11 +1286,14 @@ class PagedServingEngine:
         ran = False
         fresh: List[int] = []
         while budget >= MIN_PREFILL_SUFFIX_ROWS:
-            slots = np.flatnonzero(self.prefilling)
-            if slots.size == 0:
+            # reserved branch slots (prefilling, no prefill state)
+            # hold no prompt to advance — only real prefills qualify
+            slots = [int(s) for s in np.flatnonzero(self.prefilling)
+                     if int(s) in self._prefills]
+            if not slots:
                 break
-            slot = int(min(slots,
-                           key=lambda s: self._requests[s].admit_seq))
+            slot = min(slots,
+                       key=lambda s: self._requests[s].admit_seq)
             req = self._requests[slot]
             st = self._prefills[slot]
             T = len(req)
@@ -1176,11 +1343,14 @@ class PagedServingEngine:
         ran = False
         fresh: List[int] = []
         while budget >= MIN_PREFILL_SUFFIX_ROWS:
-            slots = np.flatnonzero(self.prefilling)
-            if slots.size == 0:
+            # reserved branch slots (prefilling, no prefill state)
+            # hold no prompt to advance — only real prefills qualify
+            slots = [int(s) for s in np.flatnonzero(self.prefilling)
+                     if int(s) in self._prefills]
+            if not slots:
                 break
-            slot = int(min(slots,
-                           key=lambda s: self._requests[s].admit_seq))
+            slot = min(slots,
+                       key=lambda s: self._requests[s].admit_seq)
             req = self._requests[slot]
             st = self._prefills[slot]
             T = len(req)
@@ -1298,7 +1468,173 @@ class PagedServingEngine:
         self.admitted.append((req.rid, slot, last_hidden))
         if self.collector is not None:
             self.collector.on_first_token(req.rid)
+        self._fork_group(slot, last_hidden)
         self._crash("post_prefill")
+
+    def _fork_group(self, slot: int, last_hidden) -> None:
+        """COW-fork the branch slots of a freshly prefilled group
+        lead: every branch gets a fresh rid, a history COPY (branches
+        diverge from the shared prompt on their first decode token), a
+        block table REFERENCING the lead's prompt pages
+        (``PagedKVCache.fork`` — charged per reference) and its own
+        admitted event carrying the SHARED prefill hidden, so the
+        caller samples each branch's first token from one prefill.
+        The ledger's ``on_fork`` raises the branch's high-water mark
+        to the fork length WITHOUT pending rows — the shared prefill
+        is priced exactly once, under the lead. Runs BEFORE the
+        ``post_prefill`` crash point: a crash there replays with the
+        fork already journaled in the step's effects, the mid-group
+        recovery case the tests pin."""
+        req = self._requests[slot]
+        if req is None or req.group_n <= 1:
+            return
+        g = self.groups.groups.get(req.gid)
+        if g is None or g["forked"]:
+            return
+        n = req.group_n
+        T = len(req)
+        reserved = list(g["reserved"])
+        del g["reserved"][:]
+        for i in range(1, n):
+            if reserved:
+                bslot = reserved.pop(0)
+                self.prefilling[bslot] = False
+            else:
+                bslot = int(np.flatnonzero(~self.active
+                                           & ~self.prefilling)[0])
+            breq = PagedRequest(self._next_rid, req.history)
+            self._next_rid += 1
+            breq.tenant = req.tenant
+            breq.gid = req.gid
+            breq.branch = i
+            breq.max_preemptions = req.max_preemptions
+            breq.deadline_steps = req.deadline_steps
+            breq.deadline_time = req.deadline_time
+            breq.submit_step = req.submit_step
+            self.groups.add_branch(req.gid, breq.rid)
+            if self.collector is not None:
+                self.collector.on_submit(breq.rid, breq.tenant, T,
+                                         gid=breq.gid)
+            if self.ledger is not None:
+                self.ledger.on_submit(breq.rid, breq.tenant, T)
+                self.ledger.on_fork(breq.rid, T)
+            # attribute BEFORE the fork so every shared-page reference
+            # charges the branch's tenant from the first reference
+            self.cache.set_seq_tenant(bslot, breq.tenant)
+            self.cache.fork(slot, bslot, T)
+            self._requests[bslot] = breq
+            breq.slot = bslot
+            breq.admit_seq = self._next_admit_seq
+            self._next_admit_seq += 1
+            self.lens[bslot] = T
+            self.active[bslot] = True
+            self._tenant_of(breq).stats.admitted += 1
+            if self.collector is not None:
+                self.collector.on_admitted(breq.rid, bslot,
+                                           retry=False)
+            self.admitted.append((breq.rid, bslot, last_hidden))
+            if self.collector is not None:
+                self.collector.on_first_token(breq.rid)
+            self.parallel_stats.branches += 1
+            self.parallel_stats.prefill_tokens_saved += T
+            self.parallel_stats.shared_blocks += \
+                self.cache.blocks_needed(T)
+        g["forked"] = True
+        # the lead is a normal slot from here: a later preemption
+        # re-prefills it alone instead of re-forking
+        req.group_n = 1
+
+    def fork_stream(self, rid: int) -> int:
+        """Beam/tree primitive: clone a RUNNING stream mid-decode into
+        a free slot — history copied, pages COW-shared at the current
+        length (the clone's next written block splits), fresh rid
+        returned. The source's group grows by the clone (a group is
+        created on demand for a previously lone stream), so the group
+        audit and outcome aggregation cover beam trees too. Raises
+        ValueError when the rid is not active or no slot is free —
+        beam scheduling is the caller's policy; the engine only
+        provides the fork."""
+        slot = None
+        for s, r in enumerate(self._requests):
+            if r is not None and r.rid == rid:
+                slot = s
+                break
+        if slot is None or not self.active[slot]:
+            raise ValueError(f"rid {rid} is not an active stream")
+        free = np.flatnonzero(~self.active & ~self.prefilling)
+        reserved = self.groups.reserved_slots()
+        free = [int(s) for s in free if int(s) not in reserved]
+        if not free:
+            raise ValueError("no free slot to fork into")
+        # buffered decode inputs must reach the history before it is
+        # copied, or the clone would re-prefill a truncated stream
+        self._flush_history()
+        req = self._requests[slot]
+        bslot = free[0]
+        L = int(self.lens[slot])
+        if req.gid is None:
+            req.gid = req.rid
+            g = self.groups.create(req.rid, 1)
+            g["forked"] = True
+            self.parallel_stats.groups += 1
+        g = self.groups.groups[req.gid]
+        breq = PagedRequest(self._next_rid, req.history)
+        self._next_rid += 1
+        breq.tenant = req.tenant
+        breq.gid = req.gid
+        breq.branch = len(g["rids"])
+        breq.max_preemptions = req.max_preemptions
+        breq.deadline_steps = req.deadline_steps
+        breq.deadline_time = req.deadline_time
+        breq.submit_step = req.submit_step
+        g["n"] += 1
+        self.groups.add_branch(req.gid, breq.rid)
+        if self.collector is not None:
+            self.collector.on_submit(breq.rid, breq.tenant, L,
+                                     gid=breq.gid)
+        if self.ledger is not None:
+            self.ledger.on_submit(breq.rid, breq.tenant, L)
+            self.ledger.on_fork(breq.rid, L)
+        self.cache.set_seq_tenant(bslot, breq.tenant)
+        self.cache.fork(slot, bslot, L)
+        self._requests[bslot] = breq
+        breq.slot = bslot
+        breq.admit_seq = self._next_admit_seq
+        self._next_admit_seq += 1
+        self.lens[bslot] = L
+        self.active[bslot] = True
+        self._tenant_of(breq).stats.admitted += 1
+        if self.collector is not None:
+            self.collector.on_admitted(breq.rid, bslot, retry=False)
+        self.parallel_stats.branches += 1
+        self.parallel_stats.prefill_tokens_saved += L
+        self.parallel_stats.shared_blocks += self.cache.blocks_needed(L)
+        return breq.rid
+
+    def cancel(self, rid: int) -> bool:
+        """Deliberate early stop of one stream (best-of-n loser
+        pruning, beam cuts, caller cancel): pages freed through the
+        normal drop path (cached-free second chance intact — the
+        content is healthy), terminal CANCELLED outcome, pending
+        ledger work resolved as ``bestof_pruned`` waste. Works on
+        running, mid-prefill and queued (preempted) members alike.
+        Returns False for an unknown/already-terminal rid."""
+        req = None
+        for r in self._requests:
+            if r is not None and r.rid == rid:
+                req = r
+                break
+        if req is None:
+            for r in self.queue:
+                if r.rid == rid:
+                    req = r
+                    break
+        if req is None:
+            return False
+        self._fail(req, RequestOutcome.CANCELLED,
+                   "cancelled (early stop)")
+        self._try_admit()
+        return True
 
     # -- release / preemption / failure -------------------------------
     def release(self, slot: int) -> None:
@@ -1330,6 +1666,13 @@ class PagedServingEngine:
         elif status == RequestOutcome.REJECTED_ADMISSION:
             st.rejected += 1
             ts.rejections += 1
+        elif status == RequestOutcome.CANCELLED:
+            st.cancelled += 1
+            ts.cancelled += 1
+        # group outcome aggregation: a member's terminal verdict
+        # retires it from its group's live set (the record drops when
+        # the last member lands)
+        self.groups.on_terminal(req.rid)
         col = self.collector
         if self.ledger is not None:
             # the terminal verdict resolves the request's pending work
@@ -1443,6 +1786,17 @@ class PagedServingEngine:
             # completed blocks registered) before they are freed
             self._flush_ragged_plan()
         self._flush_history()
+        req = self._requests[slot]
+        if req is not None and req.group_n > 1 and \
+                req.gid is not None:
+            # an UNFORKED group lead leaving its slot (preemption /
+            # failure / cancel) releases the branch-slot reservation —
+            # a re-admission reserves afresh
+            g = self.groups.groups.get(req.gid)
+            if g is not None and g["reserved"]:
+                for rs in g["reserved"]:
+                    self.prefilling[rs] = False
+                del g["reserved"][:]
         if quarantine:
             self.cache.quarantine_seq(slot)
         else:
@@ -1490,7 +1844,8 @@ class PagedServingEngine:
         branches degenerate to every held slot — the pre-tenant
         youngest-first policy, bit-identical."""
         held = [int(s) for s in
-                np.flatnonzero(self.active | self.prefilling)]
+                np.flatnonzero(self.active | self.prefilling)
+                if self._requests[int(s)] is not None]
         ten = self._tenant_of(req)
         if ten.reserved_blocks and \
                 self.cache.tenant_charge(ten.tid) < ten.reserved_blocks:
@@ -1508,7 +1863,8 @@ class PagedServingEngine:
     def _preempt_youngest(self, cands: Optional[List[int]] = None) -> int:
         if cands is None:
             cands = [int(s) for s in
-                     np.flatnonzero(self.active | self.prefilling)]
+                     np.flatnonzero(self.active | self.prefilling)
+                     if self._requests[int(s)] is not None]
         victim = max(cands, key=lambda s: self._requests[s].admit_seq)
         self.preempt(victim)
         return victim
@@ -2021,7 +2377,8 @@ class PagedServingEngine:
                 ten.stats.quota_hits += 1
                 own = [int(s) for s in
                        np.flatnonzero(self.active | self.prefilling)
-                       if self._requests[int(s)].tenant == ten.tid]
+                       if self._requests[int(s)] is not None
+                       and self._requests[int(s)].tenant == ten.tid]
                 if len(own) <= 1:
                     self._fail(req, RequestOutcome.FAILED_OOM,
                                f"tenant {ten.tid!r} block quota "
@@ -2039,7 +2396,8 @@ class PagedServingEngine:
                     own = [int(s) for s in
                            np.flatnonzero(self.active
                                           | self.prefilling)
-                           if self._requests[int(s)].tenant == ten.tid]
+                           if self._requests[int(s)] is not None
+                           and self._requests[int(s)].tenant == ten.tid]
                     # sole member: self-evict and wait queued (the
                     # floor clears when its owner charges up); with
                     # peers, the tenant's youngest yields
@@ -2111,7 +2469,17 @@ class PagedServingEngine:
         covers its length. Run it after every step under the test
         suite's ``--audit-invariants`` flag, or from a serving loop's
         debug path."""
+        reserved = self.groups.reserved_slots()
         for slot in np.flatnonzero(self.active | self.prefilling):
+            if int(slot) in reserved:
+                # branch-slot reservation of an unforked group lead:
+                # held (prefilling) but deliberately requestless
+                assert self.prefilling[int(slot)] and \
+                    self._requests[int(slot)] is None and \
+                    int(slot) not in self._prefills and \
+                    self.lens[int(slot)] == 0, \
+                    f"reserved branch slot {int(slot)} inconsistent"
+                continue
             req = self._requests[int(slot)]
             assert req is not None and req.slot == int(slot), \
                 f"slot {int(slot)} active without a matching request"
@@ -2129,6 +2497,8 @@ class PagedServingEngine:
         # set_tenant refuses quotas below the current charge)
         for slot in np.flatnonzero(self.active | self.prefilling):
             req = self._requests[int(slot)]
+            if req is None:        # reserved branch slot (audited above)
+                continue
             assert req.tenant in self.tenants, \
                 f"slot {int(slot)} request of unknown tenant " \
                 f"{req.tenant!r}"
@@ -2166,9 +2536,40 @@ class PagedServingEngine:
         assert self._queue_len == total_q, \
             (f"queue depth gauge {self._queue_len} != {total_q} "
              f"request(s) across the sub-queues")
+        self._audit_groups()
         self.cache.check_invariants(lens=self.lens, active=self.active)
         self.resilience_stats.audits += 1
         return True
+
+    def _audit_groups(self) -> None:
+        """Fork-shared page audit: for every live group, every pool
+        block's MULTIPLICITY across the member slots' block tables is
+        covered by the allocator's refcount (each branch-table
+        reference holds one count — the one-charge-per-reference
+        policy made physical). ``>=`` not ``==``: the prefix cache and
+        the cached-free index may hold further legitimate references
+        on top of the group's own. Also audits the group records
+        themselves: members map back to the group, reserved slots are
+        requestless holders, and only unforked groups reserve."""
+        by_slot = {r.rid: s for s, r in enumerate(self._requests)
+                   if r is not None}
+        for gid, g in self.groups.groups.items():
+            assert g["rids"][0] == gid, \
+                f"group {gid} lead rid mismatch: {g['rids']}"
+            assert set(g["live"]) <= set(g["rids"]), \
+                f"group {gid} live set exceeds its members"
+            if g["forked"]:
+                assert not g["reserved"], \
+                    f"forked group {gid} still holds reserved slots"
+            slots = [by_slot[rid] for rid in g["rids"]
+                     if rid in by_slot]   # queued / preempted /
+            if not slots:                 # terminal members hold no
+                continue                  # table to audit
+            rep = self.cache.share_report(slots)
+            for b, m in rep["multiplicity"].items():
+                assert rep["refcount"][b] >= m, \
+                    (f"group {gid}: block {b} referenced by {m} member "
+                     f"table(s) but refcount is {rep['refcount'][b]}")
 
     # -- page migration (disaggregated serving) -----------------------
     def export_request_slice(self, rid: int) -> Optional[dict]:
@@ -2237,6 +2638,9 @@ class PagedServingEngine:
                                    else req.deadline_time - now),
             "submit_step": req.submit_step,
             "tenant": req.tenant,
+            "gid": req.gid,
+            "branch": req.branch,
+            "group_n": req.group_n,
         }
 
     def snapshot(self) -> dict:
@@ -2292,6 +2696,7 @@ class PagedServingEngine:
                          "next_admit_seq": self._next_admit_seq,
                          "step_count": self._step_count,
                          "has_deadlines": self._has_deadlines},
+            "groups": self.groups.snapshot(),
             # tenant isolation state: configs, WFQ virtual times (the
             # list order IS the registration order — the WFQ
             # tie-break), and per-tenant stats; restore rebuilds the
@@ -2307,7 +2712,9 @@ class PagedServingEngine:
             "stats": {"prefix": self._stats_rec(self.prefix_stats),
                       "prefill": self._stats_rec(self.prefill_stats),
                       "resilience":
-                          self._stats_rec(self.resilience_stats)},
+                          self._stats_rec(self.resilience_stats),
+                      "parallel":
+                          self._stats_rec(self.parallel_stats)},
             "events": {
                 "admitted": [(rid, slot,
                               None if h is None
@@ -2400,6 +2807,11 @@ class PagedServingEngine:
             if rec["deadline_remaining"] is not None:
                 req.deadline_time = now + rec["deadline_remaining"]
             req.submit_step = rec["submit_step"]
+            # pre-group snapshots carry no branch fields: they restore
+            # as the lone streams they were
+            req.gid = rec.get("gid")
+            req.branch = rec.get("branch", 0)
+            req.group_n = rec.get("group_n", 1)
             reqs[req.rid] = req
         eng._requests = [None if rid is None else reqs[rid]
                          for rid in snap["slot_rids"]]
@@ -2429,10 +2841,15 @@ class PagedServingEngine:
         eng._next_admit_seq = c["next_admit_seq"]
         eng._step_count = c["step_count"]
         eng._has_deadlines = c["has_deadlines"]
+        # branch groups (version-gated: pre-group snapshots restore
+        # to an empty table)
+        eng.groups.restore(snap.get("groups", {}))
         cls._stats_set(eng.prefix_stats, snap["stats"]["prefix"])
         cls._stats_set(eng.prefill_stats, snap["stats"]["prefill"])
         cls._stats_set(eng.resilience_stats,
                        snap["stats"]["resilience"])
+        cls._stats_set(eng.parallel_stats,
+                       snap["stats"].get("parallel", {}))
         ev = snap["events"]
         eng.admitted = [(rid, slot,
                          None if h is None else Tensor(h))

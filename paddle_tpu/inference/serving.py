@@ -27,8 +27,8 @@ import numpy as np
 from ..framework.tensor import Tensor
 from .telemetry import StatsBase
 
-__all__ = ["ContinuousBatchingEngine", "PrefillStats",
-           "PrefixCacheStats", "ResilienceStats",
+__all__ = ["ContinuousBatchingEngine", "ParallelStats",
+           "PrefillStats", "PrefixCacheStats", "ResilienceStats",
            "ShardedServingCore", "SpecDecodeStats", "TenantStats"]
 
 # The five stats siblings below share ONE declarative base
@@ -144,12 +144,16 @@ class ResilienceStats(StatsBase):
                        admission control refused them at submit:
                        quota- or pool-impossible, or the deadline
                        below the prefill-step lower bound)
+      cancelled        requests CANCELLED — deliberate early stop
+                       (best-of-n loser pruning, beam cuts, caller
+                       cancel); NOT counted as a failure
       audits           check_invariants() passes run through the
                        engine surface
     """
 
     __slots__ = FIELDS = ("shed", "retried", "deadline_failed",
-                          "nan_failed", "rejected", "audits")
+                          "nan_failed", "rejected", "cancelled",
+                          "audits")
     DERIVED = {"failed": None}
     REPR = ("shed", "retried", "deadline_failed", "nan_failed",
             "rejected")
@@ -177,8 +181,8 @@ class TenantStats(StatsBase):
                      tenant's block quota (each may preempt or shed
                      within the tenant, never a neighbor)
       preemptions    evictions charged to this tenant's requests
-      deadline_failed / nan_failed   per-tenant split of the engine
-                     ResilienceStats counters
+      deadline_failed / nan_failed / cancelled   per-tenant split of
+                     the engine ResilienceStats counters
       blocks_held    pool blocks currently charged to the tenant (one
                      charge per block-table reference its slots hold)
       tokens_served  decode tokens consumed by this tenant's slots
@@ -187,7 +191,7 @@ class TenantStats(StatsBase):
 
     __slots__ = FIELDS = ("admitted", "sheds", "rejections",
                           "quota_hits", "preemptions",
-                          "deadline_failed", "nan_failed",
+                          "deadline_failed", "nan_failed", "cancelled",
                           "blocks_held", "tokens_served")
     DERIVED = {"failed": None}
     REPR = ("blocks_held", "tokens_served", "sheds", "rejections",
@@ -197,6 +201,40 @@ class TenantStats(StatsBase):
     def failed(self) -> int:
         return (self.sheds + self.rejections + self.deadline_failed
                 + self.nan_failed)
+
+
+class ParallelStats(StatsBase):
+    """Serving-surface accounting for fork-shared parallel decoding
+    (branch groups, scheduler.py): one ``submit(n=k)`` prefills the
+    prompt ONCE and COW-forks k branch slots over the same prompt
+    pages. Sibling of the other stats classes; counters only grow.
+
+      groups                branch groups admitted (submit(n>1) that
+                            passed the health gate, plus on-demand
+                            groups minted by ``fork_stream``)
+      branches              branch slots forked (excludes the lead:
+                            a group of n adds n-1 here; every
+                            ``fork_stream`` clone adds 1)
+      prefill_tokens_saved  prompt tokens whose prefill the fork
+                            skipped (branch length at fork time,
+                            summed over branches) — the work the
+                            shared prefill amortized
+      shared_blocks         block-table references the forks added to
+                            already-resident pages (each one a page
+                            NOT allocated; charged per reference
+                            under the PR 7 quota policy)
+    """
+
+    __slots__ = FIELDS = ("groups", "branches",
+                          "prefill_tokens_saved", "shared_blocks")
+    DERIVED = {"branches_per_group": 2}
+    REPR = ("groups", "branches", "prefill_tokens_saved")
+
+    @property
+    def branches_per_group(self) -> float:
+        if self.groups == 0:
+            return 0.0
+        return self.branches / self.groups
 
 
 class SpecDecodeStats(StatsBase):

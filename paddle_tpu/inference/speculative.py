@@ -58,7 +58,50 @@ from .resilience import RequestOutcome
 from .scheduler import PagedServingEngine, chunked_prefill
 from .serving import SpecDecodeStats
 
-__all__ = ["TokenServingModel", "SpeculativeEngine", "SpecDecodeStats"]
+__all__ = ["TokenServingModel", "SpeculativeEngine", "SpecDecodeStats",
+           "branch_lane_seed", "register_logit_mask", "logit_mask_fn"]
+
+
+def branch_lane_seed(seed: int, branch: int) -> int:
+    """Deterministic RNG-lane seed for branch ``branch`` of a group
+    submitted with ``seed``: branch 0 IS the request seed (a lone
+    seeded request and a group lead draw identically), later branches
+    decorrelate through the golden-ratio increment. This derivation is
+    the published bit-identity oracle: an n-branch group's streams are
+    byte-for-byte the streams of n independent submits seeded
+    ``branch_lane_seed(seed, i)`` for i in range(n)."""
+    return (int(seed) + 0x9E3779B9 * int(branch)) % (2 ** 32)
+
+
+# -- grammar / JSON constrained decoding: the logit-mask registry -----
+# Masks register BY NAME so snapshots and recovery journals carry a
+# string, not a callable — replay re-resolves the name. A mask fn maps
+# (tokens_so_far, vocab_size) -> bool[vocab_size], True where the
+# grammar allows the next token; it must allow at least one token.
+_LOGIT_MASKS: Dict[str, object] = {}
+
+
+def register_logit_mask(name: str, fn) -> None:
+    """Register ``fn(tokens_so_far: List[int], vocab_size: int) ->
+    bool[vocab_size]`` under ``name``. Sampling applies the mask
+    additively (0 where allowed, -1e30 where banned) BEFORE softmax /
+    argmax on every lane that carries it — draft proposals, target
+    verification and the rejection-sampling residual all stay inside
+    the language, at zero kernel cost (the mask rides the logits into
+    the existing ops)."""
+    if not callable(fn):
+        raise ValueError("logit mask must be callable")
+    _LOGIT_MASKS[str(name)] = fn
+
+
+def logit_mask_fn(name: str):
+    """Resolve a registered mask by name (KeyError names the miss)."""
+    try:
+        return _LOGIT_MASKS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown logit mask {name!r} — register_logit_mask() it "
+            f"before submit") from None
 
 
 class TokenServingModel:
@@ -187,15 +230,37 @@ class TokenServingModel:
 
     def sample(self, logits, mode: str = "greedy",
                temperature: float = 1.0, top_k: Optional[int] = None,
-               rng: Optional[np.random.RandomState] = None
+               rng: Optional[np.random.RandomState] = None,
+               rng_rows: Optional[list] = None,
+               logit_mask=None
                ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """logits [..., vocab] Tensor -> (token ids int64 [...], probs
         float32 [..., vocab] or None). Greedy is a pure on-device
         argmax (probs None). Stochastic modes build the distribution
         on-device and draw per row on host with ``rng`` (inverse-CDF),
         returning the probs so speculative rejection sampling can
-        price the draws."""
+        price the draws.
+
+        ``rng_rows`` (branch groups): one RandomState-or-None per FLAT
+        row — a laned row draws its uniform from its own lane,
+        laneless rows fall back to ``rng`` sequentially. MT19937's
+        batched ``random_sample(n)`` IS n sequential draws, so passing
+        None (the default) or all-None rows is bit-identical to the
+        batched path.
+
+        ``logit_mask`` (grammar-constrained decoding): bool array of
+        the logits' shape, True where the grammar allows the token;
+        applied ADDITIVELY (0 allowed / -1e30 banned) before argmax /
+        softmax, so greedy picks the best in-language token and the
+        stochastic distribution renormalizes over the language — and
+        the rejection-sampling residual max(p - q, 0) stays
+        in-language because BOTH p and q were masked. None skips the
+        add entirely (bit-identical to before)."""
         import paddle_tpu as paddle
+        if logit_mask is not None:
+            neg = np.where(np.asarray(logit_mask, bool), 0.0,
+                           -1e30).astype(np.float32)
+            logits = logits + paddle.to_tensor(neg)
         if mode == "greedy":
             toks = np.asarray(paddle.argmax(logits, axis=-1).numpy())
             return toks.astype(np.int64), None
@@ -207,7 +272,17 @@ class TokenServingModel:
             rng = np.random
         flat = p.reshape(-1, p.shape[-1]).astype(np.float64)
         flat = flat / flat.sum(axis=-1, keepdims=True)
-        u = rng.random_sample(flat.shape[0])
+        if rng_rows is None:
+            u = rng.random_sample(flat.shape[0])
+        else:
+            if len(rng_rows) != flat.shape[0]:
+                raise ValueError(
+                    f"rng_rows needs one entry per flat row "
+                    f"({flat.shape[0]}), got {len(rng_rows)}")
+            u = np.empty(flat.shape[0], np.float64)
+            for i in range(flat.shape[0]):
+                r = rng_rows[i]
+                u[i] = (rng if r is None else r).random_sample()
         cdf = np.cumsum(flat, axis=-1)
         toks = np.empty(flat.shape[0], np.int64)
         for i in range(flat.shape[0]):
@@ -284,9 +359,13 @@ class TokenServingModel:
 class _SpecSeq:
     """Host-side token state of one request: the full stream (prompt +
     every emitted token; the LAST entry is the pending token — emitted
-    to the caller but not yet consumed by the models)."""
+    to the caller but not yet consumed by the models). Branch-group
+    members additionally carry their group id / branch index, their
+    private snapshot-carried RNG lane (``branch_lane_seed``) and the
+    name of their grammar mask."""
 
-    __slots__ = ("rid", "toks", "prompt_len", "slot", "started")
+    __slots__ = ("rid", "toks", "prompt_len", "slot", "started",
+                 "lane", "gid", "branch", "mask")
 
     def __init__(self, rid: int, prompt: List[int]):
         self.rid = rid
@@ -294,6 +373,10 @@ class _SpecSeq:
         self.prompt_len = len(prompt)
         self.slot: Optional[int] = None
         self.started = False    # first token sampled at admission?
+        self.lane: Optional[np.random.RandomState] = None
+        self.gid: Optional[int] = None
+        self.branch = 0
+        self.mask: Optional[str] = None
 
     @property
     def n_generated(self) -> int:
@@ -381,6 +464,12 @@ class SpeculativeEngine:
         # draft-pool OOM: rounds run unspeculated until a rebuild
         # lands (the verify path never depends on draft state)
         self._draft_dirty: set = set()
+        # branch groups (fork-shared parallel decoding): per-gid meta
+        # — seed / mask / best-of policy plus the member rid list. The
+        # SLOT-level group truth (reservations, live set, page audit)
+        # lives in the wrapped engine's _GroupTable; this layer owns
+        # the RNG lanes and the outcome policy.
+        self._groups: Dict[int, dict] = {}
         if self.k > 0:
             # second, smaller pool: same per-seq page capacity as the
             # target (the draft never runs ahead of the target's
@@ -410,13 +499,29 @@ class SpeculativeEngine:
                deadline_steps: Optional[int] = None,
                deadline_s: Optional[float] = None,
                tenant_id: Optional[str] = None,
-               resume: bool = False) -> int:
+               resume: bool = False, n: int = 1,
+               seed: Optional[int] = None, best_of: bool = False,
+               logit_mask: Optional[str] = None) -> int:
         """Queue a token-ID prompt; admission (now or later) samples
         the first token on-device and prefills the draft cache. The
         resilience and tenancy knobs pass straight through to the
         wrapped PagedServingEngine (see its ``submit``); terminal
         RequestOutcomes — including a health-based
         ``REJECTED_ADMISSION`` — surface in ``outcomes``.
+
+        ``n > 1`` admits a BRANCH GROUP: the prompt prefills once,
+        then the scheduler COW-forks n slots over the same prompt
+        pages and each branch samples its first token from the SHARED
+        prefill hidden. The returned rid is the lead's == the group
+        id; branch rids appear in ``group(gid)["rids"]`` as they fork.
+        ``seed`` gives every branch an independent snapshot-carried
+        RNG lane, ``branch_lane_seed(seed, i)`` — the n streams are
+        bit-identical to n independent submits with those seeds (seed
+        on a lone request is lane 0 of a group of one). ``best_of``
+        makes the group race: the first member to finish wins and the
+        losers are cancelled (pages freed, ``bestof_pruned`` waste).
+        ``logit_mask`` names a ``register_logit_mask`` grammar applied
+        to every lane of the request.
 
         ``resume=True`` HANDS OFF a stream that was already running on
         another engine (the disaggregated router's resubmission path,
@@ -441,13 +546,31 @@ class SpeculativeEngine:
             raise ValueError(
                 "resume=True needs >= 2 tokens: a nonempty consumed "
                 "prefix plus the pending (sampled, unconsumed) token")
+        if resume and n > 1:
+            raise ValueError("a resumed (handed-off) stream is one "
+                             "branch — submit it with n=1")
+        if best_of and n <= 1:
+            raise ValueError("best_of needs n > 1 branches to race")
+        if logit_mask is not None:
+            logit_mask_fn(logit_mask)   # fail unknown names loudly now
         prefix = toks[:-1] if resume else toks
         rid = self.engine.submit(self.target.embed(prefix),
                                  max_preemptions=max_preemptions,
                                  deadline_steps=deadline_steps,
                                  deadline_s=deadline_s,
-                                 tenant_id=tenant_id)
+                                 tenant_id=tenant_id, n=n)
         seq = _SpecSeq(rid, toks)
+        seq.mask = logit_mask
+        if seed is not None:
+            seq.lane = np.random.RandomState(branch_lane_seed(seed, 0))
+        if n > 1:
+            seq.gid = rid
+            self._groups[rid] = {
+                "gid": rid, "n": int(n), "seed": seed,
+                "best_of": bool(best_of), "mask": logit_mask,
+                "prompt": list(toks), "rids": [rid],
+                "next_branch": 1, "done": False, "winner": None,
+                "released": []}
         if resume:
             # prompt_len counts the whole handed-off stream: THIS
             # engine generated none of it, so generated(rid) reports
@@ -500,6 +623,12 @@ class SpeculativeEngine:
         refill would admit an orphan slot this wrapper no longer
         tracks."""
         seq = self._by_rid.pop(rid)
+        g = self._groups.get(seq.gid) if seq.gid is not None else None
+        if g is not None:
+            g["released"].append(rid)
+            if set(g["rids"]) <= set(g["released"]):
+                # last member released: the group record drains
+                del self._groups[seq.gid]
         if seq.slot is not None:
             slot = seq.slot
             self._seqs.pop(slot, None)
@@ -512,16 +641,154 @@ class SpeculativeEngine:
                     self.engine._dequeue(req)
         self._handle_events()
 
+    def group(self, gid: int) -> Optional[dict]:
+        """Live branch-group record (None once every member has been
+        released): member ``rids`` in branch order, the best-of
+        ``winner``, seed / mask — the outcome-delivery unit for
+        parallel sampling."""
+        g = self._groups.get(gid)
+        return None if g is None else dict(g)
+
+    def cancel(self, rid: int) -> bool:
+        """Deliberate early stop of one stream (beam cuts, caller
+        cancel; best-of loser pruning calls the same path): the
+        wrapped engine frees the pages and records a CANCELLED
+        outcome, this layer detaches the stream from its slot — the
+        partial tokens stay readable via ``tokens(rid)`` until
+        ``release(rid)``."""
+        ok = self.engine.cancel(rid)
+        self._handle_events()
+        return ok
+
+    def fork_stream(self, rid: int) -> int:
+        """Beam/tree primitive at the token level: clone a RUNNING
+        stream into a free slot (engine ``fork_stream`` — pages
+        COW-shared at the current length, fresh rid, the source's
+        group grows by the clone). The clone copies the host-side
+        stream including the pending token, inherits the grammar
+        mask, gets its own RNG lane (``branch_lane_seed(seed,
+        branch)`` when the source group is seeded; an unseeded-group
+        clone duplicates the source's lane state; laneless sources
+        clone laneless) and rebuilds its draft cache from the stream.
+        Returns the clone's rid."""
+        seq = self._by_rid[rid]
+        if seq.slot is None:
+            raise ValueError(f"rid {rid} is not an active stream")
+        brid = self.engine.fork_stream(rid)
+        bslot, breq = None, None
+        for s2, r in enumerate(self.engine._requests):
+            if r is not None and r.rid == brid:
+                bslot, breq = s2, r
+                break
+        assert breq is not None, "engine fork_stream lost its clone"
+        gid = breq.gid
+        seq.gid = gid
+        g = self._groups.get(gid)
+        if g is None:
+            # on-demand group for a previously lone stream (mirrors
+            # the engine's _GroupTable create): seedless unless the
+            # source was — a lone seeded submit records no group, so
+            # its clones duplicate the lane state instead
+            g = {"gid": gid, "n": 1, "seed": None, "best_of": False,
+                 "mask": seq.mask,
+                 "prompt": list(seq.toks[:seq.prompt_len]),
+                 "rids": [rid], "next_branch": 1, "done": False,
+                 "winner": None, "released": []}
+            self._groups[gid] = g
+        g["n"] += 1
+        branch = breq.branch
+        g["next_branch"] = max(g["next_branch"], branch + 1)
+        g["rids"].append(brid)
+        clone = _SpecSeq(brid, [])
+        clone.toks = list(seq.toks)
+        clone.prompt_len = seq.prompt_len
+        clone.started = True
+        clone.slot = bslot
+        clone.gid = gid
+        clone.branch = branch
+        clone.mask = seq.mask
+        if g["seed"] is not None:
+            clone.lane = np.random.RandomState(
+                branch_lane_seed(g["seed"], branch))
+        elif seq.lane is not None:
+            clone.lane = np.random.RandomState(0)
+            clone.lane.set_state(seq.lane.get_state())
+        self._by_rid[brid] = clone
+        self._seqs[bslot] = clone
+        try:
+            self._draft_prefill(bslot, clone)
+            self._draft_dirty.discard(bslot)
+        except BlockOOM:
+            self._clear_draft_slot(bslot)
+            self._draft_dirty.add(bslot)
+        return brid
+
     def _clear_draft_slot(self, slot: int) -> None:
         if self.draft_cache is not None:
             self.draft_cache.free_seq(slot)
             self._draft_lens[slot] = 0
         self._draft_dirty.discard(slot)
 
-    def _sample(self, model: TokenServingModel, logits):
+    def _sample(self, model: TokenServingModel, logits,
+                rng_rows: Optional[list] = None, logit_mask=None):
         return model.sample(logits, mode=self.sampling,
                             temperature=self.temperature,
-                            top_k=self.top_k, rng=self._rng)
+                            top_k=self.top_k, rng=self._rng,
+                            rng_rows=rng_rows, logit_mask=logit_mask)
+
+    def _lane_rows(self, slots, L: int) -> Optional[list]:
+        """Per-flat-row RNG lanes for a [max_batch, L]-row sample: row
+        s*L+l draws from slot s's lane; laneless slots (and inactive
+        trash rows) keep the shared engine RNG. None when no active
+        stream carries a lane — the batched draw path then stays
+        bit-identical to the pre-group engine."""
+        if not any(self._seqs[s].lane is not None for s in slots):
+            return None
+        rows: List[Optional[np.random.RandomState]] = \
+            [None] * (self.max_batch * L)
+        for s in slots:
+            lane = self._seqs[s].lane
+            if lane is not None:
+                for pos in range(L):
+                    rows[s * L + pos] = lane
+        return rows
+
+    def _mask_next(self, model: TokenServingModel, slots,
+                   extra: Dict[int, List[int]]):
+        """bool[max_batch, vocab] grammar mask for sampling ONE next
+        token per slot (the draft roll): row s masks the token
+        following stream(s) + extra[s] (the proposals rolled so far).
+        None when no active stream carries a mask."""
+        masked = [s for s in slots if self._seqs[s].mask is not None]
+        if not masked:
+            return None
+        V = model.vocab_size
+        m = np.ones((self.max_batch, V), bool)
+        for s in masked:
+            seq = self._seqs[s]
+            fn = logit_mask_fn(seq.mask)
+            m[s] = np.asarray(
+                fn(list(seq.toks) + list(extra.get(s, [])), V), bool)
+        return m
+
+    def _mask_rows(self, model: TokenServingModel, slots,
+                   drafts: Dict[int, List[int]], L: int):
+        """bool[max_batch, L, vocab] grammar mask for the multi-token
+        verify sample: row (s, l) masks the token following
+        stream(s) + drafts[s][:l] — the context each verify position
+        scores. None when no active stream carries a mask."""
+        masked = [s for s in slots if self._seqs[s].mask is not None]
+        if not masked:
+            return None
+        V = model.vocab_size
+        m = np.ones((self.max_batch, L, V), bool)
+        for s in masked:
+            seq = self._seqs[s]
+            fn = logit_mask_fn(seq.mask)
+            for pos in range(L):
+                m[s, pos] = np.asarray(
+                    fn(list(seq.toks) + drafts[s][:pos], V), bool)
+        return m
 
     def _handle_events(self) -> None:
         """Reconcile wrapped-engine events: preemptions drop the draft
@@ -561,9 +828,12 @@ class SpeculativeEngine:
                 self._clear_draft_slot(slot)
                 seq.slot = None
                 self.finished.append((rid, len(seq.toks)))
+                self._member_done(seq)
         eng.finished.clear()
         for rid, slot, h in eng.admitted:
             seq = self._by_rid.get(rid)
+            if seq is None:
+                seq = self._adopt_branch(rid)
             if seq is None:
                 # released while queued (release() drops queued
                 # requests, so this is a belt-and-braces path): never
@@ -574,7 +844,14 @@ class SpeculativeEngine:
             seq.slot = slot
             self._seqs[slot] = seq
             if not seq.started:
-                tok, _ = self._sample(self.target, self.logits_of(h))
+                m = None
+                if seq.mask is not None:
+                    m = np.asarray(logit_mask_fn(seq.mask)(
+                        list(seq.toks), self.target.vocab_size),
+                        bool)[None]
+                rows = None if seq.lane is None else [seq.lane]
+                tok, _ = self._sample(self.target, self.logits_of(h),
+                                      rng_rows=rows, logit_mask=m)
                 seq.toks.append(int(tok.reshape(-1)[0]))
                 seq.started = True
             try:
@@ -587,6 +864,53 @@ class SpeculativeEngine:
                 self._clear_draft_slot(slot)
                 self._draft_dirty.add(slot)
         eng.admitted.clear()
+
+    def _adopt_branch(self, rid: int) -> Optional[_SpecSeq]:
+        """First sight of a branch rid the scheduler fork minted (an
+        admitted event with no _SpecSeq yet): build the branch's
+        stream state — prompt copy, deterministic branch index, RNG
+        lane ``branch_lane_seed(seed, branch)``, the group's mask —
+        so the caller's admission loop samples its first token from
+        the SHARED prefill hidden like any admission. Returns None
+        for rids that belong to no live group (the orphan-release
+        path keeps those). Branch indices follow admitted-event order,
+        which is the scheduler's fork order — deterministic, so a
+        replayed run adopts identical lanes."""
+        gid = self.engine.groups.gid_of(rid)
+        g = self._groups.get(gid) if gid is not None else None
+        if g is None:
+            return None
+        branch = g["next_branch"]
+        g["next_branch"] = branch + 1
+        g["rids"].append(rid)
+        seq = _SpecSeq(rid, g["prompt"])
+        seq.gid = g["gid"]
+        seq.branch = branch
+        seq.mask = g["mask"]
+        if g["seed"] is not None:
+            seq.lane = np.random.RandomState(
+                branch_lane_seed(g["seed"], branch))
+        self._by_rid[rid] = seq
+        return seq
+
+    def _member_done(self, seq: _SpecSeq) -> None:
+        """Group outcome policy on a member finishing: under
+        ``best_of`` the FIRST member to finish wins and every other
+        live member is cancelled — pages freed through the normal
+        drop path, CANCELLED outcome, pending ledger rows resolved as
+        ``bestof_pruned`` waste. Without best_of, members finish
+        independently and the record drains at release. The
+        cancellations' outcomes land in the engine event queues and
+        are drained by the next ``_handle_events`` pass (every round
+        starts with one)."""
+        g = self._groups.get(seq.gid) if seq.gid is not None else None
+        if g is None or not g["best_of"] or g["done"]:
+            return
+        g["done"] = True
+        g["winner"] = seq.rid
+        for rid in list(g["rids"]):
+            if rid != seq.rid and rid in self._by_rid:
+                self.engine.cancel(rid)
 
     def logits_of(self, hidden) -> Tensor:
         return self.target.logits(hidden)
@@ -726,6 +1050,10 @@ class SpeculativeEngine:
                 seq.slot = None
                 self._clear_draft_slot(slot)
                 eng.release(slot)
+                # best-of: first finisher wins, losers cancel (their
+                # outcomes drain on the next _handle_events pass —
+                # the loop top runs one before anything samples)
+                self._member_done(seq)
         slots = sorted(self._seqs)
         if not slots and eng.prefill_token_budget is not None and \
                 (eng.num_prefilling > 0 or eng._queue_len):
@@ -817,7 +1145,11 @@ class SpeculativeEngine:
                         lg = self.draft.logits(out[:, -1])
                         if self.injector is not None:
                             lg = self.injector.corrupt_draft_logits(lg)
-                        toks, probs = self._sample(self.draft, lg)
+                        toks, probs = self._sample(
+                            self.draft, lg,
+                            rng_rows=self._lane_rows(slots, 1),
+                            logit_mask=self._mask_next(
+                                self.draft, slots, drafts))
                         for s in slots:
                             drafts[s].append(int(toks[s]))
                             if probs is not None:
@@ -907,8 +1239,10 @@ class SpeculativeEngine:
             return {}
         if col is not None:
             col.span_begin("sample_verify")
-        g_toks, g_probs = self._sample(self.target,
-                                       self.target.logits(out))
+        g_toks, g_probs = self._sample(
+            self.target, self.target.logits(out),
+            rng_rows=self._lane_rows(slots, L),
+            logit_mask=self._mask_rows(self.target, slots, drafts, L))
         preempted_mid = {rid for rid in eng.preempted}
         failed_mid = {oc.rid for oc in eng.outcomes if oc.failed}
 
@@ -927,7 +1261,7 @@ class SpeculativeEngine:
                 emitted = d[:n] + [int(g_toks[s, n])]
             else:
                 n, correction = self._reject_sample(
-                    d, dprobs[s], g_probs[s])
+                    d, dprobs[s], g_probs[s], rng=seq.lane)
                 bonus = int(g_toks[s, k_eff]) if n == k_eff \
                     else correction
                 emitted = d[:n] + [bonus]
@@ -978,25 +1312,31 @@ class SpeculativeEngine:
         return emitted_by_rid
 
     def _reject_sample(self, d: List[int], q_rows: List[np.ndarray],
-                       p_rows: np.ndarray) -> Tuple[int, int]:
+                       p_rows: np.ndarray,
+                       rng: Optional[np.random.RandomState] = None
+                       ) -> Tuple[int, int]:
         """Standard speculative rejection sampling: accept proposal
         d[i] with prob min(1, p_i[d_i] / q_i[d_i]); at the first
         rejection draw the correction from the residual
         normalize(max(p_i - q_i, 0)). Returns (n_accepted,
         correction_token) — correction is only meaningful when
-        n_accepted < len(d)."""
+        n_accepted < len(d). ``rng`` is the sequence's private RNG
+        lane (branch groups); None keeps the shared engine RNG —
+        laned streams consume accept/residual draws from their own
+        lane only, the independence the bit-identity oracle needs."""
+        r = self._rng if rng is None else rng
         for i, tok in enumerate(d):
             p_i = p_rows[i].astype(np.float64)
             q_i = q_rows[i].astype(np.float64)
             ratio = p_i[tok] / max(q_i[tok], 1e-30)
-            if self._rng.random_sample() < min(1.0, ratio):
+            if r.random_sample() < min(1.0, ratio):
                 continue
             resid = np.maximum(p_i - q_i, 0.0)
             tot = resid.sum()
             if tot <= 0.0:      # p == q: accept-equivalent, take p draw
                 resid, tot = p_i, p_i.sum()
             cdf = np.cumsum(resid / tot)
-            c = int(np.searchsorted(cdf, self._rng.random_sample(),
+            c = int(np.searchsorted(cdf, r.random_sample(),
                                     side="right"))
             return i, min(c, len(p_i) - 1)
         return len(d), -1
@@ -1024,13 +1364,20 @@ class SpeculativeEngine:
             "engine": self.engine.snapshot(),
             "seqs": [{"rid": s.rid, "toks": list(s.toks),
                       "prompt_len": s.prompt_len, "slot": s.slot,
-                      "started": s.started}
+                      "started": s.started, "gid": s.gid,
+                      "branch": s.branch, "mask": s.mask,
+                      "lane": (None if s.lane is None
+                               else s.lane.get_state())}
                      for s in self._by_rid.values()],
             "rng": self._rng.get_state(),
             "stats": PagedServingEngine._stats_rec(self.stats),
             "finished": list(self.finished),
             "outcomes": [oc.as_dict() for oc in self.outcomes],
             "draft_dirty": sorted(self._draft_dirty),
+            # branch groups: meta records (seed/mask/policy/members);
+            # the per-branch LANE STATES ride in the seq records above
+            # so a restored run draws the same streams
+            "groups": [dict(g) for g in self._groups.values()],
         }
 
     @classmethod
@@ -1092,10 +1439,19 @@ class SpeculativeEngine:
             seq.prompt_len = rec["prompt_len"]
             seq.slot = rec["slot"]
             seq.started = rec["started"]
+            seq.gid = rec.get("gid")
+            seq.branch = rec.get("branch", 0)
+            seq.mask = rec.get("mask")
+            lane = rec.get("lane")
+            if lane is not None:
+                seq.lane = np.random.RandomState(0)
+                seq.lane.set_state(lane)
             spec._by_rid[seq.rid] = seq
             if seq.slot is not None:
                 spec._seqs[seq.slot] = seq
         spec._rng.set_state(snap["rng"])
+        spec._groups = {int(g["gid"]): dict(g)
+                        for g in snap.get("groups", [])}
         PagedServingEngine._stats_set(spec.stats, snap["stats"])
         spec.finished = list(snap["finished"])
         spec.outcomes = [RequestOutcome(**oc)

@@ -293,14 +293,16 @@ class _ReqTrace:
     """Lifecycle record of one request (collector-internal; exported
     via ``as_dict``). Timestamps are collector-relative seconds."""
 
-    __slots__ = ("rid", "tenant", "submit_ts", "admit_ts", "first_ts",
-                 "last_ts", "tokens", "chunks", "preemptions",
-                 "stall_s", "_preempt_ts", "outcome", "outcome_step",
-                 "events", "replayed")
+    __slots__ = ("rid", "tenant", "gid", "submit_ts", "admit_ts",
+                 "first_ts", "last_ts", "tokens", "chunks",
+                 "preemptions", "stall_s", "_preempt_ts", "outcome",
+                 "outcome_step", "events", "replayed")
 
-    def __init__(self, rid: int, tenant, ts, replayed: bool = False):
+    def __init__(self, rid: int, tenant, ts, replayed: bool = False,
+                 gid=None):
         self.rid = rid
         self.tenant = tenant
+        self.gid = gid             # fork-shared branch group, or None
         self.submit_ts = ts
         self.admit_ts = None
         self.first_ts = None
@@ -339,6 +341,7 @@ class _ReqTrace:
     def as_dict(self) -> dict:
         r = lambda v: None if v is None else round(v, 6)  # noqa: E731
         return {"rid": self.rid, "tenant": self.tenant,
+                "gid": self.gid,
                 "tokens": self.tokens, "chunks": self.chunks,
                 "preemptions": self.preemptions,
                 "outcome": self.outcome,
@@ -538,7 +541,7 @@ class TraceCollector:
         return self._replay and not rec.replayed
 
     def on_submit(self, rid: int, tenant: str,
-                  prompt_tokens: int) -> None:
+                  prompt_tokens: int, gid=None) -> None:
         if rid in self.requests:        # replayed submit of a known
             return                      # rid: the live record stands
         if len(self.requests) >= self.max_requests:
@@ -551,7 +554,8 @@ class TraceCollector:
                 del self.requests[victim]
                 self.evicted_requests += 1
         ts = self.now()
-        rec = _ReqTrace(rid, tenant, ts, replayed=self._replay)
+        rec = _ReqTrace(rid, tenant, ts, replayed=self._replay,
+                        gid=None if gid is None else int(gid))
         rec.events.append((ts, "submitted",
                            {"prompt_tokens": int(prompt_tokens)}))
         self.requests[rid] = rec
@@ -677,6 +681,33 @@ class TraceCollector:
                 "per_tenant": {t: roll(rs)
                                for t, rs in by_tenant.items()}}
 
+    def group_summary(self) -> dict:
+        """Per fork-shared branch group (scheduler ``submit(n>1)`` /
+        ``fork_stream``): branch count, total tokens, and GROUP TTFT —
+        the wall time from the group's earliest submit (the lead's;
+        branches are forked later, at prefill completion) to the
+        earliest first token emitted by ANY member. That is the
+        latency the caller of one n-way request observes, which
+        per-branch ``ttft_s`` (tiny for forked branches) does not
+        measure. Non-replayed records only, keyed by str(gid) for
+        JSON round-tripping."""
+        by_gid: Dict[int, list] = {}
+        for r in self.requests.values():
+            if r.gid is not None and not r.replayed:
+                by_gid.setdefault(r.gid, []).append(r)
+        out = {}
+        for gid, recs in by_gid.items():
+            firsts = [r.first_ts for r in recs if r.first_ts is not None]
+            submit = min(r.submit_ts for r in recs)
+            out[str(gid)] = {
+                "branches": len(recs),
+                "tokens": sum(r.tokens for r in recs),
+                "group_ttft_s": None if not firsts
+                else round(min(firsts) - submit, 6),
+                "outcomes": sorted(r.outcome for r in recs
+                                   if r.outcome is not None)}
+        return out
+
     def as_dict(self) -> dict:
         return {"steps": self.steps,
                 "replayed_steps": self.replayed_steps,
@@ -685,7 +716,8 @@ class TraceCollector:
                 "requests": len(self.requests),
                 "evicted_requests": self.evicted_requests,
                 "registry": self.registry.as_dict(),
-                "summary": self.request_summary()}
+                "summary": self.request_summary(),
+                "groups": self.group_summary()}
 
     def chrome_trace(self) -> dict:
         """The ``trace_events`` JSON object (Chrome/Perfetto): engine

@@ -324,6 +324,33 @@ def test_serving_int8_smoke_leg():
     assert res["int8"]["tokens_per_sec"] > 0
 
 
+def test_serving_parallel_smoke_leg():
+    res = bench_extra.bench_serving_parallel(smoke=True)
+    assert res["metric"] == "serving_parallel_fork_shared"
+    # the headline acceptance rode the bench: at EQUAL pool bytes the
+    # n=4 branch group serves >= 2x the tokens per continuation of the
+    # independent backlog inside the group's own step budget (measured
+    # 4x: the group runs all 4 branches concurrently while the
+    # independents serialize at one resident)
+    assert res["group"]["pool_bytes"] == res["independent"]["pool_bytes"]
+    assert res["tokens_per_continuation_ratio"] >= 2.0
+    assert res["independent"]["max_concurrent"] == 1
+    # one prefill for 4 continuations: the fork skipped n-1 prompts'
+    # worth of prefill, and the prompt's pages are held ONCE under
+    # 4 branch tables (every full prompt block referenced by all 4)
+    assert res["group"]["prefill_tokens_computed"] == res["prompt_len"]
+    assert res["group"]["prefill_tokens_saved"] == \
+        (res["branches"] - 1) * res["prompt_len"]
+    assert res["group"]["shared_prompt_blocks"] == \
+        res["prompt_len"] // res["block_size"]
+    assert res["group"]["share_bytes_saved"] > 0
+    # determinism guarantees asserted in-leg: a group rerun is
+    # bit-identical, and branch i's stream equals an independent
+    # submit seeded branch_lane_seed(S, i) token-for-token
+    assert res["rerun_bit_identical"] is True
+    assert res["lane_oracle_held"] is True
+
+
 def test_serving_monitor_smoke_leg():
     res = bench_extra.bench_serving_monitor(smoke=True)
     assert res["metric"] == "serving_health_monitoring"

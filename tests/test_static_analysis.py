@@ -262,12 +262,16 @@ class TestRealTree:
         assert {"PagedKVCache", "PagedServingEngine",
                 "SpeculativeEngine", "FleetSupervisor",
                 "MoeServingCore"} <= snap_classes
+        # the fork-shared group table auto-engaged the day it landed:
+        # it carries snapshot()/restore(), so its fields ride the
+        # completeness audit (mutation spot-check below proves it)
+        assert "_GroupTable" in snap_classes
         jc = cs.JournalCoverage()
         kinds = {}
         for sf in files:
             kinds[sf.base] = set(jc._written_kinds(sf))
         assert {"submit", "round", "release", "import_slice",
-                "set_tenant", "outcomes", "compact"} <= \
+                "set_tenant", "outcomes", "compact", "cancel"} <= \
             kinds["recovery.py"]
         assert {"submit", "emit", "tick", "delivered", "release",
                 "respawn", "rebalance"} <= kinds["router.py"]
@@ -275,7 +279,7 @@ class TestRealTree:
         members = jc._outcome_members(files)
         assert {"FINISHED", "FAILED_OOM", "FAILED_NUMERIC",
                 "FAILED_DEADLINE", "REJECTED_ADMISSION",
-                "FAILED_UNROUTABLE"} <= set(members)
+                "FAILED_UNROUTABLE", "CANCELLED"} <= set(members)
         # hot classes resolve in the real tree (the sharded serving
         # core included — mesh-era code inherits the purity contract)
         hot = {c.name for sf in files for c in sf.classes()}
@@ -310,7 +314,10 @@ class TestRealTree:
                 m = cs.methods_of(c)
                 if "snapshot" in m and "restore" in m:
                     keys = sc._snapshot_keys(m["snapshot"])
-                    assert len(keys) >= 5, (c.name, sorted(keys))
+                    # _GroupTable is a two-field holder (groups +
+                    # member index) — everything else carries >= 5
+                    floor = 2 if c.name == "_GroupTable" else 5
+                    assert len(keys) >= floor, (c.name, sorted(keys))
         # the compiled-step purity pass really engages the compiled
         # runner and the serving hand-off: the real tree's two
         # legitimate host hops (legacy _allreduce device_put +
@@ -395,6 +402,32 @@ class TestMutations:
         assert [(f.path, f.line) for f in kept] == \
             [(path, lineno(path, 'self.journal.append("release"'))]
         assert "'release'" in kept[0].msg
+
+    def test_deleted_group_snapshot_field(self, tmp_path):
+        """The fork-shared group acceptance: the snapshot-completeness
+        pass auto-engaged ``_GroupTable`` the day it landed — a
+        ``snapshot()`` that silently drops the rid->gid member index
+        flips exit 0 -> 1, anchored at the field's declaration."""
+        root, path = _mutate(
+            tmp_path, "scheduler.py",
+            ''',
+                "by_rid": dict(self._by_rid)}''', "}")
+        kept, _ = run(root, ["snapshot-completeness"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "self._by_rid: Dict[int, int]"))]
+        assert "_by_rid" in kept[0].msg
+
+    def test_deleted_cancel_replay_handler(self, tmp_path):
+        """A ``recover()`` that stops replaying journaled "cancel"
+        records (best-of pruning / caller early stop) flips
+        exit 0 -> 1, anchored at the append site."""
+        root, path = _mutate(
+            tmp_path, "recovery.py",
+            'kind == "cancel"', 'kind == "cancel_zzz"')
+        kept, _ = run(root, ["journal-coverage"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, 'self.journal.append("cancel"'))]
+        assert "'cancel'" in kept[0].msg
 
     def test_deleted_respawn_replay_handler(self, tmp_path):
         """The fleet WAL acceptance: a ``Router.recover`` that stops
