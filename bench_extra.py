@@ -1792,6 +1792,228 @@ def bench_serving_fleet(smoke=False):
     }
 
 
+# --------------------------------------------------------- network faults
+def bench_serving_netfaults(smoke=False):
+    """Transient-network-fault tolerance (inference/net.py): the same
+    workload over real SocketWorker processes, three configs:
+
+      baseline            ONE uninterrupted engine — the stream oracle
+                          and the tokens/s denominator
+      resilient           a seeded NetworkFaultInjector storm (conn
+                          drops before AND after delivery, torn/
+                          corrupt frames, a black-holed reply — zero
+                          kills) over the session transport: every
+                          fault is absorbed by reconnect + idempotent
+                          retry; the leg ASSERTS zero respawns, zero
+                          worker deaths and bit-identical streams
+      respawn_everything  the pre-session-layer answer to the same
+                          fault CLASS: without reconnect, every
+                          connection fault is indistinguishable from
+                          death, so each one costs a full kill +
+                          respawn cycle (modeled as one SIGKILL per
+                          connection-class fault) — the goodput gap
+                          vs the resilient leg is what the transport
+                          buys
+
+    The net.* counters ride the result — two runs of the same seed
+    report identical values (the determinism contract)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import (FleetSupervisor,
+                                      NetworkFaultInjector,
+                                      RequestOutcome, Router,
+                                      SocketWorker,
+                                      build_server_from_spec,
+                                      token_chain_hashes)
+
+    smoke = smoke or _SMOKE
+    if smoke:
+        dim, heads, ffn, layers = 32, 4, 64, 2
+        vocab, n_req, gen = 50, 3, 6
+    else:
+        dim, heads, ffn, layers = 64, 4, 128, 2
+        vocab, n_req, gen = 128, 4, 10
+    block, prompt_len = 4, 8
+    mbps = -(-(prompt_len + gen + 2) // block) + 1
+    rng = np.random.default_rng(23)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_req)]
+    d = tempfile.mkdtemp(prefix="pt_netfault_bench_")
+    names = ("n0", "n1")
+    kills = 2                   # one per connection-class fault group
+
+    def spec(name):
+        return dict(d_model=dim, heads=heads, ffn=ffn, layers=layers,
+                    vocab=vocab, head_roll=1, block_size=block,
+                    num_blocks=4 * mbps + 2, max_blocks_per_seq=mbps,
+                    snapshot_every=2,
+                    journal_path=f"{d}/{name}.wal",
+                    snapshot_path=f"{d}/{name}.ckpt")
+
+    def run_baseline():
+        srv = build_server_from_spec(spec("solo"))
+        t0 = time.perf_counter()
+        rids = [srv.submit(p) for p in prompts]
+        done = {}
+        for _ in range(6000):
+            if len(done) == n_req:
+                break
+            srv.step()
+            for i, r in enumerate(rids):
+                if i not in done and \
+                        len(srv.engine.generated(r)) >= gen:
+                    done[i] = srv.engine.generated(r)[:gen]
+                    srv.release(r)
+        wall = time.perf_counter() - t0
+        model = srv.engine.target
+        srv.close()
+        assert len(done) == n_req
+        return wall, done, model
+
+    def run_leg(model, tag, *, resilient, injector=None,
+                kill_at=None):
+        specs = {n: spec(f"{tag}_{n}") for n in names}
+        workers = [SocketWorker(specs[n], name=n, timeout=180.0,
+                                resilient=resilient,
+                                net_injector=injector)
+                   for n in names]
+        by_name = {w.name: w for w in workers}
+        wal = f"{d}/{tag}_router.wal"
+        r = Router(workers,
+                   hash_fn=lambda t: token_chain_hashes(model, t,
+                                                        block),
+                   backoff_ticks=1, journal_path=wal,
+                   call_timeout=3.0)
+        sup = FleetSupervisor(r, specs, transport="socket",
+                              socket_timeout=180.0)
+        t0 = time.perf_counter()
+        rids = [r.submit(p, max_new_tokens=gen) for p in prompts]
+        ocs, ticks = [], 0
+        try:
+            for _ in range(6000):
+                r.step()
+                sup.tick()
+                ticks += 1
+                if kill_at and ticks in kill_at:
+                    victim = by_name.get(kill_at[ticks])
+                    if victim is not None and victim.alive:
+                        victim.proc.kill()
+                ocs += r.drain_outcomes()
+                if len(ocs) >= n_req:
+                    break
+            # ride out any faults scheduled past the last outcome
+            # (scrapes keep advancing the op seqs), then settle the
+            # fleet back to full capacity
+            for _ in range(200):
+                settled = injector is None or injector.pending == 0
+                if settled and {ws.status
+                                for ws in r._workers.values()} \
+                        == {"up"}:
+                    break
+                r.step()
+                sup.tick()
+                ticks += 1
+            wall = time.perf_counter() - t0
+            done = {i: r.generated(rid)
+                    for i, rid in enumerate(rids)}
+            r.check_invariants()
+            net = {}
+            for w in r._workers.values():
+                fn = getattr(w.handle, "net_stats", None)
+                for k, v in (fn() if fn else {}).items():
+                    net[k] = net.get(k, 0) + v
+            out = dict(wall=wall, ticks=ticks, done=done, ocs=ocs,
+                       stats=r.stats, respawns=sup.respawns_total,
+                       net=net)
+            r.close()
+            return out
+        finally:
+            for w in workers:
+                try:
+                    w.kill()
+                except Exception:
+                    pass
+
+    b_wall, b_done, model = run_baseline()
+
+    storm = NetworkFaultInjector.storm(11, list(names), span=(2, 40),
+                                       drops=3, frames=2,
+                                       blackholes=1)
+    res = run_leg(model, "res", resilient=True, injector=storm)
+    # the headline guarantees ride the bench run itself
+    assert res["respawns"] == 0, \
+        "a transient network fault escalated to a respawn"
+    assert res["stats"].worker_deaths == 0
+    assert res["done"] == b_done, \
+        "storm streams diverged from the uninterrupted baseline"
+    assert sorted(o.rid for o in res["ocs"]) == \
+        sorted(set(o.rid for o in res["ocs"]))      # exactly once
+    assert all(o.status == RequestOutcome.FINISHED
+               for o in res["ocs"])
+    assert storm.pending == 0, f"storm did not drain: {storm.plan}"
+    assert res["stats"].net_reconnects >= 3
+
+    old = run_leg(model, "old", resilient=False,
+                  kill_at={4: "n0", 7: "n1"})
+    assert old["respawns"] == kills
+    assert old["done"] == b_done
+    shutil.rmtree(d, ignore_errors=True)
+
+    total = n_req * gen
+    base_tps = total / b_wall
+    res_tps = total / res["wall"]
+    old_tps = total / old["wall"]
+    return {
+        "metric": "serving_netfault_tolerance",
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "block_size": block, "requests": n_req,
+        "gen_per_request": gen, "workers": len(names),
+        "storm": storm.as_dict(),
+        "baseline": {
+            "wall_s": round(b_wall, 3),
+            "tokens_per_sec": round(base_tps, 1),
+        },
+        "resilient": {
+            "wall_s": round(res["wall"], 3),
+            "ticks": res["ticks"],
+            "goodput_tokens_per_sec": round(res_tps, 1),
+            "goodput_vs_baseline": round(res_tps / base_tps, 3),
+            "respawns": 0,
+            "worker_deaths": 0,
+            "net": res["net"],
+            "net_reconnects": res["stats"].net_reconnects,
+            "degraded_transitions":
+                res["stats"].degraded_transitions,
+        },
+        "respawn_everything": {
+            "wall_s": round(old["wall"], 3),
+            "ticks": old["ticks"],
+            "goodput_tokens_per_sec": round(old_tps, 1),
+            "goodput_vs_baseline": round(old_tps / base_tps, 3),
+            "respawns": old["respawns"],
+            "worker_deaths": old["stats"].worker_deaths,
+            "resubmissions": old["stats"].resubmissions,
+        },
+        "resilient_vs_respawn_speedup": round(res_tps / old_tps, 3),
+        "streams_bit_identical": True,      # asserted above
+        "note": "seeded network storm (3 conn drops, 2 torn/corrupt "
+                "frames, 1 black-holed reply, ZERO kills) over the "
+                "session transport: every fault resolves by "
+                "reconnect + idempotent retry (the worker's reply "
+                "cache answers re-delivered ops without "
+                "re-executing), so the resilient leg finishes with "
+                "zero respawns and streams bit-identical to the "
+                "uninterrupted baseline; the respawn_everything leg "
+                "pays the pre-session-layer price for the same fault "
+                "class — one SIGKILL + snapshot rebuild per "
+                "connection fault group — and its goodput gap is "
+                "what the transport buys (tests/test_net.py proves "
+                "determinism: same seed -> identical reconnect "
+                "sequences and net.* counters)",
+    }
+
+
 # --------------------------------------------------------- chunked prefill
 def bench_serving_longprompt(smoke=False):
     """Chunked paged prefill vs the retired dense-scratch path on a
@@ -3567,6 +3789,7 @@ BENCHES = {
     "serving_recovery": bench_serving_recovery,
     "serving_router": bench_serving_router,
     "serving_fleet": bench_serving_fleet,
+    "serving_netfaults": bench_serving_netfaults,
     "serving_sharded": bench_serving_sharded,
     "serving_sharded_compiled": bench_serving_sharded_compiled,
     "serving_obs": bench_serving_obs,

@@ -35,8 +35,8 @@ from .serving import (ContinuousBatchingEngine,  # noqa: F401
                       ParallelStats, PrefillStats, PrefixCacheStats,
                       ResilienceStats, ShardedServingCore,
                       SpecDecodeStats, TenantStats)
-from .telemetry import (MetricsRegistry, StatsBase,  # noqa: F401
-                        TraceCollector)
+from .telemetry import (MetricsRegistry, NetStats,  # noqa: F401
+                        StatsBase, TraceCollector)
 from .accounting import (CostLedger, WorkModel,  # noqa: F401
                          WASTE_CAUSES)
 from .monitor import (Alert, HealthMonitor,  # noqa: F401
@@ -47,8 +47,8 @@ from .paged_cache import (BlockAllocator, BlockOOM,  # noqa: F401
                           PagedPrefillView,
                           chain_block_hashes, chain_hash)
 from .resilience import (CrashInjector, EngineCrash,  # noqa: F401
-                         FaultInjector, RequestOutcome,
-                         RouterFaultInjector)
+                         FaultInjector, NetworkFaultInjector,
+                         RequestOutcome, RouterFaultInjector)
 from .scheduler import (DEFAULT_TENANT,  # noqa: F401
                         MIN_PREFILL_SUFFIX_ROWS,
                         PagedRequest, PagedServingEngine, Tenant,
@@ -67,6 +67,8 @@ from .router import (EngineWorker, InProcWorker,  # noqa: F401
                      WorkerError, WorkerTimeout,
                      build_model_from_spec, build_server_from_spec,
                      token_chain_hashes)
+from .net import (ReplyCache, ResilientTransport,  # noqa: F401
+                  SocketHost)
 from .fleet import (FleetSupervisor, MigrationPolicy,  # noqa: F401
                     SocketWorker)
 
@@ -96,7 +98,9 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "RouterFaultInjector", "RouterStats", "WorkerDied",
            "WorkerError", "WorkerTimeout", "build_model_from_spec",
            "build_server_from_spec", "token_chain_hashes",
-           "FleetSupervisor", "MigrationPolicy", "SocketWorker"]
+           "FleetSupervisor", "MigrationPolicy", "SocketWorker",
+           "NetStats", "NetworkFaultInjector", "ReplyCache",
+           "ResilientTransport", "SocketHost"]
 
 
 class PrecisionType:

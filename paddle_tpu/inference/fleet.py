@@ -31,10 +31,15 @@ taxonomy:
 * ``SocketWorker`` — the ``EngineWorker`` op protocol over TCP with
   the journal's length+CRC framing (``recovery.frame_message``). The
   op dispatcher and fault domain were already transport-neutral; this
-  is the one-machine wall falling. A dead socket, a torn frame, or a
-  CRC mismatch all mean exactly what a dead pipe means: WorkerDied,
-  abandonment, resubmission. SIGKILL on the child is a REAL process
-  death.
+  is the one-machine wall falling. On the RAW transport
+  (``resilient=False``) a dead socket, a torn frame, or a CRC
+  mismatch all mean exactly what a dead pipe means: WorkerDied,
+  abandonment, resubmission. The default session layer
+  (``resilient=True``, inference/net.py) absorbs those as transient
+  network faults — reconnect, idempotent resend, reply cache — and
+  escalates to the SAME taxonomy only on a refused liveness probe or
+  an exhausted retry budget. SIGKILL on the child is a REAL process
+  death either way.
 
 * ``MigrationPolicy`` — prices each candidate prefill→decode move
   instead of taking it unconditionally. Move only when
@@ -64,6 +69,7 @@ import time as _time
 from typing import Dict, Optional
 
 from .accounting import WorkModel
+from .net import ResilientTransport, SocketHost
 from .recovery import (FRAME_HEADER_SIZE, frame_body_size,
                        frame_message, unframe_message)
 from .resilience import EngineCrash
@@ -162,7 +168,9 @@ def _read_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _socket_worker_main(host: str, port: int, spec: dict) -> None:
+def _socket_worker_main(host: str, port: int, spec: dict,
+                        resilient: bool = False,
+                        accept_timeout: float = 60.0) -> None:
     """Child-process entry (multiprocessing spawn target): connect
     back to the parent FIRST (cheap, so the parent's accept returns
     before the model build), then build the server from the data-only
@@ -170,14 +178,31 @@ def _socket_worker_main(host: str, port: int, spec: dict) -> None:
     error surface as the pipe child: application errors return as
     ``{"_err": ...}``, ``EngineCrash`` reports ``{"_died": True}``
     and exits — the engine must be abandoned, and over a socket an
-    exit IS the abandonment (the parent reads EOF)."""
+    exit IS the abandonment (the parent reads EOF).
+
+    With ``resilient=True`` the child first binds its OWN listening
+    socket and advertises the port in the ready message; op serving
+    is then handed to ``SocketHost`` (inference/net.py), which treats
+    a dropped connection as a re-accept — the process outlives its
+    connections, and retried ops are answered from the reply cache
+    instead of re-executing. ``EngineCrash`` and ``close`` still end
+    the process: real death stays real."""
+    serve_sock = None
+    if resilient:
+        serve_sock = _socketlib.socket(_socketlib.AF_INET,
+                                       _socketlib.SOCK_STREAM)
+        serve_sock.bind((host, 0))
+        serve_sock.listen(1)
     sock = _socketlib.create_connection((host, int(port)))
     try:
         try:
             worker = EngineWorker(build_server_from_spec(spec),
                                   name=spec.get("name", "worker"),
                                   role=spec.get("role", "mixed"))
-            sock.sendall(frame_message({"ready": True}))
+            ready = {"ready": True}
+            if serve_sock is not None:
+                ready["port"] = serve_sock.getsockname()[1]
+            sock.sendall(frame_message(ready))
         except Exception as e:     # surface build failures loudly
             try:
                 sock.sendall(frame_message(
@@ -185,6 +210,11 @@ def _socket_worker_main(host: str, port: int, spec: dict) -> None:
                      "_died": True}))
             except OSError:
                 pass
+            return
+        if serve_sock is not None:
+            host_loop = SocketHost(serve_sock, worker, conn=sock,
+                                   accept_timeout=accept_timeout)
+            host_loop.serve()
             return
         while True:
             try:
@@ -216,25 +246,51 @@ def _socket_worker_main(host: str, port: int, spec: dict) -> None:
                 break
     finally:
         sock.close()
+        if serve_sock is not None:
+            serve_sock.close()
 
 
 class SocketWorker(WorkerHandle):
     """A REAL worker process speaking the ``EngineWorker`` op protocol
     over TCP (127.0.0.1 by default — the same class serves a remote
-    bind address) with the journal's length+CRC framing. Fault
-    mapping is the whole point: a closed socket, EOF mid-frame, or a
-    CRC mismatch is ``WorkerDied`` (dead socket == dead pipe == same
-    abandonment semantics); only a silent peer inside its deadline is
-    ``WorkerTimeout``. ``kill()`` is a genuine SIGKILL."""
+    bind address) with the journal's length+CRC framing.
+
+    Fault mapping depends on the transport mode. The ORIGINAL mapping
+    (``resilient=False``) equates every wire anomaly with death: a
+    closed socket, EOF mid-frame, or a CRC mismatch is ``WorkerDied``
+    (dead socket == dead pipe == same abandonment semantics); only a
+    silent peer inside its deadline is ``WorkerTimeout``. With
+    ``resilient=True`` (the default) the session layer
+    (``ResilientTransport``, inference/net.py) absorbs those wire
+    anomalies with reconnect + idempotent resend, and only a REFUSED
+    liveness probe (``WorkerDied``) or an exhausted retry budget
+    (``WorkerTimeout``) escalates — the same taxonomy, reached only
+    when the worker is genuinely gone or genuinely silent.
+    ``kill()`` is a genuine SIGKILL either way.
+
+      resilient     run the session layer (child serves through
+                    ``SocketHost``; reconnect survives drops)
+      net_injector  optional ``NetworkFaultInjector`` handed to the
+                    transport — test/bench wiring; absent, the fault
+                    hooks cost nothing
+    """
 
     def __init__(self, spec: dict, *, name: str, role: str = "mixed",
                  timeout: float = 120.0, start_method: str = "spawn",
-                 wait_ready: bool = True, host: str = "127.0.0.1"):
+                 wait_ready: bool = True, host: str = "127.0.0.1",
+                 resilient: bool = True, net_injector=None,
+                 probe_timeout: float = 5.0, max_retries: int = 4):
         import multiprocessing as mp
         ctx = mp.get_context(start_method)
         self.name = str(name)
         self.role = role
         self.timeout = float(timeout)
+        self.resilient = bool(resilient)
+        self.probe_timeout = float(probe_timeout)
+        self.max_retries = int(max_retries)
+        self._net_injector = net_injector
+        self._net: Optional[ResilientTransport] = None
+        self._host = str(host)
         lsock = _socketlib.socket(_socketlib.AF_INET,
                                   _socketlib.SOCK_STREAM)
         try:
@@ -244,7 +300,8 @@ class SocketWorker(WorkerHandle):
             self.proc = ctx.Process(
                 target=_socket_worker_main,
                 args=(bound_host, port,
-                      dict(spec, name=name, role=role)),
+                      dict(spec, name=name, role=role),
+                      self.resilient),
                 daemon=True)
             self.proc.start()
             # the child connects before building its model, so this
@@ -272,6 +329,18 @@ class SocketWorker(WorkerHandle):
             raise WorkerDied(f"worker {self.name!r} failed to "
                              f"build: {ready.get('_err')}")
         self._ready = True
+        port = ready.get("port")
+        if self.resilient and port:
+            # the child advertised its own listener: hand the socket
+            # to the session layer and open the session (the hello
+            # ack doubles as the first liveness proof)
+            self._net = ResilientTransport(
+                self._sock, name=self.name,
+                peer=(self._host, int(port)), timeout=self.timeout,
+                probe_timeout=self.probe_timeout,
+                max_retries=self.max_retries,
+                injector=self._net_injector)
+            self._net.hello()
 
     def _pop_msg(self) -> Optional[dict]:
         """One complete framed message off the receive buffer, or
@@ -301,13 +370,19 @@ class SocketWorker(WorkerHandle):
         op's reply). ``want_seq=None`` accepts anything (the build
         handshake)."""
         deadline = _time.monotonic() + timeout
-        self._sock.settimeout(0.05)
         while True:
             msg = self._pop_msg()
             if msg is not None:
                 if want_seq is None or msg.get("_seq") == want_seq:
                     return msg
                 continue               # stale late answer
+            # clamp the poll to the remaining budget: the final poll
+            # must fire AT the deadline, not up to 50 ms past it
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise WorkerTimeout(
+                    f"worker {self.name!r}: no answer in {timeout}s")
+            self._sock.settimeout(min(0.05, remaining))
             try:
                 chunk = self._sock.recv(1 << 16)
                 if not chunk:          # EOF: peer gone (SIGKILL too)
@@ -315,21 +390,34 @@ class SocketWorker(WorkerHandle):
                         f"worker {self.name!r} socket closed "
                         f"(exitcode {self.proc.exitcode})")
                 self._buf += chunk
-                continue
             except _socketlib.timeout:
                 pass
             except (ConnectionError, OSError) as e:
                 raise WorkerDied(
                     f"worker {self.name!r} socket error: {e}") from e
-            if _time.monotonic() > deadline:
-                raise WorkerTimeout(
-                    f"worker {self.name!r}: no answer in {timeout}s")
 
     def request(self, op, payload=None, timeout=None) -> dict:
         if self._killed:
             raise WorkerDied(f"worker {self.name!r} is dead")
         if not self._ready:
             self._handshake()          # deferred-build handshake
+        if self._net is not None:
+            # session-layer path: the transport absorbs transient
+            # wire faults; only its WorkerDied/WorkerTimeout
+            # escalations reach us, and the app-level verdicts below
+            # are interpreted identically to the raw path
+            try:
+                resp = self._net.call(op, payload, timeout)
+            except WorkerDied:
+                self._killed = True
+                raise
+            if resp.get("_died"):
+                self._killed = True
+                raise WorkerDied(
+                    f"worker {self.name!r}: {resp['_err']}")
+            if "_err" in resp:
+                raise WorkerError(resp["_err"])
+            return resp
         self._seq += 1
         try:
             self._sock.sendall(
@@ -352,6 +440,8 @@ class SocketWorker(WorkerHandle):
         if self.proc.is_alive():
             self.proc.kill()           # SIGKILL — real process death
         self.proc.join(timeout=10)
+        if self._net is not None:
+            self._net.close()
         try:
             self._sock.close()
         except OSError:
@@ -367,10 +457,18 @@ class SocketWorker(WorkerHandle):
             self.proc.terminate()
         self.proc.join(timeout=10)
         self._killed = True
+        if self._net is not None:
+            self._net.close()
         try:
             self._sock.close()
         except OSError:
             pass
+
+    def net_stats(self) -> dict:
+        """The session transport's ``net.*`` counters ({} on the raw
+        transport) — the router's degraded-state pass and the fleet
+        registry's ``net`` prefix both read this."""
+        return self._net.net_stats() if self._net is not None else {}
 
     @property
     def alive(self) -> bool:
@@ -438,6 +536,7 @@ class FleetSupervisor:
         self.registry = (MetricsRegistry() if registry is None
                          else registry)
         self.registry.attach("fleet", self._fleet_gauges)
+        self.registry.attach("net", self._net_gauges)
         self.monitor = monitor
         if monitor is not None:
             monitor.bind(self.registry)
@@ -459,16 +558,41 @@ class FleetSupervisor:
     def _fleet_gauges(self) -> dict:
         r = self.router
         live = sum(1 for ws in r._workers.values()
-                   if ws.status == "up")
+                   if ws.status in ("up", "degraded"))
+        degraded = sum(1 for ws in r._workers.values()
+                       if ws.status == "degraded")
         return {
             "workers_total": len(r._workers),
             "workers_live": live,
+            "workers_degraded": degraded,
             "respawns": r.stats.respawns,
             "migrations.forced": (r.stats.migrations
                                   - r.stats.rebalances),
             "migrations.policy": r.stats.rebalances,
             "migrations.skipped": r.stats.migrations_skipped,
         }
+
+    def _net_gauges(self) -> dict:
+        """Fleet-wide sums of the session transports' ``net.*``
+        counters. DARK ({}) when no worker runs the session layer —
+        the ``net.*`` series never appears and the monitor's
+        network-flapping detector stays off, the same
+        dark-without-the-subsystem contract the fleet series keeps
+        without a supervisor."""
+        tot: Dict[str, int] = {}
+        seen = False
+        for name in sorted(self.router._workers):
+            fn = getattr(self.router._workers[name].handle,
+                         "net_stats", None)
+            if fn is None:
+                continue
+            d = fn()
+            if not d:
+                continue
+            seen = True
+            for k, v in d.items():
+                tot[k] = tot.get(k, 0) + int(v)
+        return tot if seen else {}
 
     # -- the control loop ---------------------------------------------
     def tick(self) -> int:

@@ -48,6 +48,10 @@ timings for kernel tile sizing:
     capacity-degraded    the fleet's live-worker fraction fell under
                          its floor (FleetSupervisor-fed; dark when no
                          supervisor registry is bound)
+    network-flapping     session-transport reconnects within the
+                         detection window crossed the bound (net.*-
+                         fed, inference/net.py; dark when no worker
+                         runs the session layer)
     slo-burn             a tenant's error-budget burn rate crossed the
                          alerting bound
 
@@ -457,6 +461,13 @@ class HealthMonitor:
         "expert_collapse_frac": 0.8,
         "expert_collapse_clear": 0.5,
         "expert_collapse_min_routed": 8,
+        # network-flapping (session-transport-fed, inference/net.py;
+        # dark when no worker runs the session layer — the net.*
+        # namespace never appears): reconnects within the detection
+        # window at/above _min fires; hysteresis: re-arms only after
+        # a window with at most _clear reconnects (a settled network)
+        "network_flapping_min": 3,
+        "network_flapping_clear": 0,
     }
 
     def __init__(self, slo=None, *, sample_every: int = 1,
@@ -592,6 +603,14 @@ class HealthMonitor:
                        num(cur, "fleet.workers_live") / total)
             self._push("fleet.respawns", step,
                        num(cur, "fleet.respawns"))
+        # session-transport counters (inference/net.py — dark when no
+        # worker runs the session layer: the net.* namespace never
+        # appears and the network-flapping detector stays off)
+        if "net.reconnects" in cur:
+            self._push("net.reconnects", step,
+                       num(cur, "net.reconnects"))
+            self._push("net.retried_ops", step,
+                       num(cur, "net.retried_ops"))
         if "moe.routed_tokens" in cur:
             self._push("moe.overflow_rate", step,
                        num(cur, "moe.overflow_rate"))
@@ -820,6 +839,24 @@ class HealthMonitor:
                 else th["expert_collapse_frac"]
             self._fire("expert-collapse", v >= bound, step,
                        "moe.top_frac", v, th["expert_collapse_frac"])
+        # 5d. network-flapping (session-transport-fed: reconnects
+        #     within the detection window crossed the bound — the
+        #     fleet is riding out repeated drops rather than a single
+        #     blip. Dark without the session layer: the net.* series
+        #     is never pushed. Hysteresis: the alert re-arms only
+        #     after a window with at most _clear NEW reconnects, so
+        #     one storm is one alert however many drops it lands.)
+        sb = self._series.get("net.reconnects")
+        if sb is not None:
+            _, vals = sb.window(self.window)
+            delta = float(vals[-1] - vals[0]) if vals.size >= 2 \
+                else 0.0
+            active = ("network-flapping", None) in self._active
+            firing = (delta > th["network_flapping_clear"] if active
+                      else delta >= th["network_flapping_min"])
+            self._fire("network-flapping", firing, step,
+                       "net.reconnects", delta,
+                       th["network_flapping_min"])
         # 6. slo-burn (per tenant, deterministic order)
         if self.slo is not None:
             status = self.slo.status()
@@ -888,6 +925,12 @@ class HealthMonitor:
                 return "critical"
             if (sb.last() or 0.0) >= th["expert_collapse_clear"]:
                 return "warn"
+        elif name == "net.reconnects":
+            if ("network-flapping", None) in self._active:
+                return "critical"
+            _, vals = sb.window(self.window)
+            if vals.size >= 2 and vals[-1] > vals[0]:
+                return "warn"          # reconnecting, under the bound
         return "ok"
 
     def report(self) -> HealthReport:

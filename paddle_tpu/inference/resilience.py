@@ -64,7 +64,8 @@ import numpy as np
 from .paged_cache import BlockOOM
 
 __all__ = ["RequestOutcome", "FaultInjector", "CrashInjector",
-           "EngineCrash", "RouterFaultInjector"]
+           "EngineCrash", "RouterFaultInjector",
+           "NetworkFaultInjector"]
 
 
 class EngineCrash(RuntimeError):
@@ -532,3 +533,131 @@ class RouterFaultInjector(CrashInjector):
         return (f"RouterFaultInjector(seed={self.seed}, "
                 f"tick={self.tick}, killed={self.killed}, "
                 f"hung_ops={self.hung_ops})")
+
+
+class NetworkFaultInjector:
+    """Deterministic NETWORK faults for the session transport
+    (inference/net.py) — the fault domain below RouterFaultInjector's
+    kills and hangs: the worker process stays healthy, only the wire
+    lies. Schedules are keyed by (worker name, op seq) — the
+    transport's per-op sequence number is its deterministic clock, so
+    two identical runs take identical faults, recover through
+    identical reconnect sequences, and report identical ``net.*``
+    counters. Each scheduled fault fires at most once (the verdict is
+    consumed on first consult), so the retry that follows runs clean.
+
+    Fault kinds (``plan = {worker: {seq: kind}}``):
+
+      drop_before       the connection drops before the op frame is
+                        delivered — the worker never saw it; the
+                        resend after reconnect executes it (once).
+      drop_after        the connection drops after delivery — the
+                        worker executed and cached the reply; the
+                        resend is answered from the reply cache, NOT
+                        re-executed (the idempotency contract).
+      truncate_header   the reply frame is torn mid-header (EOF after
+                        4 of the 8 header bytes).
+      truncate_payload  the reply frame is torn mid-payload.
+      corrupt           one payload byte is flipped — the CRC check
+                        rejects the frame.
+      duplicate         the reply frame arrives twice; the second
+                        copy must be discarded by the want-seq check.
+      blackhole         every byte of the reply is swallowed until
+                        the op deadline expires — a silent peer; the
+                        liveness probe then proves the worker alive
+                        and the resend resolves the op.
+
+    Like every injector here: pure schedule playback, zero overhead
+    when absent (the transport consults it only when one was passed),
+    ``arm(False)`` disarms during replay."""
+
+    FAULTS = ("drop_before", "drop_after", "truncate_header",
+              "truncate_payload", "corrupt", "duplicate", "blackhole")
+    SEND_FAULTS = ("drop_before", "drop_after", "blackhole")
+    FRAME_FAULTS = ("truncate_header", "truncate_payload", "corrupt",
+                    "duplicate")
+
+    def __init__(self, plan: Optional[Dict[str, dict]] = None,
+                 seed: int = 0):
+        self.seed = int(seed)
+        self.plan: Dict[str, Dict[int, str]] = {}
+        for worker, sched in (plan or {}).items():
+            for s, kind in sched.items():
+                if kind not in self.FAULTS:
+                    raise ValueError(f"unknown network fault {kind!r} "
+                                     f"(one of {self.FAULTS})")
+            self.plan[str(worker)] = {int(s): str(k)
+                                      for s, k in sched.items()}
+        self._armed = True
+        self.fired: Dict[str, int] = {k: 0 for k in self.FAULTS}
+
+    @classmethod
+    def storm(cls, seed: int, workers, *, span=(2, 30), drops: int = 3,
+              frames: int = 2, blackholes: int = 1
+              ) -> "NetworkFaultInjector":
+        """Seeded random network storm: ``drops`` connection drops
+        (before/after delivery), ``frames`` torn/corrupt/duplicate
+        reply frames and ``blackholes`` silent-peer timeouts, each
+        aimed at a random (worker, op seq) in ``span``. Same seed ->
+        same storm — the acceptance-criteria generator."""
+        rng = np.random.RandomState(seed)
+        workers = list(workers)
+        n = drops + frames + blackholes
+        lo, hi = int(span[0]), int(span[1])
+        if hi - lo < n:
+            raise ValueError("not enough op seqs for the net storm")
+        kinds = (list(rng.choice(["drop_before", "drop_after"],
+                                 size=drops))
+                 + list(rng.choice(["truncate_header",
+                                    "truncate_payload", "corrupt",
+                                    "duplicate"], size=frames))
+                 + ["blackhole"] * blackholes)
+        plan: Dict[str, Dict[int, str]] = {}
+        # distinct seqs per worker so two faults never collide on one op
+        seqs = {w: list(rng.choice(np.arange(lo, hi), size=n,
+                                   replace=False)) for w in workers}
+        for kind in kinds:
+            w = workers[rng.randint(len(workers))]
+            plan.setdefault(w, {})[int(seqs[w].pop())] = str(kind)
+        return cls(plan=plan, seed=seed)
+
+    def arm(self, on: bool) -> None:
+        self._armed = bool(on)
+
+    def _take(self, worker: str, seq: int, kinds) -> Optional[str]:
+        if not self._armed:
+            return None
+        sched = self.plan.get(worker)
+        if not sched:
+            return None
+        kind = sched.get(int(seq))
+        if kind is None or kind not in kinds:
+            return None
+        del sched[int(seq)]           # fires at most once
+        self.fired[kind] += 1
+        return kind
+
+    def on_send(self, worker: str, seq: int) -> Optional[str]:
+        """Verdict consulted by the transport as it is about to send
+        op ``seq``: None (clean), "drop_before", "drop_after" or
+        "blackhole"."""
+        return self._take(worker, seq, self.SEND_FAULTS)
+
+    def on_reply(self, worker: str, seq: int) -> Optional[str]:
+        """Verdict consulted when a complete reply frame for op
+        ``seq`` is buffered: None, "truncate_header",
+        "truncate_payload", "corrupt" or "duplicate"."""
+        return self._take(worker, seq, self.FRAME_FAULTS)
+
+    @property
+    def pending(self) -> int:
+        return sum(len(s) for s in self.plan.values())
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed, "pending": self.pending,
+                "fired": dict(self.fired)}
+
+    def __repr__(self):
+        shot = {k: v for k, v in self.fired.items() if v}
+        return (f"NetworkFaultInjector(seed={self.seed}, "
+                f"pending={self.pending}, fired={shot})")

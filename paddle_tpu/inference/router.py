@@ -483,8 +483,14 @@ class PipeWorker(WorkerHandle):
         import time as _time
         deadline = _time.monotonic() + timeout
         while True:
+            # clamp the poll to the remaining budget: the final poll
+            # must fire AT the deadline, not up to 50 ms past it
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise WorkerTimeout(
+                    f"worker {self.name!r}: no answer in {timeout}s")
             try:
-                if self._conn.poll(0.05):
+                if self._conn.poll(min(0.05, remaining)):
                     resp = self._conn.recv()
                     if want_seq is None or \
                             resp.get("_seq") == want_seq:
@@ -497,9 +503,6 @@ class PipeWorker(WorkerHandle):
             if not self.proc.is_alive():
                 raise WorkerDied(f"worker {self.name!r} process died "
                                  f"(exitcode {self.proc.exitcode})")
-            if _time.monotonic() > deadline:
-                raise WorkerTimeout(
-                    f"worker {self.name!r}: no answer in {timeout}s")
 
     def request(self, op, payload=None, timeout=None) -> dict:
         if self._killed or not self.proc.is_alive():
@@ -576,6 +579,12 @@ class RouterStats(StatsBase):
                          journals stay byte-identical)
       migrations_skipped streams a ``MigrationPolicy`` priced and
                          declined to move (zero slice bytes shipped)
+      net_reconnects     session-transport reconnects the router has
+                         OBSERVED via ``handle.net_stats()`` (the
+                         degraded-state trigger; 0 on raw transports)
+      degraded_transitions  up -> degraded transitions: a worker rode
+                         out a network fault WITHOUT resubmission —
+                         the cheap failure the session layer buys
     """
 
     __slots__ = FIELDS = (
@@ -584,7 +593,8 @@ class RouterStats(StatsBase):
         "export_batches",
         "resubmissions", "oom_resubmissions", "worker_deaths",
         "worker_timeouts", "stale_released", "unroutable",
-        "respawns", "rebalances", "migrations_skipped")
+        "respawns", "rebalances", "migrations_skipped",
+        "net_reconnects", "degraded_transitions")
     REPR = ("submitted", "delivered", "migrations", "resubmissions",
             "worker_deaths", "unroutable")
 
@@ -626,7 +636,8 @@ class _WorkerState:
     __slots__ = ("handle", "name", "role", "order", "status",
                  "backoff", "retry_at", "assigned", "by_rid", "stale",
                  "index", "pressure", "queued", "active", "health",
-                 "respawned")
+                 "respawned", "net_session", "net_mark",
+                 "degraded_until")
 
     def __init__(self, handle: WorkerHandle, order: int,
                  backoff: int):
@@ -634,7 +645,7 @@ class _WorkerState:
         self.name = handle.name
         self.role = handle.role
         self.order = order
-        self.status = "up"            # up | suspect | dead
+        self.status = "up"            # up | degraded | suspect | dead
         self.backoff = backoff
         self.retry_at = 0
         self.assigned: Dict[int, int] = {}    # worker rid -> client rid
@@ -649,6 +660,14 @@ class _WorkerState:
         # by a supervisor and its first successful ping IS the rejoin
         # (journaled so a WAL reader can pair spawn <-> rejoin)
         self.respawned = False
+        # session-transport bookkeeping (_net_pass): whether the
+        # handle's session has been journaled, the reconnect counter
+        # high-water mark already accounted for, and the tick at
+        # which a degraded worker (riding out a reconnect — streams
+        # NOT resubmitted, copies NOT released) is promoted back up
+        self.net_session = False
+        self.net_mark = 0
+        self.degraded_until = 0
 
     @property
     def load(self):
@@ -693,6 +712,15 @@ class Router:
                           deterministic FAILED_UNROUTABLE verdict
       backoff_ticks/backoff_max  circuit-breaker retry schedule for
                           suspect workers (exponential, capped)
+      degraded_ticks      ticks a worker stays in the ``degraded``
+                          state after its session transport reports a
+                          reconnect with no NEW reconnects — degraded
+                          workers keep serving their streams (nothing
+                          is resubmitted or released; the WorkerDied
+                          machinery engages only on real death) but
+                          are folded into the hot set so NEW
+                          placements prefer calmer workers, and they
+                          neither donate nor receive migrations
       spill_pressure      pool-pressure fraction above which a
                           best-match / best-role worker is passed
                           over for a cooler one
@@ -706,6 +734,7 @@ class Router:
                  max_resubmissions: int = 4,
                  unroutable_after: int = 4,
                  backoff_ticks: int = 2, backoff_max: int = 16,
+                 degraded_ticks: int = 2,
                  spill_pressure: float = 0.92,
                  scrape_every: int = 1,
                  call_timeout: float = 120.0,
@@ -727,6 +756,7 @@ class Router:
         self.unroutable_after = int(unroutable_after)
         self.backoff_ticks = int(backoff_ticks)
         self.backoff_max = int(backoff_max)
+        self.degraded_ticks = int(degraded_ticks)
         self.spill_pressure = float(spill_pressure)
         self.scrape_every = int(scrape_every)
         self.call_timeout = float(call_timeout)
@@ -785,8 +815,11 @@ class Router:
                                  timeout=self.call_timeout)
 
     def _live(self) -> List[_WorkerState]:
+        # degraded workers ARE live: they keep their streams and
+        # serve their rounds — the state only biases NEW placement
+        # and migration away from them while the network settles
         return [ws for ws in self._workers.values()
-                if ws.status == "up"]
+                if ws.status in ("up", "degraded")]
 
     def _all_dead(self) -> bool:
         return all(ws.status == "dead"
@@ -825,7 +858,8 @@ class Router:
 
     def step(self) -> Dict[int, List[int]]:
         """One router tick. Order: tick the injector clock, retry
-        suspended workers, scrape placement inputs, retry unplaced
+        suspended workers, settle degraded session transports
+        (``_net_pass``), scrape placement inputs, retry unplaced
         streams (or give the deterministic unroutable verdict),
         migrate finished prefills, then drive ONE round on every
         worker holding streams. Returns {rid: [tokens]} — every token
@@ -836,6 +870,7 @@ class Router:
         if self.injector is not None:
             self.injector.begin_tick()
         self._retry_suspects()
+        self._net_pass()
         self._scrape_pass()
         self._pending_pass()
         if self.migrate:
@@ -975,6 +1010,17 @@ class Router:
                 # per-incarnation (the recovered streams resubmit
                 # through the normal placement pass)
                 router.stats.rebalances += 1
+            elif kind == "net":
+                # session-transport lane (reconnects and degraded
+                # transitions): the worker states themselves are
+                # per-incarnation — a rebuilt router starts from the
+                # handles it was given — but the counters replay so
+                # the flapping history survives the router's death
+                if payload.get("event") == "reconnect":
+                    router.stats.net_reconnects += \
+                        int(payload.get("n", 1))
+                elif payload.get("event") == "degraded":
+                    router.stats.degraded_transitions += 1
         for req in router._reqs.values():
             if req.terminal:
                 continue
@@ -1012,6 +1058,8 @@ class Router:
         return n
 
     def _hot(self, ws: _WorkerState) -> bool:
+        if ws.status == "degraded":
+            return True               # flapping network: place cooler
         if ws.pressure >= self.spill_pressure:
             return True
         h = ws.health
@@ -1241,6 +1289,55 @@ class Router:
                                        "tick": self.tick})
             self._release_stale(ws)
 
+    def _net_pass(self) -> None:
+        """Poll each live handle's session-transport counters
+        (``net_stats`` — {} or absent on raw transports: this pass is
+        DARK without the session layer). A reconnect since the last
+        look marks the worker ``degraded`` for ``degraded_ticks``:
+        its streams stay put and its copies stay held — a network
+        blip must not engage the resubmission machinery — but new
+        placement and migration route around it until it holds a
+        quiet transport for the full window. Transitions and
+        reconnect deltas are journaled as "net" records so a WAL
+        reader (tools/fleet_doctor.py) can audit the lane and
+        ``Router.recover`` replays the counters."""
+        for name in sorted(self._workers):
+            ws = self._workers[name]
+            if ws.status not in ("up", "degraded"):
+                continue
+            fn = getattr(ws.handle, "net_stats", None)
+            if fn is None:
+                continue
+            d = fn()
+            if not d:
+                continue
+            if not ws.net_session:
+                ws.net_session = True
+                self._jrec("net", {"worker": ws.name,
+                                   "event": "session",
+                                   "tick": self.tick})
+            rec = int(d.get("reconnects", 0))
+            if rec > ws.net_mark:
+                delta = rec - ws.net_mark
+                ws.net_mark = rec
+                self.stats.net_reconnects += delta
+                ws.degraded_until = self.tick + self.degraded_ticks
+                self._jrec("net", {"worker": ws.name,
+                                   "event": "reconnect", "n": delta,
+                                   "tick": self.tick})
+                if ws.status == "up":
+                    ws.status = "degraded"
+                    self.stats.degraded_transitions += 1
+                    self._jrec("net", {"worker": ws.name,
+                                       "event": "degraded",
+                                       "tick": self.tick})
+            elif ws.status == "degraded" and \
+                    self.tick >= ws.degraded_until:
+                ws.status = "up"
+                self._jrec("net", {"worker": ws.name,
+                                   "event": "recovered",
+                                   "tick": self.tick})
+
     def _release_stale(self, ws: _WorkerState) -> None:
         for wrid in sorted(ws.stale):
             try:
@@ -1328,7 +1425,8 @@ class Router:
         if not targets:
             return
         for src in [ws for ws in self._live()
-                    if ws.role == "prefill"]:
+                    if ws.role == "prefill"
+                    and ws.status == "up"]:
             moved = [(wrid, rid) for wrid, rid
                      in sorted(src.assigned.items())
                      if not self._reqs[rid].terminal
@@ -1490,11 +1588,11 @@ class Router:
 
     def _round_pass(self) -> None:
         for ws in list(self._workers.values()):
-            if ws.status != "up":
+            if ws.status not in ("up", "degraded"):
                 continue
             if ws.stale:
                 self._release_stale(ws)
-                if ws.status != "up":
+                if ws.status not in ("up", "degraded"):
                     continue
             if not ws.assigned:
                 continue

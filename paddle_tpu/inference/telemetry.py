@@ -78,8 +78,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["StatsBase", "MetricsRegistry", "TraceCollector",
-           "percentiles"]
+__all__ = ["StatsBase", "MetricsRegistry", "NetStats",
+           "TraceCollector", "percentiles"]
 
 
 # ---------------------------------------------------------------------
@@ -118,6 +118,42 @@ class StatsBase:
             parts.append(f"{name}={v:.4g}" if isinstance(v, float)
                          else f"{name}={v}")
         return f"{type(self).__name__}({', '.join(parts)})"
+
+
+class NetStats(StatsBase):
+    """Session-transport accounting (inference/net.py), one instance
+    per ``ResilientTransport``. A fleet supervisor sums these across
+    its workers under the ``net.*`` registry namespace — the series
+    the monitor's ``network-flapping`` detector watches. Every field
+    is deterministic under a seeded ``NetworkFaultInjector`` storm:
+    two identical runs report identical counters.
+
+      sessions          session hellos answered (1 + reconnects,
+                        counting the initial adoption)
+      reconnects        successful reconnect+hello sequences after a
+                        transient fault (EOF / torn frame / CRC /
+                        op timeout)
+      probes            liveness probe attempts (each reconnect try
+                        IS a probe: connect + hello; a failed probe
+                        escalates to WorkerDied)
+      retried_ops       ops resent on a resumed session after a fault
+      reply_cache_hits  retried ops the worker answered from its
+                        bounded reply cache instead of re-executing
+                        (the transport-level idempotency contract)
+      frames_rejected   reply frames discarded as torn or
+                        CRC-corrupt (never surfaced as data)
+      stale_frames      late/duplicate frames for an already-resolved
+                        op seq, discarded by the want-seq check
+      blackholes        op deadlines that expired with the connection
+                        open (a silent peer, recovered via probe)
+    """
+
+    __slots__ = FIELDS = (
+        "sessions", "reconnects", "probes", "retried_ops",
+        "reply_cache_hits", "frames_rejected", "stale_frames",
+        "blackholes")
+    REPR = ("sessions", "reconnects", "retried_ops",
+            "reply_cache_hits", "frames_rejected")
 
 
 # ---------------------------------------------------------------------

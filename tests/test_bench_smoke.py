@@ -460,3 +460,30 @@ def test_serving_moe_smoke_leg():
     assert res["dense"]["tokens_per_sec"] > 0
     assert res["moe"]["tokens_per_sec"] > 0
     assert res["moe_ep2"]["tokens_per_sec"] > 0
+
+
+def test_serving_netfaults_smoke_leg():
+    res = bench_extra.bench_serving_netfaults(smoke=True)
+    assert res["metric"] == "serving_netfault_tolerance"
+    # the acceptance guarantees rode the bench itself: zero respawns
+    # under the network-only storm, streams bit-identical to the
+    # uninterrupted baseline, outcomes exactly-once (asserted inside
+    # the leg — reaching the report dict means they all held)
+    assert res["resilient"]["respawns"] == 0
+    assert res["resilient"]["worker_deaths"] == 0
+    assert res["streams_bit_identical"] is True
+    # the storm fully drained: every scheduled fault fired
+    assert res["storm"]["pending"] == 0
+    fired = res["storm"]["fired"]
+    assert fired["drop_before"] + fired["drop_after"] == 3
+    assert fired["blackhole"] == 1
+    # the session layer did real work and reported it
+    assert res["resilient"]["net_reconnects"] >= 3
+    assert res["resilient"]["net"]["reply_cache_hits"] >= 1
+    # the comparison leg really paid the respawn-everything price
+    assert res["respawn_everything"]["respawns"] == 2
+    assert res["respawn_everything"]["worker_deaths"] >= 2
+    # all legs actually served every requested token
+    assert res["baseline"]["tokens_per_sec"] > 0
+    assert res["resilient"]["goodput_tokens_per_sec"] > 0
+    assert res["respawn_everything"]["goodput_tokens_per_sec"] > 0

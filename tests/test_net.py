@@ -1,0 +1,1036 @@
+"""Transient-network-fault tests (inference/net.py + the
+NetworkFaultInjector in resilience.py, the degraded worker state in
+router.py, the net.* observability lane in fleet.py/monitor.py and
+tools/fleet_doctor.py).
+
+The acceptance bar: a seeded network storm over a resilient socket
+fleet (connection drops before AND after delivery, torn/corrupt
+frames, a black-holed reply — zero kills) ends with ZERO respawns,
+every stream bit-identical to the fault-free single-engine run and
+every outcome delivered exactly once; a composed network+SIGKILL
+storm still ends at full capacity via the respawn path (the taxonomy
+is narrowed, never weakened); and two runs of either storm recover
+through identical reconnect sequences and identical net.* counters.
+"""
+import socket
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (EngineCrash, FleetSupervisor,
+                                  HealthMonitor, InProcWorker,
+                                  MetricsRegistry,
+                                  NetworkFaultInjector, ReplyCache,
+                                  RequestOutcome, ResilientTransport,
+                                  Router, SocketHost, SocketWorker,
+                                  WorkerDied, WorkerTimeout,
+                                  read_journal)
+from paddle_tpu.inference.net import POLL_SLICE, _slice_plan
+from paddle_tpu.inference.recovery import (FRAME_HEADER_SIZE,
+                                           RequestJournal,
+                                           frame_message)
+from paddle_tpu.inference.router import PipeWorker
+from paddle_tpu.inference.telemetry import NetStats
+from tests.test_fleet import (PROMPTS, _drive, _hash_fn,
+                              _respawn_events, _single_engine_streams,
+                              _spec)
+
+pytestmark = pytest.mark.netfault
+
+
+# ---------------------------------------------------------------------
+# units: the slice-budget deadline arithmetic
+# ---------------------------------------------------------------------
+
+class TestSlicePlan:
+    def test_sums_exactly_to_timeout(self):
+        for t in (0.27, 0.52, 1.0, 0.003, 0.123456):
+            plan = _slice_plan(t)
+            assert sum(plan) == pytest.approx(t, abs=1e-6)
+            assert all(0 < s <= POLL_SLICE + 1e-12 for s in plan)
+
+    def test_final_slice_is_the_clamped_remainder(self):
+        plan = _slice_plan(0.27)
+        assert plan[:-1] == [POLL_SLICE] * 5
+        assert plan[-1] == pytest.approx(0.02)
+
+    def test_exact_multiple_gets_no_extra_slice(self):
+        assert _slice_plan(0.1) == [POLL_SLICE, POLL_SLICE]
+
+    def test_zero_still_polls_once(self):
+        plan = _slice_plan(0.0)
+        assert len(plan) == 1 and plan[0] > 0
+
+
+# ---------------------------------------------------------------------
+# units: the reply cache (the idempotency contract's data structure)
+# ---------------------------------------------------------------------
+
+class TestReplyCache:
+    def test_put_get_and_high_water(self):
+        c = ReplyCache(capacity=4)
+        c.put(1, b"one")
+        c.put(3, b"three")
+        assert c.get(1) == b"one" and c.get(3) == b"three"
+        assert c.get(2) is None
+        assert c.last_seq == 3 and len(c) == 2
+
+    def test_fifo_eviction_past_capacity(self):
+        c = ReplyCache(capacity=2)
+        for s in (1, 2, 3):
+            c.put(s, str(s).encode())
+        assert c.get(1) is None            # the oldest fell out
+        assert c.get(2) == b"2" and c.get(3) == b"3"
+        assert c.last_seq == 3
+
+    def test_re_put_does_not_double_count(self):
+        c = ReplyCache(capacity=2)
+        c.put(1, b"a")
+        c.put(1, b"b")                     # overwrite, not append
+        c.put(2, b"c")
+        assert len(c) == 2 and c.get(1) == b"b"
+
+    def test_reset_clears_everything(self):
+        c = ReplyCache()
+        c.put(5, b"x")
+        c.reset()
+        assert c.get(5) is None and c.last_seq == 0 and len(c) == 0
+
+
+# ---------------------------------------------------------------------
+# units: the injector (seeded, fires-once, deterministic)
+# ---------------------------------------------------------------------
+
+class TestNetworkFaultInjector:
+    def test_unknown_kind_refused(self):
+        with pytest.raises(ValueError):
+            NetworkFaultInjector(plan={"w": {2: "set_on_fire"}})
+
+    def test_fires_at_most_once(self):
+        inj = NetworkFaultInjector(plan={"w": {2: "drop_before"}})
+        assert inj.on_send("w", 2) == "drop_before"
+        assert inj.on_send("w", 2) is None      # consumed
+        assert inj.fired["drop_before"] == 1 and inj.pending == 0
+
+    def test_send_and_reply_fault_domains_are_disjoint(self):
+        inj = NetworkFaultInjector(plan={"w": {2: "corrupt",
+                                               3: "blackhole"}})
+        assert inj.on_send("w", 2) is None      # corrupt is reply-side
+        assert inj.on_reply("w", 2) == "corrupt"
+        assert inj.on_reply("w", 3) is None     # blackhole is send-side
+        assert inj.on_send("w", 3) == "blackhole"
+
+    def test_disarm_suppresses_without_consuming(self):
+        inj = NetworkFaultInjector(plan={"w": {2: "duplicate"}})
+        inj.arm(False)
+        assert inj.on_reply("w", 2) is None and inj.pending == 1
+        inj.arm(True)
+        assert inj.on_reply("w", 2) == "duplicate"
+
+    def test_storm_same_seed_same_plan(self):
+        a = NetworkFaultInjector.storm(11, ["s0", "s1"])
+        b = NetworkFaultInjector.storm(11, ["s0", "s1"])
+        assert a.plan == b.plan and a.plan
+        c = NetworkFaultInjector.storm(12, ["s0", "s1"])
+        assert c.plan != a.plan
+
+    def test_storm_composition_matches_the_acceptance_mix(self):
+        inj = NetworkFaultInjector.storm(11, ["s0", "s1"], drops=3,
+                                         frames=2, blackholes=1)
+        kinds = [k for sched in inj.plan.values()
+                 for k in sched.values()]
+        assert len(kinds) == 6
+        assert sum(k in ("drop_before", "drop_after")
+                   for k in kinds) == 3
+        assert sum(k in ("truncate_header", "truncate_payload",
+                         "corrupt", "duplicate") for k in kinds) == 2
+        assert kinds.count("blackhole") == 1
+        # every fault lands inside the requested op-seq span
+        for sched in inj.plan.values():
+            assert all(2 <= s < 30 for s in sched)
+
+    def test_storm_refuses_an_undersized_span(self):
+        with pytest.raises(ValueError):
+            NetworkFaultInjector.storm(1, ["w"], span=(2, 5),
+                                       drops=3, frames=2,
+                                       blackholes=1)
+
+
+# ---------------------------------------------------------------------
+# the session layer in-process: SocketHost thread <-> transport
+# ---------------------------------------------------------------------
+
+class _Echo:
+    """A stand-in EngineWorker: records every EXECUTION so the tests
+    can distinguish a reply-cache hit from a re-execution."""
+
+    def __init__(self):
+        self.calls = []
+
+    def handle(self, op, payload):
+        if op == "boom":
+            raise EngineCrash("injected engine death")
+        self.calls.append((op, payload.get("x")))
+        return {"op": op, "x": payload.get("x"),
+                "n": len(self.calls)}
+
+
+class _Session:
+    """One SocketHost serving on a daemon thread + one transport."""
+
+    def __init__(self, name, injector=None, worker=None, **tkw):
+        self.worker = worker or _Echo()
+        self.lsock = socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)
+        self.lsock.bind(("127.0.0.1", 0))
+        self.lsock.listen(1)
+        self.peer = ("127.0.0.1", self.lsock.getsockname()[1])
+        csock = socket.create_connection(self.peer)
+        conn, _ = self.lsock.accept()
+        self.host = SocketHost(self.lsock, self.worker, conn=conn,
+                               accept_timeout=30.0)
+        self.verdicts = []
+        self.thread = threading.Thread(
+            target=lambda: self.verdicts.append(self.host.serve()),
+            daemon=True)
+        self.thread.start()
+        kw = dict(timeout=5.0, probe_timeout=2.0, max_retries=3)
+        kw.update(tkw)
+        self.t = ResilientTransport(csock, name=name, peer=self.peer,
+                                    injector=injector, **kw)
+        self.t.hello()
+
+    def executions(self, x):
+        return sum(1 for _, px in self.worker.calls if px == x)
+
+    def shutdown(self):
+        try:
+            if not self.t._closed:
+                self.t.call("close")
+        except (WorkerDied, WorkerTimeout):
+            pass
+        self.t.close()
+        self.thread.join(timeout=10)
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+
+
+class TestSessionLayer:
+    def test_roundtrip_and_session_open(self):
+        s = _Session("sl-rt")
+        try:
+            r1 = s.t.call("ping", {"x": 1})
+            r2 = s.t.call("ping", {"x": 2})
+            assert (r1["x"], r2["x"]) == (1, 2)
+            assert "_seq" not in r1
+            st = s.t.net_stats()
+            assert st["sessions"] == 1 and st["reconnects"] == 0
+            assert st["retried_ops"] == 0
+        finally:
+            s.shutdown()
+
+    def test_drop_before_delivery_executes_fresh(self):
+        inj = NetworkFaultInjector(plan={"sl-db": {2: "drop_before"}})
+        s = _Session("sl-db", injector=inj)
+        try:
+            s.t.call("ping", {"x": 1})
+            r = s.t.call("ping", {"x": 2})     # seq 2: dropped first
+            assert r["x"] == 2
+            # the worker never saw the first attempt: ONE execution
+            assert s.executions(2) == 1
+            st = s.t.net_stats()
+            assert st["reconnects"] == 1 and st["retried_ops"] == 1
+            assert st["reply_cache_hits"] == 0  # nothing was cached
+        finally:
+            s.shutdown()
+
+    def test_drop_after_delivery_is_a_cache_hit(self):
+        inj = NetworkFaultInjector(plan={"sl-da": {2: "drop_after"}})
+        s = _Session("sl-da", injector=inj)
+        try:
+            s.t.call("ping", {"x": 1})
+            r = s.t.call("ping", {"x": 2})
+            # the worker executed the FIRST delivery (n == 2); a
+            # re-execution would have answered with n == 3
+            assert r["x"] == 2 and r["n"] == 2
+            assert s.executions(2) == 1
+            st = s.t.net_stats()
+            assert st["reconnects"] == 1 and st["retried_ops"] == 1
+            assert st["reply_cache_hits"] == 1
+        finally:
+            s.shutdown()
+
+    @pytest.mark.parametrize("kind", ["truncate_header",
+                                      "truncate_payload", "corrupt"])
+    def test_torn_and_corrupt_replies_recover_from_cache(self, kind):
+        name = f"sl-{kind}"
+        inj = NetworkFaultInjector(plan={name: {2: kind}})
+        s = _Session(name, injector=inj)
+        try:
+            s.t.call("ping", {"x": 1})
+            r = s.t.call("ping", {"x": 2})
+            assert r["x"] == 2 and r["n"] == 2
+            assert s.executions(2) == 1        # cache, not re-run
+            st = s.t.net_stats()
+            assert st["frames_rejected"] == 1
+            assert st["reconnects"] == 1
+            assert st["reply_cache_hits"] == 1
+        finally:
+            s.shutdown()
+
+    def test_duplicate_reply_discarded_as_stale(self):
+        inj = NetworkFaultInjector(plan={"sl-dup": {2: "duplicate"}})
+        s = _Session("sl-dup", injector=inj)
+        try:
+            s.t.call("ping", {"x": 1})
+            r2 = s.t.call("ping", {"x": 2})    # delivered twice
+            r3 = s.t.call("ping", {"x": 3})    # must see ITS reply
+            assert r2["x"] == 2 and r3["x"] == 3
+            st = s.t.net_stats()
+            assert st["stale_frames"] == 1     # the second copy
+            assert st["reconnects"] == 0       # no retry needed
+        finally:
+            s.shutdown()
+
+    def test_blackhole_rides_the_deadline_then_cache(self):
+        inj = NetworkFaultInjector(plan={"sl-bh": {2: "blackhole"}})
+        s = _Session("sl-bh", injector=inj)
+        try:
+            s.t.call("ping", {"x": 1})
+            r = s.t.call("ping", {"x": 2}, timeout=0.4)
+            assert r["x"] == 2 and r["n"] == 2
+            assert s.executions(2) == 1
+            st = s.t.net_stats()
+            assert st["blackholes"] == 1
+            assert st["reconnects"] == 1
+            assert st["reply_cache_hits"] == 1
+        finally:
+            s.shutdown()
+
+    def test_engine_crash_travels_the_data_channel(self):
+        s = _Session("sl-crash")
+        try:
+            resp = s.t.call("boom")
+            assert resp.get("_died") and "EngineCrash" in resp["_err"]
+            with pytest.raises(WorkerDied):
+                s.t.call("ping")
+            s.thread.join(timeout=10)
+            assert s.verdicts == ["died"]
+        finally:
+            s.shutdown()
+
+    def test_same_session_reconnect_preserves_the_cache(self):
+        s = _Session("sl-keep")
+        try:
+            s.t.call("ping", {"x": 1})          # seq 1 executed
+            s.t._drop_conn()
+            ack = s.t._reconnect(1)
+            # same session id: last_seq survives the reconnect — the
+            # hello ack proves a retry of seq 1 would be a cache hit
+            assert int(ack["last_seq"]) == 1
+            assert s.t.net_stats()["reply_cache_hits"] == 1
+        finally:
+            s.shutdown()
+
+    def test_new_session_resets_the_reply_cache(self):
+        s = _Session("sl-reset")
+        try:
+            s.t.call("ping", {"x": 1})
+            s.t._drop_conn()                    # free the host thread
+            c2 = socket.create_connection(s.peer)
+            t2 = ResilientTransport(c2, name="sl-reset-2",
+                                    peer=s.peer, probe_timeout=2.0)
+            ack = t2._hello_on(c2)
+            # a NEW incarnation must never read the old one's replies
+            assert ack is not None and int(ack["last_seq"]) == 0
+            t2.close()
+        finally:
+            s.shutdown()
+
+    def test_refused_probe_escalates_to_worker_died(self):
+        s = _Session("sl-refused", backoff_base=1, backoff_cap=1)
+        s.t.call("close")                       # host exits cleanly
+        s.thread.join(timeout=10)
+        s.lsock.close()                         # nothing listens now
+        with pytest.raises(WorkerDied, match="probe refused"):
+            s.t.call("ping")
+        # the verdict is terminal: the transport stays closed
+        with pytest.raises(WorkerDied):
+            s.t.call("ping")
+
+    def test_exhausted_retry_budget_is_worker_timeout(self):
+        """A peer that ACCEPTS but never answers: the probe proves
+        nothing, the budget burns down, and the verdict is
+        WorkerTimeout — a hung worker is not a dead one."""
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        peer = ("127.0.0.1", lsock.getsockname()[1])
+        stop = threading.Event()
+        conns = []
+
+        def silent():
+            lsock.settimeout(0.1)
+            while not stop.is_set():
+                try:
+                    conns.append(lsock.accept()[0])
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+
+        th = threading.Thread(target=silent, daemon=True)
+        th.start()
+        try:
+            csock = socket.create_connection(peer)
+            t = ResilientTransport(csock, name="sl-hung", peer=peer,
+                                   timeout=0.3, probe_timeout=0.2,
+                                   max_retries=2, backoff_base=1,
+                                   backoff_cap=1)
+            with pytest.raises(WorkerTimeout, match="unanswered"):
+                t.call("ping")
+            st = t.net_stats()
+            assert st["probes"] == 2 and st["reconnects"] == 0
+            t.close()
+        finally:
+            stop.set()
+            th.join(timeout=5)
+            for c in conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            lsock.close()
+
+    def test_two_identical_fault_scripts_identical_counters(self):
+        plan = {2: "drop_after", 3: "corrupt", 5: "drop_before",
+                6: "duplicate"}
+        stats = []
+        for run in range(2):
+            name = f"sl-det{run}"
+            inj = NetworkFaultInjector(plan={name: dict(plan)})
+            s = _Session(name, injector=inj)
+            try:
+                for x in range(1, 8):
+                    assert s.t.call("ping", {"x": x})["x"] == x
+                assert inj.pending == 0
+                stats.append(s.t.net_stats())
+            finally:
+                s.shutdown()
+        assert stats[0] == stats[1]
+        assert stats[0]["reconnects"] == 3     # both drops + corrupt
+
+
+# ---------------------------------------------------------------------
+# satellite: the raw transport's final poll clamps to the deadline
+# ---------------------------------------------------------------------
+
+class TestRecvDeadlineClamp:
+    """Regression for the off-by-one-slice deadline: a timeout of
+    0.52 s must raise AT ~0.52 s, not at the next 50 ms poll boundary
+    (0.55 s) — on both process transports."""
+
+    BUDGET, CEIL = 0.52, 0.545
+
+    def test_socket_worker_recv_clamps(self, tmp_path):
+        w = SocketWorker(_spec(tmp_path, "clamp_s"), name="clamp_s",
+                         timeout=180.0, resilient=False)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(WorkerTimeout):
+                w._recv(self.BUDGET, want_seq=999)
+            el = time.monotonic() - t0
+            assert self.BUDGET - 0.02 <= el <= self.CEIL, el
+        finally:
+            w.kill()
+
+    def test_pipe_worker_recv_clamps(self, tmp_path):
+        w = PipeWorker(_spec(tmp_path, "clamp_p"), name="clamp_p",
+                       timeout=180.0)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(WorkerTimeout):
+                w._recv(self.BUDGET, want_seq=999)
+            el = time.monotonic() - t0
+            assert self.BUDGET - 0.02 <= el <= self.CEIL, el
+        finally:
+            w.kill()
+
+
+# ---------------------------------------------------------------------
+# satellite: frame-boundary faults on the RAW transport map to the
+# documented taxonomy — never to data
+# ---------------------------------------------------------------------
+
+def _raw_worker():
+    """A SocketWorker shell over one end of a socketpair: the raw
+    ``_recv``/``_pop_msg`` machinery against a peer the test scripts
+    byte-by-byte."""
+    a, b = socket.socketpair()
+    w = SocketWorker.__new__(SocketWorker)
+    w.name = "raw"
+    w.role = "mixed"
+    w.timeout = 5.0
+    w.resilient = False
+    w._net = None
+    w._net_injector = None
+    w._host = "127.0.0.1"
+    w._sock = a
+    w._buf = b""
+    w._killed = False
+    w._seq = 0
+    w._ready = True
+    w.proc = types.SimpleNamespace(exitcode=-9,
+                                   is_alive=lambda: False,
+                                   kill=lambda: None,
+                                   join=lambda timeout=None: None)
+    return w, b
+
+
+class TestRawFrameBoundaries:
+    def test_torn_mid_header_is_worker_died(self):
+        w, peer = _raw_worker()
+        frame = frame_message({"_seq": 1, "ok": True})
+        peer.sendall(frame[:FRAME_HEADER_SIZE // 2])
+        peer.close()
+        with pytest.raises(WorkerDied, match="socket closed"):
+            w._recv(2.0, want_seq=1)
+        w._sock.close()
+
+    def test_torn_mid_payload_is_worker_died(self):
+        w, peer = _raw_worker()
+        frame = frame_message({"_seq": 1, "ok": True})
+        peer.sendall(frame[:FRAME_HEADER_SIZE + 3])
+        peer.close()
+        with pytest.raises(WorkerDied, match="socket closed"):
+            w._recv(2.0, want_seq=1)
+        w._sock.close()
+
+    def test_torn_between_frames_first_frame_still_data(self):
+        w, peer = _raw_worker()
+        f1 = frame_message({"_seq": 1, "ok": True})
+        f2 = frame_message({"_seq": 2, "ok": True})
+        peer.sendall(f1 + f2[:FRAME_HEADER_SIZE - 2])
+        assert w._recv(2.0, want_seq=1)["ok"] is True
+        peer.close()
+        with pytest.raises(WorkerDied):
+            w._recv(2.0, want_seq=2)
+        w._sock.close()
+
+    def test_corrupt_crc_is_worker_died(self):
+        w, peer = _raw_worker()
+        frame = bytearray(frame_message({"_seq": 1, "ok": True}))
+        frame[FRAME_HEADER_SIZE] ^= 0xFF
+        peer.sendall(bytes(frame))
+        with pytest.raises(WorkerDied, match="torn/corrupt frame"):
+            w._recv(2.0, want_seq=1)
+        assert w._killed
+        peer.close()
+        w._sock.close()
+
+    def test_stale_late_answer_never_read_as_data(self):
+        """A timed-out op's answer arriving late must be DISCARDED,
+        not returned to the next op — the verdict is WorkerTimeout,
+        never the stale payload."""
+        w, peer = _raw_worker()
+        peer.sendall(frame_message({"_seq": 1, "stale": "poison"}))
+        with pytest.raises(WorkerTimeout):
+            w._recv(0.4, want_seq=2)
+        assert w._buf == b""               # consumed and dropped
+        peer.close()
+        w._sock.close()
+
+
+# ---------------------------------------------------------------------
+# real worker processes under injected faults
+# ---------------------------------------------------------------------
+
+class TestResilientSocketWorker:
+    def test_faulted_ops_recover_and_streams_match(self, tmp_path):
+        """One REAL worker process under a per-op fault script: the
+        submit is dropped after delivery (cache hit, rid not burned
+        twice), a round's reply is corrupted, another round's
+        connection drops pre-delivery — and the emitted stream is
+        bit-identical to the fault-free single engine."""
+        n = 5
+        base = _single_engine_streams(tmp_path, PROMPTS[:1], n)
+        inj = NetworkFaultInjector(plan={"z0": {1: "drop_after",
+                                                3: "corrupt",
+                                                4: "drop_before"}})
+        w = SocketWorker(_spec(tmp_path, "z0"), name="z0",
+                         timeout=180.0, net_injector=inj)
+        try:
+            sub = w.request("submit", {"tokens": PROMPTS[0]})
+            rid = sub["rid"]
+            got = list(sub["emitted"].get(rid, sub["emitted"].get(
+                str(rid), [])))
+            for _ in range(40):
+                out = w.request("round", {})
+                got += out["emitted"].get(rid, [])
+                if len(got) >= n:
+                    break
+            assert got[:n] == base[0]
+            assert inj.pending == 0
+            st = w.net_stats()
+            assert st["reconnects"] == 3
+            assert st["retried_ops"] == 3
+            assert st["reply_cache_hits"] >= 2  # drop_after + corrupt
+            assert st["frames_rejected"] == 1
+        finally:
+            w.kill()
+
+    def test_sigkill_still_escalates_to_worker_died(self, tmp_path):
+        """The taxonomy is narrowed, never weakened: SIGKILL a
+        resilient worker and the EOF -> probe -> connection-refused
+        chain lands on the same WorkerDied the raw transport gave."""
+        w = SocketWorker(_spec(tmp_path, "z1"), name="z1",
+                         timeout=180.0)
+        try:
+            assert w.request("ping") == {}
+            w.proc.kill()
+            w.proc.join(timeout=10)
+            with pytest.raises(WorkerDied):
+                w.request("ping")
+            assert not w.alive
+        finally:
+            w.kill()
+
+
+# ---------------------------------------------------------------------
+# the degraded worker state at the router
+# ---------------------------------------------------------------------
+
+class _SessionedInProc(InProcWorker):
+    """An in-proc worker wearing a session transport's counter face:
+    the tests drive ``net_stats`` deltas by hand to exercise the
+    router's degraded-state pass without sockets."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.net = {k: 0 for k in NetStats.FIELDS}
+        self.net["sessions"] = 1
+
+    def net_stats(self):
+        return dict(self.net)
+
+
+def _net_events(wal):
+    return [(p["worker"], p["event"], p.get("n"), p["tick"])
+            for _, k, p in read_journal(wal) if k == "net"]
+
+
+class TestDegradedState:
+    def _router(self, tmp_path, names=("d0", "d1"), **kw):
+        from tests.test_router import _tsm
+        model = _tsm()
+        workers = [_SessionedInProc(_spec(tmp_path, n), name=n,
+                                    role="mixed") for n in names]
+        wal = str(tmp_path / "router.wal")
+        r = Router(workers, hash_fn=_hash_fn(model),
+                   journal_path=wal, backoff_ticks=1, **kw)
+        return r, {w.name: w for w in workers}, wal
+
+    def test_reconnect_degrades_without_resubmission(self, tmp_path):
+        n = 5
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], n)
+        r, ws, wal = self._router(tmp_path)
+        rids = [r.submit(p, max_new_tokens=n) for p in PROMPTS[:2]]
+        r.step()
+        victim = r._reqs[rids[0]].worker
+        placed = {rid: r._reqs[rid].worker for rid in rids}
+        ws[victim].net["reconnects"] += 1
+        r.step()
+        st = r._workers[victim]
+        assert st.status == "degraded"
+        assert r.stats.net_reconnects == 1
+        assert r.stats.degraded_transitions == 1
+        # the whole point: a blip never engages the resubmission
+        # machinery — streams stay put, copies stay held
+        assert r.stats.resubmissions == 0
+        assert r.stats.worker_deaths == 0
+        assert {rid: r._reqs[rid].worker for rid in rids} == placed
+        ocs = _drive(r, len(rids), max_ticks=60)
+        assert {i: r.generated(rid)
+                for i, rid in enumerate(rids)} == base
+        assert all(o.status == RequestOutcome.FINISHED for o in ocs)
+        # quiet transport for the window: back to "up", journaled
+        for _ in range(10):
+            if r._workers[victim].status == "up":
+                break
+            r.step()
+        assert r._workers[victim].status == "up"
+        ev = [(w, e) for w, e, _, _ in _net_events(wal)
+              if w == victim]
+        assert ev == [(victim, "session"), (victim, "reconnect"),
+                      (victim, "degraded"), (victim, "recovered")]
+        r.close()
+
+    def test_new_placement_routes_around_degraded(self, tmp_path):
+        r, ws, _ = self._router(tmp_path)
+        r.step()                            # sessions sighted
+        ws["d0"].net["reconnects"] += 1
+        r.step()
+        assert r._workers["d0"].status == "degraded"
+        rid = r.submit(PROMPTS[0], max_new_tokens=3)
+        r.step()
+        assert r._reqs[rid].worker == "d1"
+        r.close()
+
+    def test_degraded_counts_as_live_capacity(self, tmp_path):
+        r, ws, _ = self._router(tmp_path)
+        r.step()
+        ws["d0"].net["reconnects"] += 1
+        ws["d1"].net["reconnects"] += 1
+        r.step()
+        assert all(s.status == "degraded"
+                   for s in r._workers.values())
+        # a fully-degraded fleet still serves: live != up
+        rid = r.submit(PROMPTS[0], max_new_tokens=3)
+        ocs = _drive(r, 1, max_ticks=40)
+        assert ocs and ocs[0].status == RequestOutcome.FINISHED
+        assert len(r.generated(rid)) >= 3
+        r.close()
+
+    def test_degraded_worker_real_death_still_resubmits(self,
+                                                        tmp_path):
+        n = 4
+        r, ws, _ = self._router(tmp_path)
+        rid = r.submit(PROMPTS[0], max_new_tokens=n)
+        r.step()
+        victim = r._reqs[rid].worker
+        ws[victim].net["reconnects"] += 1
+        r.step()
+        assert r._workers[victim].status == "degraded"
+        ws[victim].kill()                  # degraded AND now dead
+        ocs = _drive(r, 1, max_ticks=60)
+        assert r._workers[victim].status == "dead"
+        assert r.stats.worker_deaths == 1
+        assert r.stats.resubmissions >= 1
+        assert ocs and ocs[0].status == RequestOutcome.FINISHED
+        assert len(r.generated(rid)) >= n
+        r.close()
+
+    def test_recover_replays_the_net_lane(self, tmp_path):
+        r, ws, wal = self._router(tmp_path)
+        r.step()
+        ws["d0"].net["reconnects"] += 2
+        r.step()
+        assert r.stats.net_reconnects == 2
+        r.close()
+        workers2 = [_SessionedInProc(_spec(tmp_path, f"{n}b"),
+                                     name=n, role="mixed")
+                    for n in ("d0", "d1")]
+        r2 = Router.recover(workers2, journal_path=wal)
+        assert r2.stats.net_reconnects == 2
+        assert r2.stats.degraded_transitions == 1
+        # worker STATES are per-incarnation: fresh handles start up
+        assert all(s.status == "up" for s in r2._workers.values())
+        r2.close()
+
+
+# ---------------------------------------------------------------------
+# observability: net.* gauges + the network-flapping detector
+# ---------------------------------------------------------------------
+
+class TestNetObservability:
+    def test_net_gauges_dark_without_session_layer(self, tmp_path):
+        specs = {n: _spec(tmp_path, n) for n in ("a0", "a1")}
+        workers = [InProcWorker(specs[n], name=n, role="mixed")
+                   for n in specs]
+        r = Router(workers)
+        sup = FleetSupervisor(r, specs)
+        g = sup.registry.as_dict()
+        assert not any(k.startswith("net.") for k in g)
+        r.close()
+
+    def test_net_gauges_sum_across_workers(self, tmp_path):
+        specs = {n: _spec(tmp_path, n) for n in ("b0", "b1")}
+        workers = [_SessionedInProc(specs[n], name=n, role="mixed")
+                   for n in specs]
+        r = Router(workers)
+        sup = FleetSupervisor(r, specs)
+        workers[0].net["reconnects"] = 2
+        workers[1].net["reconnects"] = 1
+        workers[1].net["retried_ops"] = 3
+        g = sup.registry.as_dict()
+        assert g["net.reconnects"] == 3
+        assert g["net.retried_ops"] == 3
+        assert g["net.sessions"] == 2
+        # and the degraded head-count gauge follows the router state
+        r.step()                           # sights sessions + deltas
+        assert sup.registry.as_dict()["fleet.workers_degraded"] == 2
+        r.close()
+
+    def _world(self, **mon_kw):
+        state = {"rec": 0, "ret": 0}
+        reg = MetricsRegistry()
+        reg.attach("net", lambda: {"reconnects": state["rec"],
+                                   "retried_ops": state["ret"]})
+        mon = HealthMonitor(window=4, **mon_kw)
+        mon.bind(reg)
+        steps = {"n": 0}
+
+        def step(rec):
+            steps["n"] += 1
+            state["rec"] = rec
+            mon.on_step(steps["n"])
+
+        return mon, step
+
+    def test_flapping_fires_once_then_rearms_on_quiet(self):
+        mon, step = self._world()
+        for rec in (0, 0, 1, 3):           # window delta hits 3
+            step(rec)
+        assert [a.kind for a in mon.alerts] == ["network-flapping"]
+        for rec in (4, 5):                 # still flapping: no refire
+            step(rec)
+        assert len(mon.alerts) == 1
+        for rec in (5, 5, 5, 5):           # a settled window clears
+            step(rec)
+        step(8)                            # a second storm refires
+        assert mon.alert_counts["network-flapping"] == 2
+
+    def test_flapping_verdict_in_report(self):
+        mon, step = self._world()
+        for rec in (0, 0, 1, 3):
+            step(rec)
+        rep = mon.report().as_dict()
+        assert rep["signals"]["net.reconnects"]["verdict"] == \
+            "critical"
+        for rec in (3, 3, 3, 3):
+            step(rec)
+        rep = mon.report().as_dict()
+        assert rep["signals"]["net.reconnects"]["verdict"] == "ok"
+
+    def test_detector_dark_without_net_namespace(self):
+        reg = MetricsRegistry()
+        reg.gauge("pool.usable", 10)
+        mon = HealthMonitor(window=4)
+        mon.bind(reg)
+        for n in range(1, 10):
+            mon.on_step(n)
+        assert mon.series("net.reconnects") is None
+        assert "network-flapping" not in [a.kind for a in mon.alerts]
+        assert "net.reconnects" not in \
+            mon.report().as_dict()["signals"]
+
+    def test_threshold_knobs_are_registered(self):
+        mon = HealthMonitor(thresholds={"network_flapping_min": 5,
+                                        "network_flapping_clear": 1})
+        assert mon.thresholds["network_flapping_min"] == 5
+        with pytest.raises(ValueError):
+            HealthMonitor(thresholds={"network_flapping_typo": 1})
+
+
+# ---------------------------------------------------------------------
+# the WAL doctor's net lane
+# ---------------------------------------------------------------------
+
+class TestFleetDoctorNetLane:
+    def _wal(self, tmp_path, records):
+        p = str(tmp_path / "doc.wal")
+        j = RequestJournal(p, fresh=True)
+        for kind, payload in records:
+            j.append(kind, payload)
+        j.close()
+        return p
+
+    def test_healthy_net_lane_passes(self, tmp_path, capsys):
+        import tools.fleet_doctor as fd
+        p = self._wal(tmp_path, [
+            ("net", {"worker": "s0", "event": "session", "tick": 1}),
+            ("net", {"worker": "s0", "event": "reconnect", "n": 2,
+                     "tick": 3}),
+            ("net", {"worker": "s0", "event": "degraded", "tick": 3}),
+            ("net", {"worker": "s0", "event": "recovered",
+                     "tick": 5}),
+        ])
+        assert fd.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "net lane" in out and "2 reconnect(s)" in out
+        assert "UNMATCHED" not in out and "ended DEGRADED" not in out
+
+    def test_ended_degraded_is_reported_not_fatal(self, tmp_path,
+                                                  capsys):
+        import tools.fleet_doctor as fd
+        p = self._wal(tmp_path, [
+            ("net", {"worker": "s0", "event": "session", "tick": 1}),
+            ("net", {"worker": "s0", "event": "reconnect", "n": 1,
+                     "tick": 2}),
+            ("net", {"worker": "s0", "event": "degraded", "tick": 2}),
+        ])
+        assert fd.main([p]) == 0
+        assert "ended DEGRADED" in capsys.readouterr().out
+
+    def test_orphan_reconnect_fails_the_audit(self, tmp_path,
+                                              capsys):
+        import tools.fleet_doctor as fd
+        p = self._wal(tmp_path, [
+            ("net", {"worker": "ghost", "event": "reconnect", "n": 1,
+                     "tick": 2}),
+        ])
+        assert fd.main([p]) == 1
+        assert "UNMATCHED" in capsys.readouterr().out
+
+    def test_pre_session_wal_has_no_net_section(self, tmp_path,
+                                                capsys):
+        import tools.fleet_doctor as fd
+        p = self._wal(tmp_path, [
+            ("submit", {"rid": 0, "tokens": [1, 2], "kw": {}}),
+        ])
+        assert fd.main([p]) == 0
+        assert "net lane" not in capsys.readouterr().out
+
+    def test_unreadable_journal_is_exit_2(self, tmp_path):
+        import tools.fleet_doctor as fd
+        assert fd.main([str(tmp_path)]) == 2      # a directory
+        assert fd.main([]) == 2                   # no WAL at all
+
+
+# ---------------------------------------------------------------------
+# acceptance: seeded storms over real socket fleets
+# ---------------------------------------------------------------------
+
+def _storm_fleet(tmp_path, tag, injector):
+    """Two resilient SocketWorker processes + router + supervisor,
+    sharing one client-side injector."""
+    from tests.test_router import _tsm
+    model = _tsm()
+    specs = {n: _spec(tmp_path, f"{tag}_{n}", snapshot_every=2)
+             for n in ("s0", "s1")}
+    workers = [SocketWorker(specs[n], name=n, timeout=180.0,
+                            net_injector=injector)
+               for n in ("s0", "s1")]
+    wal = str(tmp_path / f"{tag}_router.wal")
+    r = Router(workers, hash_fn=_hash_fn(model), journal_path=wal,
+               backoff_ticks=1, call_timeout=4.0)
+    sup = FleetSupervisor(r, specs, transport="socket",
+                          socket_timeout=180.0)
+    return r, sup, workers, wal
+
+
+@pytest.mark.slow
+class TestNetworkStormAcceptance:
+    N = 6
+    SEED = 11
+
+    def _net_only_run(self, tmp_path, tag):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        inj = NetworkFaultInjector.storm(
+            self.SEED, ["s0", "s1"], span=(2, 26),
+            drops=3, frames=2, blackholes=1)
+        r, sup, workers, wal = _storm_fleet(tmp_path, tag, inj)
+        try:
+            rids = [r.submit(p, max_new_tokens=self.N)
+                    for p in PROMPTS[:2]]
+            ocs = _drive(r, len(rids), max_ticks=80, supervisor=sup)
+            # keep ticking until every scheduled fault has fired
+            # (scrapes advance the op seq even with no live streams)
+            for _ in range(120):
+                if inj.pending == 0:
+                    break
+                r.step()
+                sup.tick()
+            assert inj.pending == 0, inj.plan
+            streams = {i: r.generated(rid)
+                       for i, rid in enumerate(rids)}
+            stats = {w.name: w.net_stats() for w in workers}
+            out = dict(ocs=ocs, streams=streams, stats=stats,
+                       fired=dict(inj.fired),
+                       respawns=sup.respawns_total,
+                       deaths=r.stats.worker_deaths,
+                       net_reconnects=r.stats.net_reconnects,
+                       events=_net_events(wal),
+                       respawn_events=_respawn_events(wal))
+            r.close()
+            return out
+        finally:
+            for w in workers:
+                try:
+                    w.kill()
+                except Exception:
+                    pass
+
+    def test_network_storm_zero_respawns_bit_identical(self,
+                                                       tmp_path):
+        """The headline acceptance: >= 3 drops, >= 2 torn/corrupt
+        frames and a black-hole, ZERO kills — the fleet rides it out
+        with zero respawns, streams bit-identical to the fault-free
+        run and outcomes exactly-once; run TWICE, both runs recover
+        through identical sequences and identical counters."""
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], self.N)
+        runs = [self._net_only_run(tmp_path / f"r{i}", f"net{i}")
+                for i in range(2)]
+        for run in runs:
+            # the storm really fired, in the acceptance mix
+            f = run["fired"]
+            assert f["drop_before"] + f["drop_after"] == 3
+            assert (f["truncate_header"] + f["truncate_payload"]
+                    + f["corrupt"] + f["duplicate"]) == 2
+            assert f["blackhole"] == 1
+            # zero respawns, zero deaths: every fault stayed cheap
+            assert run["respawns"] == 0
+            assert run["deaths"] == 0
+            assert run["respawn_events"] == []
+            # bit-identity + exactly-once
+            assert run["streams"] == base
+            assert sorted(o.rid for o in run["ocs"]) == \
+                sorted(set(o.rid for o in run["ocs"]))
+            assert all(o.status == RequestOutcome.FINISHED
+                       for o in run["ocs"])
+            # the lane was journaled and the router counted it
+            assert run["net_reconnects"] >= 3   # 3 drops at minimum
+            assert any(e == "degraded"
+                       for _, e, _, _ in run["events"])
+        # determinism: identical recovery sequences AND counters
+        assert runs[0]["events"] == runs[1]["events"]
+        assert runs[0]["stats"] == runs[1]["stats"]
+        assert runs[0]["fired"] == runs[1]["fired"]
+        assert runs[0]["net_reconnects"] == runs[1]["net_reconnects"]
+
+    def test_composed_network_and_sigkill_storm(self, tmp_path):
+        """Network faults AND a real SIGKILL in the same run: the
+        session layer absorbs the wire faults, the supervisor
+        respawn path handles the death, and the fleet ends at FULL
+        capacity with streams bit-identical."""
+        base = _single_engine_streams(tmp_path, PROMPTS[:2], self.N)
+        inj = NetworkFaultInjector.storm(
+            self.SEED, ["s0", "s1"], span=(2, 20),
+            drops=2, frames=1, blackholes=0)
+        r, sup, workers, wal = _storm_fleet(tmp_path, "mix", inj)
+        try:
+            rids = [r.submit(p, max_new_tokens=self.N)
+                    for p in PROMPTS[:2]]
+            r.step()
+            victim = r._reqs[rids[0]].worker or "s0"
+            {w.name: w for w in workers}[victim].proc.kill()
+            ocs = _drive(r, len(rids), max_ticks=80, supervisor=sup)
+            assert r.stats.worker_deaths >= 1
+            assert sup.respawns_total == 1
+            assert {i: r.generated(rid)
+                    for i, rid in enumerate(rids)} == base
+            assert all(o.status == RequestOutcome.FINISHED
+                       for o in ocs)
+            # full capacity via the respawn path
+            for _ in range(120):
+                if {ws.status for ws in r._workers.values()} \
+                        == {"up"}:
+                    break
+                r.step()
+                sup.tick()
+            assert {ws.status for ws in r._workers.values()} == \
+                {"up"}
+            ev = [(w, e) for w, e, _ in _respawn_events(wal)]
+            assert ev == [(victim, "spawn"), (victim, "rejoin")]
+            r.close()
+        finally:
+            for w in workers:
+                try:
+                    w.kill()
+                except Exception:
+                    pass

@@ -603,6 +603,32 @@ class TestMutations:
         msgs = " | ".join(f.msg for f in kept)
         assert "ContinuousBatchingEngine" in msgs
 
+    def test_net_transport_time_import_flips_red(self, tmp_path):
+        """The session-transport determinism gate: net.py importing
+        the clock module — under ANY alias — flips exit 0 -> 1 the
+        moment the import lands, before a single clock read."""
+        root, path = _mutate(
+            tmp_path, "net.py",
+            "import select as _select",
+            "import select as _select\nimport time as _clock")
+        kept, _ = run(root, ["net-clock-purity"])
+        assert [(f.path, f.line) for f in kept] == \
+            [(path, lineno(path, "import time as _clock"))]
+        assert "imports time" in kept[0].msg
+
+    def test_net_transport_clock_read_flips_red(self, tmp_path):
+        """...and a wall-clock READ sneaking into the backoff path
+        (the exact mutation that would silently break two-runs-
+        recover-identically) is anchored at the call site."""
+        root, path = _mutate(
+            tmp_path, "net.py",
+            "import select as _select",
+            "import select as _select\nfrom time import monotonic")
+        kept, _ = run(root, ["net-clock-purity"])
+        assert kept and kept[0].line == \
+            lineno(path, "from time import monotonic")
+        assert "no clock symbols" in kept[0].msg
+
 
 # =====================================================================
 # CLI: exit codes, --json envelope, pass selection
@@ -642,7 +668,7 @@ class TestCLI:
         out = capsys.readouterr().out
         for pid in cs.PASS_IDS:
             assert pid in out
-        assert len(cs.PASS_IDS) == 7
+        assert len(cs.PASS_IDS) == 8
 
     def test_json_envelope_clean(self, capsys):
         """--json speaks the shared paddle_tpu.report.v1 envelope

@@ -1396,12 +1396,78 @@ class CompiledStepPurity:
 
 
 # =====================================================================
+# pass 8: net-clock-purity
+# =====================================================================
+
+# Files holding the session transport's retry/backoff machinery: the
+# determinism contract (two seeded storms recover identically) forbids
+# ANY wall-clock read — deadlines are slice counts, backoff is keyed
+# by attempt index, waits ride select.select. The file must not even
+# import time (the monitor module's discipline, enforced).
+NET_CLOCK_FILES = {"net.py"}
+
+
+class NetClockPurity:
+    id = "net-clock-purity"
+    doc = ("no wall-clock reads anywhere in the session transport "
+           "(inference/net.py): no time import under any alias, no "
+           "clock calls — retry/backoff schedules must be keyed to "
+           "op seqs and attempt indices, never to a clock")
+
+    def run(self, files: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files:
+            if sf.base not in NET_CLOCK_FILES:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name == "time" or \
+                                a.name.startswith("time."):
+                            findings.append(Finding(
+                                self.id, sf.path, node.lineno,
+                                f"{sf.base} imports time (as "
+                                f"{a.asname or a.name!r}) — the "
+                                f"session transport must not even "
+                                f"import the clock module; express "
+                                f"deadlines as POLL_SLICE counts"))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "time":
+                        findings.append(Finding(
+                            self.id, sf.path, node.lineno,
+                            f"{sf.base} imports from time — no "
+                            f"clock symbols in the session "
+                            f"transport"))
+            clock_mods, clock_funcs = clock_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                c = call_chain(node)
+                if not c:
+                    continue
+                parts = c.split(".")
+                bare_clock = (len(parts) == 1
+                              and parts[0] in clock_funcs)
+                mod_clock = (len(parts) == 2
+                             and parts[0] in clock_mods
+                             and parts[1] in CLOCK_CALLS)
+                if bare_clock or mod_clock:
+                    findings.append(Finding(
+                        self.id, sf.path, node.lineno,
+                        f"wall-clock read {c}() in {sf.base} — "
+                        f"retry/backoff must be keyed to op seq / "
+                        f"attempt index (slice-counted deadlines, "
+                        f"select-based waits), never to a clock"))
+        return findings
+
+
+# =====================================================================
 # framework
 # =====================================================================
 
 PASSES = [SnapshotCompleteness(), HotPathPurity(), JournalCoverage(),
           ChargeDiscipline(), SpanSafety(), ExportDrift(),
-          CompiledStepPurity()]
+          CompiledStepPurity(), NetClockPurity()]
 PASS_IDS = [p.id for p in PASSES]
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*ok\(([^)]*)\)")

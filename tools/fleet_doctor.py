@@ -1,0 +1,120 @@
+"""Offline fleet WAL doctor: the router journal's FLEET lanes in one
+report — supervisor lifecycle (respawn spawn<->rejoin pairing, policy
+rebalance lanes, would-resubmit streams; the PR 16 summary, shared
+with tools/recovery_check.py) plus the session-transport lane the
+resilient socket fleet writes (inference/net.py):
+
+  * reconnect counts per worker — every "net"/"reconnect" record is a
+    connection the session layer re-established WITHOUT a respawn
+    (the cheap failure; compare against the respawn lane to see what
+    the transport saved)
+  * degraded dwell — "degraded" -> "recovered" pairing per worker: a
+    journal whose last degraded transition for some worker never
+    recovered records a fleet that ended a run still routing around
+    that worker
+  * session integrity — a "reconnect"/"degraded"/"recovered" record
+    for a worker with NO earlier "session" record is a corrupt or
+    truncated lane (the router journals the session sighting before
+    any reconnect can be accounted to it) and FAILS the check
+
+Usage:
+  python tools/fleet_doctor.py ROUTER.WAL
+  python tools/fleet_doctor.py --journal ROUTER.WAL
+
+Exit status: 0 clean, 1 unmatched respawn OR a net-lane record with
+no matching session, 2 unreadable journal / bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.recovery_check import _fleet_journal_summary  # noqa: E402
+
+
+def _net_lane_summary(recs) -> int:
+    """The session-transport section. Returns the exit contribution
+    (1 = a net record references a worker whose session was never
+    journaled, or arrived before it)."""
+    sessions = set()
+    reconnects = {}            # worker -> total reconnect count
+    last_state = {}            # worker -> "degraded" | "recovered"
+    orphans = []               # (seq, worker, event) before a session
+    for seq, kind, p in recs:
+        if kind != "net":
+            continue
+        worker = p.get("worker")
+        event = p.get("event")
+        if event == "session":
+            sessions.add(worker)
+            continue
+        if worker not in sessions:
+            orphans.append((seq, worker, event))
+            continue
+        if event == "reconnect":
+            reconnects[worker] = (reconnects.get(worker, 0)
+                                  + int(p.get("n", 1)))
+        elif event in ("degraded", "recovered"):
+            last_state[worker] = event
+    if not (sessions or orphans):
+        return 0               # pre-session-layer WAL: no section
+    print(f"  net lane: {len(sessions)} session(s), "
+          f"{sum(reconnects.values())} reconnect(s)")
+    for worker in sorted(sessions):
+        n = reconnects.get(worker, 0)
+        state = last_state.get(worker)
+        tail = ""
+        if state == "degraded":
+            tail = (" — ended DEGRADED (the run closed while still "
+                    "routing around this worker)")
+        print(f"    worker {worker!r}: {n} reconnect(s)"
+              + (f", last transition {state!r}" if state else "")
+              + tail)
+    rc = 0
+    for seq, worker, event in orphans:
+        print(f"    UNMATCHED: net/{event} for worker {worker!r} "
+              f"(seq {seq}) with no session record — corrupt or "
+              f"truncated net lane")
+        rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="audit a router WAL's fleet + net lanes offline")
+    ap.add_argument("journal", nargs="?", default=None)
+    ap.add_argument("--journal", dest="journal_opt", default=None,
+                    help="router WAL path (same as the positional)")
+    args = ap.parse_args(argv)
+    path = args.journal_opt or args.journal
+    if path is None:
+        ap.print_usage(sys.stderr)
+        print("fleet_doctor: need a router WAL", file=sys.stderr)
+        return 2
+
+    from paddle_tpu.inference.recovery import read_journal
+    try:
+        recs = read_journal(path)
+    except (ValueError, OSError) as e:
+        print(f"UNREADABLE: {e}")
+        return 2
+    kinds = {}
+    for _, kind, _p in recs:
+        kinds[kind] = kinds.get(kind, 0) + 1
+    print(f"journal {path}: {len(recs)} record(s) {kinds or '{}'}, "
+          f"last seq {recs[-1][0] if recs else 0}")
+    rc = 0
+    if "respawn" in kinds or "rebalance" in kinds or \
+            "submit" in kinds:
+        rc = max(rc, _fleet_journal_summary(recs, kinds))
+    if "net" in kinds:
+        rc = max(rc, _net_lane_summary(recs))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
